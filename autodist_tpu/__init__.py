@@ -1,0 +1,25 @@
+"""autodist_tpu: a TPU-native distributed training strategy compiler.
+
+A ground-up JAX/XLA rebuild of the capabilities of AutoDist (reference at
+``/root/reference``): the user brings a single-device model; a pluggable
+``StrategyBuilder`` analyzes (model × cluster resources) and emits an explicit,
+serializable ``Strategy`` (per-variable synchronization/partitioning choice);
+a lowering layer turns the strategy into ``jax.sharding`` annotations +
+collective plans over a TPU device mesh; and a thin multi-controller runtime
+(``jax.distributed``) replaces the reference's SSH + TF-server launcher.
+
+Where the reference rewired TF graphs op-by-op
+(``/root/reference/autodist/kernel/``), this framework annotates shardings and
+lets XLA GSPMD insert the collectives — the idiomatic TPU mechanism with the
+same user-visible contract (single-device model in, distributed execution out).
+"""
+from autodist_tpu import const
+from autodist_tpu.resource_spec import ResourceSpec
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ResourceSpec",
+    "const",
+    "__version__",
+]

@@ -1,0 +1,70 @@
+"""Constants and environment-variable contract.
+
+TPU-native analog of the reference const module
+(``/root/reference/autodist/const.py:32-89``): working dirs, name prefixes and
+a typed ``ENV`` enum with per-variable defaults. The ``AUTODIST_WORKER`` /
+``AUTODIST_STRATEGY_ID`` role-dispatch contract is preserved verbatim so that
+multi-host launches keep the reference's "chief builds the strategy, workers
+load it by id" model (``/root/reference/autodist/coordinator.py:66-90``).
+"""
+import os
+from enum import Enum
+
+# Working directories (reference: /tmp/autodist{,/strategies}, const.py:32-36).
+DEFAULT_WORKING_DIR = "/tmp/autodist_tpu"
+DEFAULT_STRATEGY_DIR = os.path.join(DEFAULT_WORKING_DIR, "strategies")
+DEFAULT_TRACE_DIR = os.path.join(DEFAULT_WORKING_DIR, "traces")
+DEFAULT_LOG_DIR = os.path.join(DEFAULT_WORKING_DIR, "logs")
+DEFAULT_HLO_DIR = os.path.join(DEFAULT_WORKING_DIR, "hlo")
+DEFAULT_CHECKPOINT_DIR = os.path.join(DEFAULT_WORKING_DIR, "checkpoints")
+
+# Coordination service port range (reference used 15000-16000 for TF grpc
+# servers, const.py:38; we use it for the jax.distributed coordinator).
+DEFAULT_PORT_RANGE = range(15000, 16000)
+DEFAULT_COORDINATOR_PORT = 15000
+
+# Default logical mesh axis names. "data" is the batch axis (reference's
+# replica set), "model" carries tensor/variable partitioning (the reference's
+# partitioner axis), "seq" is new TPU-native sequence/context parallelism.
+MESH_AXIS_DATA = "data"
+MESH_AXIS_MODEL = "model"
+MESH_AXIS_SEQ = "seq"
+ALL_MESH_AXES = (MESH_AXIS_DATA, MESH_AXIS_MODEL, MESH_AXIS_SEQ)
+
+MAX_INT32 = 2**31 - 1
+
+
+class ENV(Enum):
+    """Environment variables (reference: const.py:55-89).
+
+    Each member's value is a lambda producing the default; ``.val`` reads the
+    environment with that default applied and type-coerced.
+    """
+
+    AUTODIST_WORKER = (lambda v: v or "")                    # noqa: E731
+    AUTODIST_STRATEGY_ID = (lambda v: v or "")               # noqa: E731
+    AUTODIST_MIN_LOG_LEVEL = (lambda v: v or "INFO")         # noqa: E731
+    AUTODIST_IS_TESTING = (lambda v: (v or "False") == "True")   # noqa: E731
+    AUTODIST_DEBUG_REMOTE = (lambda v: (v or "False") == "True")  # noqa: E731
+    AUTODIST_RESOURCE_SPEC = (lambda v: v or "")             # noqa: E731
+    AUTODIST_COORDINATOR = (lambda v: v or "")               # ip:port of jax.distributed coordinator
+    AUTODIST_NUM_PROCESSES = (lambda v: int(v or "1"))       # noqa: E731
+    AUTODIST_PROCESS_ID = (lambda v: int(v or "0"))          # noqa: E731
+    AUTODIST_DUMP_HLO = (lambda v: (v or "False") == "True")  # noqa: E731
+    SYS_DATA_PATH = (lambda v: v or "")                      # noqa: E731
+    SYS_RESOURCE_PATH = (lambda v: v or "")                  # noqa: E731
+
+    @property
+    def val(self):
+        """Return the typed value of this env var (default applied)."""
+        return self.value(os.environ.get(self.name))  # pylint: disable=too-many-function-args
+
+
+def is_worker() -> bool:
+    """True when this process was launched as a non-chief worker."""
+    return bool(ENV.AUTODIST_WORKER.val)
+
+
+def is_chief_process() -> bool:
+    """True when this process is the chief (strategy-building) process."""
+    return not is_worker()

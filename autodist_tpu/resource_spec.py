@@ -1,0 +1,323 @@
+"""Resource model (L0): describe a TPU cluster and derive a logical mesh.
+
+TPU-native re-imagining of the reference resource layer
+(``/root/reference/autodist/resource_spec.py:45-215``). The reference parses a
+``resource_spec.yml`` of GPU hosts joined by Ethernet + SSH into ``DeviceSpec``
+objects, a chief address, SSH configs and per-node bandwidth. Here the same
+file shape describes TPU hosts: each node carries TPU *chips* instead of GPUs,
+SSH gives way to the jax.distributed multi-controller model, and
+``network_bandwidth`` generalizes into distinct ICI (intra-slice) and DCN
+(cross-slice) bandwidths, which strategy builders use the way the reference
+used ``Connectivity`` / bandwidth hints.
+
+Spec shape (all keys optional except ``nodes`` when a file is given)::
+
+    nodes:
+      - address: 10.0.0.1
+        chips: 4            # TPU chips attached to this host ("gpus" accepted
+        chief: true         # for drop-in compat with reference specs)
+      - address: 10.0.0.2
+        chips: 4
+    tpu:
+      accelerator: v5p      # informational
+      topology: 2x2x2       # physical ICI torus of the slice
+      ici_bandwidth_gbps: 900
+      dcn_bandwidth_gbps: 50
+    mesh:                   # optional logical-mesh override
+      data: 4
+      model: 2
+
+Reference parity notes:
+- chief detection / exactly-one-chief validation: resource_spec.py:160-183
+- loopback validation for multi-node: resource_spec.py:185-188
+- per-node bandwidth default (1 GbE): resource_spec.py:209-215
+"""
+from __future__ import annotations
+
+import hashlib
+import math
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import yaml
+
+_LOOPBACK_ADDRESSES = ("localhost", "127.0.0.1", "0.0.0.0", "::1")
+
+# Reference default bandwidth is 1 GbE (resource_spec.py:209-215). TPU
+# defaults reflect v5p-class hardware: ~4800 Gbps ICI per chip aggregate is
+# overkill for planning, we use a conservative per-link figure.
+DEFAULT_ICI_BANDWIDTH_GBPS = 900.0
+DEFAULT_DCN_BANDWIDTH_GBPS = 50.0
+DEFAULT_CHIPS_PER_HOST = 4
+
+
+class DeviceType(Enum):
+    """Device kinds (reference: resource_spec.py DeviceType{CPU,GPU})."""
+
+    CPU = "CPU"
+    TPU = "TPU"
+
+
+@dataclass(frozen=True)
+class DeviceSpec:
+    """One addressable device: ``<host-address>:<type>:<index>``.
+
+    String form mirrors the reference's AutoDist device strings
+    (``ip:GPU:0`` → ``ip:TPU:0``) so strategy protos stay readable.
+    """
+
+    host_address: str
+    device_type: DeviceType = DeviceType.TPU
+    device_index: int = 0
+
+    def name_string(self) -> str:
+        return f"{self.host_address}:{self.device_type.value}:{self.device_index}"
+
+    @classmethod
+    def from_string(cls, s: str) -> "DeviceSpec":
+        host, dtype, idx = s.rsplit(":", 2)
+        return cls(host, DeviceType(dtype), int(idx))
+
+    def __str__(self) -> str:  # pragma: no cover - convenience
+        return self.name_string()
+
+
+@dataclass
+class NodeSpec:
+    """One host in the cluster (reference: a ``nodes:`` entry)."""
+
+    address: str
+    chips: int = DEFAULT_CHIPS_PER_HOST
+    cpus: int = 1
+    chief: bool = False
+
+
+@dataclass
+class TPUTopology:
+    """Physical slice description: accelerator kind + ICI torus shape."""
+
+    accelerator: str = "v5p"
+    topology: Optional[Tuple[int, ...]] = None  # e.g. (2, 2, 2)
+    ici_bandwidth_gbps: float = DEFAULT_ICI_BANDWIDTH_GBPS
+    dcn_bandwidth_gbps: float = DEFAULT_DCN_BANDWIDTH_GBPS
+
+    @property
+    def num_chips(self) -> Optional[int]:
+        if self.topology is None:
+            return None
+        return int(math.prod(self.topology))
+
+
+def _parse_topology(s) -> Tuple[int, ...]:
+    if isinstance(s, (list, tuple)):
+        return tuple(int(x) for x in s)
+    return tuple(int(x) for x in str(s).lower().split("x"))
+
+
+class ResourceSpec:
+    """Parsed cluster description + derived logical mesh shape.
+
+    Construct from a YAML file path (reference-compatible), a dict, or from
+    the local JAX runtime via :meth:`from_local_devices`.
+    """
+
+    def __init__(self, resource_file: Optional[str] = None, resource_dict: Optional[dict] = None):
+        if resource_file is not None and resource_dict is not None:
+            raise ValueError("pass either resource_file or resource_dict, not both")
+        if resource_file is not None:
+            with open(resource_file, "r", encoding="utf-8") as f:
+                resource_dict = yaml.safe_load(f) or {}
+            if not isinstance(resource_dict, dict):
+                raise ValueError(
+                    f"resource spec {resource_file!r} must be a YAML mapping, "
+                    f"got {type(resource_dict).__name__}"
+                )
+        self._raw = dict(resource_dict or {})
+        self._nodes: List[NodeSpec] = []
+        self._tpu = TPUTopology()
+        self._mesh_override: Optional[Dict[str, int]] = None
+        self._parse(self._raw)
+        self._validate()
+
+    # ------------------------------------------------------------------ parse
+    def _parse(self, d: dict) -> None:
+        for entry in d.get("nodes", []) or []:
+            chips = entry.get("chips", entry.get("gpus", DEFAULT_CHIPS_PER_HOST))
+            self._nodes.append(
+                NodeSpec(
+                    address=str(entry["address"]),
+                    chips=int(chips),
+                    cpus=int(entry.get("cpus", 1)),
+                    chief=bool(entry.get("chief", False)),
+                )
+            )
+        if not self._nodes:
+            # Single-host default: one loopback node.
+            self._nodes.append(NodeSpec(address="localhost", chief=True))
+
+        # Reference behavior: if no node is marked chief, the first is
+        # (resource_spec.py:160-183).
+        if not any(n.chief for n in self._nodes):
+            self._nodes[0].chief = True
+
+        tpu = d.get("tpu", {}) or {}
+        self._tpu = TPUTopology(
+            accelerator=str(tpu.get("accelerator", "v5p")),
+            topology=_parse_topology(tpu["topology"]) if "topology" in tpu else None,
+            ici_bandwidth_gbps=float(tpu.get("ici_bandwidth_gbps", DEFAULT_ICI_BANDWIDTH_GBPS)),
+            dcn_bandwidth_gbps=float(
+                tpu.get("dcn_bandwidth_gbps", d.get("network_bandwidth", DEFAULT_DCN_BANDWIDTH_GBPS))
+            ),
+        )
+        mesh = d.get("mesh")
+        if mesh:
+            self._mesh_override = {str(k): int(v) for k, v in mesh.items()}
+
+    def _validate(self) -> None:
+        chiefs = [n for n in self._nodes if n.chief]
+        if len(chiefs) != 1:
+            raise ValueError(f"exactly one chief required, got {len(chiefs)}")
+        addrs = [n.address for n in self._nodes]
+        if len(set(addrs)) != len(addrs):
+            raise ValueError(f"duplicate node addresses in resource spec: {addrs}")
+        # Loopback validation (reference: resource_spec.py:185-188): a
+        # multi-node spec must use real addresses so processes can find the
+        # coordinator.
+        if len(self._nodes) > 1 and any(a in _LOOPBACK_ADDRESSES for a in addrs):
+            raise ValueError("multi-node resource specs cannot contain loopback addresses")
+        if any(n.chips < 0 for n in self._nodes):
+            raise ValueError("chips must be >= 0")
+        if self._mesh_override:
+            if math.prod(self._mesh_override.values()) != self.num_chips:
+                raise ValueError(
+                    f"mesh override {self._mesh_override} does not cover "
+                    f"{self.num_chips} chips"
+                )
+        topo_chips = self._tpu.num_chips
+        if topo_chips is not None and topo_chips != self.num_chips:
+            raise ValueError(
+                f"tpu.topology implies {topo_chips} chips but nodes declare {self.num_chips}"
+            )
+
+    # ------------------------------------------------------------- properties
+    @property
+    def nodes(self) -> List[NodeSpec]:
+        return list(self._nodes)
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self._nodes)
+
+    @property
+    def chief(self) -> NodeSpec:
+        return next(n for n in self._nodes if n.chief)
+
+    @property
+    def chief_address(self) -> str:
+        return self.chief.address
+
+    @property
+    def is_single_node(self) -> bool:
+        return len(self._nodes) == 1
+
+    @property
+    def num_chips(self) -> int:
+        return sum(n.chips for n in self._nodes)
+
+    @property
+    def tpu(self) -> TPUTopology:
+        return self._tpu
+
+    @property
+    def tpu_devices(self) -> List[DeviceSpec]:
+        """All TPU chips as DeviceSpecs, chief-first then sorted by address.
+
+        Deterministic ordering across processes matters for the same reason
+        the reference sorts its ip:port list (cluster.py:78-80): every
+        process must agree on device numbering.
+        """
+        ordered = sorted(self._nodes, key=lambda n: (not n.chief, n.address))
+        out = []
+        for node in ordered:
+            for i in range(node.chips):
+                out.append(DeviceSpec(node.address, DeviceType.TPU, i))
+        return out
+
+    @property
+    def cpu_devices(self) -> List[DeviceSpec]:
+        """Host CPU devices — PS-style reduction destinations live here."""
+        ordered = sorted(self._nodes, key=lambda n: (not n.chief, n.address))
+        return [DeviceSpec(n.address, DeviceType.CPU, 0) for n in ordered]
+
+    @property
+    def network_bandwidth(self) -> float:
+        """Cross-host (DCN) bandwidth in Gbps — the planning-relevant figure
+        for multi-host strategies, like the reference's per-node bandwidth."""
+        return self._tpu.dcn_bandwidth_gbps
+
+    @property
+    def ici_bandwidth(self) -> float:
+        return self._tpu.ici_bandwidth_gbps
+
+    # ------------------------------------------------------------------ mesh
+    def mesh_shape(self, axes: Sequence[str] = ("data",)) -> Dict[str, int]:
+        """Derive a logical mesh shape covering every chip.
+
+        With no override: all chips go on the first axis ("data"), matching
+        the reference's pure-data-parallel replica set
+        (``architecture.rst:49-51``). An explicit ``mesh:`` block in the spec
+        wins; extra requested axes get size 1.
+        """
+        if self._mesh_override:
+            shape = dict(self._mesh_override)
+            for ax in axes:
+                shape.setdefault(ax, 1)
+            return shape
+        shape = {ax: 1 for ax in axes}
+        first = axes[0] if axes else "data"
+        shape[first] = max(self.num_chips, 1)
+        return shape
+
+    # ------------------------------------------------------- constructors/io
+    @classmethod
+    def from_local_devices(cls) -> "ResourceSpec":
+        """Build a spec from the current JAX runtime (single- or multi-host)."""
+        import jax  # local import: keep L0 importable without jax configured
+
+        n_proc = jax.process_count()
+        local = jax.local_device_count()
+        if n_proc == 1:
+            return cls(resource_dict={"nodes": [{"address": "localhost", "chips": local, "chief": True}]})
+        nodes = [
+            {"address": f"process-{p}", "chips": local, "chief": p == 0}
+            for p in range(n_proc)
+        ]
+        return cls(resource_dict={"nodes": nodes})
+
+    def to_dict(self) -> dict:
+        return {
+            "nodes": [
+                {"address": n.address, "chips": n.chips, "cpus": n.cpus, "chief": n.chief}
+                for n in self._nodes
+            ],
+            "tpu": {
+                "accelerator": self._tpu.accelerator,
+                **({"topology": "x".join(map(str, self._tpu.topology))} if self._tpu.topology else {}),
+                "ici_bandwidth_gbps": self._tpu.ici_bandwidth_gbps,
+                "dcn_bandwidth_gbps": self._tpu.dcn_bandwidth_gbps,
+            },
+            **({"mesh": dict(self._mesh_override)} if self._mesh_override else {}),
+        }
+
+    def fingerprint(self) -> str:
+        """Stable hash of the spec — used in strategy ids so a strategy built
+        for one cluster is never silently reused on another."""
+        blob = yaml.safe_dump(self.to_dict(), sort_keys=True).encode()
+        return hashlib.md5(blob).hexdigest()[:8]
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"ResourceSpec(nodes={self.num_nodes}, chips={self.num_chips}, "
+            f"chief={self.chief_address!r}, accel={self._tpu.accelerator})"
+        )

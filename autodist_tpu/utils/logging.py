@@ -1,0 +1,58 @@
+"""Logging for autodist_tpu.
+
+Mirrors the reference logger's behavior (``/root/reference/autodist/utils/
+logging.py:33-107``): a module-level logger that writes PID-tagged records to
+stderr and to a timestamped file under the working dir, with verbosity taken
+from ``AUTODIST_MIN_LOG_LEVEL``.
+"""
+import logging as _logging
+import os
+import sys
+import time
+
+from autodist_tpu.const import DEFAULT_LOG_DIR, ENV
+
+_LOGGER_NAME = "autodist_tpu"
+_FMT = "%(asctime)s [pid %(process)d] %(levelname)s %(name)s: %(message)s"
+
+
+def _build_logger() -> _logging.Logger:
+    logger = _logging.getLogger(_LOGGER_NAME)
+    if logger.handlers:
+        return logger
+    level = getattr(_logging, str(ENV.AUTODIST_MIN_LOG_LEVEL.val).upper(), _logging.INFO)
+    logger.setLevel(level)
+    formatter = _logging.Formatter(_FMT)
+
+    stream = _logging.StreamHandler(sys.stderr)
+    stream.setFormatter(formatter)
+    logger.addHandler(stream)
+
+    try:
+        os.makedirs(DEFAULT_LOG_DIR, exist_ok=True)
+        fname = os.path.join(DEFAULT_LOG_DIR, f"log.{time.strftime('%Y%m%d-%H%M%S')}.{os.getpid()}")
+        fileh = _logging.FileHandler(fname)
+        fileh.setFormatter(formatter)
+        logger.addHandler(fileh)
+    except OSError:  # read-only fs etc. — stderr logging still works
+        pass
+    logger.propagate = False
+    return logger
+
+
+_logger = _build_logger()
+
+debug = _logger.debug
+info = _logger.info
+warning = _logger.warning
+error = _logger.error
+critical = _logger.critical
+
+
+def set_verbosity(level: str) -> None:
+    """Set the log level by name (DEBUG/INFO/WARNING/ERROR)."""
+    _logger.setLevel(getattr(_logging, level.upper()))
+
+
+def get_logger() -> _logging.Logger:
+    return _logger

@@ -1,0 +1,142 @@
+"""Resource spec tests (parity: reference tests/test_resource_spec.py)."""
+import pytest
+import yaml
+
+from autodist_tpu.resource_spec import (
+    DeviceSpec,
+    DeviceType,
+    ResourceSpec,
+)
+
+
+@pytest.fixture
+def multi_node_yaml(tmp_path):
+    spec = {
+        "nodes": [
+            {"address": "10.0.0.1", "chips": 4, "chief": True},
+            {"address": "10.0.0.2", "chips": 4},
+        ],
+        "tpu": {"accelerator": "v5p", "topology": "2x2x2", "ici_bandwidth_gbps": 900},
+    }
+    p = tmp_path / "spec.yml"
+    p.write_text(yaml.safe_dump(spec))
+    return str(p)
+
+
+def test_parse_multi_node(multi_node_yaml):
+    rs = ResourceSpec(multi_node_yaml)
+    assert rs.num_nodes == 2
+    assert rs.num_chips == 8
+    assert rs.chief_address == "10.0.0.1"
+    assert rs.tpu.topology == (2, 2, 2)
+    assert rs.tpu.num_chips == 8
+    assert not rs.is_single_node
+
+
+def test_device_ordering_chief_first(multi_node_yaml):
+    rs = ResourceSpec(multi_node_yaml)
+    devs = rs.tpu_devices
+    assert len(devs) == 8
+    assert devs[0].host_address == "10.0.0.1"
+    assert [d.device_index for d in devs[:4]] == [0, 1, 2, 3]
+    assert devs[4].host_address == "10.0.0.2"
+
+
+def test_device_spec_string_roundtrip():
+    d = DeviceSpec("10.0.0.1", DeviceType.TPU, 3)
+    assert d.name_string() == "10.0.0.1:TPU:3"
+    assert DeviceSpec.from_string("10.0.0.1:TPU:3") == d
+    c = DeviceSpec.from_string("localhost:CPU:0")
+    assert c.device_type == DeviceType.CPU
+
+
+def test_default_single_node():
+    rs = ResourceSpec(resource_dict={})
+    assert rs.num_nodes == 1
+    assert rs.chief.chief
+    assert rs.is_single_node
+
+
+def test_first_node_becomes_chief():
+    rs = ResourceSpec(resource_dict={"nodes": [{"address": "a", "chips": 2}, {"address": "b", "chips": 2}]})
+    assert rs.chief_address == "a"
+
+
+def test_two_chiefs_rejected():
+    with pytest.raises(ValueError, match="exactly one chief"):
+        ResourceSpec(
+            resource_dict={
+                "nodes": [
+                    {"address": "a", "chips": 1, "chief": True},
+                    {"address": "b", "chips": 1, "chief": True},
+                ]
+            }
+        )
+
+
+def test_multi_node_loopback_rejected():
+    # Parity: reference resource_spec.py:185-188 loopback validation.
+    with pytest.raises(ValueError, match="loopback"):
+        ResourceSpec(
+            resource_dict={
+                "nodes": [
+                    {"address": "localhost", "chips": 1, "chief": True},
+                    {"address": "10.0.0.2", "chips": 1},
+                ]
+            }
+        )
+
+
+def test_gpus_key_compat():
+    # Reference-style specs with "gpus:" still parse; gpus are read as chips.
+    rs = ResourceSpec(resource_dict={"nodes": [{"address": "x", "gpus": 2, "chief": True}]})
+    assert rs.num_chips == 2
+
+
+def test_mesh_shape_default_all_data():
+    rs = ResourceSpec(resource_dict={"nodes": [{"address": "x", "chips": 8, "chief": True}]})
+    assert rs.mesh_shape(("data", "model")) == {"data": 8, "model": 1}
+
+
+def test_mesh_override():
+    rs = ResourceSpec(
+        resource_dict={
+            "nodes": [{"address": "x", "chips": 8, "chief": True}],
+            "mesh": {"data": 4, "model": 2},
+        }
+    )
+    assert rs.mesh_shape(("data", "model")) == {"data": 4, "model": 2}
+
+
+def test_mesh_override_must_cover_chips():
+    with pytest.raises(ValueError, match="mesh override"):
+        ResourceSpec(
+            resource_dict={
+                "nodes": [{"address": "x", "chips": 8, "chief": True}],
+                "mesh": {"data": 4},
+            }
+        )
+
+
+def test_topology_chip_mismatch_rejected():
+    with pytest.raises(ValueError, match="topology"):
+        ResourceSpec(
+            resource_dict={
+                "nodes": [{"address": "x", "chips": 4, "chief": True}],
+                "tpu": {"topology": "2x2x2"},
+            }
+        )
+
+
+def test_fingerprint_stable_and_distinct(multi_node_yaml):
+    rs1 = ResourceSpec(multi_node_yaml)
+    rs2 = ResourceSpec(resource_dict=rs1.to_dict())
+    assert rs1.fingerprint() == rs2.fingerprint()
+    rs3 = ResourceSpec(resource_dict={})
+    assert rs1.fingerprint() != rs3.fingerprint()
+
+
+def test_from_local_devices():
+    rs = ResourceSpec.from_local_devices()
+    assert rs.num_chips == 8  # conftest forces 8 host-platform devices
+    assert rs.is_single_node
