@@ -13,13 +13,23 @@ Where the reference rewired TF graphs op-by-op
 lets XLA GSPMD insert the collectives — the idiomatic TPU mechanism with the
 same user-visible contract (single-device model in, distributed execution out).
 """
-from autodist_tpu import const
+from autodist_tpu import const, strategy
+from autodist_tpu.api import AutoDist, get_default_autodist
+from autodist_tpu.kernel import DistributedTrainStep, TrainState
+from autodist_tpu.model_item import ModelItem, OptimizerSpec
 from autodist_tpu.resource_spec import ResourceSpec
 
 __version__ = "0.1.0"
 
 __all__ = [
+    "AutoDist",
+    "DistributedTrainStep",
+    "ModelItem",
+    "OptimizerSpec",
     "ResourceSpec",
+    "TrainState",
     "const",
+    "get_default_autodist",
+    "strategy",
     "__version__",
 ]

@@ -8,10 +8,10 @@ multi-host launches keep the reference's "chief builds the strategy, workers
 load it by id" model (``/root/reference/autodist/coordinator.py:66-90``).
 """
 import os
-from enum import Enum
+
 
 # Working directories (reference: /tmp/autodist{,/strategies}, const.py:32-36).
-DEFAULT_WORKING_DIR = "/tmp/autodist_tpu"
+DEFAULT_WORKING_DIR = "/tmp/autodist-tpu"
 DEFAULT_STRATEGY_DIR = os.path.join(DEFAULT_WORKING_DIR, "strategies")
 DEFAULT_TRACE_DIR = os.path.join(DEFAULT_WORKING_DIR, "traces")
 DEFAULT_LOG_DIR = os.path.join(DEFAULT_WORKING_DIR, "logs")
@@ -34,30 +34,51 @@ ALL_MESH_AXES = (MESH_AXIS_DATA, MESH_AXIS_MODEL, MESH_AXIS_SEQ)
 MAX_INT32 = 2**31 - 1
 
 
-class ENV(Enum):
-    """Environment variables (reference: const.py:55-89).
+class _EnvVar:
+    """One typed environment variable with a default. The variable name is
+    taken from the attribute it is assigned to (``__set_name__``)."""
 
-    Each member's value is a lambda producing the default; ``.val`` reads the
-    environment with that default applied and type-coerced.
-    """
+    __slots__ = ("name", "default")
 
-    AUTODIST_WORKER = (lambda v: v or "")                    # noqa: E731
-    AUTODIST_STRATEGY_ID = (lambda v: v or "")               # noqa: E731
-    AUTODIST_MIN_LOG_LEVEL = (lambda v: v or "INFO")         # noqa: E731
-    AUTODIST_IS_TESTING = (lambda v: (v or "False") == "True")   # noqa: E731
-    AUTODIST_DEBUG_REMOTE = (lambda v: (v or "False") == "True")  # noqa: E731
-    AUTODIST_RESOURCE_SPEC = (lambda v: v or "")             # noqa: E731
-    AUTODIST_COORDINATOR = (lambda v: v or "")               # ip:port of jax.distributed coordinator
-    AUTODIST_NUM_PROCESSES = (lambda v: int(v or "1"))       # noqa: E731
-    AUTODIST_PROCESS_ID = (lambda v: int(v or "0"))          # noqa: E731
-    AUTODIST_DUMP_HLO = (lambda v: (v or "False") == "True")  # noqa: E731
-    SYS_DATA_PATH = (lambda v: v or "")                      # noqa: E731
-    SYS_RESOURCE_PATH = (lambda v: v or "")                  # noqa: E731
+    def __init__(self, default):
+        self.name = None
+        self.default = default
+
+    def __set_name__(self, owner, name):
+        self.name = name
 
     @property
     def val(self):
         """Return the typed value of this env var (default applied)."""
-        return self.value(os.environ.get(self.name))  # pylint: disable=too-many-function-args
+        raw = os.environ.get(self.name)
+        if raw is None:
+            return self.default
+        if isinstance(self.default, bool):
+            return raw == "True"
+        if isinstance(self.default, int):
+            return int(raw)
+        return raw
+
+    def __repr__(self):  # pragma: no cover
+        return f"ENV.{self.name}(={self.val!r})"
+
+
+class ENV:
+    """Environment-variable contract (reference: const.py:55-89)."""
+
+    AUTODIST_WORKER = _EnvVar("")
+    AUTODIST_STRATEGY_ID = _EnvVar("")
+    AUTODIST_MIN_LOG_LEVEL = _EnvVar("INFO")
+    AUTODIST_IS_TESTING = _EnvVar(False)
+    AUTODIST_DEBUG_REMOTE = _EnvVar(False)
+    AUTODIST_RESOURCE_SPEC = _EnvVar("")
+    # ip:port of the jax.distributed coordinator
+    AUTODIST_COORDINATOR = _EnvVar("")
+    AUTODIST_NUM_PROCESSES = _EnvVar(1)
+    AUTODIST_PROCESS_ID = _EnvVar(0)
+    AUTODIST_DUMP_HLO = _EnvVar(False)
+    SYS_DATA_PATH = _EnvVar("")
+    SYS_RESOURCE_PATH = _EnvVar("")
 
 
 def is_worker() -> bool:

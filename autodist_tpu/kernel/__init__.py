@@ -1,0 +1,25 @@
+"""Kernel layer (L2): lowering from Strategy IR to XLA sharding plans.
+
+Replaces the reference's graph-rewriting kernel passes
+(``/root/reference/autodist/kernel/``) with GSPMD sharding emission.
+"""
+from autodist_tpu.kernel.lowering import (
+    DistributedTrainStep,
+    GraphTransformer,
+    ShardingPlan,
+    SyncKind,
+    TrainState,
+    VarPlan,
+)
+from autodist_tpu.kernel.mesh import build_mesh, data_axis
+
+__all__ = [
+    "DistributedTrainStep",
+    "GraphTransformer",
+    "ShardingPlan",
+    "SyncKind",
+    "TrainState",
+    "VarPlan",
+    "build_mesh",
+    "data_axis",
+]

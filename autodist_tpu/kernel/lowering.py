@@ -1,0 +1,382 @@
+"""Strategy lowering (L2): Strategy IR → sharding plan → compiled train step.
+
+This is the TPU-native replacement for the reference's entire kernel layer —
+``GraphTransformer`` + partitioner + replicator + synchronizers
+(``/root/reference/autodist/kernel/graph_transformer.py:55-92``,
+``partitioner.py``, ``replicator.py``, ``synchronization/*.py``). Where the
+reference rewrote a TF graph op-by-op (replicating it per device, splicing
+accumulators, queues and collective ops), this layer emits
+``jax.sharding.NamedSharding`` annotations per variable and lets XLA GSPMD
+insert the collectives:
+
+- ``AllReduceSynchronizer`` → parameter replicated over the mesh; with the
+  batch sharded over the "data" axis, autodiff of the mean loss makes XLA
+  emit the gradient all-reduce over ICI (the ``lax.psum`` path) — replacing
+  the reference's explicit ``collective_ops.all_reduce`` splicing
+  (``all_reduce_synchronizer.py:100-126``).
+- ``PSSynchronizer`` (unpartitioned, dense) → parameter replicated, but
+  optimizer slots *sharded*: weight-update sharding (the ZeRO-style scheme of
+  arXiv 2004.13336), so the "server-side" update computation and optimizer
+  memory are distributed exactly where the reference placed them on PS
+  devices. ``reduction_destination`` degrees of freedom collapse onto mesh
+  coordinates.
+- ``partitioner: "1,k,1"`` → the parameter itself is sharded on the active
+  axis (``NamedSharding``); XLA all-gathers on use and reduce-scatters the
+  gradient — a *true* tensor-parallel upgrade of the reference's
+  variable-only partitioning (``docs/design/kernels.md:11-17``).
+- sparse-update PS variables (embeddings) → row-sharded on axis 0, keeping
+  the PS sparse-path capability (``ps_synchronizer.py:473-532``) with
+  gather/scatter collectives instead of SparseConditionalAccumulators.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from autodist_tpu import const
+from autodist_tpu.kernel.mesh import data_axis
+from autodist_tpu.model_item import ModelItem, VarItem, _path_to_name
+from autodist_tpu.strategy.ir import (
+    AllReduceSynchronizer,
+    NodeConfig,
+    PSSynchronizer,
+    Strategy,
+)
+from autodist_tpu.utils import logging
+
+
+class SyncKind(Enum):
+    ALL_REDUCE = "all_reduce"
+    PS = "ps"
+
+
+@dataclass
+class VarPlan:
+    """Resolved per-variable lowering decision."""
+
+    var: VarItem
+    kind: SyncKind
+    pspec: P                       # parameter sharding
+    update_pspec: P                # optimizer-slot / weight-update sharding
+    compressor: str = "NoneCompressor"
+    group: int = 0
+    staleness: int = 0
+    sync: bool = True
+    reduction_destination: str = ""
+    local_replication: bool = False
+    num_shards: int = 1
+
+
+@struct.dataclass
+class TrainState:
+    """Minimal functional train state (the reference's mutable-graph state —
+    variables + optimizer slots — as an explicit pytree). ``.replace`` comes
+    from the struct.dataclass decorator."""
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def _spec_with_axis(rank: int, dim: int, mesh_axis: str) -> P:
+    entries: List[Optional[str]] = [None] * rank
+    entries[dim] = mesh_axis
+    return P(*entries)
+
+
+class GraphTransformer:
+    """Lower a compiled Strategy over a mesh into a :class:`ShardingPlan`.
+
+    Keeps the reference's pass-manager name (graph_transformer.py:45-92); the
+    passes here are sharding-assignment rules instead of graph rewrites.
+    """
+
+    def __init__(self, strategy: Strategy, model_item: ModelItem, mesh: Mesh):
+        self.strategy = strategy
+        self.model_item = model_item
+        self.mesh = mesh
+
+    def transform(self) -> "ShardingPlan":
+        plans: Dict[str, VarPlan] = {}
+        for node in self.strategy.node_config:
+            var = self.model_item.var(node.var_name)
+            plans[var.name] = self._lower_node(node, var)
+        # Non-trainable vars: replicated.
+        for var in self.model_item.variables:
+            if var.name not in plans:
+                plans[var.name] = VarPlan(
+                    var=var, kind=SyncKind.ALL_REDUCE, pspec=P(), update_pspec=P()
+                )
+        return ShardingPlan(mesh=self.mesh, var_plans=plans)
+
+    # ------------------------------------------------------------------ rules
+    def _shard_axis_name(self) -> str:
+        """Mesh axis carrying variable partitioning: the "model" axis when it
+        is non-trivial, else the data axis (ZeRO-style sharding)."""
+        shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        model_ax = const.MESH_AXIS_MODEL
+        if shape.get(model_ax, 1) > 1:
+            return model_ax
+        return data_axis(self.mesh)
+
+    def _lower_node(self, node: NodeConfig, var: VarItem) -> VarPlan:
+        sync = node.synchronizer
+        shard_ax = self._shard_axis_name()
+        rank = len(var.shape)
+
+        if isinstance(sync, AllReduceSynchronizer):
+            kind = SyncKind.ALL_REDUCE
+            compressor, group = sync.compressor, sync.group
+            staleness, sync_flag, dest, proxy = 0, True, "", False
+        else:
+            assert isinstance(sync, PSSynchronizer)
+            kind = SyncKind.PS
+            compressor, group = "NoneCompressor", 0
+            staleness, sync_flag = sync.staleness, sync.sync
+            dest, proxy = sync.reduction_destination, sync.local_replication
+
+        mesh_shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        n_shard = mesh_shape[shard_ax]
+
+        def divisible(axis: int) -> bool:
+            # jax NamedSharding requires exact divisibility; non-divisible
+            # axes (incl. UnevenPartitionedPS's deliberate non-divisor shard
+            # counts) fall back to replication until pad-and-mask sharding
+            # lands (SURVEY.md §7.4 item 5).
+            ok = var.shape[axis] % n_shard == 0 and var.shape[axis] >= n_shard
+            if not ok:
+                logging.debug(
+                    "var %s axis %d (size %d) not divisible by mesh axis %s=%d; "
+                    "replicating instead",
+                    var.name, axis, var.shape[axis], shard_ax, n_shard,
+                )
+            return ok
+
+        part_axis = node.active_partition_axis
+        if part_axis is not None and rank > 0 and divisible(part_axis):
+            # Explicit partitioning: shard the parameter itself.
+            pspec = _spec_with_axis(rank, part_axis, shard_ax)
+            update_pspec = pspec
+        elif kind is SyncKind.PS and var.sparse_update and rank > 0 and divisible(0):
+            # PS sparse path: row-sharded embedding (axis 0).
+            pspec = _spec_with_axis(rank, 0, shard_ax)
+            update_pspec = pspec
+        elif kind is SyncKind.PS and rank > 0:
+            # Dense PS: replicated parameter + sharded weight update
+            # (ZeRO-1 / arXiv 2004.13336) over the data axis.
+            pspec = P()
+            update_pspec = self._weight_update_spec(var)
+        else:
+            pspec = P()
+            update_pspec = P()
+
+        return VarPlan(
+            var=var,
+            kind=kind,
+            pspec=pspec,
+            update_pspec=update_pspec,
+            compressor=compressor,
+            group=group,
+            staleness=staleness,
+            sync=sync_flag,
+            reduction_destination=dest,
+            local_replication=proxy,
+            num_shards=node.num_shards,
+        )
+
+    def _weight_update_spec(self, var: VarItem) -> P:
+        """Largest axis divisible by the data-axis size, else replicated."""
+        ax_name = data_axis(self.mesh)
+        n = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))[ax_name]
+        if n <= 1 or not var.shape:
+            return P()
+        candidates = [i for i, d in enumerate(var.shape) if d % n == 0 and d >= n]
+        if not candidates:
+            return P()
+        best = max(candidates, key=lambda i: var.shape[i])
+        return _spec_with_axis(len(var.shape), best, ax_name)
+
+
+@dataclass
+class ShardingPlan:
+    """The lowered strategy: mesh + per-variable shardings."""
+
+    mesh: Mesh
+    var_plans: Dict[str, VarPlan]
+
+    # --------------------------------------------------------------- lookups
+    def plan_for(self, name: str) -> VarPlan:
+        return self.var_plans[name]
+
+    @property
+    def has_sparse_ps(self) -> bool:
+        return any(
+            p.kind is SyncKind.PS and p.var.sparse_update for p in self.var_plans.values()
+        )
+
+    def _sharding(self, pspec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, pspec)
+
+    # ------------------------------------------------------------- shardings
+    def params_shardings(self, params) -> Any:
+        """Pytree of NamedShardings matching ``params`` (matched by path)."""
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
+        out = []
+        for path, leaf in leaves:
+            name = _path_name(path)
+            plan = self.var_plans.get(name)
+            pspec = plan.pspec if plan is not None else P()
+            out.append(self._sharding(pspec))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def opt_shardings(self, opt_state_shapes) -> Any:
+        """Shardings for an optimizer-state pytree.
+
+        Slot leaves are matched to variables by path suffix (optax states
+        embed the params tree, e.g. ``0/mu/dense/kernel``); matched slots get
+        the variable's ``update_pspec`` (weight-update sharding for PS vars,
+        the param sharding for partitioned vars); unmatched leaves (step
+        counts, scalars) are replicated.
+        """
+        names = sorted(self.var_plans, key=len, reverse=True)
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(opt_state_shapes)
+        out = []
+        for path, leaf in leaves:
+            leaf_name = _path_name(path)
+            spec = P()
+            for n in names:
+                if leaf_name == n or leaf_name.endswith("/" + n):
+                    plan = self.var_plans[n]
+                    if tuple(getattr(leaf, "shape", ())) == tuple(plan.var.shape):
+                        spec = plan.update_pspec
+                    break
+            out.append(self._sharding(spec))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def batch_shardings(self, batch, strict: bool = True) -> Any:
+        """Batch leaves sharded along the data axis on dim 0 (the remapper's
+        feed-splitting contract, remapper.py:81-123). With ``strict=False``,
+        non-divisible leading dims replicate instead of raising."""
+        ax = data_axis(self.mesh)
+        n = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))[ax]
+
+        def leaf_sharding(leaf):
+            shape = tuple(getattr(leaf, "shape", ()))
+            if len(shape) >= 1 and shape[0] % n == 0 and shape[0] > 0:
+                return self._sharding(P(ax))
+            if len(shape) >= 1 and shape[0] % n != 0 and strict:
+                raise ValueError(
+                    f"global batch dim {shape[0]} not divisible by data-parallel "
+                    f"degree {n}"
+                )
+            return self._sharding(P())
+
+        return jax.tree_util.tree_map(leaf_sharding, batch)
+
+    def state_shardings(self, state_shapes: TrainState) -> TrainState:
+        return TrainState(
+            step=self._sharding(P()),
+            params=self.params_shardings(state_shapes.params),
+            opt_state=self.opt_shardings(state_shapes.opt_state),
+        )
+
+    def describe(self) -> str:
+        lines = [f"ShardingPlan(mesh={dict(zip(self.mesh.axis_names, self.mesh.devices.shape))})"]
+        for name, p in self.var_plans.items():
+            lines.append(
+                f"  {name}: {p.kind.value} param={p.pspec} update={p.update_pspec}"
+                + (f" dest={p.reduction_destination}" if p.reduction_destination else "")
+            )
+        return "\n".join(lines)
+
+
+# Param names are matched by string equality against ModelItem's names, so
+# both sides must use the one path-to-name implementation.
+_path_name = _path_to_name
+
+
+class DistributedTrainStep:
+    """Compiled distributed train step — the ``WrappedSession`` analog
+    (reference runner.py:117-132): users call it like the single-device step;
+    sharding, collectives and device placement are invisible.
+    """
+
+    def __init__(
+        self,
+        plan: ShardingPlan,
+        loss_fn: Callable,
+        optimizer: optax.GradientTransformation,
+        has_aux: bool = False,
+        donate_state: bool = True,
+    ):
+        self.plan = plan
+        self.loss_fn = loss_fn
+        self.tx = optimizer
+        self.has_aux = has_aux
+        self._donate = donate_state
+        self._compiled = None
+        self._state_shardings = None
+
+    # ------------------------------------------------------------------ init
+    def init(self, params) -> TrainState:
+        """Build + shard the initial state (runs the reference's "run
+        initializers on session creation", runner.py:86-100).
+
+        Copies param leaves: the returned state's buffers are donated on each
+        step, and ``device_put`` may alias the caller's arrays when shardings
+        already match — donation must never invalidate user-held arrays.
+        """
+        params = jax.tree.map(
+            lambda x: jnp.array(x, copy=True) if isinstance(x, jax.Array) else jnp.asarray(x),
+            params,
+        )
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=self.tx.init(params))
+        shardings = self.plan.state_shardings(jax.eval_shape(lambda: state))
+        self._state_shardings = shardings
+        return jax.device_put(state, shardings)
+
+    # ------------------------------------------------------------------ step
+    def _step(self, state: TrainState, batch):
+        if self.has_aux:
+            (loss, aux), grads = jax.value_and_grad(self.loss_fn, has_aux=True)(state.params, batch)
+        else:
+            loss, grads = jax.value_and_grad(self.loss_fn)(state.params, batch)
+            aux = None
+        updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt)
+        metrics = {"loss": loss}
+        if aux is not None:
+            metrics["aux"] = aux
+        return new_state, metrics
+
+    def _compile(self, state: TrainState, batch):
+        if self._state_shardings is None:
+            self._state_shardings = self.plan.state_shardings(jax.eval_shape(lambda: state))
+        in_shardings = (self._state_shardings, self.plan.batch_shardings(batch))
+        out_shardings = (self._state_shardings, None)
+        self._compiled = jax.jit(
+            self._step,
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
+            donate_argnums=(0,) if self._donate else (),
+        )
+        return self._compiled
+
+    def __call__(self, state: TrainState, batch) -> Tuple[TrainState, Dict[str, Any]]:
+        fn = self._compiled or self._compile(state, batch)
+        return fn(state, batch)
+
+    def lower_text(self, state: TrainState, batch) -> str:
+        """Stable-HLO dump of the compiled step — the TPU analog of the
+        reference's per-stage TensorBoard graph snapshots
+        (visualization_util.py:24-36)."""
+        fn = self._compiled or self._compile(state, batch)
+        return fn.lower(state, batch).as_text()

@@ -1,0 +1,290 @@
+"""Model IR (L3): the abstract description strategies are built against.
+
+TPU-native analog of the reference's ``GraphItem``
+(``/root/reference/autodist/graph_item.py:217-473``). The reference wraps a
+captured ``tf.Graph`` plus metadata (grad→target pairs, optimizer capture,
+update-op discovery). In JAX there is no mutable graph to wrap: a model *is*
+a params pytree plus a pure loss function. ``ModelItem`` therefore records:
+
+- one ``VarItem`` per parameter leaf (name = pytree path, shape, dtype,
+  trainable flag, sparse-update flag) — standing in for
+  ``trainable_var_op_to_var`` / ``var_op_name_to_grad_info``;
+- the optimizer as an explicit ``OptimizerSpec`` — replacing the reference's
+  optimizer monkey-patch capture (``graph_item.py:72-108``, ``patch.py:79-88``)
+  with functional capture, which JAX gives us for free;
+- sparse-update detection by *jaxpr inspection*: a parameter consumed by a
+  ``gather`` primitive gets ``sparse_update=True`` — the analog of the
+  reference detecting ``IndexedSlices`` gradients from ``embedding_lookup``
+  (``graph_item.py:275-296``).
+
+Like ``GraphItem``, a ``ModelItem`` serializes (JSON) so the chief's analysis
+can be shipped to workers.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu.utils import logging
+
+# Primitives whose output aliases their input closely enough that a gather on
+# the output is a gather on the parameter (dtype casts around embeddings).
+_ALIASING_PRIMITIVES = {"convert_element_type", "reshape", "transpose", "copy"}
+# Primitives that read a parameter sparsely (row lookup).
+_SPARSE_READ_PRIMITIVES = {"gather", "take", "dynamic_slice"}
+
+
+def _path_to_name(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:  # pragma: no cover - future key types
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+@dataclass(frozen=True)
+class VarItem:
+    """One trainable (or frozen) parameter leaf."""
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: str
+    trainable: bool = True
+    sparse_update: bool = False
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def byte_size(self) -> int:
+        """Payload bytes — the load metric for PS load balancing
+        (reference ``byte_size_load_fn``, ps_lb_strategy.py:87-117)."""
+        return self.size * np.dtype(self.dtype).itemsize
+
+
+@dataclass
+class OptimizerSpec:
+    """Explicit optimizer capture (replaces reference optimizer patching).
+
+    ``name`` indexes into :data:`OPTIMIZER_REGISTRY`; ``kwargs`` are its
+    hyperparameters. ``make()`` materializes the optax transform.
+    """
+
+    name: str = "sgd"
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+
+    def make(self):
+        import optax
+
+        registry = {
+            "sgd": optax.sgd,
+            "momentum": lambda learning_rate, momentum=0.9, **kw: optax.sgd(
+                learning_rate, momentum=momentum, **kw
+            ),
+            "adam": optax.adam,
+            "adamw": optax.adamw,
+            "adagrad": optax.adagrad,
+            "rmsprop": optax.rmsprop,
+            "lamb": optax.lamb,
+            "lion": optax.lion,
+            "adafactor": optax.adafactor,
+        }
+        if self.name not in registry:
+            raise ValueError(f"unknown optimizer {self.name!r}; known: {sorted(registry)}")
+        return registry[self.name](**self.kwargs)
+
+
+class ModelItem:
+    """Abstract model description: variables + optimizer + traced metadata."""
+
+    def __init__(
+        self,
+        variables: Sequence[VarItem],
+        optimizer_spec: Optional[OptimizerSpec] = None,
+        params_treedef=None,
+    ):
+        self._variables = list(variables)
+        self.optimizer_spec = optimizer_spec or OptimizerSpec()
+        self._params_treedef = params_treedef
+
+    # ----------------------------------------------------------- constructors
+    @classmethod
+    def from_params(
+        cls,
+        params,
+        optimizer_spec: Optional[OptimizerSpec] = None,
+        loss_fn: Optional[Callable] = None,
+        example_batch=None,
+        sparse_names: Sequence[str] = (),
+        trainable_filter: Optional[Callable[[str], bool]] = None,
+    ) -> "ModelItem":
+        """Build from a params pytree (concrete or ShapeDtypeStructs).
+
+        When ``loss_fn`` + ``example_batch`` are given, sparse-update
+        parameters are auto-detected from the jaxpr; ``sparse_names``
+        substrings force-mark additional parameters.
+        """
+        leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(params)
+        detected_sparse = set()
+        if loss_fn is not None and example_batch is not None:
+            detected_sparse = cls._detect_sparse(loss_fn, params, example_batch)
+        variables = []
+        for i, (path, leaf) in enumerate(leaves_with_path):
+            name = _path_to_name(path)
+            shape = tuple(getattr(leaf, "shape", ()))
+            dtype = str(jnp.result_type(getattr(leaf, "dtype", jnp.float32)))
+            trainable = trainable_filter(name) if trainable_filter else True
+            sparse = i in detected_sparse or any(s in name for s in sparse_names)
+            variables.append(
+                VarItem(name=name, shape=shape, dtype=dtype, trainable=trainable, sparse_update=sparse)
+            )
+        return cls(variables, optimizer_spec=optimizer_spec, params_treedef=treedef)
+
+    @staticmethod
+    def _detect_sparse(loss_fn: Callable, params, example_batch) -> set:
+        """Indices of param leaves read via gather-style primitives.
+
+        Mirrors the reference's IndexedSlices detection
+        (``graph_item.py:275-296``) but at the jaxpr level: flatten
+        ``(params, batch)`` into jaxpr invars, then walk equations looking
+        for sparse-read primitives whose *operand* is a param invar (alias
+        propagation through dtype casts/reshapes included).
+        """
+        try:
+            jaxpr = jax.make_jaxpr(loss_fn)(params, example_batch)
+        except Exception as e:  # noqa: BLE001 - detection is best-effort
+            logging.warning("sparse detection trace failed (%s); marking none", e)
+            return set()
+        n_params = len(jax.tree_util.tree_leaves(params))
+        param_invars = jaxpr.jaxpr.invars[:n_params]
+        # var id -> param leaf index, propagated through aliasing primitives
+        alias: Dict[int, int] = {id(v): i for i, v in enumerate(param_invars)}
+        sparse: set = set()
+
+        def map_through(outer_vars, inner_vars, sub_jaxpr):
+            for outer, inner in zip(outer_vars, inner_vars):
+                if id(outer) in alias:
+                    alias[id(inner)] = alias[id(outer)]
+            walk(sub_jaxpr)
+
+        def walk(jpr):
+            for eqn in jpr.eqns:
+                prim = eqn.primitive.name
+                if prim in _SPARSE_READ_PRIMITIVES:
+                    operand = eqn.invars[0]
+                    if id(operand) in alias:
+                        sparse.add(alias[id(operand)])
+                elif prim in _ALIASING_PRIMITIVES:
+                    src = eqn.invars[0]
+                    if id(src) in alias:
+                        for out in eqn.outvars:
+                            alias[id(out)] = alias[id(src)]
+                # Recurse into sub-jaxprs. Invar alignment is primitive-
+                # specific: while carries separate cond/body const blocks,
+                # cond prefixes a predicate, scan/pjit align directly.
+                if prim == "while":
+                    cn = eqn.params["cond_nconsts"]
+                    bn = eqn.params["body_nconsts"]
+                    cond_j = eqn.params["cond_jaxpr"].jaxpr
+                    body_j = eqn.params["body_jaxpr"].jaxpr
+                    carry = eqn.invars[cn + bn:]
+                    map_through(eqn.invars[:cn] + carry, cond_j.invars, cond_j)
+                    map_through(eqn.invars[cn:cn + bn] + carry, body_j.invars, body_j)
+                elif prim == "cond":
+                    for branch in eqn.params["branches"]:
+                        map_through(eqn.invars[1:], branch.jaxpr.invars, branch.jaxpr)
+                else:
+                    for val in eqn.params.values():
+                        if hasattr(val, "jaxpr"):  # scan/pjit/custom_*: direct tail-align
+                            sub = val.jaxpr
+                            map_through(eqn.invars[-len(sub.invars):], sub.invars, sub)
+
+        walk(jaxpr.jaxpr)
+        return sparse
+
+    # -------------------------------------------------------------- accessors
+    @property
+    def variables(self) -> List[VarItem]:
+        return list(self._variables)
+
+    @property
+    def trainable_variables(self) -> List[VarItem]:
+        return [v for v in self._variables if v.trainable]
+
+    @property
+    def sparse_variables(self) -> List[VarItem]:
+        return [v for v in self._variables if v.sparse_update]
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(v.byte_size for v in self._variables)
+
+    def var(self, name: str) -> VarItem:
+        for v in self._variables:
+            if v.name == name:
+                return v
+        raise KeyError(name)
+
+    @property
+    def params_treedef(self):
+        return self._params_treedef
+
+    # ---------------------------------------------------------- serialization
+    def to_json(self) -> dict:
+        return {
+            "variables": [
+                {
+                    "name": v.name,
+                    "shape": list(v.shape),
+                    "dtype": v.dtype,
+                    "trainable": v.trainable,
+                    "sparse_update": v.sparse_update,
+                }
+                for v in self._variables
+            ],
+            "optimizer": {"name": self.optimizer_spec.name, "kwargs": self.optimizer_spec.kwargs},
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ModelItem":
+        return cls(
+            [
+                VarItem(
+                    name=v["name"],
+                    shape=tuple(v["shape"]),
+                    dtype=v["dtype"],
+                    trainable=v.get("trainable", True),
+                    sparse_update=v.get("sparse_update", False),
+                )
+                for v in d.get("variables", [])
+            ],
+            optimizer_spec=OptimizerSpec(**d.get("optimizer", {})),
+        )
+
+    def serialize(self, path: str) -> str:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_json(), f, indent=2, sort_keys=True)
+        return path
+
+    @classmethod
+    def deserialize(cls, path: str) -> "ModelItem":
+        with open(path, "r", encoding="utf-8") as f:
+            return cls.from_json(json.load(f))
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"ModelItem({len(self._variables)} vars, "
+            f"{self.total_bytes / 1e6:.2f} MB, opt={self.optimizer_spec.name})"
+        )

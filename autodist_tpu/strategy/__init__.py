@@ -1,0 +1,41 @@
+"""Strategy layer: explicit serializable parallelization plans + builders.
+
+Mirrors the reference strategy package (``/root/reference/autodist/strategy/``)
+— same 8 builder policies, retargeted to a TPU mesh.
+"""
+from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+from autodist_tpu.strategy.base import StrategyBuilder, StrategyCompiler
+from autodist_tpu.strategy.ir import (
+    AllReduceSpec,
+    AllReduceSynchronizer,
+    GraphConfig,
+    NodeConfig,
+    PSSynchronizer,
+    Strategy,
+)
+from autodist_tpu.strategy.parallax_strategy import Parallax
+from autodist_tpu.strategy.partitioned_all_reduce_strategy import PartitionedAR
+from autodist_tpu.strategy.partitioned_ps_strategy import PartitionedPS
+from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
+from autodist_tpu.strategy.ps_strategy import PS
+from autodist_tpu.strategy.random_axis_partition_all_reduce_strategy import RandomAxisPartitionAR
+from autodist_tpu.strategy.uneven_partition_ps_strategy import UnevenPartitionedPS
+
+__all__ = [
+    "AllReduce",
+    "AllReduceSpec",
+    "AllReduceSynchronizer",
+    "GraphConfig",
+    "NodeConfig",
+    "PS",
+    "PSLoadBalancing",
+    "PSSynchronizer",
+    "Parallax",
+    "PartitionedAR",
+    "PartitionedPS",
+    "RandomAxisPartitionAR",
+    "Strategy",
+    "StrategyBuilder",
+    "StrategyCompiler",
+    "UnevenPartitionedPS",
+]

@@ -1,0 +1,117 @@
+"""Strategy builder ABC + compiler (reference: strategy/base.py:102-168).
+
+A ``StrategyBuilder`` maps (ModelItem × ResourceSpec) → ``Strategy``. The
+``StrategyCompiler`` then prunes and validates the strategy against the model
+— the analog of the reference compiler's stateless-var pruning and
+AutoDist-device → TF-device resolution (``base.py:137-168``); here devices
+resolve to logical-mesh coordinates at lowering time instead, so compilation
+only prunes, validates, and normalizes.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+from autodist_tpu import const
+from autodist_tpu.model_item import ModelItem, VarItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.ir import NodeConfig, Strategy
+from autodist_tpu.utils import logging
+
+
+def byte_size_load_fn(var: VarItem) -> float:
+    """Byte-size load metric (reference ``byte_size_load_fn``,
+    ps_lb_strategy.py:87-117) — trivial here since VarItem knows its bytes."""
+    return float(var.byte_size)
+
+
+def min_divisor_shards(n: int) -> int:
+    """Smallest non-trivial divisor of ``n`` (or ``n`` itself when prime) —
+    the reference's ``get_num_shards`` (partitioned_ps_strategy.py:125-135)."""
+    if n < 2:
+        return 1
+    for i in range(2, n):
+        if n % i == 0:
+            return i
+    return n
+
+
+def min_non_divisor_shards(n: int) -> int:
+    """Smallest integer ≥2 that does *not* divide ``n`` — the uneven-split
+    policy (uneven_partition_ps_strategy.py:128-137). Deviates from the
+    reference for n == 2 (it returns 2, an even split, from a loop-bound
+    quirk); we honor the contract and return 3 — downstream the shard count
+    is capped at the dim size anyway."""
+    if n < 2:
+        return 1
+    for i in range(2, n + 2):
+        if n % i > 0:
+            return i
+    return n  # pragma: no cover - unreachable: n+1 never divides n for n >= 2
+
+
+def replica_devices(resource_spec: ResourceSpec) -> List[str]:
+    """The data-parallel replica set: every TPU chip, plus the host CPU of
+    any chip-less node (reference: ps_strategy.py:38-55 uses GPUs + CPUs of
+    GPU-less nodes)."""
+    out = [d.name_string() for d in resource_spec.tpu_devices]
+    chipless = {n.address for n in resource_spec.nodes if n.chips == 0}
+    out.extend(d.name_string() for d in resource_spec.cpu_devices if d.host_address in chipless)
+    return out
+
+
+def reduction_devices(resource_spec: ResourceSpec) -> List[str]:
+    """PS reduction destinations: one host CPU per node (reference:
+    ``resource_spec.cpu_devices``)."""
+    return [d.name_string() for d in resource_spec.cpu_devices]
+
+
+def part_name(var_name: str, i: int) -> str:
+    """Shard naming contract (reference: ``'{}/part_{}:0'``)."""
+    return f"{var_name}/part_{i}"
+
+
+class StrategyBuilder(ABC):
+    """Interface: analyze model + resources, emit a Strategy
+    (reference: strategy/base.py:102-117)."""
+
+    @abstractmethod
+    def build(self, model_item: ModelItem, resource_spec: ResourceSpec) -> Strategy:
+        """Generate the strategy."""
+        raise NotImplementedError
+
+    def _new_strategy(self, resource_spec: ResourceSpec) -> Strategy:
+        s = Strategy(id=Strategy.new_id(resource_spec.fingerprint()))
+        s.graph_config.replicas = replica_devices(resource_spec)
+        return s
+
+
+class StrategyCompiler:
+    """Prune + validate a strategy against the model
+    (reference: strategy/base.py:120-168)."""
+
+    def __init__(self, model_item: ModelItem):
+        self._model_item = model_item
+
+    def compile(self, strategy: Strategy) -> Strategy:
+        trainable = {v.name for v in self._model_item.trainable_variables}
+        kept: List[NodeConfig] = []
+        for node in strategy.node_config:
+            if node.var_name not in trainable:
+                # Analog of pruning node configs for stateless/non-trainable
+                # vars (base.py:156-161).
+                logging.debug("pruning node config for non-trainable %r", node.var_name)
+                continue
+            var = self._model_item.var(node.var_name)
+            node.validate_against_shape(var.shape)
+            if node.partitioner and node.part_config and len(node.part_config) != node.num_shards:
+                raise ValueError(
+                    f"{node.var_name!r}: {len(node.part_config)} part configs "
+                    f"but partitioner {node.partitioner!r} implies {node.num_shards}"
+                )
+            kept.append(node)
+        missing = trainable - {n.var_name for n in kept}
+        if missing:
+            raise ValueError(f"strategy has no node config for trainable vars: {sorted(missing)}")
+        strategy.node_config = kept
+        return strategy

@@ -1,0 +1,246 @@
+"""Strategy IR: the explicit, serializable per-variable parallelization plan.
+
+Port of the reference's protobuf schema (``/root/reference/autodist/proto/
+strategy.proto:30-69``, ``synchronizers.proto:25-57``) to frozen dataclasses
+with JSON serialization. The schema is backend-neutral and survives nearly
+verbatim; the *meanings* are retargeted to TPU:
+
+- ``PSSynchronizer`` — centralized-reduction semantics. On TPU this lowers to
+  weight-update sharding (ZeRO-style): the variable's optimizer state and
+  update computation live on its ``reduction_destination`` shard of the mesh,
+  gradients reduce-scatter there and fresh values all-gather back over ICI —
+  preserving the PS capability without grpc parameter servers.
+- ``AllReduceSynchronizer`` — gradient all-reduce. ``spec`` picks the
+  transport (AUTO/ICI/DCN, replacing the reference's AUTO/NCCL/RING);
+  ``compressor`` names a gradient compressor; ``group`` fuses several
+  variables into one collective (replacing scoped-allocator merging,
+  ``all_reduce_strategy.py:60-68``).
+- ``partitioner`` — an axis-shard spec string like ``"1,2,1"`` (one active
+  axis, same grammar as ``kernel/partitioner.py:38-150``) that lowers to a
+  sharded mesh axis in a ``NamedSharding`` rather than graph surgery.
+- ``GraphConfig.replicas`` — the data-parallel replica set (device strings),
+  which lowers to the mesh "data" axis.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Union
+
+from autodist_tpu import const
+from autodist_tpu.utils import logging
+
+
+# --------------------------------------------------------------------------- #
+# Synchronizers (reference: proto/synchronizers.proto)
+# --------------------------------------------------------------------------- #
+@dataclass(frozen=True)
+class PSSynchronizer:
+    """Centralized-reduction sync config (synchronizers.proto:25-30)."""
+
+    reduction_destination: str = ""  # DeviceSpec string, e.g. "10.0.0.1:CPU:0"
+    local_replication: bool = False  # proxy-variable analog: keep a device-local cached copy
+    sync: bool = True                # synchronous updates (async/staleness otherwise)
+    staleness: int = 0               # bounded staleness in steps (0 = fully sync)
+
+
+class AllReduceSpec:
+    """Transport hint for the all-reduce (reference: AUTO|NCCL|RING)."""
+
+    AUTO = "AUTO"
+    ICI = "ICI"    # intra-slice interconnect collectives
+    DCN = "DCN"    # cross-slice / data-center network
+    VALID = (AUTO, ICI, DCN)
+
+
+@dataclass(frozen=True)
+class AllReduceSynchronizer:
+    """All-reduce sync config (synchronizers.proto:35-57)."""
+
+    spec: str = AllReduceSpec.AUTO
+    compressor: str = "NoneCompressor"  # see kernel/compressor.py registry
+    group: int = 0                      # collective fusion group id
+
+    def __post_init__(self):
+        if self.spec not in AllReduceSpec.VALID:
+            raise ValueError(f"invalid all-reduce spec {self.spec!r}")
+
+
+Synchronizer = Union[PSSynchronizer, AllReduceSynchronizer]
+
+_SYNCHRONIZER_TYPES = {
+    "PSSynchronizer": PSSynchronizer,
+    "AllReduceSynchronizer": AllReduceSynchronizer,
+}
+
+
+# --------------------------------------------------------------------------- #
+# Node / graph config (reference: proto/strategy.proto)
+# --------------------------------------------------------------------------- #
+@dataclass
+class NodeConfig:
+    """Per-variable plan (strategy.proto:30-55).
+
+    ``partitioner`` of ``"1,4,1"`` means: shard axis 1 four ways. When set,
+    ``part_config`` may carry one NodeConfig per shard (the reference's
+    per-part sync choice, strategy.proto:46-50).
+    """
+
+    var_name: str
+    synchronizer: Synchronizer = field(default_factory=AllReduceSynchronizer)
+    partitioner: str = ""
+    part_config: List["NodeConfig"] = field(default_factory=list)
+
+    @property
+    def partition_axes(self) -> List[int]:
+        """Parsed partitioner string, empty if unpartitioned."""
+        if not self.partitioner:
+            return []
+        return [int(x) for x in self.partitioner.split(",")]
+
+    @property
+    def active_partition_axis(self) -> Optional[int]:
+        """Index of the single sharded axis (grammar: one axis > 1)."""
+        axes = self.partition_axes
+        active = [i for i, n in enumerate(axes) if n > 1]
+        if not active:
+            return None
+        if len(active) > 1:
+            raise ValueError(
+                f"partitioner {self.partitioner!r} for {self.var_name!r} has "
+                f"more than one active axis (reference grammar allows one: "
+                f"partitioner.py:108-126)"
+            )
+        return active[0]
+
+    @property
+    def num_shards(self) -> int:
+        ax = self.active_partition_axis
+        return self.partition_axes[ax] if ax is not None else 1
+
+    def validate_against_shape(self, shape) -> None:
+        axes = self.partition_axes
+        if axes and len(axes) != len(shape):
+            raise ValueError(
+                f"partitioner {self.partitioner!r} rank {len(axes)} != "
+                f"var {self.var_name!r} rank {len(shape)}"
+            )
+
+
+@dataclass
+class GraphConfig:
+    """Graph-wide config: the replica set (strategy.proto:62-68)."""
+
+    replicas: List[str] = field(default_factory=list)
+
+
+# --------------------------------------------------------------------------- #
+# Strategy wrapper (reference: strategy/base.py:34-99)
+# --------------------------------------------------------------------------- #
+def _sync_to_json(s: Synchronizer) -> dict:
+    return {"type": type(s).__name__, **dataclasses.asdict(s)}
+
+
+def _sync_from_json(d: dict) -> Synchronizer:
+    d = dict(d)
+    cls = _SYNCHRONIZER_TYPES[d.pop("type")]
+    return cls(**d)
+
+
+def _node_to_json(n: NodeConfig) -> dict:
+    return {
+        "var_name": n.var_name,
+        "synchronizer": _sync_to_json(n.synchronizer),
+        "partitioner": n.partitioner,
+        "part_config": [_node_to_json(p) for p in n.part_config],
+    }
+
+
+def _node_from_json(d: dict) -> NodeConfig:
+    return NodeConfig(
+        var_name=d["var_name"],
+        synchronizer=_sync_from_json(d["synchronizer"]),
+        partitioner=d.get("partitioner", ""),
+        part_config=[_node_from_json(p) for p in d.get("part_config", [])],
+    )
+
+
+@dataclass
+class Strategy:
+    """The serialized "compiler flags" artifact shipped chief → workers.
+
+    Ids are timestamped like the reference (strategy/base.py:45-52) plus the
+    resource-spec fingerprint, so a strategy built for one cluster is never
+    silently loaded on another.
+    """
+
+    node_config: List[NodeConfig] = field(default_factory=list)
+    graph_config: GraphConfig = field(default_factory=GraphConfig)
+    id: str = ""
+    path: str = ""
+
+    @classmethod
+    def new_id(cls, fingerprint: str = "") -> str:
+        ts = time.strftime("%Y%m%dT%H%M%S")
+        suffix = f"-{fingerprint}" if fingerprint else ""
+        return f"{ts}{suffix}-{os.getpid()}"
+
+    def to_json(self) -> dict:
+        return {
+            "id": self.id,
+            "path": self.path,
+            "node_config": [_node_to_json(n) for n in self.node_config],
+            "graph_config": {"replicas": list(self.graph_config.replicas)},
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Strategy":
+        return cls(
+            id=d.get("id", ""),
+            path=d.get("path", ""),
+            node_config=[_node_from_json(n) for n in d.get("node_config", [])],
+            graph_config=GraphConfig(replicas=list(d.get("graph_config", {}).get("replicas", []))),
+        )
+
+    def serialize(self, path: Optional[str] = None) -> str:
+        """Write to ``<strategy_dir>/<id>`` (reference: base.py:78-88)."""
+        if not self.id:
+            self.id = self.new_id()
+        if path is None:
+            os.makedirs(const.DEFAULT_STRATEGY_DIR, exist_ok=True)
+            path = os.path.join(const.DEFAULT_STRATEGY_DIR, self.id)
+        self.path = path
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_json(), f, indent=2, sort_keys=True)
+        logging.debug("serialized strategy %s -> %s", self.id, path)
+        return path
+
+    @classmethod
+    def deserialize(cls, strategy_id: Optional[str] = None, path: Optional[str] = None) -> "Strategy":
+        """Load by id from the strategy dir, or from an explicit path
+        (reference: base.py:89-99)."""
+        if path is None:
+            if not strategy_id:
+                raise ValueError("need strategy_id or path")
+            path = os.path.join(const.DEFAULT_STRATEGY_DIR, strategy_id)
+        with open(path, "r", encoding="utf-8") as f:
+            s = cls.from_json(json.load(f))
+        s.path = path
+        return s
+
+    def node_config_for(self, var_name: str) -> Optional[NodeConfig]:
+        for n in self.node_config:
+            if n.var_name == var_name:
+                return n
+        return None
+
+    def __str__(self) -> str:  # pragma: no cover - debugging aid
+        lines = [f"Strategy(id={self.id!r}, replicas={len(self.graph_config.replicas)})"]
+        for n in self.node_config:
+            sync = type(n.synchronizer).__name__
+            part = f" partitioner={n.partitioner!r}" if n.partitioner else ""
+            lines.append(f"  {n.var_name}: {sync}{part}")
+        return "\n".join(lines)

@@ -1,0 +1,54 @@
+"""Parallax hybrid strategy (reference: strategy/parallax_strategy.py:40-71,
+from the Parallax paper, arXiv 1808.02621): dense variables → AllReduce,
+sparse-update (embedding) variables → load-balanced PS *without* proxy
+caching (sparse vars are large and each replica touches few rows)."""
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+from autodist_tpu.strategy.base import byte_size_load_fn, reduction_devices
+from autodist_tpu.strategy.ir import AllReduceSynchronizer, NodeConfig, PSSynchronizer, Strategy
+from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
+
+
+class Parallax(PSLoadBalancing, AllReduce):
+    """Per-variable dense/sparse dispatch (multiple inheritance mirrors the
+    reference's PSLoadBalancing + AllReduce composition)."""
+
+    def __init__(self, chunk_size: int = 128, local_proxy_variable: bool = False,
+                 sync: bool = True, staleness: int = 0,
+                 all_reduce_spec: str = "AUTO", compressor: str = "NoneCompressor"):
+        PSLoadBalancing.__init__(self, local_proxy_variable, sync, staleness)
+        AllReduce.__init__(self, chunk_size, all_reduce_spec, compressor)
+
+    def build(self, model_item: ModelItem, resource_spec: ResourceSpec) -> Strategy:
+        expr = self._new_strategy(resource_spec)
+        self.loads = {ps: 0.0 for ps in reduction_devices(resource_spec)}
+        node_config = []
+        for idx, var in enumerate(model_item.trainable_variables):
+            if not var.sparse_update:  # dense → all-reduce
+                node_config.append(
+                    NodeConfig(
+                        var_name=var.name,
+                        synchronizer=AllReduceSynchronizer(
+                            spec=self.all_reduce_spec,
+                            compressor=self.compressor,
+                            group=idx // self.chunk_size,
+                        ),
+                    )
+                )
+            else:  # sparse → PS, no proxy (parallax_strategy.py:59-64)
+                min_ps = min(self.loads, key=self.loads.get)
+                self.loads[min_ps] += byte_size_load_fn(var)
+                node_config.append(
+                    NodeConfig(
+                        var_name=var.name,
+                        synchronizer=PSSynchronizer(
+                            reduction_destination=min_ps,
+                            local_replication=False,
+                            sync=self._sync,
+                            staleness=self._staleness,
+                        ),
+                    )
+                )
+        expr.node_config = node_config
+        return expr

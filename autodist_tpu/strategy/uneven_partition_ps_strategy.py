@@ -1,0 +1,16 @@
+"""Uneven partitioned PS: shard count = smallest *non*-divisor of dim 0,
+exercising the uneven-split path (reference:
+strategy/uneven_partition_ps_strategy.py:128-137). On TPU, uneven shards
+lower to pad-and-mask sharding (SURVEY.md §7.4 item 5)."""
+from autodist_tpu.model_item import VarItem
+from autodist_tpu.strategy.base import min_non_divisor_shards
+from autodist_tpu.strategy.partitioned_ps_strategy import PartitionedPS
+
+
+class UnevenPartitionedPS(PartitionedPS):
+    """Same placement policy as PartitionedPS, uneven shard counts."""
+
+    def get_num_shards(self, var: VarItem) -> int:
+        if not var.shape:
+            return 1
+        return min_non_divisor_shards(var.shape[0])

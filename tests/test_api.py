@@ -1,0 +1,102 @@
+"""User-API tests (parity: reference tests/test_autodist.py)."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import autodist_tpu as ad
+from autodist_tpu.const import ENV
+
+
+@pytest.fixture(autouse=True)
+def fresh_autodist():
+    ad.AutoDist.reset_default()
+    yield
+    ad.AutoDist.reset_default()
+
+
+def loss_fn(params, batch):
+    x, y = batch
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def make_model():
+    params = {"w": jnp.ones((4, 2)), "b": jnp.zeros((2,))}
+    batch = (jnp.ones((8, 4)), jnp.zeros((8, 2)))
+    return params, batch
+
+
+def test_singleton_enforced():
+    # Parity: second AutoDist() in-process raises (test_autodist.py:19-23).
+    ad.AutoDist()
+    with pytest.raises(RuntimeError, match="one AutoDist"):
+        ad.AutoDist()
+
+
+def test_default_builder_is_ps_load_balancing():
+    a = ad.AutoDist()
+    assert type(a.strategy_builder).__name__ == "PSLoadBalancing"
+
+
+def test_build_and_train_end_to_end():
+    params, batch = make_model()
+    a = ad.AutoDist(strategy_builder=ad.strategy.AllReduce())
+    step = a.build(loss_fn, params, example_batch=batch,
+                   optimizer=ad.OptimizerSpec("sgd", {"learning_rate": 0.1}))
+    state = step.init(params)
+    state, metrics = step(state, batch)
+    assert float(metrics["loss"]) > 0
+    assert a.strategy is not None and a.plan is not None
+    # strategy was serialized to disk for workers
+    assert os.path.exists(a.strategy.path)
+
+
+def test_worker_loads_chief_strategy(monkeypatch):
+    params, batch = make_model()
+    a = ad.AutoDist(strategy_builder=ad.strategy.PartitionedPS())
+    a.build(loss_fn, params, example_batch=batch)
+    sid = a.strategy.id
+    assert os.environ[ENV.AUTODIST_STRATEGY_ID.name] == sid
+
+    # Simulate a worker process: same build call loads, not rebuilds.
+    ad.AutoDist.reset_default()
+    monkeypatch.setenv("AUTODIST_WORKER", "10.0.0.2")
+    monkeypatch.setenv("AUTODIST_STRATEGY_ID", sid)
+    b = ad.AutoDist(strategy_builder=ad.strategy.PartitionedPS())
+    assert not b.is_chief
+    step = b.build(loss_fn, params, example_batch=batch)
+    assert b.strategy.id == sid
+    state = step.init(params)
+    state, _ = step(state, batch)
+
+
+def test_raw_optax_optimizer_accepted():
+    params, batch = make_model()
+    a = ad.AutoDist(strategy_builder=ad.strategy.AllReduce())
+    step = a.build(loss_fn, params, example_batch=batch, optimizer=optax.adam(1e-3))
+    state = step.init(params)
+    state, _ = step(state, batch)
+    assert int(state.step) == 1
+
+
+def test_function_wrapper():
+    params, batch = make_model()
+    a = ad.AutoDist(strategy_builder=ad.strategy.AllReduce())
+    a.build(loss_fn, params, example_batch=batch)
+
+    @a.function
+    def eval_step(x):
+        return (x * 2).sum()
+
+    out = eval_step(jnp.ones((16, 2)))
+    assert float(out) == 64.0
+
+
+def test_scope_context():
+    a = ad.AutoDist()
+    with a.scope() as s:
+        assert s is a

@@ -1,0 +1,200 @@
+"""Strategy-builder policy tests.
+
+Property-checks the 8 builder policies against the reference semantics
+(SURVEY.md §2.1 #6-13) with no devices involved.
+"""
+import jax.numpy as jnp
+import pytest
+
+from autodist_tpu.model_item import ModelItem, OptimizerSpec, VarItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import (
+    AllReduce,
+    AllReduceSynchronizer,
+    PS,
+    PSLoadBalancing,
+    PSSynchronizer,
+    Parallax,
+    PartitionedAR,
+    PartitionedPS,
+    RandomAxisPartitionAR,
+    StrategyCompiler,
+    UnevenPartitionedPS,
+)
+from autodist_tpu.strategy.base import min_divisor_shards, min_non_divisor_shards
+
+
+@pytest.fixture
+def rs():
+    return ResourceSpec(
+        resource_dict={
+            "nodes": [
+                {"address": "10.0.0.1", "chips": 4, "chief": True},
+                {"address": "10.0.0.2", "chips": 4},
+            ]
+        }
+    )
+
+
+@pytest.fixture
+def model():
+    return ModelItem(
+        [
+            VarItem("dense/kernel", (12, 8), "float32"),
+            VarItem("dense/bias", (8,), "float32"),
+            VarItem("embed/embedding", (100, 16), "float32", sparse_update=True),
+            VarItem("scalar", (), "float32"),
+        ],
+        optimizer_spec=OptimizerSpec("sgd", {"learning_rate": 0.1}),
+    )
+
+
+ALL_BUILDERS = [
+    PS(),
+    PS(local_proxy_variable=True),
+    PS(sync=True, staleness=2),
+    PSLoadBalancing(),
+    PartitionedPS(),
+    UnevenPartitionedPS(),
+    AllReduce(chunk_size=2),
+    PartitionedAR(chunk_size=2),
+    RandomAxisPartitionAR(chunk_size=2, seed=0),
+    Parallax(chunk_size=2),
+]
+
+
+@pytest.mark.parametrize("builder", ALL_BUILDERS, ids=lambda b: type(b).__name__)
+def test_builder_covers_all_trainables_and_compiles(builder, model, rs):
+    s = builder.build(model, rs)
+    assert len(s.graph_config.replicas) == 8
+    assert {n.var_name for n in s.node_config} == {v.name for v in model.trainable_variables}
+    compiled = StrategyCompiler(model).compile(s)
+    assert compiled is s
+    # Serialization round-trip for every builder output.
+    s2 = type(s).from_json(s.to_json())
+    assert s2.to_json() == s.to_json()
+
+
+def test_divisor_policies():
+    # min non-trivial divisor (partitioned_ps_strategy.py:125-135)
+    assert min_divisor_shards(12) == 2
+    assert min_divisor_shards(9) == 3
+    assert min_divisor_shards(7) == 7  # prime → itself
+    assert min_divisor_shards(1) == 1
+    # smallest non-divisor (uneven_partition_ps_strategy.py:128-137)
+    assert min_non_divisor_shards(12) == 5
+    assert min_non_divisor_shards(8) == 3
+    assert min_non_divisor_shards(2) == 3  # deviates from reference quirk (even split)
+
+
+def test_ps_single_destination(model, rs):
+    s = PS().build(model, rs)
+    dests = {n.synchronizer.reduction_destination for n in s.node_config}
+    assert dests == {"10.0.0.1:CPU:0"}  # chief CPU only
+
+
+def test_ps_staleness_requires_sync():
+    with pytest.raises(AssertionError):
+        PS(sync=False, staleness=1)
+
+
+def test_ps_lb_greedy_balance(rs):
+    # Greedy byte-size balancing: many equal vars spread evenly.
+    model = ModelItem([VarItem(f"v{i}", (4, 4), "float32") for i in range(10)])
+    builder = PSLoadBalancing()
+    builder.build(model, rs)
+    loads = sorted(builder.loads.values())
+    assert loads[0] == pytest.approx(loads[-1], rel=0.25)  # 5 vars each
+
+
+def test_partitioned_ps_shard_policy(model, rs):
+    s = PartitionedPS().build(model, rs)
+    kernel = s.node_config_for("dense/kernel")
+    assert kernel.partitioner == "2,1"  # dim0=12 → min divisor 2
+    assert len(kernel.part_config) == 2
+    assert kernel.part_config[0].var_name == "dense/kernel/part_0"
+    embed = s.node_config_for("embed/embedding")
+    assert embed.partitioner == "2,1"  # dim0=100 → 2
+    bias = s.node_config_for("dense/bias")
+    assert bias.partitioner == "2"  # dim0=8 → 2
+    scalar = s.node_config_for("scalar")
+    assert scalar.partitioner == ""  # scalars unpartitioned
+
+
+def test_partitioned_ps_round_robin_placement(rs):
+    # 7 shards over 2 reduction devices → round-robin in greedy order
+    # (partitioned_ps_strategy.py:88-96).
+    model = ModelItem([VarItem("v", (7, 2), "float32")])
+    s = PartitionedPS().build(model, rs)
+    node = s.node_config_for("v")
+    assert node.partitioner == "7,1"  # 7 is prime → 7 shards
+    dests = [p.synchronizer.reduction_destination for p in node.part_config]
+    assert len(dests) == 7
+    assert set(dests) == {"10.0.0.1:CPU:0", "10.0.0.2:CPU:0"}
+
+
+def test_uneven_partitioned_ps(model, rs):
+    s = UnevenPartitionedPS().build(model, rs)
+    kernel = s.node_config_for("dense/kernel")
+    assert kernel.partitioner == "5,1"  # dim0=12 → smallest non-divisor 5
+
+
+def test_allreduce_grouping(model, rs):
+    s = AllReduce(chunk_size=2).build(model, rs)
+    groups = [n.synchronizer.group for n in s.node_config]
+    assert groups == [0, 0, 1, 1]
+    assert all(isinstance(n.synchronizer, AllReduceSynchronizer) for n in s.node_config)
+
+
+def test_allreduce_chunk_size_validation():
+    with pytest.raises(ValueError, match="chunk_size"):
+        AllReduce(chunk_size=0)
+
+
+def test_partitioned_ar_group_advance(rs):
+    # Shard group ids advance per-shard (partitioned_all_reduce_strategy.py:113-118).
+    model = ModelItem([VarItem("a", (4, 2), "float32"), VarItem("b", (6, 2), "float32")])
+    s = PartitionedAR(chunk_size=2).build(model, rs)
+    a = s.node_config_for("a")
+    assert a.partitioner == "2,1"
+    assert [p.synchronizer.group for p in a.part_config] == [0, 0]
+    b = s.node_config_for("b")
+    # var_counter is 2 after a's shards → b's shards get groups (2+0)//2, (2+1)//2
+    assert [p.synchronizer.group for p in b.part_config] == [1, 1]
+
+
+def test_random_axis_ar_sparse_forced_axis0(model, rs):
+    s = RandomAxisPartitionAR(seed=42).build(model, rs)
+    embed = s.node_config_for("embed/embedding")
+    assert embed.active_partition_axis == 0  # sparse → axis 0 forced
+
+
+def test_random_axis_ar_deterministic_with_seed(model, rs):
+    s1 = RandomAxisPartitionAR(seed=7).build(model, rs)
+    s2 = RandomAxisPartitionAR(seed=7).build(model, rs)
+    assert [n.partitioner for n in s1.node_config] == [n.partitioner for n in s2.node_config]
+
+
+def test_parallax_dense_sparse_dispatch(model, rs):
+    s = Parallax(chunk_size=2).build(model, rs)
+    assert isinstance(s.node_config_for("dense/kernel").synchronizer, AllReduceSynchronizer)
+    assert isinstance(s.node_config_for("dense/bias").synchronizer, AllReduceSynchronizer)
+    embed = s.node_config_for("embed/embedding")
+    assert isinstance(embed.synchronizer, PSSynchronizer)
+    assert not embed.synchronizer.local_replication  # sparse never proxied
+
+
+def test_compiler_prunes_non_trainable(rs, model):
+    s = AllReduce().build(model, rs)
+    s.node_config.append(
+        type(s.node_config[0])(var_name="not_a_var", synchronizer=AllReduceSynchronizer())
+    )
+    compiled = StrategyCompiler(model).compile(s)
+    assert all(n.var_name != "not_a_var" for n in compiled.node_config)
+
+
+def test_compiler_missing_config_rejected(rs, model):
+    s = AllReduce().build(model, rs)
+    s.node_config = s.node_config[:-1]
+    with pytest.raises(ValueError, match="no node config"):
+        StrategyCompiler(model).compile(s)

@@ -1,0 +1,130 @@
+"""Unit tests for strategy lowering → sharding plans."""
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from autodist_tpu.kernel import GraphTransformer, SyncKind, build_mesh
+from autodist_tpu.model_item import ModelItem, OptimizerSpec, VarItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import (
+    AllReduce,
+    PS,
+    PSLoadBalancing,
+    Parallax,
+    PartitionedAR,
+    PartitionedPS,
+    StrategyCompiler,
+)
+
+
+@pytest.fixture
+def rs():
+    return ResourceSpec(resource_dict={"nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+
+
+@pytest.fixture
+def model():
+    return ModelItem(
+        [
+            VarItem("dense/kernel", (16, 8), "float32"),
+            VarItem("dense/bias", (8,), "float32"),
+            VarItem("embed/embedding", (96, 16), "float32", sparse_update=True),
+        ],
+        optimizer_spec=OptimizerSpec("sgd", {"learning_rate": 0.1}),
+    )
+
+
+def make_plan(builder, model, rs):
+    strategy = StrategyCompiler(model).compile(builder.build(model, rs))
+    mesh = build_mesh(rs)
+    return GraphTransformer(strategy, model, mesh).transform()
+
+
+def test_allreduce_lowering_replicates_params(model, rs):
+    plan = make_plan(AllReduce(), model, rs)
+    for name in ("dense/kernel", "dense/bias", "embed/embedding"):
+        assert plan.plan_for(name).pspec == P()
+        assert plan.plan_for(name).kind is SyncKind.ALL_REDUCE
+
+
+def test_ps_lowering_weight_update_sharding(model, rs):
+    plan = make_plan(PS(), model, rs)
+    kernel = plan.plan_for("dense/kernel")
+    assert kernel.kind is SyncKind.PS
+    assert kernel.pspec == P()  # param replicated
+    assert kernel.update_pspec == P("data", None)  # 16 % 8 == 0 → axis 0
+    bias = plan.plan_for("dense/bias")
+    assert bias.update_pspec == P("data")  # 8 % 8 == 0
+    # sparse embedding → row-sharded param
+    embed = plan.plan_for("embed/embedding")
+    assert embed.pspec == P("data", None)
+
+
+def test_partitioned_ps_lowering_shards_param(model, rs):
+    plan = make_plan(PartitionedPS(), model, rs)
+    kernel = plan.plan_for("dense/kernel")
+    assert kernel.pspec == P("data", None)  # partitioner "2,1" → axis 0 sharded
+    assert kernel.num_shards == 2
+
+
+def test_partitioned_ar_lowering(model, rs):
+    plan = make_plan(PartitionedAR(), model, rs)
+    kernel = plan.plan_for("dense/kernel")
+    assert kernel.kind is SyncKind.ALL_REDUCE
+    assert kernel.pspec == P("data", None)
+
+
+def test_parallax_lowering(model, rs):
+    plan = make_plan(Parallax(), model, rs)
+    assert plan.plan_for("dense/kernel").pspec == P()
+    assert plan.plan_for("embed/embedding").pspec == P("data", None)
+    assert plan.has_sparse_ps
+
+
+def test_model_axis_preferred_when_present(model):
+    rs2 = ResourceSpec(
+        resource_dict={
+            "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+            "mesh": {"data": 4, "model": 2},
+        }
+    )
+    plan = make_plan(PartitionedPS(), model, rs2)
+    assert plan.plan_for("dense/kernel").pspec == P("model", None)
+
+
+def test_mesh_size_mismatch_rejected():
+    rs_bad = ResourceSpec(resource_dict={"nodes": [{"address": "localhost", "chips": 4, "chief": True}]})
+    with pytest.raises(ValueError, match="resource spec and runtime disagree"):
+        build_mesh(rs_bad)
+
+
+def test_batch_shardings_divisibility(model, rs):
+    plan = make_plan(AllReduce(), model, rs)
+    batch = {"x": jnp.zeros((16, 4)), "y": jnp.zeros((16,))}
+    sh = plan.batch_shardings(batch)
+    assert sh["x"].spec == P("data")
+    with pytest.raises(ValueError, match="not divisible"):
+        plan.batch_shardings({"x": jnp.zeros((12, 4))})
+
+
+def test_opt_shardings_match_slots(model, rs):
+    import optax
+
+    plan = make_plan(PS(), model, rs)
+    params = {
+        "dense": {"kernel": jnp.zeros((16, 8)), "bias": jnp.zeros((8,))},
+        "embed": {"embedding": jnp.zeros((96, 16))},
+    }
+    tx = optax.adam(1e-3)
+    opt_shapes = jax.eval_shape(tx.init, params)
+    sh = plan.opt_shardings(opt_shapes)
+    leaves = jax.tree_util.tree_flatten_with_path(sh)[0]
+    specs = {"/".join(str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p)))) for p in path): s.spec
+             for path, s in leaves}
+    # mu/nu slots for kernel get the weight-update sharding
+    mu_kernel = [s.spec for path, s in leaves if "mu" in str(path) and "kernel" in str(path)]
+    assert mu_kernel and all(spec == P("data", None) for spec in mu_kernel)
+    # scalar count leaves replicated
+    counts = [s.spec for path, s in leaves if "count" in str(path)]
+    assert all(spec == P() for spec in counts)
