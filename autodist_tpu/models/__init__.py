@@ -1,0 +1,31 @@
+"""Model zoo (L6 workloads) — the benchmark models the reference ships.
+
+The reference vendors ~12.9k LoC of TF official-models code for its benchmarks
+(``/root/reference/examples/benchmark/{imagenet,bert,ncf}.py``,
+``examples/lm1b/language_model.py``). Here each workload is a compact
+pure-JAX functional model: ``init(rng) -> params`` pytree plus
+``loss_fn(params, batch) -> scalar``, which is exactly the capture format the
+user API consumes (:meth:`autodist_tpu.api.AutoDist.build`). Keeping models
+functional (no framework module system) makes parameter names deterministic
+pytree paths — what strategy builders key on.
+"""
+from autodist_tpu.models.spec import ModelSpec, get_model, register_model
+from autodist_tpu.models import layers
+from autodist_tpu.models.mlp import mlp_model
+from autodist_tpu.models.transformer import TransformerConfig, transformer_lm
+from autodist_tpu.models.resnet import resnet
+from autodist_tpu.models.lstm_lm import lstm_lm
+from autodist_tpu.models.ncf import neumf
+
+__all__ = [
+    "ModelSpec",
+    "get_model",
+    "register_model",
+    "layers",
+    "mlp_model",
+    "TransformerConfig",
+    "transformer_lm",
+    "resnet",
+    "lstm_lm",
+    "neumf",
+]

@@ -1,0 +1,146 @@
+"""Functional NN layers shared by the model zoo.
+
+Pure functions over explicit param dicts: deterministic pytree paths (what
+strategy builders key on), bfloat16-friendly compute, and shapes that keep
+matmuls on the MXU (feature dims padded by the caller, not here).
+"""
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+# ------------------------------------------------------------------ initializers
+def glorot(rng, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+def he_normal(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    std = math.sqrt(2.0 / fan_in)
+    return jax.random.normal(rng, shape, dtype) * std
+
+
+def normal(rng, shape, stddev=0.02, dtype=jnp.float32):
+    return jax.random.normal(rng, shape, dtype) * stddev
+
+
+def _fans(shape) -> Tuple[int, int]:
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    receptive = int(np.prod(shape[:-2])) if len(shape) > 2 else 1
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+# ------------------------------------------------------------------------ dense
+def dense_init(rng, in_dim: int, out_dim: int, use_bias: bool = True):
+    p = {"kernel": glorot(rng, (in_dim, out_dim))}
+    if use_bias:
+        p["bias"] = jnp.zeros((out_dim,))
+    return p
+
+
+def dense(p, x, *, compute_dtype=None):
+    k = p["kernel"]
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+        k = k.astype(compute_dtype)
+    y = x @ k
+    if "bias" in p:
+        y = y + p["bias"].astype(y.dtype)
+    return y
+
+
+# -------------------------------------------------------------------- layernorm
+def layernorm_init(dim: int):
+    return {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))}
+
+
+def layernorm(p, x, eps: float = 1e-6):
+    # Normalize in fp32 regardless of compute dtype (numerics on TPU bf16).
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(-1, keepdims=True)
+    var = ((x32 - mean) ** 2).mean(-1, keepdims=True)
+    y = (x32 - mean) * lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+# -------------------------------------------------------------------- embedding
+def embedding_init(rng, vocab: int, dim: int, stddev: float = 0.02):
+    return {"embedding": normal(rng, (vocab, dim), stddev)}
+
+
+def embedding_lookup(p, ids):
+    """Row gather — the sparse-update path. ``jnp.take`` lowers to a
+    ``gather`` primitive, which ModelItem's jaxpr scan detects as a
+    sparse-update read (the reference's IndexedSlices analog,
+    ``/root/reference/autodist/graph_item.py:275-296``)."""
+    return jnp.take(p["embedding"], ids, axis=0)
+
+
+# ------------------------------------------------------------------------- conv
+def conv_init(rng, kh: int, kw: int, cin: int, cout: int):
+    return {"kernel": he_normal(rng, (kh, kw, cin, cout))}
+
+
+def conv(p, x, stride: int = 1, padding: str = "SAME", *, compute_dtype=None):
+    """NHWC conv; kernel HWIO. Large convs are MXU work — XLA tiles them."""
+    k = p["kernel"]
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+        k = k.astype(compute_dtype)
+    return lax.conv_general_dilated(
+        x, k,
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+# -------------------------------------------------------------------- batchnorm
+def batchnorm_init(dim: int):
+    return {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))}
+
+
+def batchnorm(p, x, eps: float = 1e-5):
+    """Training-mode batch norm over N,H,W (batch statistics only).
+
+    Running averages are an inference concern; the training hot loop — what
+    the benchmarks measure — always uses batch stats, so they are omitted
+    from the differentiable path. Under data parallelism the stats are
+    per-shard (the reference behaved identically: each replica normalized
+    its own split batch)."""
+    x32 = x.astype(jnp.float32)
+    axes = tuple(range(x.ndim - 1))
+    mean = x32.mean(axes)
+    var = x32.var(axes)
+    y = (x32 - mean) * lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+# ----------------------------------------------------------------------- losses
+def softmax_xent(logits, labels):
+    """Mean cross-entropy. Under pjit with batch sharded on the data axis the
+    mean induces the gradient ``psum`` — the AllReduce synchronizer's job in
+    the reference (``all_reduce_synchronizer.py:100-126``) done by autodiff."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logit = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return (logz - label_logit).mean()
+
+
+def sigmoid_xent(logits, labels):
+    logits = logits.astype(jnp.float32)
+    labels = labels.astype(jnp.float32)
+    return jnp.mean(
+        jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )

@@ -1,0 +1,101 @@
+"""LM1B-style LSTM language model — the reference's sparse-gradient showcase
+(``/root/reference/examples/lm1b/language_model.py:66,88``: embedding_lookup +
+sampled_softmax_loss produce IndexedSlices grads, the Parallax strategy's
+target workload).
+
+TPU-native shape: the time loop is ``lax.scan`` (static trip count, compiles
+once); the embedding table is read via gather (detected as sparse-update by
+ModelItem) and large enough that PS-style row sharding matters.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from autodist_tpu.models import layers as L
+from autodist_tpu.models.spec import ModelSpec, register_model
+
+
+def _lstm_cell_init(rng, in_dim: int, hidden: int):
+    # One fused kernel for the 4 gates: [in+hidden, 4*hidden] keeps the
+    # per-step matmul big enough for the MXU.
+    k1, k2 = jax.random.split(rng)
+    return {
+        "kernel": L.glorot(k1, (in_dim + hidden, 4 * hidden)),
+        "bias": jnp.zeros((4 * hidden,)),
+        "proj": L.glorot(k2, (hidden, hidden)),
+    }
+
+
+def _lstm_cell(p, carry, x, dtype):
+    h, c = carry
+    z = jnp.concatenate([x, h], axis=-1).astype(dtype) @ p["kernel"].astype(dtype)
+    z = z.astype(jnp.float32) + p["bias"]
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    h = (h.astype(dtype) @ p["proj"].astype(dtype)).astype(jnp.float32)
+    return (h, c), h
+
+
+def init_params(rng, vocab: int, embed_dim: int, hidden: int, num_layers: int) -> Dict[str, Any]:
+    keys = jax.random.split(rng, num_layers + 2)
+    params: Dict[str, Any] = {
+        "embed": L.embedding_init(keys[0], vocab, embed_dim),
+        "softmax": {
+            "kernel": L.glorot(keys[1], (hidden, vocab)),
+            "bias": jnp.zeros((vocab,)),
+        },
+    }
+    for i in range(num_layers):
+        in_dim = embed_dim if i == 0 else hidden
+        params[f"lstm_{i}"] = _lstm_cell_init(keys[i + 2], in_dim, hidden)
+    return params
+
+
+def forward(params, tokens, num_layers: int, hidden: int, dtype=jnp.bfloat16):
+    """tokens [B, S] -> logits [B, S, V]."""
+    b, s = tokens.shape
+    x = L.embedding_lookup(params["embed"], tokens)  # [B, S, E] — sparse read
+    x = jnp.swapaxes(x, 0, 1)  # scan over time: [S, B, E]
+    for i in range(num_layers):
+        cell = params[f"lstm_{i}"]
+        carry = (jnp.zeros((b, hidden)), jnp.zeros((b, hidden)))
+        carry, x = lax.scan(lambda cr, xt: _lstm_cell(cell, cr, xt, dtype), carry, x)
+    x = jnp.swapaxes(x, 0, 1)  # [B, S, H]
+    logits = x.astype(dtype) @ params["softmax"]["kernel"].astype(dtype)
+    return logits.astype(jnp.float32) + params["softmax"]["bias"]
+
+
+@register_model("lstm_lm")
+def lstm_lm(
+    vocab_size: int = 8192,
+    embed_dim: int = 512,
+    hidden: int = 1024,
+    num_layers: int = 2,
+    seq_len: int = 32,
+) -> ModelSpec:
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]
+        logits = forward(params, tokens[:, :-1], num_layers, hidden)
+        return L.softmax_xent(logits, tokens[:, 1:])
+
+    def example_batch(batch_size: int):
+        tokens = (
+            jnp.arange(batch_size * (seq_len + 1), dtype=jnp.int32)
+            .reshape(batch_size, seq_len + 1)
+            % vocab_size
+        )
+        return {"tokens": tokens}
+
+    return ModelSpec(
+        name="lstm_lm",
+        init=lambda rng: init_params(rng, vocab_size, embed_dim, hidden, num_layers),
+        loss_fn=loss_fn,
+        example_batch=example_batch,
+        apply=lambda p, t: forward(p, t, num_layers, hidden),
+        sparse_names=("embed",),
+    )

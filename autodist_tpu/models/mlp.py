@@ -1,0 +1,69 @@
+"""Small MLP / linear-regression workloads — the reference's minimal examples
+(``/root/reference/examples/linear_regression.py:15-37``, integration cases
+c0/c3). Used by the numeric-equivalence tests.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.models import layers as L
+from autodist_tpu.models.spec import ModelSpec, register_model
+
+
+@register_model("mlp")
+def mlp_model(
+    in_dim: int = 32,
+    hidden: Sequence[int] = (64, 64),
+    num_classes: int = 10,
+) -> ModelSpec:
+    dims = [in_dim, *hidden, num_classes]
+
+    def init(rng):
+        keys = jax.random.split(rng, len(dims) - 1)
+        return {
+            f"dense_{i}": L.dense_init(k, dims[i], dims[i + 1])
+            for i, k in enumerate(keys)
+        }
+
+    def apply(params, x):
+        for i in range(len(dims) - 1):
+            x = L.dense(params[f"dense_{i}"], x)
+            if i < len(dims) - 2:
+                x = jax.nn.relu(x)
+        return x
+
+    def loss_fn(params, batch):
+        return L.softmax_xent(apply(params, batch["x"]), batch["y"])
+
+    def example_batch(batch_size: int):
+        x = jnp.linspace(-1.0, 1.0, batch_size * in_dim).reshape(batch_size, in_dim)
+        y = (jnp.arange(batch_size) % num_classes).astype(jnp.int32)
+        return {"x": x, "y": y}
+
+    return ModelSpec("mlp", init, loss_fn, example_batch, apply=apply)
+
+
+@register_model("linear_regression")
+def linear_regression(in_dim: int = 8) -> ModelSpec:
+    """y = x@w + b with MSE loss — the c0 numeric-assertion workload
+    (``tests/integration/cases/c0.py:90-121`` in the reference)."""
+
+    def init(rng):
+        return {"w": jnp.zeros((in_dim, 1)), "b": jnp.zeros((1,))}
+
+    def apply(params, x):
+        return x @ params["w"] + params["b"]
+
+    def loss_fn(params, batch):
+        pred = apply(params, batch["x"])[..., 0]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    def example_batch(batch_size: int):
+        x = jnp.linspace(0.0, 1.0, batch_size * in_dim).reshape(batch_size, in_dim)
+        y = x.sum(-1)
+        return {"x": x, "y": y}
+
+    return ModelSpec("linear_regression", init, loss_fn, example_batch, apply=apply)

@@ -1,0 +1,75 @@
+"""NCF / NeuMF recommender — the reference's MovieLens benchmark
+(``/root/reference/examples/benchmark/ncf.py`` + ``utils/recommendation/**``).
+NeuMF = GMF (elementwise product of user/item embeddings) + MLP tower over
+concatenated embeddings, sigmoid cross-entropy on implicit feedback. Four
+embedding tables — all sparse-update, the PS load-balancing stress case.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.models import layers as L
+from autodist_tpu.models.spec import ModelSpec, register_model
+
+
+def init_params(
+    rng, num_users: int, num_items: int, mf_dim: int, mlp_dims: Sequence[int]
+) -> Dict[str, Any]:
+    keys = jax.random.split(rng, 5 + len(mlp_dims))
+    params: Dict[str, Any] = {
+        "mf_user": L.embedding_init(keys[0], num_users, mf_dim, stddev=0.01),
+        "mf_item": L.embedding_init(keys[1], num_items, mf_dim, stddev=0.01),
+        "mlp_user": L.embedding_init(keys[2], num_users, mlp_dims[0] // 2, stddev=0.01),
+        "mlp_item": L.embedding_init(keys[3], num_items, mlp_dims[0] // 2, stddev=0.01),
+    }
+    for i in range(len(mlp_dims) - 1):
+        params[f"mlp_{i}"] = L.dense_init(keys[4 + i], mlp_dims[i], mlp_dims[i + 1])
+    params["head"] = L.dense_init(keys[-1], mf_dim + mlp_dims[-1], 1)
+    return params
+
+
+def forward(params, users, items, num_mlp_layers: int):
+    gmf = L.embedding_lookup(params["mf_user"], users) * L.embedding_lookup(
+        params["mf_item"], items
+    )
+    x = jnp.concatenate(
+        [
+            L.embedding_lookup(params["mlp_user"], users),
+            L.embedding_lookup(params["mlp_item"], items),
+        ],
+        axis=-1,
+    )
+    for i in range(num_mlp_layers):
+        x = jax.nn.relu(L.dense(params[f"mlp_{i}"], x))
+    return L.dense(params["head"], jnp.concatenate([gmf, x], axis=-1))[..., 0]
+
+
+@register_model("ncf")
+def neumf(
+    num_users: int = 6040,
+    num_items: int = 3706,
+    mf_dim: int = 64,
+    mlp_dims: Sequence[int] = (256, 256, 128, 64),
+) -> ModelSpec:
+    n_mlp = len(mlp_dims) - 1
+
+    def loss_fn(params, batch):
+        logits = forward(params, batch["users"], batch["items"], n_mlp)
+        return L.sigmoid_xent(logits, batch["labels"])
+
+    def example_batch(batch_size: int):
+        users = (jnp.arange(batch_size, dtype=jnp.int32) * 7) % num_users
+        items = (jnp.arange(batch_size, dtype=jnp.int32) * 13) % num_items
+        labels = (jnp.arange(batch_size) % 2).astype(jnp.float32)
+        return {"users": users, "items": items, "labels": labels}
+
+    return ModelSpec(
+        name="ncf",
+        init=lambda rng: init_params(rng, num_users, num_items, mf_dim, mlp_dims),
+        loss_fn=loss_fn,
+        example_batch=example_batch,
+        sparse_names=("mf_user", "mf_item", "mlp_user", "mlp_item"),
+    )

@@ -1,0 +1,140 @@
+"""ResNet image classifiers — the reference's ImageNet CNN benchmark family
+(``/root/reference/examples/benchmark/imagenet.py:52-66``: ResNet101, VGG16,
+DenseNet121, InceptionV3). ResNet-v1.5 bottleneck/basic variants in NHWC with
+bf16 conv compute — convs are MXU work.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.models import layers as L
+from autodist_tpu.models.spec import ModelSpec, register_model
+
+# depth -> (block kind, stage sizes)
+_CONFIGS: Dict[int, Tuple[str, List[int]]] = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def _basic_block_init(rng, cin, cout, stride):
+    k = jax.random.split(rng, 3)
+    p = {
+        "conv1": L.conv_init(k[0], 3, 3, cin, cout),
+        "bn1": L.batchnorm_init(cout),
+        "conv2": L.conv_init(k[1], 3, 3, cout, cout),
+        "bn2": L.batchnorm_init(cout),
+    }
+    if stride != 1 or cin != cout:
+        p["proj"] = L.conv_init(k[2], 1, 1, cin, cout)
+        p["bn_proj"] = L.batchnorm_init(cout)
+    return p
+
+
+def _bottleneck_init(rng, cin, cmid, stride):
+    cout = cmid * 4
+    k = jax.random.split(rng, 4)
+    p = {
+        "conv1": L.conv_init(k[0], 1, 1, cin, cmid),
+        "bn1": L.batchnorm_init(cmid),
+        "conv2": L.conv_init(k[1], 3, 3, cmid, cmid),
+        "bn2": L.batchnorm_init(cmid),
+        "conv3": L.conv_init(k[2], 1, 1, cmid, cout),
+        "bn3": L.batchnorm_init(cout),
+    }
+    if stride != 1 or cin != cout:
+        p["proj"] = L.conv_init(k[3], 1, 1, cin, cout)
+        p["bn_proj"] = L.batchnorm_init(cout)
+    return p
+
+
+def _basic_block(p, x, stride, dtype):
+    y = L.conv(p["conv1"], x, stride=stride, compute_dtype=dtype)
+    y = jax.nn.relu(L.batchnorm(p["bn1"], y))
+    y = L.conv(p["conv2"], y, compute_dtype=dtype)
+    y = L.batchnorm(p["bn2"], y)
+    sc = x
+    if "proj" in p:
+        sc = L.batchnorm(p["bn_proj"], L.conv(p["proj"], x, stride=stride, compute_dtype=dtype))
+    return jax.nn.relu(y + sc)
+
+
+def _bottleneck(p, x, stride, dtype):
+    y = jax.nn.relu(L.batchnorm(p["bn1"], L.conv(p["conv1"], x, compute_dtype=dtype)))
+    # ResNet-v1.5: stride lives on the 3x3 conv.
+    y = jax.nn.relu(L.batchnorm(p["bn2"], L.conv(p["conv2"], y, stride=stride, compute_dtype=dtype)))
+    y = L.batchnorm(p["bn3"], L.conv(p["conv3"], y, compute_dtype=dtype))
+    sc = x
+    if "proj" in p:
+        sc = L.batchnorm(p["bn_proj"], L.conv(p["proj"], x, stride=stride, compute_dtype=dtype))
+    return jax.nn.relu(y + sc)
+
+
+def init_params(rng, depth: int, num_classes: int, width: int = 64) -> Dict[str, Any]:
+    kind, stages = _CONFIGS[depth]
+    keys = jax.random.split(rng, sum(stages) + 2)
+    params: Dict[str, Any] = {
+        "stem": {"conv": L.conv_init(keys[0], 7, 7, 3, width), "bn": L.batchnorm_init(width)},
+    }
+    ki = 1
+    cin = width
+    for si, n_blocks in enumerate(stages):
+        cmid = width * (2 ** si)
+        for bi in range(n_blocks):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            if kind == "basic":
+                params[f"stage{si}_block{bi}"] = _basic_block_init(keys[ki], cin, cmid, stride)
+                cin = cmid
+            else:
+                params[f"stage{si}_block{bi}"] = _bottleneck_init(keys[ki], cin, cmid, stride)
+                cin = cmid * 4
+            ki += 1
+    params["head"] = L.dense_init(keys[ki], cin, num_classes)
+    return params
+
+
+def forward(params, images, depth: int, dtype=jnp.bfloat16):
+    """images [B, H, W, 3] -> logits [B, num_classes]."""
+    kind, stages = _CONFIGS[depth]
+    x = L.conv(params["stem"]["conv"], images, stride=2, compute_dtype=dtype)
+    x = jax.nn.relu(L.batchnorm(params["stem"]["bn"], x))
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    block = _basic_block if kind == "basic" else _bottleneck
+    for si, n_blocks in enumerate(stages):
+        for bi in range(n_blocks):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            x = block(params[f"stage{si}_block{bi}"], x, stride, dtype)
+    x = x.mean(axis=(1, 2))
+    return L.dense(params["head"], x).astype(jnp.float32)
+
+
+@register_model("resnet")
+def resnet(depth: int = 50, num_classes: int = 1000, image_size: int = 224) -> ModelSpec:
+    def loss_fn(params, batch):
+        return L.softmax_xent(forward(params, batch["images"], depth), batch["labels"])
+
+    def example_batch(batch_size: int):
+        images = jnp.linspace(
+            0.0, 1.0, batch_size * image_size * image_size * 3
+        ).reshape(batch_size, image_size, image_size, 3)
+        labels = (jnp.arange(batch_size) % num_classes).astype(jnp.int32)
+        return {"images": images, "labels": labels}
+
+    # ~4.1 GFLOPs fwd for ResNet-50 @224; scale by depth-ish factor; x3 fwd+bwd.
+    fwd_gflops = {18: 1.8e9, 34: 3.7e9, 50: 4.1e9, 101: 7.8e9, 152: 11.6e9}[depth]
+    return ModelSpec(
+        name=f"resnet{depth}",
+        init=lambda rng: init_params(rng, depth, num_classes),
+        loss_fn=loss_fn,
+        example_batch=example_batch,
+        apply=lambda p, x: forward(p, x, depth),
+        flops_per_example=3.0 * fwd_gflops * (image_size / 224.0) ** 2,
+    )

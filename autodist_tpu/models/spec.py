@@ -1,0 +1,43 @@
+"""ModelSpec: the uniform workload contract consumed by the user API.
+
+A model is ``init(rng) -> params`` + ``loss_fn(params, batch) -> scalar`` +
+``example_batch(batch_size)``. This is the TPU-native analog of the
+reference's "user builds a graph inside scope()" capture
+(``/root/reference/autodist/autodist.py:309-322``) — a pure pytree/function
+pair instead of a mutable graph.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+_MODEL_REGISTRY: Dict[str, Callable[..., "ModelSpec"]] = {}
+
+
+@dataclass
+class ModelSpec:
+    """One benchmark workload, ready to hand to ``AutoDist.build``."""
+
+    name: str
+    init: Callable[[Any], Any]                  # rng -> params pytree
+    loss_fn: Callable[[Any, Any], Any]          # (params, batch) -> scalar loss
+    example_batch: Callable[[int], Any]         # batch_size -> batch pytree
+    apply: Optional[Callable[..., Any]] = None  # (params, inputs) -> outputs
+    sparse_names: tuple = ()                    # force-marked sparse params
+    config: Any = None
+    # FLOPs of one forward+backward pass per example, for MFU accounting
+    # (None = unknown).
+    flops_per_example: Optional[float] = None
+
+
+def register_model(name: str):
+    def deco(factory: Callable[..., ModelSpec]):
+        _MODEL_REGISTRY[name] = factory
+        return factory
+    return deco
+
+
+def get_model(name: str, **kwargs) -> ModelSpec:
+    if name not in _MODEL_REGISTRY:
+        raise ValueError(f"unknown model {name!r}; known: {sorted(_MODEL_REGISTRY)}")
+    return _MODEL_REGISTRY[name](**kwargs)

@@ -1,0 +1,212 @@
+"""Transformer language model — the flagship workload.
+
+Covers the reference's BERT benchmark slot (``/root/reference/examples/
+benchmark/bert.py:40-49`` + ``utils/modeling/**``) as a compact pure-JAX
+transformer: causal (GPT-style next-token) or bidirectional (BERT-style MLM)
+loss, tied input/output embeddings, pre-norm blocks.
+
+TPU-first choices:
+- compute in bfloat16 (params fp32, matmuls bf16) — MXU-native;
+- attention impl selectable: ``dot`` (XLA fused), ``flash`` (pallas kernel,
+  :mod:`autodist_tpu.ops.flash_attention`), ``ring`` (sequence-parallel ring
+  attention, :mod:`autodist_tpu.parallel.ring_attention`);
+- optional ``jax.checkpoint`` per block (remat trades FLOPs for HBM);
+- static shapes everywhere; the layer stack is a Python loop over identical
+  blocks so XLA can pipeline it.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.models import layers as L
+from autodist_tpu.models.spec import ModelSpec, register_model
+
+
+@dataclass
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    d_model: int = 768
+    num_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    causal: bool = True                 # False => BERT-style MLM
+    dtype: Any = jnp.bfloat16           # compute dtype (params stay fp32)
+    attention_impl: str = "dot"         # dot | flash | ring
+    remat: bool = False
+    mlm_mask_token: int = 0             # [MASK] id for the MLM objective
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.num_heads == 0
+        return self.d_model // self.num_heads
+
+    def param_count(self) -> int:
+        d, f, v, l_ = self.d_model, self.d_ff, self.vocab_size, self.num_layers
+        # 4 attn kernels + 2 mlp kernels + attn biases + mlp biases + 2 LNs
+        per_layer = 4 * d * d + 2 * d * f + 4 * d + (f + d) + 4 * d
+        return v * d + self.max_seq_len * d + l_ * per_layer + 2 * d
+
+    def flops_per_example(self, seq_len: Optional[int] = None) -> float:
+        """fwd+bwd FLOPs per sequence: 3x forward; forward = 2*P*s matmul
+        FLOPs + attention 4*s^2*d per layer."""
+        s = seq_len or self.max_seq_len
+        fwd = 2.0 * self.param_count() * s + 4.0 * self.num_layers * s * s * self.d_model
+        return 3.0 * fwd
+
+
+# ---------------------------------------------------------------------- params
+def init_params(rng, cfg: TransformerConfig) -> Dict[str, Any]:
+    keys = jax.random.split(rng, cfg.num_layers + 2)
+    params: Dict[str, Any] = {
+        "embed": L.embedding_init(keys[0], cfg.vocab_size, cfg.d_model),
+        "pos_embed": L.embedding_init(keys[1], cfg.max_seq_len, cfg.d_model),
+        "ln_f": L.layernorm_init(cfg.d_model),
+    }
+    for i in range(cfg.num_layers):
+        k = jax.random.split(keys[i + 2], 6)
+        params[f"layers_{i}"] = {
+            "ln1": L.layernorm_init(cfg.d_model),
+            "attn": {
+                "wq": L.dense_init(k[0], cfg.d_model, cfg.d_model),
+                "wk": L.dense_init(k[1], cfg.d_model, cfg.d_model),
+                "wv": L.dense_init(k[2], cfg.d_model, cfg.d_model),
+                "wo": L.dense_init(k[3], cfg.d_model, cfg.d_model),
+            },
+            "ln2": L.layernorm_init(cfg.d_model),
+            "mlp": {
+                "fc1": L.dense_init(k[4], cfg.d_model, cfg.d_ff),
+                "fc2": L.dense_init(k[5], cfg.d_ff, cfg.d_model),
+            },
+        }
+    return params
+
+
+# --------------------------------------------------------------------- forward
+def _dot_attention(q, k, v, causal: bool):
+    """Plain fused attention: softmax(QK^T/sqrt(d))V, fp32 softmax."""
+    head_dim = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    logits = logits / jnp.sqrt(head_dim).astype(jnp.float32)
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _attention(q, k, v, cfg: TransformerConfig):
+    if cfg.attention_impl == "dot":
+        return _dot_attention(q, k, v, cfg.causal)
+    if cfg.attention_impl == "flash":
+        from autodist_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=cfg.causal)
+    if cfg.attention_impl == "ring":
+        from autodist_tpu.parallel.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, causal=cfg.causal)
+    raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
+
+
+def _block(block_params, x, cfg: TransformerConfig):
+    b, s, _ = x.shape
+    h = L.layernorm(block_params["ln1"], x)
+    attn_p = block_params["attn"]
+    q = L.dense(attn_p["wq"], h, compute_dtype=cfg.dtype)
+    k = L.dense(attn_p["wk"], h, compute_dtype=cfg.dtype)
+    v = L.dense(attn_p["wv"], h, compute_dtype=cfg.dtype)
+    q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(b, s, cfg.num_heads, cfg.head_dim)
+    v = v.reshape(b, s, cfg.num_heads, cfg.head_dim)
+    o = _attention(q, k, v, cfg).reshape(b, s, cfg.d_model)
+    x = x + L.dense(attn_p["wo"], o, compute_dtype=cfg.dtype)
+
+    h = L.layernorm(block_params["ln2"], x)
+    h = L.dense(block_params["mlp"]["fc1"], h, compute_dtype=cfg.dtype)
+    h = jax.nn.gelu(h)
+    h = L.dense(block_params["mlp"]["fc2"], h, compute_dtype=cfg.dtype)
+    return x + h
+
+
+def forward(params, tokens, cfg: TransformerConfig):
+    """tokens [B, S] int32 -> logits [B, S, V] (fp32)."""
+    b, s = tokens.shape
+    x = L.embedding_lookup(params["embed"], tokens).astype(cfg.dtype)
+    pos = jnp.arange(s)
+    x = x + L.embedding_lookup(params["pos_embed"], pos).astype(cfg.dtype)
+    block = partial(_block, cfg=cfg)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+    for i in range(cfg.num_layers):
+        x = block(params[f"layers_{i}"], x)
+    x = L.layernorm(params["ln_f"], x)
+    # Tied output embedding: one big [B*S, D] x [D, V] matmul on the MXU.
+    logits = x.astype(cfg.dtype) @ params["embed"]["embedding"].T.astype(cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: TransformerConfig):
+    if cfg.causal:
+        tokens = batch["tokens"]
+        logits = forward(params, tokens[:, :-1], cfg)
+        return L.softmax_xent(logits, tokens[:, 1:])
+    # MLM: corrupt masked positions with [MASK], predict the original ids.
+    mask = batch["mlm_mask"]
+    inputs = jnp.where(mask.astype(bool), cfg.mlm_mask_token, batch["tokens"])
+    logits = forward(params, inputs, cfg)
+    mask = mask.astype(jnp.float32)  # 1 where masked
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logit = jnp.take_along_axis(logits, batch["labels"][..., None], axis=-1)[..., 0]
+    per_tok = (logz - label_logit) * mask
+    return per_tok.sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+# ------------------------------------------------------------------- modelspec
+@register_model("transformer")
+def transformer_lm(**overrides) -> ModelSpec:
+    cfg = TransformerConfig(**overrides)
+
+    def example_batch(batch_size: int):
+        s = cfg.max_seq_len
+        tokens = (jnp.arange(batch_size * s, dtype=jnp.int32).reshape(batch_size, s)
+                  % cfg.vocab_size)
+        if cfg.causal:
+            return {"tokens": tokens}
+        mask = (jnp.arange(s) % 7 == 0).astype(jnp.int32)
+        return {
+            "tokens": tokens,
+            "labels": tokens,
+            "mlm_mask": jnp.broadcast_to(mask, (batch_size, s)),
+        }
+
+    return ModelSpec(
+        name="transformer",
+        init=lambda rng: init_params(rng, cfg),
+        loss_fn=lambda p, b: loss_fn(p, b, cfg),
+        example_batch=example_batch,
+        apply=lambda p, tokens: forward(p, tokens, cfg),
+        config=cfg,
+        flops_per_example=cfg.flops_per_example(),
+    )
+
+
+@register_model("bert_base")
+def bert_base(**overrides) -> ModelSpec:
+    """BERT-base MLM pretraining config (the reference's BERT benchmark slot,
+    examples/benchmark/bert.py)."""
+    kw = dict(
+        vocab_size=30522, num_layers=12, d_model=768, num_heads=12,
+        d_ff=3072, max_seq_len=128, causal=False,
+    )
+    kw.update(overrides)
+    spec = transformer_lm(**kw)
+    spec.name = "bert_base"
+    return spec

@@ -1,0 +1,73 @@
+"""Model-zoo tests: init + loss + gradient flow for every registered model,
+and an end-to-end AutoDist build for each (the reference's integration matrix
+of cases × strategies, tests/integration/test_all.py:20-75, shrunk to smoke
+size)."""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from autodist_tpu.api import AutoDist
+from autodist_tpu.models import get_model
+from autodist_tpu.model_item import ModelItem
+
+SMALL = {
+    "mlp": {},
+    "linear_regression": {},
+    "transformer": dict(vocab_size=128, num_layers=2, d_model=32, num_heads=4,
+                        d_ff=64, max_seq_len=16),
+    "bert_base": dict(vocab_size=128, num_layers=2, d_model=32, num_heads=4,
+                      d_ff=64, max_seq_len=16),
+    "resnet": dict(depth=18, num_classes=10, image_size=32),
+    "lstm_lm": dict(vocab_size=64, embed_dim=16, hidden=32, num_layers=1, seq_len=8),
+    "ncf": dict(num_users=40, num_items=24, mf_dim=8, mlp_dims=(16, 16, 8)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(SMALL))
+def test_model_loss_and_grads(name):
+    spec = get_model(name, **SMALL[name])
+    params = spec.init(jax.random.PRNGKey(0))
+    batch = spec.example_batch(8)
+    loss, grads = jax.value_and_grad(spec.loss_fn)(params, batch)
+    assert jnp.isfinite(loss), f"{name} loss not finite"
+    norms = [jnp.linalg.norm(g) for g in jax.tree.leaves(grads)]
+    assert all(jnp.isfinite(n) for n in norms)
+    assert any(n > 0 for n in norms), f"{name} has no gradient signal"
+
+
+@pytest.mark.parametrize("name", ["lstm_lm", "ncf"])
+def test_sparse_detection(name):
+    """Embedding tables must be auto-detected as sparse-update (the
+    reference's IndexedSlices contract, graph_item.py:275-296)."""
+    spec = get_model(name, **SMALL[name])
+    params = spec.init(jax.random.PRNGKey(0))
+    item = ModelItem.from_params(
+        params, loss_fn=spec.loss_fn, example_batch=spec.example_batch(4)
+    )
+    sparse = {v.name for v in item.sparse_variables}
+    embeds = {v.name for v in item.variables if "embed" in v.name.lower()
+              or v.name.startswith(("mf_", "mlp_user", "mlp_item"))}
+    embed_tables = {n for n in embeds if n.endswith("embedding")}
+    assert embed_tables and embed_tables <= sparse, (embed_tables, sparse)
+
+
+@pytest.mark.parametrize("name", sorted(SMALL))
+def test_end_to_end_build(name):
+    """Every model trains one step through the full AutoDist pipeline on the
+    8-device mesh, and loss decreases over a few steps."""
+    AutoDist.reset_default()
+    try:
+        ad = AutoDist()
+        spec = get_model(name, **SMALL[name])
+        params = spec.init(jax.random.PRNGKey(0))
+        batch = spec.example_batch(16)
+        step = ad.build(spec.loss_fn, params, batch, sparse_names=spec.sparse_names)
+        state = step.init(params)
+        losses = []
+        for _ in range(3):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert all(jnp.isfinite(l) for l in losses)
+        assert losses[-1] <= losses[0], f"{name} loss did not decrease: {losses}"
+    finally:
+        AutoDist.reset_default()
