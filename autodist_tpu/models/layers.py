@@ -128,14 +128,19 @@ def batchnorm(p, x, eps: float = 1e-5):
 
 
 # ----------------------------------------------------------------------- losses
+def per_token_xent(logits, labels):
+    """Per-position cross-entropy (fp32 logsumexp), no reduction."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logit = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return logz - label_logit
+
+
 def softmax_xent(logits, labels):
     """Mean cross-entropy. Under pjit with batch sharded on the data axis the
     mean induces the gradient ``psum`` — the AllReduce synchronizer's job in
     the reference (``all_reduce_synchronizer.py:100-126``) done by autodiff."""
-    logits = logits.astype(jnp.float32)
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    label_logit = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-    return (logz - label_logit).mean()
+    return per_token_xent(logits, labels).mean()
 
 
 def sigmoid_xent(logits, labels):

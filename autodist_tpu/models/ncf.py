@@ -18,6 +18,11 @@ from autodist_tpu.models.spec import ModelSpec, register_model
 def init_params(
     rng, num_users: int, num_items: int, mf_dim: int, mlp_dims: Sequence[int]
 ) -> Dict[str, Any]:
+    if mlp_dims[0] % 2 != 0:
+        raise ValueError(
+            f"mlp_dims[0] must be even (user+item embeddings each get half), "
+            f"got {mlp_dims[0]}"
+        )
     keys = jax.random.split(rng, 5 + len(mlp_dims))
     params: Dict[str, Any] = {
         "mf_user": L.embedding_init(keys[0], num_users, mf_dim, stddev=0.01),

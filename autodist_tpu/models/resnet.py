@@ -13,14 +13,20 @@ import jax.numpy as jnp
 from autodist_tpu.models import layers as L
 from autodist_tpu.models.spec import ModelSpec, register_model
 
-# depth -> (block kind, stage sizes)
-_CONFIGS: Dict[int, Tuple[str, List[int]]] = {
-    18: ("basic", [2, 2, 2, 2]),
-    34: ("basic", [3, 4, 6, 3]),
-    50: ("bottleneck", [3, 4, 6, 3]),
-    101: ("bottleneck", [3, 4, 23, 3]),
-    152: ("bottleneck", [3, 8, 36, 3]),
+# depth -> (block kind, stage sizes, fwd GFLOPs @ 224x224)
+_CONFIGS: Dict[int, Tuple[str, List[int], float]] = {
+    18: ("basic", [2, 2, 2, 2], 1.8e9),
+    34: ("basic", [3, 4, 6, 3], 3.7e9),
+    50: ("bottleneck", [3, 4, 6, 3], 4.1e9),
+    101: ("bottleneck", [3, 4, 23, 3], 7.8e9),
+    152: ("bottleneck", [3, 8, 36, 3], 11.6e9),
 }
+
+
+def _lookup(depth: int):
+    if depth not in _CONFIGS:
+        raise ValueError(f"unsupported resnet depth {depth}; valid: {sorted(_CONFIGS)}")
+    return _CONFIGS[depth]
 
 
 def _basic_block_init(rng, cin, cout, stride):
@@ -77,7 +83,7 @@ def _bottleneck(p, x, stride, dtype):
 
 
 def init_params(rng, depth: int, num_classes: int, width: int = 64) -> Dict[str, Any]:
-    kind, stages = _CONFIGS[depth]
+    kind, stages, _ = _lookup(depth)
     keys = jax.random.split(rng, sum(stages) + 2)
     params: Dict[str, Any] = {
         "stem": {"conv": L.conv_init(keys[0], 7, 7, 3, width), "bn": L.batchnorm_init(width)},
@@ -101,7 +107,7 @@ def init_params(rng, depth: int, num_classes: int, width: int = 64) -> Dict[str,
 
 def forward(params, images, depth: int, dtype=jnp.bfloat16):
     """images [B, H, W, 3] -> logits [B, num_classes]."""
-    kind, stages = _CONFIGS[depth]
+    kind, stages, _ = _lookup(depth)
     x = L.conv(params["stem"]["conv"], images, stride=2, compute_dtype=dtype)
     x = jax.nn.relu(L.batchnorm(params["stem"]["bn"], x))
     x = jax.lax.reduce_window(
@@ -128,13 +134,12 @@ def resnet(depth: int = 50, num_classes: int = 1000, image_size: int = 224) -> M
         labels = (jnp.arange(batch_size) % num_classes).astype(jnp.int32)
         return {"images": images, "labels": labels}
 
-    # ~4.1 GFLOPs fwd for ResNet-50 @224; scale by depth-ish factor; x3 fwd+bwd.
-    fwd_gflops = {18: 1.8e9, 34: 3.7e9, 50: 4.1e9, 101: 7.8e9, 152: 11.6e9}[depth]
+    _, _, fwd_flops = _lookup(depth)
     return ModelSpec(
         name=f"resnet{depth}",
         init=lambda rng: init_params(rng, depth, num_classes),
         loss_fn=loss_fn,
         example_batch=example_batch,
         apply=lambda p, x: forward(p, x, depth),
-        flops_per_example=3.0 * fwd_gflops * (image_size / 224.0) ** 2,
+        flops_per_example=3.0 * fwd_flops * (image_size / 224.0) ** 2,
     )

@@ -162,10 +162,7 @@ def loss_fn(params, batch, cfg: TransformerConfig):
     inputs = jnp.where(mask.astype(bool), cfg.mlm_mask_token, batch["tokens"])
     logits = forward(params, inputs, cfg)
     mask = mask.astype(jnp.float32)  # 1 where masked
-    logits = logits.astype(jnp.float32)
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    label_logit = jnp.take_along_axis(logits, batch["labels"][..., None], axis=-1)[..., 0]
-    per_tok = (logz - label_logit) * mask
+    per_tok = L.per_token_xent(logits, batch["labels"]) * mask
     return per_tok.sum() / jnp.maximum(mask.sum(), 1.0)
 
 

@@ -1,0 +1,108 @@
+"""Benchmark: BERT-base train-step throughput + MFU on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+The reference publishes no numeric tables (BASELINE.md), so ``vs_baseline``
+is measured MFU / 0.50, the BASELINE.json north-star target (>=50% MFU).
+
+Runs the flagship BERT-base MLM workload through the full AutoDist pipeline
+(AllReduce strategy) on whatever devices are visible — the real TPU chip
+under the driver, or CPU (tiny config) for local smoke runs.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+# Peak bf16 FLOPs/s per chip by TPU generation (public figures). Matched
+# against jax Device.device_kind, longest key first ("v5 lite" is v5e).
+PEAK_FLOPS = {
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v6e": 918e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+}
+DEFAULT_PEAK = 459e12  # v5p
+TARGET_MFU = 0.50      # BASELINE.json north star
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return DEFAULT_PEAK
+
+
+def main() -> None:
+    from autodist_tpu.api import AutoDist
+    from autodist_tpu.models import get_model
+    import autodist_tpu.strategy as S
+
+    dev = jax.devices()[0]
+    on_accel = dev.platform != "cpu"
+    if on_accel:
+        batch_size, steps = 64, 20
+        model_kw = dict(max_seq_len=128)
+    else:  # CPU smoke: shrink so the line still prints quickly
+        batch_size, steps = 8, 3
+        model_kw = dict(
+            vocab_size=512, num_layers=2, d_model=64, num_heads=4,
+            d_ff=128, max_seq_len=32,
+        )
+
+    AutoDist.reset_default()
+    ad = AutoDist(strategy_builder=S.AllReduce())
+    spec = get_model("bert_base", **model_kw)
+    params = spec.init(jax.random.PRNGKey(0))
+    batch = spec.example_batch(batch_size)
+    step = ad.build(spec.loss_fn, params, batch)
+    state = step.init(params)
+
+    # Warmup/compile. Sync via host transfer of the loss: on some platforms
+    # (axon tunnel) block_until_ready returns before remote execution
+    # finishes, so a device->host fetch is the only trustworthy barrier.
+    state, metrics = step(state, batch)
+    float(metrics["loss"])
+
+    trials = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, batch)
+        float(metrics["loss"])
+        trials.append(time.perf_counter() - t0)
+    dt = sorted(trials)[len(trials) // 2]  # median trial
+
+    seq = spec.config.max_seq_len
+    tokens_per_sec = batch_size * seq * steps / dt
+    flops_per_step = spec.flops_per_example * batch_size
+    achieved = flops_per_step * steps / dt
+    n_chips = jax.device_count()
+    peak = _peak_flops(dev) * n_chips if on_accel else float("nan")
+    mfu = achieved / peak if on_accel else float("nan")
+
+    result = {
+        "metric": "bert_base_mfu" if on_accel else "bert_base_tokens_per_sec_cpu_smoke",
+        "value": round(mfu, 4) if on_accel else round(tokens_per_sec, 1),
+        "unit": "mfu" if on_accel else "tokens/sec",
+        "vs_baseline": round(mfu / TARGET_MFU, 4) if on_accel else None,
+        "tokens_per_sec_per_chip": round(tokens_per_sec / n_chips, 1),
+        "achieved_tflops_per_chip": round(achieved / n_chips / 1e12, 2),
+        "device": getattr(dev, "device_kind", dev.platform),
+        "n_chips": n_chips,
+        "batch_size": batch_size,
+        "seq_len": seq,
+        "loss": round(float(metrics["loss"]), 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
