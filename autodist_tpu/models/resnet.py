@@ -13,7 +13,7 @@ import jax.numpy as jnp
 from autodist_tpu.models import layers as L
 from autodist_tpu.models.spec import ModelSpec, register_model
 
-# depth -> (block kind, stage sizes, fwd GFLOPs @ 224x224)
+# depth -> (block kind, stage sizes, fwd FLOPs @ 224x224)
 _CONFIGS: Dict[int, Tuple[str, List[int], float]] = {
     18: ("basic", [2, 2, 2, 2], 1.8e9),
     34: ("basic", [3, 4, 6, 3], 3.7e9),

@@ -154,9 +154,12 @@ def forward(params, tokens, cfg: TransformerConfig):
 
 def loss_fn(params, batch, cfg: TransformerConfig):
     if cfg.causal:
+        # Run attention on the full (block-aligned) sequence and shift the
+        # logits, not the inputs — trimming to s-1 would break the flash
+        # kernel's block alignment and silently fall back to O(s^2) attention.
         tokens = batch["tokens"]
-        logits = forward(params, tokens[:, :-1], cfg)
-        return L.softmax_xent(logits, tokens[:, 1:])
+        logits = forward(params, tokens, cfg)
+        return L.softmax_xent(logits[:, :-1], tokens[:, 1:])
     # MLM: corrupt masked positions with [MASK], predict the original ids.
     mask = batch["mlm_mask"]
     inputs = jnp.where(mask.astype(bool), cfg.mlm_mask_token, batch["tokens"])

@@ -1,0 +1,10 @@
+"""TPU kernels (pallas) for the hot ops.
+
+The reference has no kernels of its own — its compute muscle is stock TF
+C++/CUDA (SURVEY.md §2: "zero C++/Rust/CUDA files"). The TPU-native build owns
+its hot ops instead: pallas kernels tuned for MXU/VMEM, with jnp reference
+implementations used for CPU fallback and numerics tests.
+"""
+from autodist_tpu.ops.flash_attention import flash_attention, mha_reference
+
+__all__ = ["flash_attention", "mha_reference"]

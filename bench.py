@@ -32,12 +32,13 @@ DEFAULT_PEAK = 459e12  # v5p
 TARGET_MFU = 0.50      # BASELINE.json north star
 
 
-def _peak_flops(device) -> float:
+def _peak_flops(device) -> tuple:
+    """(peak, detected): detected=False means the MFU denominator is a guess."""
     kind = getattr(device, "device_kind", "").lower()
     for key, val in PEAK_FLOPS.items():
         if key in kind:
-            return val
-    return DEFAULT_PEAK
+            return val, True
+    return DEFAULT_PEAK, False
 
 
 def main() -> None:
@@ -85,7 +86,8 @@ def main() -> None:
     flops_per_step = spec.flops_per_example * batch_size
     achieved = flops_per_step * steps / dt
     n_chips = jax.device_count()
-    peak = _peak_flops(dev) * n_chips if on_accel else float("nan")
+    peak_per_chip, peak_detected = _peak_flops(dev)
+    peak = peak_per_chip * n_chips if on_accel else float("nan")
     mfu = achieved / peak if on_accel else float("nan")
 
     result = {
@@ -96,6 +98,7 @@ def main() -> None:
         "tokens_per_sec_per_chip": round(tokens_per_sec / n_chips, 1),
         "achieved_tflops_per_chip": round(achieved / n_chips / 1e12, 2),
         "device": getattr(dev, "device_kind", dev.platform),
+        "peak_tflops_assumed": None if peak_detected else round(DEFAULT_PEAK / 1e12),
         "n_chips": n_chips,
         "batch_size": batch_size,
         "seq_len": seq,

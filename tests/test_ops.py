@@ -1,0 +1,69 @@
+"""Flash-attention kernel numerics vs the jnp reference (interpret mode on
+CPU exercises the same kernel code paths that compile on TPU)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from autodist_tpu.ops.flash_attention import flash_attention, mha_reference
+
+
+def _make_qkv(rng, b=2, s=256, h=2, d=64, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (b, s, h, d), dtype)
+    k = jax.random.normal(kk, (b, s, h, d), dtype)
+    v = jax.random.normal(kv, (b, s, h, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_reference(causal):
+    q, k, v = _make_qkv(jax.random.PRNGKey(0))
+    out = flash_attention(q, k, v, causal)
+    ref = mha_reference(q, k, v, causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_reference(causal):
+    q, k, v = _make_qkv(jax.random.PRNGKey(1), s=256)
+
+    def f_flash(q, k, v):
+        return (flash_attention(q, k, v, causal) ** 2).sum()
+
+    def f_ref(q, k, v):
+        return (mha_reference(q, k, v, causal) ** 2).sum()
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(gf, gr, atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_nonaligned_seq_falls_back():
+    # seq not divisible by block size -> reference fallback, still correct +
+    # differentiable.
+    q, k, v = _make_qkv(jax.random.PRNGKey(2), s=100)
+    out = flash_attention(q, k, v, True)
+    ref = mha_reference(q, k, v, True)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+    g = jax.grad(lambda q: flash_attention(q, k, v, True).sum())(q)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_transformer_with_flash_impl():
+    """The flagship model runs with attention_impl='flash'."""
+    from autodist_tpu.models import get_model
+
+    spec_dot = get_model("transformer", vocab_size=64, num_layers=1, d_model=32,
+                         num_heads=2, d_ff=64, max_seq_len=128,
+                         attention_impl="dot", dtype=jnp.float32)
+    spec_flash = get_model("transformer", vocab_size=64, num_layers=1, d_model=32,
+                           num_heads=2, d_ff=64, max_seq_len=128,
+                           attention_impl="flash", dtype=jnp.float32)
+    params = spec_dot.init(jax.random.PRNGKey(0))
+    batch = spec_dot.example_batch(2)
+    l1 = spec_dot.loss_fn(params, batch)
+    l2 = spec_flash.loss_fn(params, batch)
+    np.testing.assert_allclose(l1, l2, atol=1e-4, rtol=1e-4)
