@@ -1,0 +1,174 @@
+"""Gradient compressors: fewer bits on the wire for the gradient sync.
+
+TPU-native rebuild of the reference's compressor layer
+(``/root/reference/autodist/kernel/synchronization/compressor.py``): there a
+``Compressor`` wrapped the explicit ``collective_ops.all_reduce`` call
+(``compressor.py:146-201``), with an error-feedback mixin (``:120-143``) and a
+drafted-but-disabled PowerSGD (``:208-284``). Here the gradient all-reduce is
+the data-axis ``lax.psum`` inside a partially-manual ``shard_map`` (manual
+over the data axis, GSPMD-auto over model axes), and each compressor owns the
+full compress → psum → decompress pattern:
+
+- ``NoneCompressor`` — plain ``psum`` average, full precision.
+- ``HorovodCompressor`` — dtype-cast transport (bf16 on TPU, replacing the
+  reference's fp16/fp32 casting): the collective itself runs on half-width
+  payloads, halving ICI bytes.
+- ``HorovodCompressorEF`` — same cast plus per-worker error feedback: the
+  rounding error of each step is carried in a residual and re-injected, so
+  compression error accumulates to zero instead of biasing the trajectory.
+- ``PowerSGDCompressor`` — rank-r low-rank approximation (arXiv 1905.13727)
+  with power-iteration warm start and error feedback; syncs two rank-r
+  factors instead of the full matrix.
+
+Per-worker state (EF residuals) is carried in ``TrainState.comp_state`` with
+a leading data-axis dimension so each mesh data-shard keeps its own residual
+— the analog of each reference worker holding its own ``error`` tensor.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from autodist_tpu.model_item import VarItem
+
+State = Dict[str, jnp.ndarray]
+
+
+class Compressor:
+    """One gradient leaf's compress → all-reduce → decompress policy.
+
+    ``step`` runs inside the data-axis-manual ``shard_map``: ``grad`` is the
+    local (per-data-shard) gradient of the local-mean loss; the result must
+    be the synchronized global-mean gradient, identical on every shard.
+    """
+
+    name = "Compressor"
+
+    def init_local(self, var: VarItem) -> State:
+        """Per-worker persistent state (one copy per data shard)."""
+        return {}
+
+    def init_shared(self, var: VarItem) -> State:
+        """Cross-worker persistent state (identical on all shards)."""
+        return {}
+
+    def step(
+        self, grad: jnp.ndarray, local: State, shared: State, *, axis: str, nshards: int
+    ) -> Tuple[jnp.ndarray, State, State]:
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity: full-precision psum average (compressor.py:146-166)."""
+
+    name = "NoneCompressor"
+
+    def step(self, grad, local, shared, *, axis, nshards):
+        return lax.psum(grad, axis) / nshards, local, shared
+
+
+class HorovodCompressor(Compressor):
+    """Cast-for-transport: the collective runs on bf16 payloads
+    (compressor.py:169-201, retargeted fp16→bf16 for the MXU/ICI)."""
+
+    name = "HorovodCompressor"
+    wire_dtype = jnp.bfloat16
+
+    def step(self, grad, local, shared, *, axis, nshards):
+        compressed = grad.astype(self.wire_dtype)
+        summed = lax.psum(compressed, axis)
+        return summed.astype(grad.dtype) / nshards, local, shared
+
+
+class HorovodCompressorEF(HorovodCompressor):
+    """Cast transport + error feedback (CompressorEF mixin,
+    compressor.py:120-143): residual_{t+1} = input - decompress(compress(input))
+    accumulated per worker."""
+
+    name = "HorovodCompressorEF"
+
+    def init_local(self, var):
+        return {"residual": jnp.zeros(var.shape, jnp.dtype(var.dtype))}
+
+    def step(self, grad, local, shared, *, axis, nshards):
+        inp = grad + local["residual"].astype(grad.dtype)
+        compressed = inp.astype(self.wire_dtype)
+        residual = inp - compressed.astype(grad.dtype)
+        summed = lax.psum(compressed, axis)
+        return (
+            summed.astype(grad.dtype) / nshards,
+            {"residual": residual},
+            shared,
+        )
+
+
+class PowerSGDCompressor(Compressor):
+    """Rank-r PowerSGD (arXiv 1905.13727; reference draft
+    compressor.py:208-284) with error feedback.
+
+    For a gradient reshaped to M (m×k): P = M·Q (psum, orthonormalize via QR),
+    Qn = Mᵀ·P (psum, averaged), M̂ = P·Qnᵀ. Wire cost per step is
+    (m+k)·r instead of m·k. Q persists across steps (warm-started power
+    iteration); the per-worker residual carries the approximation error.
+    Rank-0/1 tensors are too small to benefit — plain full-precision psum.
+    """
+
+    name = "PowerSGDCompressor"
+
+    def __init__(self, rank: int = 2, seed: int = 0):
+        self.rank = rank
+        self.seed = seed
+
+    def _matrix_shape(self, shape) -> Tuple[int, int]:
+        return shape[0], math.prod(shape[1:])
+
+    def init_local(self, var):
+        if len(var.shape) < 2:
+            return {}
+        return {"residual": jnp.zeros(var.shape, jnp.dtype(var.dtype))}
+
+    def init_shared(self, var):
+        if len(var.shape) < 2:
+            return {}
+        _, k = self._matrix_shape(var.shape)
+        r = min(self.rank, k, var.shape[0])
+        q = jax.random.normal(
+            jax.random.PRNGKey(self.seed), (k, r), jnp.dtype(var.dtype)
+        )
+        q, _ = jnp.linalg.qr(q)
+        return {"q": q}
+
+    def step(self, grad, local, shared, *, axis, nshards):
+        if grad.ndim < 2:
+            return lax.psum(grad, axis) / nshards, local, shared
+        m_rows, k = self._matrix_shape(grad.shape)
+        inp = grad + local["residual"]
+        mat = inp.reshape(m_rows, k)
+        q = shared["q"]
+        # Left factor: aggregate across workers, then orthonormalize.
+        p = lax.psum(mat @ q, axis)
+        p, _ = jnp.linalg.qr(p)
+        # Right factor: aggregate of Mᵀ·P, averaged.
+        qn = lax.psum(mat.T @ p, axis) / nshards
+        approx = (p @ qn.T).reshape(grad.shape)
+        residual = inp - approx
+        return approx, {"residual": residual}, {"q": qn}
+
+
+_REGISTRY = {
+    "NoneCompressor": NoneCompressor,
+    "HorovodCompressor": HorovodCompressor,
+    "HorovodCompressorEF": HorovodCompressorEF,
+    "PowerSGDCompressor": PowerSGDCompressor,
+}
+
+
+def get_compressor(name: str) -> Compressor:
+    """Instantiate by strategy-IR name (AllReduceSynchronizer.compressor)."""
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown compressor {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]()

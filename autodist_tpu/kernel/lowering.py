@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from flax import struct
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from autodist_tpu import const
@@ -78,11 +79,14 @@ class VarPlan:
 class TrainState:
     """Minimal functional train state (the reference's mutable-graph state —
     variables + optimizer slots — as an explicit pytree). ``.replace`` comes
-    from the struct.dataclass decorator."""
+    from the struct.dataclass decorator. ``comp_state`` carries gradient-
+    compressor persistence (EF residuals per data shard, PowerSGD bases);
+    empty dict when no compressor is active."""
 
     step: jax.Array
     params: Any
     opt_state: Any
+    comp_state: Any = struct.field(default_factory=dict)
 
 
 def _spec_with_axis(rank: int, dim: int, mesh_axis: str) -> P:
@@ -280,11 +284,24 @@ class ShardingPlan:
 
         return jax.tree_util.tree_map(leaf_sharding, batch)
 
+    def comp_shardings(self, comp_state) -> Any:
+        """Compressor-state shardings: per-worker ("local") leaves carry a
+        leading data-axis dim and shard over it; "shared" leaves replicate."""
+        ax = data_axis(self.mesh)
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(comp_state)
+        out = []
+        for path, _leaf in leaves:
+            name = _path_name(path)
+            spec = P(ax) if "/local/" in f"/{name}/" else P()
+            out.append(self._sharding(spec))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
     def state_shardings(self, state_shapes: TrainState) -> TrainState:
         return TrainState(
             step=self._sharding(P()),
             params=self.params_shardings(state_shapes.params),
             opt_state=self.opt_shardings(state_shapes.opt_state),
+            comp_state=self.comp_shardings(state_shapes.comp_state),
         )
 
     def describe(self) -> str:
@@ -323,6 +340,33 @@ class DistributedTrainStep:
         self._donate = donate_state
         self._compiled = None
         self._state_shardings = None
+        self._compressors = self._resolve_compressors(plan)
+
+    @staticmethod
+    def _resolve_compressors(plan: ShardingPlan):
+        """var name → Compressor for vars whose strategy asks for one.
+
+        Compression wraps the data-axis gradient psum, so it applies only to
+        vars not sharded over the data axis (matching the reference, where
+        compressors exist only on the dense AllReduce path,
+        compressor.py:146-201); others are skipped with a warning.
+        """
+        from autodist_tpu.kernel.compressor import get_compressor
+
+        ax = data_axis(plan.mesh)
+        out = {}
+        for name, p in plan.var_plans.items():
+            if p.compressor in ("", "NoneCompressor"):
+                continue
+            if any(e == ax or (isinstance(e, tuple) and ax in e) for e in p.pspec):
+                logging.warning(
+                    "compressor %s on %s ignored: var is sharded over the data "
+                    "axis (sparse/ZeRO path has no gradient all-reduce to "
+                    "compress)", p.compressor, name,
+                )
+                continue
+            out[name] = get_compressor(p.compressor)
+        return out
 
     # ------------------------------------------------------------------ init
     def init(self, params) -> TrainState:
@@ -337,25 +381,161 @@ class DistributedTrainStep:
             lambda x: jnp.array(x, copy=True) if isinstance(x, jax.Array) else jnp.asarray(x),
             params,
         )
-        state = TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=self.tx.init(params))
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=self.tx.init(params),
+            comp_state=self._init_comp_state(),
+        )
         shardings = self.plan.state_shardings(jax.eval_shape(lambda: state))
         self._state_shardings = shardings
         return jax.device_put(state, shardings)
 
+    def _init_comp_state(self):
+        """Compressor persistence: {"<var>": {"local": ..., "shared": ...}}.
+        Local (per-worker) entries are stacked with a leading data-axis dim —
+        one residual per data shard (each reference worker kept its own
+        ``error`` tensor)."""
+        if not self._compressors:
+            return {}
+        n = dict(zip(self.plan.mesh.axis_names, self.plan.mesh.devices.shape))[
+            data_axis(self.plan.mesh)
+        ]
+        comp_state = {}
+        for name, comp in self._compressors.items():
+            var = self.plan.var_plans[name].var
+            local = comp.init_local(var)
+            comp_state[name] = {
+                "local": jax.tree.map(
+                    lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), local
+                ),
+                "shared": comp.init_shared(var),
+            }
+        return comp_state
+
     # ------------------------------------------------------------------ step
     def _step(self, state: TrainState, batch):
-        if self.has_aux:
-            (loss, aux), grads = jax.value_and_grad(self.loss_fn, has_aux=True)(state.params, batch)
+        if self._compressors:
+            loss, aux, grads, new_comp = self._compressed_grads(state, batch)
         else:
-            loss, grads = jax.value_and_grad(self.loss_fn)(state.params, batch)
-            aux = None
+            if self.has_aux:
+                (loss, aux), grads = jax.value_and_grad(self.loss_fn, has_aux=True)(
+                    state.params, batch
+                )
+            else:
+                loss, grads = jax.value_and_grad(self.loss_fn)(state.params, batch)
+                aux = None
+            new_comp = state.comp_state
         updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
-        new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt)
+        new_state = TrainState(
+            step=state.step + 1, params=new_params, opt_state=new_opt, comp_state=new_comp
+        )
         metrics = {"loss": loss}
         if aux is not None:
             metrics["aux"] = aux
         return new_state, metrics
+
+    # ------------------------------------------------- compressed grad sync
+    def _data_only_spec(self, pspec: P, ax: str) -> P:
+        """Restrict a PartitionSpec to the data axis (other axes stay under
+        GSPMD-auto inside the partially-manual shard_map)."""
+        return P(*[
+            ax if (e == ax or (isinstance(e, (tuple, list)) and ax in e)) else None
+            for e in pspec
+        ])
+
+    def _compressed_grads(self, state: TrainState, batch):
+        """Gradient sync with compression around the data-axis psum.
+
+        Runs the loss/grad computation inside a ``shard_map`` that is manual
+        over the data axis only: each instance sees its local batch shard,
+        computes local-mean grads, and each var's compressor performs the
+        compress → psum → decompress sequence (so the collective itself runs
+        on compressed payloads — the reference wrapped
+        ``collective_ops.all_reduce`` the same way). Model/other mesh axes
+        stay GSPMD-auto, so tensor-parallel vars keep their shardings.
+
+        Assumes ``loss_fn`` computes a *mean* over the batch (the reference's
+        merge=Add final=Div semantics, all_reduce_synchronizer.py:100-126).
+        """
+        from jax import shard_map
+
+        mesh = self.plan.mesh
+        ax = data_axis(mesh)
+        n = dict(zip(mesh.axis_names, mesh.devices.shape))[ax]
+        compressors = self._compressors
+
+        def spec_for_param(path, leaf):
+            name = _path_name(path)
+            plan = self.plan.var_plans.get(name)
+            return self._data_only_spec(plan.pspec if plan else P(), ax)
+
+        p_leaves, p_treedef = jax.tree_util.tree_flatten_with_path(state.params)
+        param_specs = jax.tree_util.tree_unflatten(
+            p_treedef, [spec_for_param(path, leaf) for path, leaf in p_leaves]
+        )
+
+        def spec_for_batch(leaf):
+            shape = tuple(getattr(leaf, "shape", ()))
+            return P(ax) if len(shape) >= 1 and shape[0] % n == 0 and shape[0] > 0 else P()
+
+        batch_specs = jax.tree_util.tree_map(spec_for_batch, batch)
+
+        c_leaves, c_treedef = jax.tree_util.tree_flatten_with_path(state.comp_state)
+        comp_specs = jax.tree_util.tree_unflatten(
+            c_treedef,
+            [
+                P(ax) if "/local/" in f"/{_path_name(path)}/" else P()
+                for path, _ in c_leaves
+            ],
+        )
+
+        loss_fn, has_aux = self.loss_fn, self.has_aux
+
+        def local_fn(params, local_batch, comp_state):
+            if has_aux:
+                (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    params, local_batch
+                )
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(params, local_batch)
+                aux = None
+            loss = lax.psum(loss, ax) / n
+            if aux is not None:
+                aux = jax.tree.map(lambda x: lax.psum(x, ax) / n, aux)
+            g_leaves, g_treedef = jax.tree_util.tree_flatten_with_path(grads)
+            new_comp = dict(comp_state)
+            synced = []
+            for path, g in g_leaves:
+                name = _path_name(path)
+                comp = compressors.get(name)
+                if comp is None:
+                    synced.append(lax.psum(g, ax) / n)
+                    continue
+                # Local state arrives as the (1, ...) slice of the stacked
+                # per-shard leaves; unwrap, step, rewrap.
+                local = jax.tree.map(lambda x: x[0], comp_state[name]["local"])
+                g_hat, new_local, new_shared = comp.step(
+                    g, local, comp_state[name]["shared"], axis=ax, nshards=n
+                )
+                new_comp[name] = {
+                    "local": jax.tree.map(lambda x: x[None], new_local),
+                    "shared": new_shared,
+                }
+                synced.append(g_hat)
+            grads = jax.tree_util.tree_unflatten(g_treedef, synced)
+            return loss, aux, grads, new_comp
+
+        sm = shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=(param_specs, batch_specs, comp_specs),
+            out_specs=(P(), P(), param_specs, comp_specs),
+            axis_names={ax},
+            check_vma=False,
+        )
+        return sm(state.params, batch, state.comp_state)
 
     def _compile(self, state: TrainState, batch):
         if self._state_shardings is None:
