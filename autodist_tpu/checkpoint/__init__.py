@@ -1,0 +1,19 @@
+"""Checkpoint subsystem: sharding-agnostic save/restore + serving export.
+
+Rebuild of the reference's ``autodist/checkpoint/``: its ``Saver`` saved from
+the *transformed* graph under *original* single-node variable names so
+checkpoints are interchangeable between single-node and distributed runs
+(``checkpoint/saver.py:50-57``), with partitioned shards merged through
+``SaveSliceInfo`` (``kernel/partitioner.py:292-308``); its
+``SavedModelBuilder`` exported a serving graph (``saved_model_builder.py``).
+
+Here the same contract, TPU-native: shards merge at save time by reading the
+global ``jax.Array`` (XLA's view of a sharded array *is* the logical tensor —
+no slice bookkeeping needed), and re-partitioning happens at restore time via
+``device_put`` with the destination's shardings. Serving export serializes
+the jitted apply function to StableHLO via ``jax.export``.
+"""
+from autodist_tpu.checkpoint.saver import Saver
+from autodist_tpu.checkpoint.saved_model import SavedModelBuilder, load_saved_model
+
+__all__ = ["Saver", "SavedModelBuilder", "load_saved_model"]

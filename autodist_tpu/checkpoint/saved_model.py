@@ -1,0 +1,100 @@
+"""Serving export: StableHLO program + weights directory.
+
+Analog of the reference's ``SavedModelBuilder``
+(``/root/reference/autodist/checkpoint/saved_model_builder.py:30-64``), which
+tagged a TF metagraph + autodist-saved variables for serving. The TPU-native
+serving artifact is a serialized ``jax.export`` StableHLO program (stable
+across jax versions, loadable without the model's Python code) plus a
+:class:`~autodist_tpu.checkpoint.saver.Saver` checkpoint of the params.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable
+
+import jax
+
+from autodist_tpu.checkpoint.saver import Saver
+from autodist_tpu.utils import logging
+
+_PROGRAM_FILE = "program.stablehlo"
+_META_FILE = "saved_model.json"
+_PARAMS_DIR = "params"
+
+
+class SavedModelBuilder:
+    """Export ``apply_fn(params, *args)`` + trained params for serving."""
+
+    def __init__(self, apply_fn: Callable):
+        self.apply_fn = apply_fn
+
+    def save(self, directory: str, params: Any, *example_args: Any) -> str:
+        """Trace ``apply_fn`` on (params, *example_args), serialize the
+        StableHLO program and the params, and write a manifest.
+
+        The program is exported over the *flat leaf list* of ``params`` (the
+        pytree structure is closed over at trace time), so loading never
+        needs the original pytree classes — FrozenDicts, NamedTuples and
+        custom nodes all round-trip.
+        """
+        from jax import export
+
+        os.makedirs(directory, exist_ok=True)
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        apply_fn = self.apply_fn
+
+        def flat_apply(leaves, *args):
+            return apply_fn(jax.tree_util.tree_unflatten(treedef, leaves), *args)
+
+        exported = export.export(jax.jit(flat_apply))(leaves, *example_args)
+        payload = exported.serialize()
+        if jax.process_index() == 0:
+            with open(os.path.join(directory, _PROGRAM_FILE), "wb") as f:
+                f.write(bytes(payload))
+        width = max(4, len(str(len(leaves))))
+        leaf_dict = {str(i).zfill(width): leaf for i, leaf in enumerate(leaves)}
+        Saver().save(leaf_dict, os.path.join(directory, _PARAMS_DIR))
+        if jax.process_index() == 0:
+            meta = {
+                "format": "autodist_tpu.saved_model",
+                "version": 1,
+                "n_params": len(leaves),
+                "leaf_index_width": width,
+                "n_example_args": len(example_args),
+                "in_avals": [str(a) for a in exported.in_avals],
+                "out_avals": [str(a) for a in exported.out_avals],
+            }
+            with open(os.path.join(directory, _META_FILE), "w", encoding="utf-8") as f:
+                json.dump(meta, f, indent=2)
+        logging.info("saved model -> %s", directory)
+        return directory
+
+
+def load_saved_model(directory: str) -> Callable:
+    """Load an exported model as ``fn(*args)`` with params bound.
+
+    The returned callable runs the deserialized StableHLO program — no model
+    Python code required, mirroring SavedModel's self-contained contract.
+    """
+    from jax import export
+
+    with open(os.path.join(directory, _PROGRAM_FILE), "rb") as f:
+        exported = export.deserialize(bytearray(f.read()))
+    with open(os.path.join(directory, _META_FILE), "r", encoding="utf-8") as f:
+        meta = json.load(f)
+    leaf_dict = Saver().restore(os.path.join(directory, _PARAMS_DIR))
+    # Zero-padded index keys: sorted order == export leaf order.
+    leaves = [leaf_dict[k] for k in sorted(leaf_dict)]
+    if len(leaves) != meta["n_params"]:
+        raise ValueError(
+            f"saved model at {directory} has {len(leaves)} param leaves, "
+            f"manifest says {meta['n_params']}"
+        )
+
+    def serve(*args: Any):
+        return exported.call(leaves, *args)
+
+    serve.params = leaves  # type: ignore[attr-defined]
+    serve.exported = exported  # type: ignore[attr-defined]
+    return serve
