@@ -67,6 +67,14 @@ class AutoDist:
                 "Only one AutoDist instance is supported per process; "
                 "call AutoDist.reset_default() first if you really need another."
             )
+        # Join the multi-controller runtime if this process was launched by
+        # the coordinator/launcher (the reference's _setup stage,
+        # autodist.py:120-128). Must happen before any ResourceSpec device
+        # query initializes the XLA backend; idempotent, no-op single-process.
+        from autodist_tpu.runtime.launcher import initialize_from_env
+
+        initialize_from_env()
+
         if resource_spec is not None:
             self.resource_spec = resource_spec
         elif resource_spec_file:

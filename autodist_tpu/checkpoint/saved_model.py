@@ -84,8 +84,9 @@ def load_saved_model(directory: str) -> Callable:
     with open(os.path.join(directory, _META_FILE), "r", encoding="utf-8") as f:
         meta = json.load(f)
     leaf_dict = Saver().restore(os.path.join(directory, _PARAMS_DIR))
-    # Zero-padded index keys: sorted order == export leaf order.
-    leaves = [leaf_dict[k] for k in sorted(leaf_dict)]
+    # Zero-padded index keys: sorted order == export leaf order. device_put
+    # once at load so serve() calls don't re-transfer weights host-to-device.
+    leaves = jax.device_put([leaf_dict[k] for k in sorted(leaf_dict)])
     if len(leaves) != meta["n_params"]:
         raise ValueError(
             f"saved model at {directory} has {len(leaves)} param leaves, "

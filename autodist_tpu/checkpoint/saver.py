@@ -77,8 +77,9 @@ class Saver:
         processes return the same path.
         """
         if path is None:
-            tag = f"ckpt-{step}" if step is not None else "ckpt"
-            path = os.path.join(self.directory, tag)
+            # Step-less saves land in ckpt-0 so latest_checkpoint()/_gc see
+            # them; a bare "ckpt" dir would be invisible to both.
+            path = os.path.join(self.directory, f"ckpt-{step or 0}")
         leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
         entries: Dict[str, dict] = {}
         is_writer = jax.process_index() == 0
@@ -95,6 +96,13 @@ class Saver:
             with open(os.path.join(path, "metadata.json"), "w", encoding="utf-8") as f:
                 json.dump(meta, f, indent=2, sort_keys=True)
             self._gc()
+        if jax.process_count() > 1:
+            # Barrier: no process may see `path` as "saved" until the writer
+            # has finished metadata.json (otherwise a non-writer's immediate
+            # restore races a half-written checkpoint).
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"autodist_tpu:save:{path}")
         logging.info("saved checkpoint with %d arrays -> %s", len(entries), path)
         return path
 

@@ -1,0 +1,22 @@
+"""Runtime (L1): multi-host bootstrap, coordination and process lifecycle.
+
+TPU-native replacement for the reference's runtime layer — ``SSHCluster`` +
+``Coordinator`` + ``server_starter`` (``/root/reference/autodist/cluster.py``,
+``coordinator.py``, ``utils/server_starter.py``). The reference started a TF
+grpc server on every node over SSH and re-executed the user script per worker;
+here the native JAX multi-controller model plays that role: every host runs
+the same script, ``jax.distributed.initialize`` forms the cluster, and XLA
+ICI/DCN collectives replace grpc.
+
+What survives from the reference (the capability contract):
+- chief/worker role dispatch via the ``AUTODIST_WORKER`` env contract;
+- chief builds + serializes the strategy, workers receive it by id;
+- "re-run the same script on every host" launch model;
+- worker monitoring with chief fail-fast on worker death;
+- stale-process cleanup on node start.
+"""
+from autodist_tpu.runtime.cluster import Cluster
+from autodist_tpu.runtime.coordinator import Coordinator
+from autodist_tpu.runtime.launcher import launch, main
+
+__all__ = ["Cluster", "Coordinator", "launch", "main"]

@@ -1,0 +1,212 @@
+"""Cluster: the jax.distributed bootstrap + process table.
+
+Replaces the reference's ``Cluster``/``SSHCluster``
+(``/root/reference/autodist/cluster.py:54-268``). The reference built a TF
+``ClusterSpec`` (``{'worker': ['ip:15000', ...]}``, sorted for cross-worker
+determinism, ``cluster.py:70-82``) and started a grpc ``tf.train.Server`` per
+node over SSH. On TPU the native equivalent is the JAX multi-controller
+runtime: one Python process per host, all connecting to a coordinator service
+on the chief (``jax.distributed.initialize``), with collectives riding
+ICI/DCN instead of grpc.
+
+Determinism parity: process ids come from the same chief-first,
+address-sorted node ordering the ResourceSpec uses for device numbering, so
+every process derives an identical cluster view from the spec alone — the
+analog of the reference's sorted ip:port list.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+from typing import Dict, List, Optional
+
+from autodist_tpu import const
+from autodist_tpu.const import ENV
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.utils import logging
+
+
+def _deterministic_port(spec: ResourceSpec) -> int:
+    """Pick a coordinator port in the reference's 15000-16000 range
+    (``const.py:38``), keyed on the spec fingerprint so concurrent clusters
+    on one machine do not collide but all members of one cluster agree."""
+    rng = const.DEFAULT_PORT_RANGE
+    return rng.start + int(spec.fingerprint(), 16) % len(rng)
+
+
+class Cluster:
+    """Process table + jax.distributed lifecycle for one ResourceSpec.
+
+    One ``Cluster`` object exists per process; ``start()`` on the chief
+    launches nothing itself (workers are launched by the
+    :class:`~autodist_tpu.runtime.coordinator.Coordinator`) but initializes
+    the distributed runtime. Single-node specs skip the coordinator service
+    entirely, matching how the reference ran localhost specs without SSH.
+    """
+
+    def __init__(self, resource_spec: ResourceSpec, coordinator_port: Optional[int] = None):
+        self.resource_spec = resource_spec
+        self.coordinator_port = coordinator_port or _deterministic_port(resource_spec)
+        # chief-first, address-sorted — must match ResourceSpec.tpu_devices.
+        self._ordered_nodes = sorted(
+            resource_spec.nodes, key=lambda n: (not n.chief, n.address)
+        )
+        self._initialized = False
+        self._local_procs: List[subprocess.Popen] = []
+
+    # ------------------------------------------------------------- identities
+    @property
+    def num_processes(self) -> int:
+        return len(self._ordered_nodes)
+
+    @property
+    def coordinator_address(self) -> str:
+        """``chief_ip:port`` — what every process dials into
+        (reference analog: session target ``grpc://localhost:port``,
+        ``cluster.py:149-157``)."""
+        override = ENV.AUTODIST_COORDINATOR.val
+        if override:
+            return override
+        return f"{self.resource_spec.chief_address}:{self.coordinator_port}"
+
+    def process_id(self, address: Optional[str] = None) -> int:
+        """Deterministic process index for a host address (default: self)."""
+        if address is None:
+            address = ENV.AUTODIST_WORKER.val or self.resource_spec.chief_address
+        for i, node in enumerate(self._ordered_nodes):
+            if node.address == address:
+                return i
+        raise ValueError(f"address {address!r} not in resource spec")
+
+    @property
+    def is_chief(self) -> bool:
+        return const.is_chief_process()
+
+    def env_for_worker(self, address: str, strategy_id: str = "") -> Dict[str, str]:
+        """The env-var contract shipped to a worker process
+        (reference: ``coordinator.py:66-76`` exported ``AUTODIST_WORKER``,
+        ``AUTODIST_STRATEGY_ID`` etc. into the remote shell)."""
+        env = {
+            ENV.AUTODIST_WORKER.name: address,
+            ENV.AUTODIST_COORDINATOR.name: self.coordinator_address,
+            ENV.AUTODIST_NUM_PROCESSES.name: str(self.num_processes),
+            ENV.AUTODIST_PROCESS_ID.name: str(self.process_id(address)),
+            ENV.AUTODIST_MIN_LOG_LEVEL.name: str(ENV.AUTODIST_MIN_LOG_LEVEL.val),
+        }
+        if strategy_id:
+            env[ENV.AUTODIST_STRATEGY_ID.name] = strategy_id
+        return env
+
+    # -------------------------------------------------------------- lifecycle
+    def initialize(self) -> None:
+        """Join the distributed runtime (idempotent).
+
+        Multi-node: ``jax.distributed.initialize`` with the deterministic
+        process table — the native replacement for starting per-node TF
+        servers (``server_starter.py:49-77``). Single-node: no-op.
+        """
+        if self._initialized or self.num_processes == 1:
+            self._initialized = True
+            return
+        import jax
+
+        pid = self.process_id()
+        logging.info(
+            "joining cluster: coordinator=%s process=%d/%d",
+            self.coordinator_address, pid, self.num_processes,
+        )
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator_address,
+            num_processes=self.num_processes,
+            process_id=pid,
+        )
+        self._initialized = True
+
+    def start(self) -> None:
+        """Chief-side cluster bring-up: clean stale state, then initialize.
+
+        The reference's ``start()`` launched servers on every node
+        (``cluster.py:160-210``); with multi-controller JAX the workers
+        bring themselves up when the Coordinator re-execs the script, so
+        chief-side start is local-only.
+        """
+        clean_stale_processes()
+        self.initialize()
+
+    def register_local_process(self, proc: subprocess.Popen) -> None:
+        self._local_procs.append(proc)
+
+    def terminate(self) -> None:
+        """Kill any worker process groups this process launched
+        (reference: killpg in ``cluster.py:212-216``)."""
+        for proc in self._local_procs:
+            if proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        self._local_procs.clear()
+
+    def shutdown(self) -> None:
+        self.terminate()
+        if self._initialized and self.num_processes > 1:
+            import jax
+
+            try:
+                jax.distributed.shutdown()
+            except Exception as e:  # noqa: BLE001 - best-effort teardown
+                logging.warning("jax.distributed.shutdown failed: %s", e)
+        self._initialized = False
+
+
+# -------------------------------------------------------------- stale cleanup
+def _pidfile_dir() -> str:
+    d = os.path.join(const.DEFAULT_WORKING_DIR, "pids")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def write_pidfile() -> str:
+    """Record this process so a later launch can clean it up if it leaks
+    (reference: ps/kill sweep on node start, ``server_starter.py:29-46``)."""
+    path = os.path.join(_pidfile_dir(), f"{os.getpid()}.pid")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(str(os.getpid()))
+    return path
+
+
+def clean_stale_processes() -> int:
+    """Kill processes recorded by previous runs that are still alive.
+
+    Returns the number of stale processes signalled. Never signals self or
+    ancestors.
+    """
+    killed = 0
+    self_pid, parent_pid = os.getpid(), os.getppid()
+    d = _pidfile_dir()
+    for name in os.listdir(d):
+        if not name.endswith(".pid"):
+            continue
+        path = os.path.join(d, name)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                pid = int(f.read().strip())
+        except (ValueError, OSError):
+            os.unlink(path)
+            continue
+        if pid in (self_pid, parent_pid):
+            continue
+        try:
+            os.kill(pid, signal.SIGTERM)
+            killed += 1
+            logging.info("killed stale autodist process %d", pid)
+        except ProcessLookupError:
+            pass
+        except PermissionError:  # someone else's pid now
+            pass
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    return killed

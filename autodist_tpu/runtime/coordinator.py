@@ -1,0 +1,145 @@
+"""Coordinator: chief-side worker launch + monitoring.
+
+Replaces the reference's ``Coordinator``
+(``/root/reference/autodist/coordinator.py:41-110``): on the chief it shipped
+the serialized strategy to every worker over SFTP, re-executed
+``python <sys.argv>`` remotely with the ``AUTODIST_*`` role env vars, and ran
+a monitor thread per worker that killed the chief (``os._exit(1)``) if any
+worker died. The same contract holds here, minus paramiko: remote exec goes
+through the system ``ssh``/``scp`` binaries (TPU-VM images ship them; GCE
+metadata handles keys), local "remote" nodes are plain subprocesses, and the
+strategy still travels as a file named by ``AUTODIST_STRATEGY_ID``.
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from autodist_tpu import const
+from autodist_tpu.const import ENV
+from autodist_tpu.runtime.cluster import Cluster
+from autodist_tpu.strategy import Strategy
+from autodist_tpu.utils import logging
+
+_LOCAL_ADDRESSES = ("localhost", "127.0.0.1", "0.0.0.0", "::1")
+
+
+def _is_local(address: str) -> bool:
+    if address in _LOCAL_ADDRESSES:
+        return True
+    try:
+        import socket
+
+        return address in (socket.gethostname(), socket.getfqdn())
+    except OSError:  # pragma: no cover
+        return False
+
+
+class Coordinator:
+    """Launch the user script on every worker host and watch it.
+
+    ``launch_clients()`` re-execs ``python <sys.argv>`` per worker with the
+    role env (reference ``coordinator.py:66-90``); monitor threads implement
+    the chief fail-fast (``coordinator.py:98-110``).
+    """
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        strategy: Optional[Strategy] = None,
+        argv: Optional[Sequence[str]] = None,
+    ):
+        self.cluster = cluster
+        self.strategy = strategy
+        self.argv = list(argv) if argv is not None else [sys.executable] + sys.argv
+        self.procs: List[subprocess.Popen] = []
+        self.threads: List[threading.Thread] = []
+        self._failed = threading.Event()
+
+    # ------------------------------------------------------------------ launch
+    def launch_clients(self) -> None:
+        strategy_id = self.strategy.id if self.strategy else ENV.AUTODIST_STRATEGY_ID.val
+        workers = [
+            n for n in self.cluster.resource_spec.nodes
+            if n.address != self.cluster.resource_spec.chief_address
+        ]
+        for node in workers:
+            env = self.cluster.env_for_worker(node.address, strategy_id)
+            if _is_local(node.address):
+                proc = self._launch_local(env)
+            else:
+                self._ship_strategy(node.address, strategy_id)
+                proc = self._launch_remote(node.address, env)
+            self.procs.append(proc)
+            self.cluster.register_local_process(proc)
+            t = threading.Thread(
+                target=self._monitor, args=(node.address, proc), daemon=True
+            )
+            t.start()
+            self.threads.append(t)
+            logging.info("launched worker on %s (pid %d)", node.address, proc.pid)
+
+    def _launch_local(self, env: Dict[str, str]) -> subprocess.Popen:
+        full_env = {**os.environ, **env}
+        # setsid: own process group so terminate() can killpg without taking
+        # down the chief (reference cluster.py:191-201 used the same trick).
+        return subprocess.Popen(
+            self.argv, env=full_env, start_new_session=True,
+            stdout=None, stderr=None,
+        )
+
+    def _launch_remote(self, address: str, env: Dict[str, str]) -> subprocess.Popen:
+        exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+        cmd = f"cd {shlex.quote(os.getcwd())} && {exports} {' '.join(shlex.quote(a) for a in self.argv)}"
+        if ENV.AUTODIST_DEBUG_REMOTE.val:
+            # Parity with AUTODIST_DEBUG_REMOTE (reference cluster.py:340-341):
+            # print instead of executing, for manual debugging.
+            logging.info("[debug-remote] ssh %s %s", address, cmd)
+            return subprocess.Popen(["true"])
+        return subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", address, cmd],
+            start_new_session=True,
+        )
+
+    def _ship_strategy(self, address: str, strategy_id: str) -> None:
+        """SFTP-analog: scp the serialized strategy file to the worker
+        (reference coordinator.py:84-88)."""
+        if not strategy_id:
+            return
+        path = os.path.join(const.DEFAULT_STRATEGY_DIR, strategy_id)
+        if not os.path.exists(path) or ENV.AUTODIST_DEBUG_REMOTE.val:
+            return
+        subprocess.run(
+            ["ssh", "-o", "StrictHostKeyChecking=no", address,
+             f"mkdir -p {shlex.quote(const.DEFAULT_STRATEGY_DIR)}"],
+            check=True,
+        )
+        subprocess.run(
+            ["scp", "-o", "StrictHostKeyChecking=no", path, f"{address}:{path}"],
+            check=True,
+        )
+
+    # ----------------------------------------------------------------- monitor
+    def _monitor(self, address: str, proc: subprocess.Popen) -> None:
+        code = proc.wait()
+        if code != 0 and not self._failed.is_set():
+            self._failed.set()
+            logging.error(
+                "worker %s exited with code %d — terminating chief "
+                "(fail-fast, reference coordinator.py:98-110)", address, code,
+            )
+            self.cluster.terminate()
+            os._exit(1)
+
+    def join(self) -> None:
+        """Block until every worker exits (clean launcher shutdown)."""
+        for proc in self.procs:
+            proc.wait()
+
+    @property
+    def any_failed(self) -> bool:
+        return self._failed.is_set()

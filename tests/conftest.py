@@ -24,6 +24,10 @@ assert jax.device_count() == 8, "tests require the 8-device host-platform mesh"
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line("markers", "integration: slow multi-process tests")
+
+
 def pytest_addoption(parser):
     # Mirror of reference tests/conftest.py:4-15 --run-integration opt-in.
     parser.addoption(

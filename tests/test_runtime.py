@@ -1,0 +1,214 @@
+"""Runtime (L1) tests: cluster determinism, env contract, process cleanup,
+coordinator launch/monitor semantics.
+
+Reference parity model: tests/integration/test_dist.py ran real 2-host
+clusters; here the contract pieces (ordering, env, fail-fast) are unit-tested
+and the multi-process jax.distributed path is an opt-in integration test.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from autodist_tpu.const import ENV
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.runtime.cluster import (
+    Cluster,
+    _deterministic_port,
+    clean_stale_processes,
+    _pidfile_dir,
+)
+from autodist_tpu.runtime.coordinator import Coordinator, _is_local
+
+
+TWO_NODE = {
+    "nodes": [
+        {"address": "10.0.0.2", "chips": 4},
+        {"address": "10.0.0.1", "chips": 4, "chief": True},
+    ]
+}
+
+
+def make_cluster():
+    return Cluster(ResourceSpec(resource_dict=TWO_NODE))
+
+
+class TestCluster:
+    def test_deterministic_port_in_range(self):
+        spec = ResourceSpec(resource_dict=TWO_NODE)
+        p1 = _deterministic_port(spec)
+        p2 = _deterministic_port(ResourceSpec(resource_dict=TWO_NODE))
+        assert p1 == p2  # all cluster members agree
+        assert 15000 <= p1 < 16000
+
+    def test_process_ordering_chief_first_then_sorted(self):
+        c = make_cluster()
+        assert c.process_id("10.0.0.1") == 0  # chief first
+        assert c.process_id("10.0.0.2") == 1
+        assert c.num_processes == 2
+
+    def test_unknown_address_raises(self):
+        with pytest.raises(ValueError, match="not in resource spec"):
+            make_cluster().process_id("10.9.9.9")
+
+    def test_coordinator_address_is_chief(self):
+        c = make_cluster()
+        host, port = c.coordinator_address.rsplit(":", 1)
+        assert host == "10.0.0.1"
+        assert int(port) == c.coordinator_port
+
+    def test_env_contract(self):
+        c = make_cluster()
+        env = c.env_for_worker("10.0.0.2", strategy_id="20260729T000000M0")
+        assert env[ENV.AUTODIST_WORKER.name] == "10.0.0.2"
+        assert env[ENV.AUTODIST_PROCESS_ID.name] == "1"
+        assert env[ENV.AUTODIST_NUM_PROCESSES.name] == "2"
+        assert env[ENV.AUTODIST_STRATEGY_ID.name] == "20260729T000000M0"
+        assert env[ENV.AUTODIST_COORDINATOR.name] == c.coordinator_address
+
+    def test_single_node_initialize_noop(self):
+        c = Cluster(ResourceSpec(resource_dict={"nodes": [{"address": "localhost", "chips": 8, "chief": True}]}))
+        c.initialize()  # must not call jax.distributed for 1 process
+        assert c.num_processes == 1
+
+
+class TestStaleCleanup:
+    def test_dead_pidfile_removed(self):
+        d = _pidfile_dir()
+        # PID that almost surely doesn't exist (max_pid is usually 4M+, but
+        # use a dead child to be exact).
+        child = subprocess.Popen([sys.executable, "-c", "pass"])
+        child.wait()
+        path = os.path.join(d, f"{child.pid}.pid")
+        with open(path, "w") as f:
+            f.write(str(child.pid))
+        clean_stale_processes()
+        assert not os.path.exists(path)
+
+    def test_live_stale_process_killed(self):
+        d = _pidfile_dir()
+        child = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+        path = os.path.join(d, f"{child.pid}.pid")
+        with open(path, "w") as f:
+            f.write(str(child.pid))
+        killed = clean_stale_processes()
+        assert killed >= 1
+        child.wait(timeout=10)
+        assert not os.path.exists(path)
+
+
+class TestCoordinator:
+    def test_is_local(self):
+        assert _is_local("localhost")
+        assert _is_local("127.0.0.1")
+        assert not _is_local("10.0.0.9")
+
+    def test_debug_remote_short_circuits_ssh(self, monkeypatch):
+        monkeypatch.setenv(ENV.AUTODIST_DEBUG_REMOTE.name, "True")
+        c = make_cluster()
+        coord = Coordinator(c, argv=["python", "train.py"])
+        coord.launch_clients()
+        for p in coord.procs:
+            assert p.wait(timeout=10) == 0  # "true" stub, no real ssh
+        assert not coord.any_failed
+
+    def test_local_worker_launch_and_join(self, tmp_path):
+        """A localhost 'remote' worker runs the argv with the role env."""
+        out = tmp_path / "worker_env.txt"
+        script = tmp_path / "w.py"
+        script.write_text(textwrap.dedent(f"""
+            import os
+            with open({str(out)!r}, "w") as f:
+                f.write(os.environ.get("AUTODIST_WORKER", "") + "," +
+                        os.environ.get("AUTODIST_PROCESS_ID", ""))
+        """))
+        spec = ResourceSpec(resource_dict={"nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+        c = Cluster(spec)
+        coord = Coordinator(c, argv=[sys.executable, str(script)])
+        # Manufacture a worker entry: patch the node list post-validation
+        # (loopback multi-node specs are rejected by design, but the local
+        # subprocess path is exactly what --num-local-processes uses).
+        import autodist_tpu.runtime.coordinator as cmod
+        workers_env = c.env_for_worker("localhost", "")
+        proc = coord._launch_local(workers_env)
+        assert proc.wait(timeout=30) == 0
+        addr, pid = out.read_text().split(",")
+        assert addr == "localhost"
+        assert pid == "0"
+
+    def test_chief_fail_fast_on_worker_death(self, tmp_path):
+        """Worker exits non-zero → chief process os._exit(1)s.
+
+        Run the whole scenario in a subprocess since fail-fast kills the
+        process (reference coordinator.py:98-110 semantics).
+        """
+        driver = tmp_path / "driver.py"
+        driver.write_text(textwrap.dedent("""
+            import sys, time
+            from autodist_tpu.resource_spec import ResourceSpec
+            from autodist_tpu.runtime.cluster import Cluster
+            from autodist_tpu.runtime.coordinator import Coordinator
+
+            spec = ResourceSpec(resource_dict={"nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+            c = Cluster(spec)
+            coord = Coordinator(c, argv=[sys.executable, "-c", "raise SystemExit(3)"])
+            import threading
+            proc = coord._launch_local(c.env_for_worker("localhost"))
+            coord.procs.append(proc)
+            t = threading.Thread(target=coord._monitor, args=("localhost", proc), daemon=True)
+            t.start()
+            time.sleep(30)   # monitor must kill us long before this
+            print("chief survived", flush=True)
+        """))
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        res = subprocess.run(
+            [sys.executable, str(driver)], env=env, cwd="/root/repo",
+            capture_output=True, text=True, timeout=60,
+        )
+        assert res.returncode == 1, res.stdout + res.stderr
+        assert "chief survived" not in res.stdout
+
+
+@pytest.mark.integration
+def test_two_process_cpu_cluster(tmp_path):
+    """Full multi-controller path: 2 local processes, jax.distributed,
+    a cross-process psum — the reference's 2-host docker CI distilled
+    (Jenkinsfile:93-131) onto one machine."""
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        from autodist_tpu.runtime.launcher import initialize_from_env
+        initialize_from_env()
+        import jax
+        import jax.numpy as jnp
+        assert jax.process_count() == 2, jax.process_count()
+        assert jax.device_count() == 4
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        import numpy as np
+        mesh = Mesh(np.array(jax.devices()).reshape(4), ("data",))
+        x = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("data")), np.ones((2,), np.float32) * (jax.process_index() + 1), (4,))
+        total = jax.jit(lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P()))(x)
+        assert float(total) == 6.0, float(total)
+        print("OK", jax.process_index(), flush=True)
+    """))
+    from autodist_tpu.runtime.launcher import _launch_local_fleet
+
+    # Scrubbed env: drop the host's default accelerator platform (e.g. a TPU
+    # plugin sitecustomize on PYTHONPATH) so the fleet really runs on CPU.
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith(("JAX_", "XLA_", "PALLAS_", "AXON", "TPU_"))
+        and k != "PYTHONPATH"
+    }
+    env["PYTHONPATH"] = "/root/repo"
+    env["JAX_PLATFORMS"] = "cpu"
+    code = _launch_local_fleet(
+        [sys.executable, str(script)], 2, coordinator_port=15999, base_env=env
+    )
+    assert code == 0
