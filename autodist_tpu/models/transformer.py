@@ -37,7 +37,7 @@ class TransformerConfig:
     max_seq_len: int = 512
     causal: bool = True                 # False => BERT-style MLM
     dtype: Any = jnp.bfloat16           # compute dtype (params stay fp32)
-    attention_impl: str = "dot"         # dot | flash | ring
+    attention_impl: str = "dot"         # dot | flash | ring | ulysses
     remat: bool = False
     mlm_mask_token: int = 0             # [MASK] id for the MLM objective
 
@@ -112,6 +112,10 @@ def _attention(q, k, v, cfg: TransformerConfig):
         from autodist_tpu.parallel.ring_attention import ring_attention
 
         return ring_attention(q, k, v, causal=cfg.causal)
+    if cfg.attention_impl == "ulysses":
+        from autodist_tpu.parallel.ring_attention import ulysses_attention
+
+        return ulysses_attention(q, k, v, causal=cfg.causal)
     raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
 
 

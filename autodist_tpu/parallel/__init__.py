@@ -1,0 +1,22 @@
+"""Sequence/context parallelism (TPU-native extension).
+
+The reference is data-parallel only (``/root/reference/docs/design/
+architecture.rst:49-51``) — long-context support is new capability, designed
+TPU-first: ring attention rotates K/V chunks around the ICI ring with
+``lax.ppermute`` (communication overlaps the per-chunk attention compute),
+and Ulysses-style all-to-all re-shards activations seq→heads so full-sequence
+flash attention runs locally (one ``lax.all_to_all`` each way).
+"""
+from autodist_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_attention_local,
+    ulysses_attention,
+    ulysses_attention_local,
+)
+
+__all__ = [
+    "ring_attention",
+    "ring_attention_local",
+    "ulysses_attention",
+    "ulysses_attention_local",
+]

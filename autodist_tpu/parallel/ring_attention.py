@@ -1,0 +1,208 @@
+"""Ring attention + Ulysses all-to-all sequence parallelism.
+
+Long-context attention over a mesh "seq" axis — capability the reference
+lacks entirely (SURVEY.md §5: no sequence-dim logic anywhere in
+``/root/reference/autodist/``), built TPU-native:
+
+- **Ring attention** (Liu et al., arXiv 2310.01889): Q stays put, K/V chunks
+  rotate around the ICI ring via ``lax.ppermute``; each step merges a chunk's
+  attention into fp32 online-softmax accumulators, so no device ever holds
+  more than ``seq/n`` of K/V and the logits matrix never materializes beyond
+  ``[chunk, chunk]``. Gradients come from autodiff through the
+  (rematerialized) scan — ``ppermute``'s transpose is the reverse rotation,
+  so the backward pass is itself a ring.
+- **Ulysses** (DeepSpeed-Ulysses, arXiv 2309.14509): two ``lax.all_to_all``
+  collectives re-shard [B, seq/n, H, D] → [B, seq, H/n, D] so each device
+  runs ordinary full-sequence flash attention on a head subset. Cheaper
+  collectives than the ring on all-to-all-friendly topologies; requires
+  ``heads % n == 0``.
+
+Both come in two forms: ``*_local`` for use inside an existing
+``shard_map`` (axis already manual), and a global-array wrapper that opens a
+partial-manual ``shard_map`` over just the seq axis (other mesh axes stay
+under GSPMD auto, so data/model sharding composes).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from autodist_tpu import const
+
+_NEG_INF = -1e30
+
+
+# ----------------------------------------------------------------- ring core
+def _chunk_merge(q, k, v, q_off, k_off, causal, scale, m, l, acc):
+    """Merge one K/V chunk into online-softmax stats.
+
+    q: [b, cq, h, d]; k, v: [b, ck, h, d]; m, l: [b, h, cq, 1];
+    acc: [b, h, cq, d] (fp32). Offsets are global sequence positions of the
+    chunks (traced values — the k offset depends on ring step).
+    """
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        cq, ck = q.shape[1], k.shape[1]
+        rows = q_off + lax.broadcasted_iota(jnp.int32, (cq, ck), 0)
+        cols = k_off + lax.broadcasted_iota(jnp.int32, (cq, ck), 1)
+        s = jnp.where((rows >= cols)[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = alpha * l + p.sum(axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum(
+        "bhqk,bkhd->bhqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, acc_new
+
+
+def ring_attention_local(
+    q, k, v, causal: bool = False, axis_name: str = const.MESH_AXIS_SEQ
+):
+    """Ring attention on per-device chunks — call inside ``shard_map``.
+
+    q, k, v: [batch, seq_local, heads, head_dim], the ``axis_name`` shard of
+    the global sequence. Returns the local output chunk, same shape as q.
+    """
+    n = lax.psum(1, axis_name)
+    r = lax.axis_index(axis_name)
+    b, c, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    m0 = jnp.full((b, h, c, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, c, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, c, d), jnp.float32)
+    q_off = r * c
+
+    @jax.checkpoint
+    def step(carry, t):
+        k_t, v_t, m, l, acc = carry
+        kv_idx = (r - t) % n
+        k_off = kv_idx * c
+
+        def attend(args):
+            m, l, acc = args
+            return _chunk_merge(q, k_t, v_t, q_off, k_off, causal, scale, m, l, acc)
+
+        if causal:
+            # Chunks strictly above the causal diagonal contribute nothing;
+            # skip their matmuls at runtime (the ring still rotates).
+            m, l, acc = lax.cond(kv_idx <= r, attend, lambda args: args, (m, l, acc))
+        else:
+            m, l, acc = attend((m, l, acc))
+        # Rotate K/V to the next device; after n steps every chunk has
+        # visited every device. (Skipped on the last step — the rotation
+        # would only restore the initial layout.)
+        k_t, v_t = jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, perm), (k_t, v_t)
+        )
+        return (k_t, v_t, m, l, acc), None
+
+    (k_f, v_f, m, l, acc), _ = lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n)
+    )
+    del k_f, v_f
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe).astype(q.dtype)          # [b, h, c, d]
+    return jnp.transpose(out, (0, 2, 1, 3))       # [b, c, h, d]
+
+
+# -------------------------------------------------------------- ulysses core
+def ulysses_attention_local(
+    q, k, v, causal: bool = False, axis_name: str = const.MESH_AXIS_SEQ
+):
+    """All-to-all sequence parallelism — call inside ``shard_map``.
+
+    Re-shards [b, seq/n, h, d] → [b, seq, h/n, d], runs full-sequence flash
+    attention on the head subset, re-shards back.
+    """
+    from autodist_tpu.ops.flash_attention import flash_attention
+
+    n = lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(
+            f"ulysses attention needs heads ({h}) divisible by the seq-axis "
+            f"size ({n}); use ring attention for this shape"
+        )
+
+    def seq_to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    o = flash_attention(qf, kf, vf, causal=causal)
+    return heads_to_seq(o)
+
+
+# ------------------------------------------------------------------ wrappers
+def _seq_sharded(fn_local, q, k, v, causal, mesh, axis_name):
+    if mesh is None:
+        mesh = _default_mesh()
+    axis_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis_name, 1)
+    if axis_size <= 1:
+        # No seq axis on this mesh — plain flash attention.
+        from autodist_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    if q.shape[1] % axis_size != 0:
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by mesh axis "
+            f"{axis_name!r}={axis_size}"
+        )
+    spec = P(None, axis_name, None, None)
+    sm = jax.shard_map(
+        functools.partial(fn_local, causal=causal, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={axis_name},   # partial-manual: data/model stay GSPMD-auto
+        check_vma=False,
+    )
+    return sm(q, k, v)
+
+
+def _default_mesh() -> Mesh:
+    from autodist_tpu.api import get_default_autodist
+
+    ad = get_default_autodist()
+    if ad is None:
+        raise ValueError(
+            "ring/ulysses attention needs a mesh: pass mesh= or construct "
+            "an AutoDist first"
+        )
+    return ad.mesh
+
+
+def ring_attention(
+    q, k, v, causal: bool = False,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = const.MESH_AXIS_SEQ,
+):
+    """Ring attention on global [B, S, H, D] arrays.
+
+    Opens a partial-manual ``shard_map`` over the mesh's seq axis; falls back
+    to plain flash attention when that axis is trivial, so models can enable
+    it unconditionally.
+    """
+    return _seq_sharded(ring_attention_local, q, k, v, causal, mesh, axis_name)
+
+
+def ulysses_attention(
+    q, k, v, causal: bool = False,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = const.MESH_AXIS_SEQ,
+):
+    """Ulysses (all-to-all) sequence-parallel attention on global arrays."""
+    return _seq_sharded(ulysses_attention_local, q, k, v, causal, mesh, axis_name)
