@@ -1,0 +1,4 @@
+"""Data pipeline: native prefetching loader + sharded feed helpers."""
+from autodist_tpu.data.loader import DataLoader
+
+__all__ = ["DataLoader"]

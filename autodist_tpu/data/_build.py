@@ -1,0 +1,88 @@
+"""Compile-on-demand for the native data loader.
+
+Builds ``native/dataloader.cc`` into a cached shared library with the host
+toolchain (g++), keyed by source hash so edits rebuild automatically. No
+pybind11 — the library exposes a plain C ABI consumed via ctypes. Returns
+None when no toolchain is available; callers fall back to pure Python.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+import tempfile
+from typing import Optional
+
+from autodist_tpu.utils import logging
+
+_SRC = os.path.join(os.path.dirname(__file__), "native", "dataloader.cc")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _cache_dir() -> str:
+    d = os.environ.get("AUTODIST_NATIVE_CACHE") or os.path.join(
+        tempfile.gettempdir(), "autodist-tpu", "native"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def build_library() -> Optional[str]:
+    """Compile (or reuse cached) libdataloader; returns path or None."""
+    cxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
+    if cxx is None:
+        logging.warning("no C++ compiler found; native data loader disabled")
+        return None
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_cache_dir(), f"libdataloader-{digest}.so")
+    if os.path.exists(out):
+        return out
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True, timeout=120)
+        os.replace(tmp, out)  # atomic: concurrent builders race benignly
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as e:
+        stderr = getattr(e, "stderr", "") or ""
+        logging.warning("native data loader build failed: %s\n%s", e, stderr[-2000:])
+        return None
+    logging.info("built native data loader -> %s", out)
+    return out
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """Build + dlopen once per process; None when unavailable."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    path = build_library()
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    u64, i64, i32 = ctypes.c_uint64, ctypes.c_int64, ctypes.c_int
+    ptr = ctypes.c_void_p
+    lib.ad_loader_create.restype = ptr
+    lib.ad_loader_create.argtypes = [i32, u64, u64, i32, i32, i32, u64, i32, i64]
+    lib.ad_loader_set_source.restype = None
+    # c_void_p, NOT c_char_p: char_p elements auto-convert to NUL-terminated
+    # bytes and would corrupt binary rows.
+    lib.ad_loader_set_source.argtypes = [ptr, i32, ctypes.c_void_p, u64]
+    lib.ad_loader_start.restype = i32
+    lib.ad_loader_start.argtypes = [ptr]
+    lib.ad_loader_next.restype = i64
+    lib.ad_loader_next.argtypes = [
+        ptr, ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(u64)
+    ]
+    lib.ad_loader_release.restype = None
+    lib.ad_loader_release.argtypes = [ptr, i32]
+    lib.ad_loader_batches_per_epoch.restype = i64
+    lib.ad_loader_batches_per_epoch.argtypes = [ptr]
+    lib.ad_loader_destroy.restype = None
+    lib.ad_loader_destroy.argtypes = [ptr]
+    _lib = lib
+    return _lib

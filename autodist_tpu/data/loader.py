@@ -1,0 +1,173 @@
+"""DataLoader: prefetched, shuffled batches from in-memory feature arrays.
+
+Facade over two engines with identical semantics:
+
+- **native** (default when a C++ toolchain exists): the multi-threaded
+  row-gather pipeline in ``native/dataloader.cc`` — batches are assembled by
+  C++ threads without the GIL while the accelerator runs the previous step,
+  the role TF's C++ input-pipeline/queue kernels played for the reference.
+- **python**: plain numpy gathering, same batch order bit-for-bit (the
+  shuffle is splitmix64-based in both), used as fallback and as the test
+  oracle for the native engine.
+
+Batch order is deterministic given (seed, batch_size, drop_remainder)
+regardless of engine or thread count.
+
+Optionally binds a :class:`~autodist_tpu.kernel.lowering.ShardingPlan` so
+every yielded batch is already ``device_put`` along the mesh data axis (the
+remapper's feed-splitting contract, reference remapper.py:81-123).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+from autodist_tpu.data import _build
+from autodist_tpu.utils import logging
+
+
+def _splitmix64(x: int) -> tuple:
+    x = (x + 0x9E3779B97F4A7C15) & (2**64 - 1)
+    z = x
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & (2**64 - 1)
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & (2**64 - 1)
+    return x, z ^ (z >> 31)
+
+
+def _epoch_perm(n_rows: int, epoch: int, seed: int, shuffle: bool) -> np.ndarray:
+    """The exact permutation the native engine uses (dataloader.cc EpochPerm)."""
+    perm = np.arange(n_rows, dtype=np.uint64)
+    if not shuffle:
+        return perm
+    s = (seed ^ ((0x5851F42D4C957F2D * (epoch + 1)) & (2**64 - 1))) & (2**64 - 1)
+    for i in range(n_rows - 1, 0, -1):
+        s, r = _splitmix64(s)
+        j = r % (i + 1)
+        perm[i], perm[j] = perm[j], perm[i]
+    return perm
+
+
+class DataLoader:
+    """Iterate dict-of-arrays data as prefetched batches.
+
+    ``data``: mapping name -> np.ndarray, all with equal leading dim.
+    ``epochs``: -1 repeats forever. ``plan``: optional ShardingPlan; when
+    given, batches come back as jax Arrays sharded along the data axis.
+    """
+
+    def __init__(
+        self,
+        data: Dict[str, np.ndarray],
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_remainder: bool = True,
+        epochs: int = 1,
+        capacity: int = 4,
+        num_threads: int = 2,
+        engine: str = "auto",      # auto | native | python
+        plan: Any = None,
+    ):
+        if not data:
+            raise ValueError("data must have at least one feature array")
+        self.names = sorted(data)
+        self.arrays = [np.ascontiguousarray(data[k]) for k in self.names]
+        n_rows = {a.shape[0] for a in self.arrays}
+        if len(n_rows) != 1:
+            raise ValueError(f"feature arrays disagree on leading dim: {n_rows}")
+        self.n_rows = n_rows.pop()
+        if batch_size <= 0 or batch_size > self.n_rows:
+            raise ValueError(
+                f"batch_size {batch_size} invalid for {self.n_rows} rows"
+            )
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+        self.epochs = epochs
+        self.capacity = capacity
+        self.num_threads = num_threads
+        self.plan = plan
+
+        lib = _build.load_library() if engine in ("auto", "native") else None
+        if engine == "native" and lib is None:
+            raise RuntimeError("native engine requested but unavailable")
+        self.engine = "native" if lib is not None else "python"
+        self._lib = lib
+
+    @property
+    def batches_per_epoch(self) -> int:
+        full = self.n_rows // self.batch_size
+        if self.drop_remainder or self.n_rows % self.batch_size == 0:
+            return full
+        return full + 1
+
+    def __len__(self) -> int:
+        if self.epochs < 0:
+            raise TypeError("infinite loader has no len()")
+        return self.epochs * self.batches_per_epoch
+
+    # ------------------------------------------------------------------- iter
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        it = self._iter_native() if self.engine == "native" else self._iter_python()
+        if self.plan is None:
+            return it
+        return (self._shard(b) for b in it)
+
+    def _shard(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        import jax
+
+        return jax.device_put(batch, self.plan.batch_shardings(batch, strict=False))
+
+    def _iter_python(self):
+        total = None if self.epochs < 0 else self.epochs
+        epoch = 0
+        while total is None or epoch < total:
+            perm = _epoch_perm(self.n_rows, epoch, self.seed, self.shuffle)
+            for b in range(self.batches_per_epoch):
+                idx = perm[b * self.batch_size:(b + 1) * self.batch_size]
+                yield {
+                    name: arr[idx.astype(np.int64)]
+                    for name, arr in zip(self.names, self.arrays)
+                }
+            epoch += 1
+
+    def _iter_native(self):
+        lib = self._lib
+        h = lib.ad_loader_create(
+            len(self.arrays), self.n_rows, self.batch_size, self.capacity,
+            self.num_threads, int(self.shuffle), self.seed,
+            int(self.drop_remainder), self.epochs,
+        )
+        if not h:
+            logging.warning("native loader create failed; falling back to python")
+            yield from self._iter_python()
+            return
+        try:
+            for i, arr in enumerate(self.arrays):
+                row_bytes = arr.dtype.itemsize * int(np.prod(arr.shape[1:], dtype=np.int64))
+                lib.ad_loader_set_source(
+                    h, i, arr.ctypes.data_as(ctypes.c_void_p), row_bytes
+                )
+            if lib.ad_loader_start(h) != 0:
+                raise RuntimeError("native loader failed to start")
+            ptrs = (ctypes.c_void_p * len(self.arrays))()
+            rows = ctypes.c_uint64()
+            while True:
+                slot = lib.ad_loader_next(h, ptrs, ctypes.byref(rows))
+                if slot < 0:
+                    break
+                n = int(rows.value)
+                batch = {}
+                for i, (name, arr) in enumerate(zip(self.names, self.arrays)):
+                    shape = (n,) + arr.shape[1:]
+                    nbytes = arr.dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+                    buf = ctypes.string_at(ptrs[i], nbytes)
+                    # Copy out of the slot so it can be refilled immediately.
+                    batch[name] = np.frombuffer(buf, dtype=arr.dtype).reshape(shape)
+                lib.ad_loader_release(h, int(slot))
+                yield batch
+        finally:
+            lib.ad_loader_destroy(h)
