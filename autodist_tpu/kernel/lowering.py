@@ -548,7 +548,26 @@ class DistributedTrainStep:
             out_shardings=out_shardings,
             donate_argnums=(0,) if self._donate else (),
         )
+        if const.ENV.AUTODIST_DUMP_HLO.val:
+            # Per-stage compile snapshots (the reference dumped its graph to
+            # TensorBoard at each transform stage, graph_transformer.py:62-90).
+            from autodist_tpu.utils import tracing
+
+            lowered = self._compiled.lower(state, batch)
+            tracing.dump_compiled("train_step", lowered, lowered.compile())
         return self._compiled
+
+    def trace_step(self, state: TrainState, batch, name: str = "train_step"):
+        """One profiled step -> TensorBoard trace dir (runner.py:64-75 analog).
+
+        Returns ``(new_state, metrics), trace_dir``."""
+        from autodist_tpu.utils import tracing
+
+        fn = self._compiled or self._compile(state, batch)
+        with tracing.trace(name) as trace_dir:
+            out = fn(state, batch)
+            jax.block_until_ready(out)
+        return out, trace_dir
 
     def __call__(self, state: TrainState, batch) -> Tuple[TrainState, Dict[str, Any]]:
         fn = self._compiled or self._compile(state, batch)

@@ -1,0 +1,109 @@
+"""Tracing/profiling subsystem tests (reference: chrome-trace + per-stage
+graph snapshots, runner.py:64-75 / visualization_util.py:24-36)."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from autodist_tpu.utils import tracing
+from autodist_tpu.const import ENV
+
+
+def test_dump_hlo_writes_stage_files(tmp_path):
+    p = tracing.dump_hlo("t", "0-stablehlo", "module {}", hlo_dir=str(tmp_path))
+    assert os.path.exists(p)
+    assert open(p).read() == "module {}"
+
+
+def test_dump_compiled_lowered_and_optimized(tmp_path):
+    lowered = jax.jit(lambda x: x * 2).lower(jnp.ones((4,)))
+    paths = tracing.dump_compiled("mul", lowered, lowered.compile(), hlo_dir=str(tmp_path))
+    assert len(paths) == 2
+    assert "stablehlo" in open(paths[0]).read()
+
+
+def test_step_timer_summary():
+    t = tracing.StepTimer(items_per_step=128, warmup=1)
+    import time
+
+    for _ in range(4):
+        with t:
+            time.sleep(0.01)
+    s = t.summary()
+    assert s["steps"] == 4 and s["measured"] == 3
+    assert s["mean_s"] >= 0.009
+    assert s["items_per_sec"] == pytest.approx(128 / s["mean_s"])
+
+
+def test_trace_context_produces_profile(tmp_path):
+    with tracing.trace("unit", trace_dir=str(tmp_path / "tr")) as d:
+        jax.block_until_ready(jnp.arange(16) * 2)
+    # jax.profiler writes plugins/profile/<ts>/*.xplane.pb under the dir.
+    found = [f for root, _, fs in os.walk(d) for f in fs]
+    assert any("xplane" in f or f.endswith(".json.gz") for f in found), found
+
+
+def test_train_step_hlo_dump_env(tmp_path, monkeypatch):
+    """AUTODIST_DUMP_HLO=True dumps compile artifacts for the train step."""
+    from autodist_tpu.api import AutoDist
+    from autodist_tpu.resource_spec import ResourceSpec
+    import autodist_tpu.strategy as S
+
+    monkeypatch.setenv(ENV.AUTODIST_DUMP_HLO.name, "True")
+    monkeypatch.setenv(ENV.SYS_DATA_PATH.name, str(tmp_path))
+    AutoDist.reset_default()
+    try:
+        ad = AutoDist(
+            resource_spec=ResourceSpec(resource_dict={
+                "nodes": [{"address": "localhost", "chips": 8, "chief": True}]
+            }),
+            strategy_builder=S.AllReduce(),
+        )
+
+        def loss_fn(params, batch):
+            return ((batch["x"] @ params["w"]) ** 2).mean()
+
+        params = {"w": np.zeros((3, 1), np.float32)}
+        batch = {"x": np.ones((16, 3), np.float32)}
+        step = ad.build(loss_fn, params, batch)
+        state = step.init(params)
+        step(state, batch)
+        names = os.listdir(tmp_path)
+        assert any("0-stablehlo" in n for n in names), names
+    finally:
+        AutoDist.reset_default()
+
+
+def test_trace_step_returns_result_and_dir(tmp_path, monkeypatch):
+    from autodist_tpu.api import AutoDist
+    from autodist_tpu.resource_spec import ResourceSpec
+    import autodist_tpu.strategy as S
+
+    AutoDist.reset_default()
+    try:
+        ad = AutoDist(
+            resource_spec=ResourceSpec(resource_dict={
+                "nodes": [{"address": "localhost", "chips": 8, "chief": True}]
+            }),
+            strategy_builder=S.AllReduce(),
+        )
+
+        def loss_fn(params, batch):
+            return ((batch["x"] @ params["w"]) ** 2).mean()
+
+        params = {"w": np.ones((3, 1), np.float32)}
+        batch = {"x": np.ones((16, 3), np.float32)}
+        step = ad.build(loss_fn, params, batch)
+        state = step.init(params)
+        import autodist_tpu.utils.tracing as tr
+
+        monkeypatch.setattr(
+            tr.const, "DEFAULT_TRACE_DIR", str(tmp_path), raising=False
+        )
+        (state, metrics), d = step.trace_step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        assert os.path.isdir(d)
+    finally:
+        AutoDist.reset_default()
