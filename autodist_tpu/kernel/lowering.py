@@ -87,6 +87,11 @@ class TrainState:
     params: Any
     opt_state: Any
     comp_state: Any = struct.field(default_factory=dict)
+    # Bounded-staleness gradient buffers ({var: [K, ...]}): the SPMD
+    # rendering of the reference's staleness queues (ps_synchronizer.py:
+    # 384-455) — gradients apply with a fixed K-step delay instead of a
+    # nondeterministic ≤K-step one. Empty when no var has staleness.
+    stale_state: Any = struct.field(default_factory=dict)
 
 
 def _spec_with_axis(rank: int, dim: int, mesh_axis: str) -> P:
@@ -296,12 +301,22 @@ class ShardingPlan:
             out.append(self._sharding(spec))
         return jax.tree_util.tree_unflatten(treedef, out)
 
+    def stale_shardings(self, stale_state) -> Any:
+        """Gradient-delay buffers: the var's sharding behind a replicated
+        leading (delay-depth) dim."""
+        out = {}
+        for name, leaf in stale_state.items():
+            pspec = self.var_plans[name].pspec if name in self.var_plans else P()
+            out[name] = self._sharding(P(None, *pspec))
+        return out
+
     def state_shardings(self, state_shapes: TrainState) -> TrainState:
         return TrainState(
             step=self._sharding(P()),
             params=self.params_shardings(state_shapes.params),
             opt_state=self.opt_shardings(state_shapes.opt_state),
             comp_state=self.comp_shardings(state_shapes.comp_state),
+            stale_state=self.stale_shardings(state_shapes.stale_state),
         )
 
     def describe(self) -> str:
@@ -341,6 +356,11 @@ class DistributedTrainStep:
         self._compiled = None
         self._state_shardings = None
         self._compressors = self._resolve_compressors(plan)
+        self._stale = {
+            name: p.staleness
+            for name, p in plan.var_plans.items()
+            if p.staleness > 0
+        }
 
     @staticmethod
     def _resolve_compressors(plan: ShardingPlan):
@@ -386,6 +406,7 @@ class DistributedTrainStep:
             params=params,
             opt_state=self.tx.init(params),
             comp_state=self._init_comp_state(),
+            stale_state=self._init_stale_state(params),
         )
         shardings = self.plan.state_shardings(jax.eval_shape(lambda: state))
         self._state_shardings = shardings
@@ -414,6 +435,41 @@ class DistributedTrainStep:
         return comp_state
 
     # ------------------------------------------------------------------ step
+    def _init_stale_state(self, params):
+        """Zero-filled [K, ...] delay buffer per stale var."""
+        if not self._stale:
+            return {}
+        buffers = {}
+        leaves, _ = jax.tree_util.tree_flatten_with_path(params)
+        by_name = {_path_name(p): leaf for p, leaf in leaves}
+        for name, k in self._stale.items():
+            leaf = by_name[name]
+            buffers[name] = jnp.zeros((k,) + tuple(leaf.shape), leaf.dtype)
+        return buffers
+
+    def _apply_staleness(self, grads, stale_state):
+        """Swap each stale var's fresh gradient for the K-step-old one.
+
+        The fresh grad enters the buffer tail; the head (computed K steps
+        ago) is what the optimizer sees — so updates lag exactly
+        ``staleness`` steps, the deterministic rendering of the reference's
+        ≤K bound (its staleness queues let the chief run ahead by at most K
+        tokens). The first K steps apply zero gradient (buffers start
+        empty), matching "workers proceed before the server has aggregated".
+        """
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(grads)
+        new_bufs = dict(stale_state)
+        out = []
+        for path, g in leaves:
+            name = _path_name(path)
+            if name in new_bufs:
+                buf = new_bufs[name]
+                delayed = buf[0]
+                new_bufs[name] = jnp.concatenate([buf[1:], g[None]], axis=0)
+                g = delayed
+            out.append(g)
+        return jax.tree_util.tree_unflatten(treedef, out), new_bufs
+
     def _step(self, state: TrainState, batch):
         if self._compressors:
             loss, aux, grads, new_comp = self._compressed_grads(state, batch)
@@ -426,10 +482,14 @@ class DistributedTrainStep:
                 loss, grads = jax.value_and_grad(self.loss_fn)(state.params, batch)
                 aux = None
             new_comp = state.comp_state
+        new_stale = state.stale_state
+        if self._stale:
+            grads, new_stale = self._apply_staleness(grads, state.stale_state)
         updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
-            step=state.step + 1, params=new_params, opt_state=new_opt, comp_state=new_comp
+            step=state.step + 1, params=new_params, opt_state=new_opt,
+            comp_state=new_comp, stale_state=new_stale,
         )
         metrics = {"loss": loss}
         if aux is not None:

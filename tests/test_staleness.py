@@ -1,0 +1,107 @@
+"""Bounded-staleness semantics: delayed-gradient application.
+
+Closed-form assertions in the reference's c0 style: a linear loss whose
+gradient is the batch mean, stepped with plain SGD, so the entire delayed
+trajectory is hand-computable.
+"""
+import jax
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu.api import AutoDist
+from autodist_tpu.model_item import OptimizerSpec
+from autodist_tpu.resource_spec import ResourceSpec
+import autodist_tpu.strategy as S
+
+
+LR = 0.5
+
+
+@pytest.fixture
+def ad():
+    AutoDist.reset_default()
+    yield lambda builder: AutoDist(
+        resource_spec=ResourceSpec(resource_dict={
+            "nodes": [{"address": "localhost", "chips": 8, "chief": True}]
+        }),
+        strategy_builder=builder,
+    )
+    AutoDist.reset_default()
+
+
+def linear_setup(autodist, staleness_builder):
+    # loss = mean(batch) * w  ->  dloss/dw = mean(batch), independent of w.
+    def loss_fn(params, batch):
+        return (batch["x"] * params["w"]).mean()
+
+    params = {"w": np.array(10.0, np.float32)}
+    batch0 = {"x": np.full((8,), 0.0, np.float32)}
+    step = autodist(staleness_builder).build(
+        loss_fn, params, batch0,
+        optimizer=OptimizerSpec("sgd", {"learning_rate": LR}),
+    )
+    return step, params
+
+
+def batches(values):
+    return [{"x": np.full((8,), v, np.float32)} for v in values]
+
+
+def test_staleness_delays_updates_exactly_k_steps(ad):
+    K = 2
+    step, params = linear_setup(ad, S.PS(staleness=K))
+    assert step.plan.var_plans["w"].staleness == K
+    state = step.init(params)
+    feed = batches([1.0, 2.0, 3.0, 4.0])
+    # Delayed SGD: w_t+1 = w_t - lr * g_{t-K}; g from before t=0 is zero.
+    want_w = [10.0]
+    gs = [0.0, 0.0, 1.0, 2.0]  # grads applied at steps 0..3
+    for g in gs:
+        want_w.append(want_w[-1] - LR * g)
+    for i, b in enumerate(feed):
+        state, _ = step(state, b)
+        np.testing.assert_allclose(float(state.params["w"]), want_w[i + 1], rtol=1e-6)
+
+
+def test_zero_staleness_is_synchronous(ad):
+    step, params = linear_setup(ad, S.PS(staleness=0))
+    state = step.init(params)
+    state, _ = step(state, batches([3.0])[0])
+    np.testing.assert_allclose(float(state.params["w"]), 10.0 - LR * 3.0, rtol=1e-6)
+
+
+def test_stale_buffer_in_state_and_sharded(ad):
+    K = 3
+    step, params = linear_setup(ad, S.PSLoadBalancing(staleness=K))
+    state = step.init(params)
+    assert set(state.stale_state) == {"w"}
+    assert state.stale_state["w"].shape == (K,)
+    # Buffer contents after two steps: last K grads, oldest first.
+    state, _ = step(state, batches([5.0])[0])
+    state, _ = step(state, batches([7.0])[0])
+    np.testing.assert_allclose(np.asarray(state.stale_state["w"]), [0.0, 5.0, 7.0])
+
+
+def test_staleness_with_momentum_matches_manual_optax(ad):
+    """Delay composes with a stateful optimizer identically to manual optax."""
+    K = 1
+    def loss_fn(params, batch):
+        return (batch["x"] * params["w"]).mean()
+
+    params = {"w": np.array(1.0, np.float32)}
+    step = ad(S.PS(staleness=K)).build(
+        loss_fn, params, {"x": np.zeros((8,), np.float32)},
+        optimizer=OptimizerSpec("momentum", {"learning_rate": 0.1, "momentum": 0.9}),
+    )
+    state = step.init(params)
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt = tx.init(params)
+    ref = {"w": np.array(1.0, np.float32)}
+    gs = [0.0, 2.0, 4.0]  # delayed by 1: applied grads are 0, 0, 2
+    applied = [0.0] + gs[:-1]
+    for b_val, g in zip(gs, applied):
+        state, _ = step(state, {"x": np.full((8,), b_val, np.float32)})
+        upd, opt = tx.update({"w": np.array(g, np.float32)}, opt, ref)
+        ref = optax.apply_updates(ref, upd)
+        np.testing.assert_allclose(float(state.params["w"]), float(ref["w"]), rtol=1e-6)
