@@ -14,6 +14,7 @@ from autodist_tpu.models import layers
 from autodist_tpu.models.mlp import mlp_model
 from autodist_tpu.models.transformer import TransformerConfig, transformer_lm
 from autodist_tpu.models.resnet import resnet
+from autodist_tpu.models.vgg import vgg
 from autodist_tpu.models.lstm_lm import lstm_lm
 from autodist_tpu.models.ncf import neumf
 
@@ -26,6 +27,7 @@ __all__ = [
     "TransformerConfig",
     "transformer_lm",
     "resnet",
+    "vgg",
     "lstm_lm",
     "neumf",
 ]

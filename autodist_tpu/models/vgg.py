@@ -1,0 +1,101 @@
+"""VGG for ImageNet-scale benchmarks.
+
+One of the reference's four ImageNet benchmark CNNs
+(``/root/reference/examples/benchmark/imagenet.py:52-66`` exposes vgg16; perf
+page ``docs/usage/performance.md:7``). VGG is the PartitionedAR showcase: the
+first FC layer's [25088, 4096] kernel dominates the parameter bytes, so
+partitioned-gradient strategies behave very differently from uniform
+AllReduce here — exactly the contrast the reference measured.
+
+Conv stacks run bfloat16 on the MXU; batch stats stay fp32 via layers.conv.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.models import layers as L
+from autodist_tpu.models.spec import ModelSpec, register_model
+
+# depth -> conv channels per stage ('M' = 2x2 maxpool)
+_CFG: Dict[int, List] = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+         512, 512, 512, "M", 512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+# fwd FLOPs per 224x224 image (approx, conv+fc MACs*2)
+_FLOPS = {11: 7.6e9, 16: 15.5e9, 19: 19.6e9}
+
+
+def init_params(rng, depth: int, num_classes: int, image_size: int) -> Dict[str, Any]:
+    cfg = _CFG[depth]
+    params: Dict[str, Any] = {}
+    cin = 3
+    keys = jax.random.split(rng, len(cfg) + 3)
+    ki = 0
+    conv_i = 0
+    spatial = image_size
+    for item in cfg:
+        if item == "M":
+            spatial //= 2
+            continue
+        params[f"conv{conv_i}"] = L.conv_init(keys[ki], 3, 3, cin, item)
+        cin = item
+        ki += 1
+        conv_i += 1
+    flat = cin * spatial * spatial
+    params["fc0"] = L.dense_init(keys[ki], flat, 4096)
+    params["fc1"] = L.dense_init(keys[ki + 1], 4096, 4096)
+    params["head"] = L.dense_init(keys[ki + 2], 4096, num_classes)
+    return params
+
+
+def forward(params, images, depth: int, dtype=jnp.bfloat16):
+    cfg = _CFG[depth]
+    x = images.astype(dtype)
+    conv_i = 0
+    for item in cfg:
+        if item == "M":
+            x = jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+            )
+            continue
+        x = jax.nn.relu(L.conv(params[f"conv{conv_i}"], x, compute_dtype=dtype))
+        conv_i += 1
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(L.dense(params["fc0"], x, compute_dtype=dtype))
+    x = jax.nn.relu(L.dense(params["fc1"], x, compute_dtype=dtype))
+    return L.dense(params["head"], x, compute_dtype=dtype).astype(jnp.float32)
+
+
+@register_model("vgg")
+def vgg(depth: int = 16, num_classes: int = 1000, image_size: int = 224) -> ModelSpec:
+    if depth not in _CFG:
+        raise ValueError(f"unsupported vgg depth {depth}; valid: {sorted(_CFG)}")
+
+    def loss_fn(params, batch):
+        logits = forward(params, batch["images"], depth)
+        return L.softmax_xent(logits, batch["labels"])
+
+    def example_batch(batch_size: int):
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        return {
+            "images": rng.standard_normal(
+                (batch_size, image_size, image_size, 3)).astype(np.float32),
+            "labels": rng.integers(0, num_classes, (batch_size,)).astype(np.int32),
+        }
+
+    return ModelSpec(
+        name=f"vgg{depth}",
+        init=lambda rng: init_params(rng, depth, num_classes, image_size),
+        loss_fn=loss_fn,
+        example_batch=example_batch,
+        apply=lambda p, images: forward(p, images, depth),
+        flops_per_example=3 * _FLOPS[depth] * (image_size / 224.0) ** 2,
+    )

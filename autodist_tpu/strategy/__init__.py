@@ -21,8 +21,29 @@ from autodist_tpu.strategy.ps_strategy import PS
 from autodist_tpu.strategy.random_axis_partition_all_reduce_strategy import RandomAxisPartitionAR
 from autodist_tpu.strategy.uneven_partition_ps_strategy import UnevenPartitionedPS
 
+BUILTIN_BUILDERS = {
+    cls.__name__: cls
+    for cls in (
+        PS, PSLoadBalancing, PartitionedPS, UnevenPartitionedPS,
+        AllReduce, PartitionedAR, RandomAxisPartitionAR, Parallax,
+    )
+}
+
+
+def from_name(name: str, **kwargs) -> StrategyBuilder:
+    """Builder by class name — the reference benchmarks' --autodist_strategy
+    flag contract (``/root/reference/examples/benchmark/imagenet.py:52-66``)."""
+    if name not in BUILTIN_BUILDERS:
+        raise ValueError(
+            f"unknown strategy {name!r}; choose from {sorted(BUILTIN_BUILDERS)}"
+        )
+    return BUILTIN_BUILDERS[name](**kwargs)
+
+
 __all__ = [
     "AllReduce",
+    "BUILTIN_BUILDERS",
+    "from_name",
     "AllReduceSpec",
     "AllReduceSynchronizer",
     "GraphConfig",

@@ -1,0 +1,63 @@
+"""Image classifier — ResNet on synthetic CIFAR-shaped data.
+
+Counterpart of the reference's ``examples/image_classifier.py``: a Keras CNN
+trained under ``autodist.scope()``. Here the single-device artifact is the
+zoo's functional ResNet; distribution is the AutoDist construction plus one
+``build`` call. Streams batches through the native prefetching DataLoader.
+
+    python examples/image_classifier.py [--strategy PartitionedAR]
+"""
+import argparse
+
+import jax
+import numpy as np
+
+import autodist_tpu as ad
+from autodist_tpu.data import DataLoader
+from autodist_tpu.models import get_model
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--strategy", default="AllReduce")
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    args = p.parse_args()
+
+    model = get_model("resnet", depth=18, num_classes=10, image_size=32)
+    autodist = ad.AutoDist(strategy_builder=ad.strategy.from_name(args.strategy))
+
+    params = model.init(jax.random.PRNGKey(0))
+    step = autodist.build(
+        model.loss_fn, params, model.example_batch(args.batch_size),
+        optimizer=ad.OptimizerSpec("momentum", {"learning_rate": 0.05, "momentum": 0.9}),
+    )
+    state = step.init(params)
+
+    # Synthetic 10-class dataset with a learnable signal: class-dependent
+    # mean shift so loss visibly falls.
+    rng = np.random.default_rng(0)
+    n = 512
+    labels = rng.integers(0, 10, (n,)).astype(np.int32)
+    images = rng.standard_normal((n, 32, 32, 3)).astype(np.float32)
+    images += labels[:, None, None, None].astype(np.float32) / 5.0
+
+    loader = DataLoader(
+        {"images": images, "labels": labels},
+        batch_size=args.batch_size, epochs=args.epochs, seed=1, plan=step.plan,
+    )
+    first = last = None
+    for i, batch in enumerate(loader):
+        state, metrics = step(state, batch)
+        loss = float(metrics["loss"])
+        first = loss if first is None else first
+        last = loss
+        if i % 4 == 0:
+            print(f"step {i}: loss={loss:.4f}")
+    print(f"loss {first:.4f} -> {last:.4f}")
+    assert last < first, "loss did not improve"
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()
