@@ -160,6 +160,7 @@ class AutoDist:
         optimizer: Union[OptimizerSpec, optax.GradientTransformation, None] = None,
         has_aux: bool = False,
         sparse_names: Sequence[str] = (),
+        expert_names: Sequence[str] = (),
         donate_state: bool = True,
     ) -> DistributedTrainStep:
         """Capture → strategy → compile → lower (autodist.py:139-150).
@@ -181,6 +182,7 @@ class AutoDist:
             loss_fn=loss_fn,
             example_batch=example_batch,
             sparse_names=sparse_names,
+            expert_names=expert_names,
         )
         strategy = self._build_or_load_strategy(model_item)
         compiled = StrategyCompiler(model_item).compile(strategy)

@@ -29,7 +29,12 @@ DEFAULT_COORDINATOR_PORT = 15000
 MESH_AXIS_DATA = "data"
 MESH_AXIS_MODEL = "model"
 MESH_AXIS_SEQ = "seq"
-ALL_MESH_AXES = (MESH_AXIS_DATA, MESH_AXIS_MODEL, MESH_AXIS_SEQ)
+MESH_AXIS_EXPERT = "expert"   # MoE expert parallelism
+MESH_AXIS_PIPE = "pipe"       # pipeline stages
+ALL_MESH_AXES = (
+    MESH_AXIS_DATA, MESH_AXIS_MODEL, MESH_AXIS_SEQ,
+    MESH_AXIS_EXPERT, MESH_AXIS_PIPE,
+)
 
 MAX_INT32 = 2**31 - 1
 

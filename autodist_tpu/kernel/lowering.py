@@ -168,8 +168,19 @@ class GraphTransformer:
                 )
             return ok
 
+        expert_ax = const.MESH_AXIS_EXPERT
+        n_expert = mesh_shape.get(expert_ax, 1)
         part_axis = node.active_partition_axis
-        if part_axis is not None and rank > 0 and divisible(part_axis):
+        if (
+            var.expert and rank > 0 and n_expert > 1
+            and var.shape[0] % n_expert == 0
+        ):
+            # Expert parallelism: the leading (expert) dim shards over the
+            # expert axis; the expert einsums then keep tokens local after
+            # the all_to_all dispatch GSPMD inserts.
+            pspec = _spec_with_axis(rank, 0, expert_ax)
+            update_pspec = pspec
+        elif part_axis is not None and rank > 0 and divisible(part_axis):
             # Explicit partitioning: shard the parameter itself.
             pspec = _spec_with_axis(rank, part_axis, shard_ax)
             update_pspec = pspec

@@ -62,6 +62,10 @@ class VarItem:
     dtype: str
     trainable: bool = True
     sparse_update: bool = False
+    # Leading dim indexes experts (MoE): shardable over the mesh "expert"
+    # axis. TPU-native extension — the reference has no expert parallelism
+    # (SURVEY.md §2.2).
+    expert: bool = False
 
     @property
     def size(self) -> int:
@@ -128,6 +132,7 @@ class ModelItem:
         loss_fn: Optional[Callable] = None,
         example_batch=None,
         sparse_names: Sequence[str] = (),
+        expert_names: Sequence[str] = (),
         trainable_filter: Optional[Callable[[str], bool]] = None,
     ) -> "ModelItem":
         """Build from a params pytree (concrete or ShapeDtypeStructs).
@@ -147,8 +152,10 @@ class ModelItem:
             dtype = str(jnp.result_type(getattr(leaf, "dtype", jnp.float32)))
             trainable = trainable_filter(name) if trainable_filter else True
             sparse = i in detected_sparse or any(s in name for s in sparse_names)
+            expert = any(s in name for s in expert_names)
             variables.append(
-                VarItem(name=name, shape=shape, dtype=dtype, trainable=trainable, sparse_update=sparse)
+                VarItem(name=name, shape=shape, dtype=dtype, trainable=trainable,
+                        sparse_update=sparse, expert=expert)
             )
         return cls(variables, optimizer_spec=optimizer_spec, params_treedef=treedef)
 
@@ -251,6 +258,7 @@ class ModelItem:
                     "dtype": v.dtype,
                     "trainable": v.trainable,
                     "sparse_update": v.sparse_update,
+                    "expert": v.expert,
                 }
                 for v in self._variables
             ],
@@ -267,6 +275,7 @@ class ModelItem:
                     dtype=v["dtype"],
                     trainable=v.get("trainable", True),
                     sparse_update=v.get("sparse_update", False),
+                    expert=v.get("expert", False),
                 )
                 for v in d.get("variables", [])
             ],

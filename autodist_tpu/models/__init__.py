@@ -17,6 +17,7 @@ from autodist_tpu.models.resnet import resnet
 from autodist_tpu.models.vgg import vgg
 from autodist_tpu.models.lstm_lm import lstm_lm
 from autodist_tpu.models.ncf import neumf
+from autodist_tpu.models.moe import MoEConfig, moe_transformer
 
 __all__ = [
     "ModelSpec",
@@ -30,4 +31,6 @@ __all__ = [
     "vgg",
     "lstm_lm",
     "neumf",
+    "MoEConfig",
+    "moe_transformer",
 ]

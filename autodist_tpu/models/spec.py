@@ -24,6 +24,7 @@ class ModelSpec:
     example_batch: Callable[[int], Any]         # batch_size -> batch pytree
     apply: Optional[Callable[..., Any]] = None  # (params, inputs) -> outputs
     sparse_names: tuple = ()                    # force-marked sparse params
+    expert_names: tuple = ()                    # params with leading expert dim
     config: Any = None
     # FLOPs of one forward+backward pass per example, for MFU accounting
     # (None = unknown).

@@ -1,0 +1,154 @@
+"""Pipeline parallelism: GPipe-style microbatching over a mesh "pipe" axis.
+
+TPU-native extension beyond the reference (pipeline parallelism explicitly
+absent, SURVEY.md §2.2). Collective-ops formulation: every device holds one
+stage's params (a leading-stacked ``[S, ...]`` pytree sharded over the pipe
+axis), microbatches stream through the ring with ``lax.ppermute`` carrying
+activations stage→stage. Stage s computes microbatch m at tick t = s + m, so
+a full run is ``n_micro + S - 1`` ticks with the classic bubble fraction
+``(S-1)/(n_micro+S-1)``. Gradients come from autodiff through the scan —
+ppermute transposes to the reverse rotation, so backward is the reverse
+pipeline, as it should be.
+
+The stage function must be shape-preserving (``[mb, ...] -> [mb, ...]``),
+which transformer block stacks are. Embedding/head layers stay outside the
+pipelined region (replicated), matching common practice for small stage
+counts.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from autodist_tpu import const
+
+
+def pipeline_apply_local(
+    stage_params,
+    x,
+    stage_fn: Callable,
+    n_microbatches: int,
+    n_stages: int,
+    axis_name: str = const.MESH_AXIS_PIPE,
+):
+    """Run the pipeline on per-device values — call inside ``shard_map``.
+
+    ``stage_params``: this device's stage slice (no leading stage dim);
+    ``x``: the full batch, identical on every pipe device; ``n_stages`` must
+    be passed statically (the tick count is a trace-time constant).
+    """
+    if x.shape[0] % n_microbatches != 0:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by n_microbatches {n_microbatches}"
+        )
+    return _pipeline_local(
+        stage_params, x, stage_fn=stage_fn, n_micro=n_microbatches,
+        n_stages=n_stages, axis_name=axis_name,
+    )
+
+
+def _pipeline_local(stage_params, x, *, stage_fn, n_micro, n_stages, axis_name):
+    s_idx = lax.axis_index(axis_name)
+    b = x.shape[0]
+    mb = b // n_micro
+    micro = x.reshape((n_micro, mb) + x.shape[1:])
+    perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    state = jnp.zeros_like(micro[0])
+    outputs = jnp.zeros_like(micro)
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage 0 injects microbatch t (clamped; masked out when t >= n_micro).
+        inj = lax.dynamic_index_in_dim(
+            micro, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False
+        )
+        inp = jnp.where(s_idx == 0, inj, state)
+        out = stage_fn(stage_params, inp)
+        # Last stage owns microbatch t - (S-1) when in range.
+        out_idx = t - (n_stages - 1)
+        write = (s_idx == n_stages - 1) & (out_idx >= 0)
+        outputs = lax.cond(
+            write,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, out, jnp.clip(out_idx, 0, n_micro - 1), axis=0
+            ),
+            lambda o: o,
+            outputs,
+        )
+        state = lax.ppermute(out, axis_name, perm_fwd)
+        return (state, outputs), None
+
+    (state, outputs), _ = lax.scan(
+        tick, (state, outputs), jnp.arange(n_micro + n_stages - 1)
+    )
+    # Broadcast the last stage's outputs to every pipe device (keeps the
+    # wrapper's out_spec replicated over the pipe axis).
+    outputs = lax.psum(
+        jnp.where(s_idx == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name,
+    )
+    return outputs.reshape((b,) + x.shape[1:])
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stacked_params,
+    x,
+    n_microbatches: int,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = const.MESH_AXIS_PIPE,
+):
+    """Apply a pipelined stage stack to global ``x``.
+
+    ``stacked_params``: pytree whose leaves carry a leading ``[S]`` stage
+    dim (stage s's slice feeds ``stage_fn`` at ring position s).
+    Falls back to a sequential ``lax.scan`` over stages when the mesh has no
+    non-trivial pipe axis — same math, no communication.
+    """
+    if mesh is None:
+        from autodist_tpu.api import get_default_autodist
+
+        ad = get_default_autodist()
+        mesh = ad.mesh if ad is not None else None
+    n_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    axis_size = (
+        dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis_name, 1)
+        if mesh is not None else 1
+    )
+    if axis_size <= 1:
+        def body(h, sp):
+            return stage_fn(sp, h), None
+
+        out, _ = lax.scan(body, x, stacked_params)
+        return out
+    if axis_size != n_stages:
+        raise ValueError(
+            f"stage dim ({n_stages}) must equal mesh axis {axis_name!r} "
+            f"size ({axis_size})"
+        )
+
+    spec_params = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    local = functools.partial(
+        _pipeline_local,
+        stage_fn=lambda sp, h: stage_fn(
+            jax.tree_util.tree_map(lambda a: a[0], sp), h
+        ),
+        n_micro=n_microbatches,
+        n_stages=n_stages,
+        axis_name=axis_name,
+    )
+    sm = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec_params, P()),
+        out_specs=P(),
+        axis_names={axis_name},
+        check_vma=False,
+    )
+    return sm(stacked_params, x)

@@ -1,0 +1,188 @@
+"""Expert-parallel MoE + pipeline-parallel tests.
+
+Correctness oracle throughout: the same pure function executed unsharded
+(single logical device view) vs. through the sharded path — GSPMD/shard_map
+must not change the math.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from autodist_tpu.api import AutoDist
+from autodist_tpu.models import get_model
+from autodist_tpu.parallel import pipeline_apply
+from autodist_tpu.resource_spec import ResourceSpec
+import autodist_tpu.strategy as S
+
+
+def make_mesh(shape, names):
+    return Mesh(np.array(jax.devices()).reshape(shape), names)
+
+
+def tiny_moe(**kw):
+    return get_model(
+        "moe_transformer", vocab_size=128, num_layers=1, d_model=32,
+        num_heads=4, d_ff=64, max_seq_len=16, num_experts=4, **kw,
+    )
+
+
+class TestMoE:
+    def test_forward_runs_and_routes(self):
+        model = tiny_moe()
+        params = model.init(jax.random.PRNGKey(0))
+        batch = model.example_batch(4)
+        loss = model.loss_fn(params, batch)
+        assert np.isfinite(float(loss))
+
+    def test_expert_vars_marked_and_sharded(self):
+        AutoDist.reset_default()
+        try:
+            ad = AutoDist(
+                resource_spec=ResourceSpec(resource_dict={
+                    "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+                    "mesh": {"data": 2, "expert": 4},
+                }),
+                strategy_builder=S.AllReduce(),
+                mesh_axes=("data", "expert"),
+            )
+            model = tiny_moe()
+            params = model.init(jax.random.PRNGKey(0))
+            batch = model.example_batch(4)
+            step = ad.build(
+                model.loss_fn, params, batch,
+                sparse_names=model.sparse_names,
+                expert_names=model.expert_names,
+            )
+            wi_plan = step.plan.var_plans["layers_0/moe/expert_wi"]
+            assert wi_plan.pspec == P("expert", None, None)
+            state = step.init(params)
+            # Expert kernels really live sharded over the expert axis.
+            shard_shape = state.params["layers_0"]["moe"]["expert_wi"].sharding.shard_shape(
+                (4, 32, 64)
+            )
+            assert shard_shape == (1, 32, 64)
+            state, metrics = step(state, batch)
+            assert np.isfinite(float(metrics["loss"]))
+        finally:
+            AutoDist.reset_default()
+
+    def test_sharded_loss_matches_unsharded(self):
+        """EP sharding must not change the routed computation."""
+        AutoDist.reset_default()
+        try:
+            model = tiny_moe()
+            params = model.init(jax.random.PRNGKey(0))
+            batch = model.example_batch(4)
+            want = float(model.loss_fn(params, batch))
+
+            ad = AutoDist(
+                resource_spec=ResourceSpec(resource_dict={
+                    "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+                    "mesh": {"data": 2, "expert": 4},
+                }),
+                strategy_builder=S.AllReduce(),
+                mesh_axes=("data", "expert"),
+            )
+            step = ad.build(
+                model.loss_fn, params, batch,
+                sparse_names=model.sparse_names, expert_names=model.expert_names,
+            )
+            state = step.init(params)
+            _, metrics = step(state, batch)
+            np.testing.assert_allclose(float(metrics["loss"]), want, rtol=1e-4)
+        finally:
+            AutoDist.reset_default()
+
+    def test_training_reduces_loss(self):
+        AutoDist.reset_default()
+        try:
+            ad = AutoDist(
+                resource_spec=ResourceSpec(resource_dict={
+                    "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+                    "mesh": {"data": 2, "expert": 4},
+                }),
+                strategy_builder=S.AllReduce(),
+                mesh_axes=("data", "expert"),
+            )
+            model = tiny_moe()
+            params = model.init(jax.random.PRNGKey(0))
+            batch = model.example_batch(8)
+            from autodist_tpu.model_item import OptimizerSpec
+
+            step = ad.build(
+                model.loss_fn, params, batch,
+                optimizer=OptimizerSpec("adam", {"learning_rate": 1e-2}),
+                expert_names=model.expert_names,
+            )
+            state = step.init(params)
+            losses = []
+            for _ in range(8):
+                state, m = step(state, batch)
+                losses.append(float(m["loss"]))
+            assert losses[-1] < losses[0]
+        finally:
+            AutoDist.reset_default()
+
+
+class TestPipeline:
+    @staticmethod
+    def stage_fn(sp, h):
+        return jnp.tanh(h @ sp["w"] + sp["b"])
+
+    def stacked(self, n_stages, d=16, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 2)
+        return {
+            "w": jax.random.normal(ks[0], (n_stages, d, d)) * 0.5,
+            "b": jax.random.normal(ks[1], (n_stages, d)) * 0.1,
+        }
+
+    def sequential(self, params, x, n_stages):
+        for s in range(n_stages):
+            x = self.stage_fn(jax.tree.map(lambda a: a[s], params), x)
+        return x
+
+    @pytest.mark.parametrize("n_micro", [4, 8])
+    def test_pipeline_matches_sequential_forward(self, n_micro):
+        mesh = make_mesh((2, 4), ("data", "pipe"))
+        params = self.stacked(4)
+        x = jax.random.normal(jax.random.PRNGKey(3), (16, 16))
+        want = self.sequential(params, x, 4)
+        got = jax.jit(
+            lambda p, xx: pipeline_apply(self.stage_fn, p, xx, n_micro, mesh=mesh)
+        )(params, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def test_pipeline_matches_sequential_grads(self):
+        mesh = make_mesh((1, 8), ("data", "pipe"))
+        params = self.stacked(8, d=8)
+        x = jax.random.normal(jax.random.PRNGKey(4), (16, 8))
+
+        def loss_pipe(p):
+            return jnp.sum(pipeline_apply(self.stage_fn, p, x, 4, mesh=mesh) ** 2)
+
+        def loss_seq(p):
+            return jnp.sum(self.sequential(p, x, 8) ** 2)
+
+        got = jax.jit(jax.grad(loss_pipe))(params)
+        want = jax.grad(loss_seq)(params)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(want[k]), atol=2e-4, rtol=2e-4
+            )
+
+    def test_trivial_pipe_axis_scans_sequentially(self):
+        mesh = make_mesh((8,), ("data",))
+        params = self.stacked(4)
+        x = jax.random.normal(jax.random.PRNGKey(5), (8, 16))
+        got = pipeline_apply(self.stage_fn, params, x, 2, mesh=mesh)
+        want = self.sequential(params, x, 4)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def test_stage_mismatch_raises(self):
+        mesh = make_mesh((1, 8), ("data", "pipe"))
+        params = self.stacked(4)
+        x = jnp.zeros((8, 16))
+        with pytest.raises(ValueError, match="must equal mesh axis"):
+            pipeline_apply(self.stage_fn, params, x, 2, mesh=mesh)
