@@ -91,6 +91,10 @@ class DataLoader:
         self.num_threads = num_threads
         self.plan = plan
 
+        if engine not in ("auto", "native", "python"):
+            raise ValueError(
+                f"unknown engine {engine!r}; choose auto, native or python"
+            )
         lib = _build.load_library() if engine in ("auto", "native") else None
         if engine == "native" and lib is None:
             raise RuntimeError("native engine requested but unavailable")
@@ -164,8 +168,11 @@ class DataLoader:
                 for i, (name, arr) in enumerate(zip(self.names, self.arrays)):
                     shape = (n,) + arr.shape[1:]
                     nbytes = arr.dtype.itemsize * int(np.prod(shape, dtype=np.int64))
-                    buf = ctypes.string_at(ptrs[i], nbytes)
-                    # Copy out of the slot so it can be refilled immediately.
+                    # bytearray copy: (a) frees the slot for immediate refill,
+                    # (b) yields a WRITEABLE array like the python engine's
+                    # fancy-indexed copies (np.frombuffer over bytes would be
+                    # read-only and break in-place batch mutation).
+                    buf = bytearray(ctypes.string_at(ptrs[i], nbytes))
                     batch[name] = np.frombuffer(buf, dtype=arr.dtype).reshape(shape)
                 lib.ad_loader_release(h, int(slot))
                 yield batch

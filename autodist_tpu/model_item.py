@@ -53,6 +53,16 @@ def _path_to_name(path) -> str:
     return "/".join(parts)
 
 
+def _marker_match(name: str, markers: Sequence[str]) -> bool:
+    """Marker matching for sparse_names/expert_names: the marker must occur
+    in the pytree path *starting at a component boundary*, so "embed"
+    matches "embed/embedding" but not "pos_embed/embedding" (plain substring
+    matching silently caught dense-gradient lookalikes)."""
+    import re
+
+    return any(re.search(rf"(^|/){re.escape(m)}", name) for m in markers)
+
+
 @dataclass(frozen=True)
 class VarItem:
     """One trainable (or frozen) parameter leaf."""
@@ -151,8 +161,8 @@ class ModelItem:
             shape = tuple(getattr(leaf, "shape", ()))
             dtype = str(jnp.result_type(getattr(leaf, "dtype", jnp.float32)))
             trainable = trainable_filter(name) if trainable_filter else True
-            sparse = i in detected_sparse or any(s in name for s in sparse_names)
-            expert = any(s in name for s in expert_names)
+            sparse = i in detected_sparse or _marker_match(name, sparse_names)
+            expert = _marker_match(name, expert_names)
             variables.append(
                 VarItem(name=name, shape=shape, dtype=dtype, trainable=trainable,
                         sparse_update=sparse, expert=expert)

@@ -178,7 +178,7 @@ def moe_transformer(**overrides) -> ModelSpec:
         loss_fn=loss_fn,
         example_batch=example_batch,
         apply=lambda p, tokens: forward(p, tokens, cfg)[0],
-        sparse_names=("embed",),
+        sparse_names=("embed/embedding",),
         expert_names=("expert_",),
         config=cfg,
     )

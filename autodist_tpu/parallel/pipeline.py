@@ -42,10 +42,6 @@ def pipeline_apply_local(
     ``x``: the full batch, identical on every pipe device; ``n_stages`` must
     be passed statically (the tick count is a trace-time constant).
     """
-    if x.shape[0] % n_microbatches != 0:
-        raise ValueError(
-            f"batch {x.shape[0]} not divisible by n_microbatches {n_microbatches}"
-        )
     return _pipeline_local(
         stage_params, x, stage_fn=stage_fn, n_micro=n_microbatches,
         n_stages=n_stages, axis_name=axis_name,
@@ -55,6 +51,10 @@ def pipeline_apply_local(
 def _pipeline_local(stage_params, x, *, stage_fn, n_micro, n_stages, axis_name):
     s_idx = lax.axis_index(axis_name)
     b = x.shape[0]
+    if b % n_micro != 0:
+        raise ValueError(
+            f"batch {b} not divisible by n_microbatches {n_micro}"
+        )
     mb = b // n_micro
     micro = x.reshape((n_micro, mb) + x.shape[1:])
     perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]

@@ -51,7 +51,6 @@ def parse_args():
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--trace", action="store_true", help="profile one step to TensorBoard")
-    p.add_argument("--bf16", action="store_true", help="bfloat16 activations where supported")
     p.add_argument("--model-kwargs", default="",
                    help='JSON overrides for the model factory, e.g. \'{"num_layers": 2}\'')
     return p.parse_args()
@@ -99,15 +98,15 @@ def main():
             items_per_step = int(np.prod(np.asarray(tok).shape))
 
     timer = StepTimer(items_per_step=items_per_step, warmup=args.warmup)
-    loss = float("nan")
+    first_loss = last_loss = float("nan")
     for i in range(args.steps):
         b = next_batch()
         with timer:
             state, metrics = step(state, b)
             jax.block_until_ready(state.params)
         if i == 0:
-            loss = float(metrics["loss"])
-    loss = float(metrics["loss"])
+            first_loss = float(metrics["loss"])
+    last_loss = float(metrics["loss"])
 
     if args.trace:
         (_, _), trace_dir = step.trace_step(state, next_batch())
@@ -122,7 +121,7 @@ def main():
         "global_batch": batch_size,
         "n_devices": n_dev,
         "mean_step_s": round(s.get("mean_s", float("nan")), 5),
-        "first_loss_to_last": [round(loss, 4)],
+        "first_loss_to_last": [round(first_loss, 4), round(last_loss, 4)],
     }
     if model.flops_per_example:
         result["model_tflops_per_sec"] = round(
