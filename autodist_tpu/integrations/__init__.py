@@ -1,0 +1,11 @@
+"""Framework adapters: bring flax/haiku models into the AutoDist contract.
+
+The reference monkey-patched Keras so ``model.fit`` ran through its
+distributed session (``/root/reference/autodist/patch.py:96-198``). JAX
+module systems need no patching — an adapter just extracts the
+(params, loss_fn) pair the user API consumes.
+"""
+from autodist_tpu.integrations.flax_adapter import from_flax
+from autodist_tpu.integrations.haiku_adapter import from_haiku
+
+__all__ = ["from_flax", "from_haiku"]

@@ -1,0 +1,37 @@
+"""Haiku adapter: ``hk.transform``'d functions as ModelSpecs."""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from autodist_tpu.models.spec import ModelSpec
+
+
+def from_haiku(
+    transformed,
+    loss: Callable[[Any, Any], Any],
+    example_inputs: Callable[[Any], Any],
+    example_batch: Optional[Callable[[int], Any]] = None,
+    name: Optional[str] = None,
+) -> ModelSpec:
+    """Wrap a ``hk.transform`` (or ``transform_with_state``-free) pair.
+
+    ``transformed`` must expose ``init(rng, inputs)`` / ``apply(params,
+    rng, inputs)`` — the standard stateless haiku contract.
+    """
+
+    def init(rng):
+        if example_batch is None:
+            raise ValueError("from_haiku needs example_batch to trace init")
+        return transformed.init(rng, example_inputs(example_batch(2)))
+
+    def loss_fn(params, batch):
+        pred = transformed.apply(params, None, example_inputs(batch))
+        return loss(pred, batch)
+
+    return ModelSpec(
+        name=name or "haiku_model",
+        init=init,
+        loss_fn=loss_fn,
+        example_batch=example_batch or (lambda b: None),
+        apply=lambda params, inputs: transformed.apply(params, None, inputs),
+    )

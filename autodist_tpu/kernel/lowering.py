@@ -300,6 +300,38 @@ class ShardingPlan:
 
         return jax.tree_util.tree_map(leaf_sharding, batch)
 
+    def global_batch_from_local(self, local_batch) -> Any:
+        """Assemble per-process batch shards into global arrays (multi-host
+        feed path — the remapper's feed-splitting contract in reverse,
+        reference remapper.py:81-123: each host loads only its slice of the
+        global batch, dim 0 concatenates across processes).
+
+        Single-process: equivalent to ``device_put`` with batch shardings.
+        """
+        if jax.process_count() == 1:
+            return jax.device_put(local_batch, self.batch_shardings(local_batch, strict=False))
+
+        n_proc = jax.process_count()
+
+        def leaf_to_global(leaf, sharding):
+            import numpy as np
+
+            arr = np.asarray(leaf)
+            global_shape = (arr.shape[0] * n_proc,) + arr.shape[1:]
+            return jax.make_array_from_process_local_data(sharding, arr, global_shape)
+
+        shardings = self.batch_shardings(
+            jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(
+                    (getattr(x, "shape", (0,))[0] * n_proc,) + tuple(getattr(x, "shape", (0,))[1:]),
+                    getattr(x, "dtype", None),
+                ),
+                local_batch,
+            ),
+            strict=False,
+        )
+        return jax.tree_util.tree_map(leaf_to_global, local_batch, shardings)
+
     def comp_shardings(self, comp_state) -> Any:
         """Compressor-state shardings: per-worker ("local") leaves carry a
         leading data-axis dim and shard over it; "shared" leaves replicate."""
