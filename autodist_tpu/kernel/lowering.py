@@ -184,6 +184,20 @@ class GraphTransformer:
             # Explicit partitioning: shard the parameter itself.
             pspec = _spec_with_axis(rank, part_axis, shard_ax)
             update_pspec = pspec
+        elif part_axis is not None and rank > 0 and self._fallback_axis(var, n_shard) is not None:
+            # Requested axis not divisible (UnevenPartitionedPS deliberately
+            # picks non-divisor counts, uneven_partition_ps_strategy.py:
+            # 128-137). XLA shardings must divide evenly, so the *intent*
+            # (shard this variable) is honored on the largest divisible
+            # axis instead of falling all the way back to replication.
+            fb = self._fallback_axis(var, n_shard)
+            logging.debug(
+                "var %s: partition axis %d (size %d) not divisible by %d; "
+                "sharding axis %d instead",
+                var.name, part_axis, var.shape[part_axis], n_shard, fb,
+            )
+            pspec = _spec_with_axis(rank, fb, shard_ax)
+            update_pspec = pspec
         elif kind is SyncKind.PS and var.sparse_update and rank > 0 and divisible(0):
             # PS sparse path: row-sharded embedding (axis 0).
             pspec = _spec_with_axis(rank, 0, shard_ax)
@@ -210,6 +224,14 @@ class GraphTransformer:
             local_replication=proxy,
             num_shards=node.num_shards,
         )
+
+    @staticmethod
+    def _fallback_axis(var: VarItem, n_shard: int):
+        """Largest axis evenly divisible by ``n_shard``, or None."""
+        cands = [
+            i for i, d in enumerate(var.shape) if d % n_shard == 0 and d >= n_shard
+        ]
+        return max(cands, key=lambda i: var.shape[i]) if cands else None
 
     def _weight_update_spec(self, var: VarItem) -> P:
         """Largest axis divisible by the data-axis size, else replicated."""

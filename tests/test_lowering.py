@@ -128,3 +128,70 @@ def test_opt_shardings_match_slots(model, rs):
     # scalar count leaves replicated
     counts = [s.spec for path, s in leaves if "count" in str(path)]
     assert all(spec == P() for spec in counts)
+
+
+class TestUnevenPartitionFallback:
+    """Non-divisible partition axes shard a divisible axis instead of
+    replicating (the XLA-legal rendering of UnevenPartitionedPS's intent)."""
+
+    def _plan_for(self, shape, mesh_shape, builder=None):
+        import numpy as np
+        from autodist_tpu.kernel import GraphTransformer, build_mesh
+        from autodist_tpu.model_item import ModelItem, VarItem
+        from autodist_tpu.resource_spec import ResourceSpec
+        from autodist_tpu.strategy import StrategyCompiler, UnevenPartitionedPS
+
+        params = {"w": np.zeros(shape, np.float32)}
+        item = ModelItem.from_params(params)
+        spec = ResourceSpec(resource_dict={
+            "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+            "mesh": mesh_shape,
+        })
+        mesh = build_mesh(spec, axes=tuple(mesh_shape))
+        strategy = (builder or UnevenPartitionedPS()).build(item, spec)
+        compiled = StrategyCompiler(item).compile(strategy)
+        return GraphTransformer(compiled, item, mesh).transform()
+
+    def test_indivisible_axis_falls_back_to_divisible_axis(self):
+        from jax.sharding import PartitionSpec as P
+
+        # axis 0 (10) not divisible by 8; axis 1 (256) is.
+        plan = self._plan_for((10, 256), {"data": 1, "model": 8})
+        assert plan.var_plans["w"].pspec == P(None, "model")
+
+    def test_no_divisible_axis_replicates(self):
+        from jax.sharding import PartitionSpec as P
+
+        plan = self._plan_for((10, 6), {"data": 1, "model": 8})
+        assert plan.var_plans["w"].pspec == P()
+
+    def test_fallback_step_executes(self):
+        import jax
+        import numpy as np
+        from autodist_tpu.api import AutoDist
+        from autodist_tpu.resource_spec import ResourceSpec
+        from autodist_tpu.strategy import UnevenPartitionedPS
+
+        AutoDist.reset_default()
+        try:
+            ad = AutoDist(
+                resource_spec=ResourceSpec(resource_dict={
+                    "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+                    "mesh": {"data": 1, "model": 8},
+                }),
+                strategy_builder=UnevenPartitionedPS(),
+            )
+
+            def loss_fn(params, batch):
+                return ((batch["x"] @ params["w"]) ** 2).mean()
+
+            params = {"w": np.ones((10, 256), np.float32)}
+            batch = {"x": np.ones((4, 10), np.float32)}
+            step = ad.build(loss_fn, params, batch)
+            state = step.init(params)
+            state, m = step(state, batch)
+            assert np.isfinite(float(m["loss"]))
+            shard = state.params["w"].sharding.shard_shape((10, 256))
+            assert shard == (10, 32)
+        finally:
+            AutoDist.reset_default()
