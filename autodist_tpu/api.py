@@ -162,11 +162,15 @@ class AutoDist:
         sparse_names: Sequence[str] = (),
         expert_names: Sequence[str] = (),
         donate_state: bool = True,
+        host_offload: bool = False,
     ) -> DistributedTrainStep:
         """Capture → strategy → compile → lower (autodist.py:139-150).
 
         ``optimizer`` may be an :class:`OptimizerSpec` (serializable, lets
         builders see the optimizer) or a raw optax transform.
+        ``host_offload=True`` parks PS-synchronized parameters + optimizer
+        slots in pinned host memory, streaming through HBM per step (the
+        reference's params-on-CPU placement, ps_strategy.py:38-55).
         """
         if isinstance(optimizer, OptimizerSpec):
             opt_spec, tx = optimizer, optimizer.make()
@@ -186,7 +190,9 @@ class AutoDist:
         )
         strategy = self._build_or_load_strategy(model_item)
         compiled = StrategyCompiler(model_item).compile(strategy)
-        plan = GraphTransformer(compiled, model_item, self.mesh).transform()
+        plan = GraphTransformer(
+            compiled, model_item, self.mesh, host_offload=host_offload
+        ).transform()
         logging.debug("sharding plan:\n%s", plan.describe())
         step = DistributedTrainStep(plan, loss_fn, tx, has_aux=has_aux, donate_state=donate_state)
         self._built, self._strategy, self._model_item = step, compiled, model_item

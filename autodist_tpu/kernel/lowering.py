@@ -73,6 +73,10 @@ class VarPlan:
     reduction_destination: str = ""
     local_replication: bool = False
     num_shards: int = 1
+    # Store the parameter (and its optimizer slots) in pinned host memory,
+    # streaming through HBM inside the step — the TPU rendering of the
+    # reference parking PS variables on host CPUs (ps_strategy.py:38-55).
+    offload: bool = False
 
 
 @struct.dataclass
@@ -100,6 +104,29 @@ def _spec_with_axis(rank: int, dim: int, mesh_axis: str) -> P:
     return P(*entries)
 
 
+def _memory_kinds_supported(mesh: Mesh) -> bool:
+    """True when the runtime can stream pinned-host leaves inside jit.
+
+    Requires (a) a pinned_host memory space, and (b) a compile path that
+    accepts in-jit memory-space transfers: the TPU toolchain, or any
+    single-device mesh (the SPMD partitioner — which rejects
+    ``annotate_device_placement`` custom calls — only runs multi-device).
+    """
+    try:
+        dev = mesh.devices.flat[0]
+        kinds = {m.kind for m in dev.addressable_memories()}
+        if "pinned_host" not in kinds:
+            raise ValueError("no pinned_host memory space")
+        if dev.platform != "tpu":
+            # The CPU runtime has no annotate_device_placement kernel and
+            # the non-TPU SPMD partitioner rejects the custom call.
+            raise ValueError("in-jit host streaming needs the TPU toolchain")
+        return True
+    except Exception as e:  # noqa: BLE001 - older runtimes lack the API
+        logging.warning("host offload requested but unsupported (%s); disabled", e)
+        return False
+
+
 class GraphTransformer:
     """Lower a compiled Strategy over a mesh into a :class:`ShardingPlan`.
 
@@ -107,10 +134,17 @@ class GraphTransformer:
     passes here are sharding-assignment rules instead of graph rewrites.
     """
 
-    def __init__(self, strategy: Strategy, model_item: ModelItem, mesh: Mesh):
+    def __init__(
+        self,
+        strategy: Strategy,
+        model_item: ModelItem,
+        mesh: Mesh,
+        host_offload: bool = False,
+    ):
         self.strategy = strategy
         self.model_item = model_item
         self.mesh = mesh
+        self.host_offload = host_offload and _memory_kinds_supported(mesh)
 
     def transform(self) -> "ShardingPlan":
         plans: Dict[str, VarPlan] = {}
@@ -223,6 +257,9 @@ class GraphTransformer:
             reduction_destination=dest,
             local_replication=proxy,
             num_shards=node.num_shards,
+            # Reference parity: PS destinations are host CPUs; offload is
+            # opt-in because HBM residency is usually faster on TPU.
+            offload=self.host_offload and kind is SyncKind.PS,
         )
 
     @staticmethod
@@ -263,43 +300,57 @@ class ShardingPlan:
             p.kind is SyncKind.PS and p.var.sparse_update for p in self.var_plans.values()
         )
 
-    def _sharding(self, pspec: P) -> NamedSharding:
+    def _sharding(self, pspec: P, offload: bool = False) -> NamedSharding:
+        if offload:
+            return NamedSharding(self.mesh, pspec, memory_kind="pinned_host")
         return NamedSharding(self.mesh, pspec)
 
+    @property
+    def has_offload(self) -> bool:
+        return any(p.offload for p in self.var_plans.values())
+
     # ------------------------------------------------------------- shardings
-    def params_shardings(self, params) -> Any:
-        """Pytree of NamedShardings matching ``params`` (matched by path)."""
+    def params_shardings(self, params, device_view: bool = False) -> Any:
+        """Pytree of NamedShardings matching ``params`` (matched by path).
+
+        ``device_view=True`` ignores host-offload markers — the sharding the
+        parameter has *inside* the step after streaming into HBM.
+        """
         leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
         out = []
         for path, leaf in leaves:
             name = _path_name(path)
             plan = self.var_plans.get(name)
             pspec = plan.pspec if plan is not None else P()
-            out.append(self._sharding(pspec))
+            offload = plan.offload if plan is not None and not device_view else False
+            out.append(self._sharding(pspec, offload))
         return jax.tree_util.tree_unflatten(treedef, out)
 
-    def opt_shardings(self, opt_state_shapes) -> Any:
+    def opt_shardings(self, opt_state_shapes, device_view: bool = False) -> Any:
         """Shardings for an optimizer-state pytree.
 
         Slot leaves are matched to variables by path suffix (optax states
         embed the params tree, e.g. ``0/mu/dense/kernel``); matched slots get
         the variable's ``update_pspec`` (weight-update sharding for PS vars,
-        the param sharding for partitioned vars); unmatched leaves (step
-        counts, scalars) are replicated.
+        the param sharding for partitioned vars) and the variable's
+        host-offload placement (slots are 1-2x the param bytes — leaving
+        them in HBM would defeat the offload); unmatched leaves (step
+        counts, scalars) are replicated on device.
         """
         names = sorted(self.var_plans, key=len, reverse=True)
         leaves, treedef = jax.tree_util.tree_flatten_with_path(opt_state_shapes)
         out = []
         for path, leaf in leaves:
             leaf_name = _path_name(path)
-            spec = P()
+            spec, offload = P(), False
             for n in names:
                 if leaf_name == n or leaf_name.endswith("/" + n):
                     plan = self.var_plans[n]
                     if tuple(getattr(leaf, "shape", ())) == tuple(plan.var.shape):
                         spec = plan.update_pspec
+                        offload = plan.offload and not device_view
                     break
-            out.append(self._sharding(spec))
+            out.append(self._sharding(spec, offload))
         return jax.tree_util.tree_unflatten(treedef, out)
 
     def batch_shardings(self, batch, strict: bool = True) -> Any:
@@ -375,11 +426,11 @@ class ShardingPlan:
             out[name] = self._sharding(P(None, *pspec))
         return out
 
-    def state_shardings(self, state_shapes: TrainState) -> TrainState:
+    def state_shardings(self, state_shapes: TrainState, device_view: bool = False) -> TrainState:
         return TrainState(
             step=self._sharding(P()),
-            params=self.params_shardings(state_shapes.params),
-            opt_state=self.opt_shardings(state_shapes.opt_state),
+            params=self.params_shardings(state_shapes.params, device_view=device_view),
+            opt_state=self.opt_shardings(state_shapes.opt_state, device_view=device_view),
             comp_state=self.comp_shardings(state_shapes.comp_state),
             stale_state=self.stale_shardings(state_shapes.stale_state),
         )
@@ -397,6 +448,16 @@ class ShardingPlan:
 # Param names are matched by string equality against ModelItem's names, so
 # both sides must use the one path-to-name implementation.
 _path_name = _path_to_name
+
+
+def _stream(tree, marker_shardings, target_shardings):
+    """device_put only the leaves whose marker sharding is host-placed."""
+    def leaf(x, marker, target):
+        if getattr(marker, "memory_kind", None) == "pinned_host":
+            return jax.device_put(x, target)
+        return x
+
+    return jax.tree_util.tree_map(leaf, tree, marker_shardings, target_shardings)
 
 
 class DistributedTrainStep:
@@ -536,6 +597,17 @@ class DistributedTrainStep:
         return jax.tree_util.tree_unflatten(treedef, out), new_bufs
 
     def _step(self, state: TrainState, batch):
+        host_shardings = None
+        if self.plan.has_offload:
+            # Weight streaming: offloaded leaves live in pinned host memory
+            # between steps; stream them into HBM for compute and back out
+            # after the update. Only offloaded leaves get device_put —
+            # annotating already-on-device leaves (e.g. the step scalar)
+            # trips the SPMD partitioner's side-effect sharding check.
+            shapes = jax.eval_shape(lambda: state)
+            host_shardings = self.plan.state_shardings(shapes)
+            device_shardings = self.plan.state_shardings(shapes, device_view=True)
+            state = _stream(state, host_shardings, device_shardings)
         if self._compressors:
             loss, aux, grads, new_comp = self._compressed_grads(state, batch)
         else:
@@ -556,6 +628,8 @@ class DistributedTrainStep:
             step=state.step + 1, params=new_params, opt_state=new_opt,
             comp_state=new_comp, stale_state=new_stale,
         )
+        if host_shardings is not None:
+            new_state = _stream(new_state, host_shardings, host_shardings)
         metrics = {"loss": loss}
         if aux is not None:
             metrics["aux"] = aux
