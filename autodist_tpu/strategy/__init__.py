@@ -4,6 +4,7 @@ Mirrors the reference strategy package (``/root/reference/autodist/strategy/``)
 — same 8 builder policies, retargeted to a TPU mesh.
 """
 from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+from autodist_tpu.strategy.auto_strategy import Auto
 from autodist_tpu.strategy.base import StrategyBuilder, StrategyCompiler
 from autodist_tpu.strategy.ir import (
     AllReduceSpec,
@@ -25,7 +26,7 @@ BUILTIN_BUILDERS = {
     cls.__name__: cls
     for cls in (
         PS, PSLoadBalancing, PartitionedPS, UnevenPartitionedPS,
-        AllReduce, PartitionedAR, RandomAxisPartitionAR, Parallax,
+        AllReduce, PartitionedAR, RandomAxisPartitionAR, Parallax, Auto,
     )
 }
 
@@ -42,6 +43,7 @@ def from_name(name: str, **kwargs) -> StrategyBuilder:
 
 __all__ = [
     "AllReduce",
+    "Auto",
     "BUILTIN_BUILDERS",
     "from_name",
     "AllReduceSpec",

@@ -1,0 +1,60 @@
+"""Auto strategy: pick a builder from model + cluster analysis.
+
+The reference's headline performance claim is that *the best strategy
+differs per model* (``/root/reference/docs/usage/performance.md:14``) — but
+it ships no selector; users choose by hand (the default is plain
+PSLoadBalancing, ``autodist.py:70``). ``Auto`` encodes the selection the
+reference's own benchmarks imply:
+
+- sparse-update variables present (embedding workloads: lm1b, NCF) →
+  **Parallax** (dense→AllReduce, sparse→load-balanced PS) — the reference's
+  showcase result for these models;
+- dense model with any variable large enough that its gradient dominates
+  all-reduce latency on the mesh's weakest link → **PartitionedAR**
+  (shard the big tensors, all-reduce the rest);
+- otherwise → **AllReduce**, the right default on ICI-connected TPU chips
+  (PS-style centralized reduction never wins on a torus).
+
+The decision is recorded in the emitted strategy's id path like any other
+builder, so workers replay it without re-analysis.
+"""
+from __future__ import annotations
+
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+from autodist_tpu.strategy.base import StrategyBuilder
+from autodist_tpu.strategy.ir import Strategy
+from autodist_tpu.strategy.parallax_strategy import Parallax
+from autodist_tpu.strategy.partitioned_all_reduce_strategy import PartitionedAR
+from autodist_tpu.utils import logging
+
+# A tensor whose all-reduce serialization cost exceeds this fraction of the
+# total gradient bytes is "dominant" — partitioning it overlaps its sync.
+_DOMINANT_FRACTION = 0.5
+
+
+class Auto(StrategyBuilder):
+    """Analyze (model × resources) and delegate to the best fit."""
+
+    def __init__(self, chunk_size: int = 128):
+        self._chunk_size = chunk_size
+
+    def _select(self, model_item: ModelItem, resource_spec: ResourceSpec) -> StrategyBuilder:
+        if model_item.sparse_variables:
+            return Parallax()
+        trainable = model_item.trainable_variables
+        total = sum(v.byte_size for v in trainable) or 1
+        biggest = max((v.byte_size for v in trainable), default=0)
+        if biggest / total >= _DOMINANT_FRACTION and len(trainable) > 1:
+            return PartitionedAR()
+        return AllReduce(chunk_size=self._chunk_size)
+
+    def build(self, model_item: ModelItem, resource_spec: ResourceSpec) -> Strategy:
+        chosen = self._select(model_item, resource_spec)
+        logging.info(
+            "Auto strategy selected %s (%d vars, %d sparse, %.1f MB)",
+            type(chosen).__name__, len(model_item.variables),
+            len(model_item.sparse_variables), model_item.total_bytes / 1e6,
+        )
+        return chosen.build(model_item, resource_spec)
