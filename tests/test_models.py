@@ -71,3 +71,19 @@ def test_end_to_end_build(name):
         assert losses[-1] <= losses[0], f"{name} loss did not decrease: {losses}"
     finally:
         AutoDist.reset_default()
+
+
+def test_space_to_depth_stem_exactly_equivalent():
+    """MXU-friendly stem rewrite must be numerically identical to the
+    7x7/s2 conv it replaces (MLPerf space-to-depth transform)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from autodist_tpu.models import layers as L
+    from autodist_tpu.models.resnet import _space_to_depth_stem
+
+    stem = L.conv_init(jax.random.PRNGKey(0), 7, 7, 3, 64)
+    img = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    want = L.conv(stem, img, stride=2, compute_dtype=jnp.float32)
+    got = _space_to_depth_stem(stem, img, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
