@@ -237,10 +237,16 @@ class GraphTransformer:
             pspec = _spec_with_axis(rank, 0, shard_ax)
             update_pspec = pspec
         elif kind is SyncKind.PS and rank > 0:
-            # Dense PS: replicated parameter + sharded weight update
-            # (ZeRO-1 / arXiv 2004.13336) over the data axis.
-            pspec = P()
+            # Dense PS: the proxy-variable knob (reference
+            # proxy_variable.py:96-114) picks the parameter's residency.
+            # With a proxy the reference cached a worker-local replica →
+            # replicated param + sharded weight update (ZeRO-1,
+            # arXiv 2004.13336). Without one, workers read the variable
+            # from the PS on every use → fully sharded param with
+            # all-gather on use (ZeRO-3), the SPMD rendering of that
+            # remote-read-per-step placement.
             update_pspec = self._weight_update_spec(var)
+            pspec = P() if proxy else update_pspec
         else:
             pspec = P()
             update_pspec = P()

@@ -49,16 +49,27 @@ def test_allreduce_lowering_replicates_params(model, rs):
 
 
 def test_ps_lowering_weight_update_sharding(model, rs):
+    # Default PS has no proxy: remote-read-per-step → ZeRO-3 sharded param.
     plan = make_plan(PS(), model, rs)
     kernel = plan.plan_for("dense/kernel")
     assert kernel.kind is SyncKind.PS
-    assert kernel.pspec == P()  # param replicated
+    assert kernel.pspec == P("data", None)  # fully sharded, all-gather on use
     assert kernel.update_pspec == P("data", None)  # 16 % 8 == 0 → axis 0
     bias = plan.plan_for("dense/bias")
     assert bias.update_pspec == P("data")  # 8 % 8 == 0
     # sparse embedding → row-sharded param
     embed = plan.plan_for("embed/embedding")
     assert embed.pspec == P("data", None)
+
+
+def test_ps_proxy_replicates_param(model, rs):
+    # local_proxy_variable=True = worker-local cached replica (reference
+    # proxy_variable.py) → replicated param, ZeRO-1 sharded update.
+    plan = make_plan(PS(local_proxy_variable=True), model, rs)
+    kernel = plan.plan_for("dense/kernel")
+    assert kernel.pspec == P()
+    assert kernel.update_pspec == P("data", None)
+    assert kernel.local_replication
 
 
 def test_partitioned_ps_lowering_shards_param(model, rs):
@@ -77,7 +88,9 @@ def test_partitioned_ar_lowering(model, rs):
 
 def test_parallax_lowering(model, rs):
     plan = make_plan(Parallax(), model, rs)
+    # Parallax dense vars go AllReduce (replicated), sparse go PS.
     assert plan.plan_for("dense/kernel").pspec == P()
+    assert plan.plan_for("dense/kernel").kind is SyncKind.ALL_REDUCE
     assert plan.plan_for("embed/embedding").pspec == P("data", None)
     assert plan.has_sparse_ps
 
