@@ -20,6 +20,7 @@ from autodist_tpu.strategy.partitioned_ps_strategy import PartitionedPS
 from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
 from autodist_tpu.strategy.ps_strategy import PS
 from autodist_tpu.strategy.random_axis_partition_all_reduce_strategy import RandomAxisPartitionAR
+from autodist_tpu.strategy.tensor_parallel_strategy import TensorParallel
 from autodist_tpu.strategy.uneven_partition_ps_strategy import UnevenPartitionedPS
 
 BUILTIN_BUILDERS = {
@@ -27,6 +28,7 @@ BUILTIN_BUILDERS = {
     for cls in (
         PS, PSLoadBalancing, PartitionedPS, UnevenPartitionedPS,
         AllReduce, PartitionedAR, RandomAxisPartitionAR, Parallax, Auto,
+        TensorParallel,
     )
 }
 
@@ -60,5 +62,6 @@ __all__ = [
     "Strategy",
     "StrategyBuilder",
     "StrategyCompiler",
+    "TensorParallel",
     "UnevenPartitionedPS",
 ]

@@ -1,0 +1,83 @@
+"""TensorParallel: Megatron-style role-aware axis selection.
+
+TPU-native extension beyond the reference's partitioned builders: those pick
+the partition axis mechanically (min divisor of dim 0 — ``partitioned_ps
+_strategy.py:125-135`` — or a random axis). For transformer-shaped models
+the *pairing* of axes is what makes tensor parallelism communication-
+optimal (Megatron-LM, arXiv 1909.08053): column-parallel into the block
+(QKV, FC1 — shard the *output* feature dim) and row-parallel out of it
+(attention output, FC2 — shard the *input* feature dim), so activations
+stay sharded through the block interior and only one all-reduce fires per
+block per direction. GSPMD inserts exactly that when the parameter
+shardings follow the pattern.
+
+Role detection is by pytree-path name. ``_COLUMN``/``_ROW`` markers cover
+this repo's model zoo plus common conventions (flax/haiku/megatron names);
+unmatched 2D+ kernels default to column (last axis), embeddings shard the
+vocab axis, and 1D vars (biases, norms) stay replicated via AllReduce.
+"""
+from __future__ import annotations
+
+from autodist_tpu.model_item import ModelItem, VarItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.base import StrategyBuilder
+from autodist_tpu.strategy.ir import AllReduceSynchronizer, NodeConfig, PSSynchronizer, Strategy
+
+# Row-parallel (shard input dim, axis -2): projections *out of* a sharded
+# interior. Matched against the last path components.
+_ROW = ("wo", "fc2", "out_proj", "o_proj", "down_proj", "proj_out", "dense_4h_to_h")
+# Column-parallel (shard output dim, axis -1): projections *into* the block.
+_COLUMN = ("wq", "wk", "wv", "fc1", "in_proj", "q_proj", "k_proj", "v_proj",
+           "up_proj", "gate_proj", "dense_h_to_4h")
+
+
+def _role_axis(var: VarItem) -> int | None:
+    """Partition axis for one variable, or None to leave it replicated."""
+    rank = len(var.shape)
+    if rank < 2:
+        return None
+    name = var.name.lower()
+    parts = name.split("/")
+    # the component holding the layer name ("attn/wq/kernel" -> "wq")
+    hay = parts[-2] if parts[-1] in ("kernel", "embedding", "w") and len(parts) >= 2 else parts[-1]
+    if var.sparse_update or "embed" in hay:
+        return 0                      # vocab/row axis
+    if any(m in hay for m in _ROW):
+        return rank - 2               # input features
+    if any(m in hay for m in _COLUMN):
+        return rank - 1               # output features
+    return rank - 1                   # default: column
+
+
+class TensorParallel(StrategyBuilder):
+    """Shard every eligible variable with Megatron axis pairing."""
+
+    def __init__(self, num_shards: int = 0, compressor: str = "NoneCompressor"):
+        # 0 = derive from the mesh's model axis at build time.
+        self._num_shards = num_shards
+        self._compressor = compressor
+
+    def build(self, model_item: ModelItem, resource_spec: ResourceSpec) -> Strategy:
+        expr = self._new_strategy(resource_spec)
+        mesh = resource_spec.mesh_shape(("data", "model"))
+        n = self._num_shards or mesh.get("model", 1)
+        if n <= 1:
+            # No model axis: every chip is pure-DP; degrade to ZeRO-style
+            # sharding over data (the lowering's shard axis fallback).
+            n = mesh.get("data", 1)
+        nodes = []
+        for v in model_item.trainable_variables:
+            axis = _role_axis(v)
+            sync = AllReduceSynchronizer(compressor=self._compressor)
+            if axis is None or v.shape[axis] % max(n, 1) != 0:
+                nodes.append(NodeConfig(var_name=v.name, synchronizer=sync))
+                continue
+            part = ["1"] * len(v.shape)
+            part[axis] = str(n)
+            if v.sparse_update:
+                sync = PSSynchronizer()
+            nodes.append(NodeConfig(
+                var_name=v.name, synchronizer=sync, partitioner=",".join(part)
+            ))
+        expr.node_config = nodes
+        return expr
