@@ -38,10 +38,12 @@ _FORMAT_VERSION = 1
 def _to_host(leaf) -> np.ndarray:
     """Full logical value of a (possibly sharded) array on the host."""
     if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
-        # Multi-host: assemble the global value before writing.
+        # Multi-host: assemble the global value before writing. tiled=True
+        # reassembles shards into the global shape (the default would stack
+        # a leading per-process dim — and is rejected for global arrays).
         from jax.experimental import multihost_utils
 
-        leaf = multihost_utils.process_allgather(leaf)
+        leaf = multihost_utils.process_allgather(leaf, tiled=True)
     return np.asarray(leaf)
 
 

@@ -38,6 +38,7 @@ MODELS = {
     "transformer": ("transformer", {}, "tokens"),
     "lm1b": ("lstm_lm", {}, "tokens"),
     "ncf": ("ncf", {}, "examples"),
+    "moe": ("moe_transformer", {}, "tokens"),
 }
 
 

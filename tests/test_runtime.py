@@ -195,6 +195,16 @@ def test_two_process_cpu_cluster(tmp_path):
             NamedSharding(mesh, P("data")), np.ones((2,), np.float32) * (jax.process_index() + 1), (4,))
         total = jax.jit(lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P()))(x)
         assert float(total) == 6.0, float(total)
+
+        # Multi-host checkpoint: every process calls save (process 0 writes,
+        # the barrier holds the rest), then all restore and compare.
+        import tempfile
+        from autodist_tpu.checkpoint import Saver
+        ckdir = os.environ["AUTODIST_TEST_CKPT_DIR"]
+        saver = Saver(directory=ckdir)
+        path = saver.save({"x": x}, step=1)
+        loaded = saver.restore(path)
+        np.testing.assert_array_equal(loaded["x"], np.array([1, 1, 2, 2], np.float32))
         print("OK", jax.process_index(), flush=True)
     """))
     from autodist_tpu.runtime.launcher import _launch_local_fleet
@@ -208,6 +218,7 @@ def test_two_process_cpu_cluster(tmp_path):
     }
     env["PYTHONPATH"] = "/root/repo"
     env["JAX_PLATFORMS"] = "cpu"
+    env["AUTODIST_TEST_CKPT_DIR"] = str(tmp_path / "ckpt")
     code = _launch_local_fleet(
         [sys.executable, str(script)], 2, coordinator_port=15999, base_env=env
     )
