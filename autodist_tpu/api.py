@@ -112,8 +112,21 @@ class AutoDist:
 
     # ----------------------------------------------------------------- build
     def _build_or_load_strategy(self, model_item: ModelItem) -> Strategy:
-        """Chief builds + serializes; workers load by id
-        (autodist.py:100-109, strategy/base.py:89-99)."""
+        """Chief builds + serializes; workers receive it
+        (autodist.py:100-109, strategy/base.py:89-99).
+
+        Two handoff paths:
+        - connected multi-controller runtime (all hosts started together,
+          the TPU launch model): the chief broadcasts the strategy bytes
+          over the distributed runtime — no shared filesystem or
+          launch-time env needed;
+        - coordinator-launched workers (reference SSH-relaunch model):
+          load by ``AUTODIST_STRATEGY_ID`` from the shipped file.
+        """
+        import jax
+
+        if jax.process_count() > 1:
+            return self._sync_strategy_multihost(model_item)
         if self.is_chief:
             strategy = self.strategy_builder.build(model_item, self.resource_spec)
             strategy.serialize()
@@ -129,6 +142,37 @@ class AutoDist:
                 )
             logging.info("worker loading strategy %s", strategy_id)
             strategy = self._wait_for_strategy(strategy_id)
+        return strategy
+
+    def _sync_strategy_multihost(self, model_item: ModelItem) -> Strategy:
+        """Chief builds; everyone else receives the bytes via the runtime.
+
+        Replaces the reference's SFTP strategy shipping
+        (coordinator.py:84-88) with a payload broadcast riding the already-
+        connected jax.distributed cluster: length first (fixed shape), then
+        the zero-padded JSON bytes.
+        """
+        import json as _json
+
+        import jax
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        if jax.process_index() == 0:
+            strategy = self.strategy_builder.build(model_item, self.resource_spec)
+            strategy.serialize()  # audit trail on the chief host
+            payload = _json.dumps(strategy.to_json()).encode()
+        else:
+            payload = b""
+        n = int(multihost_utils.broadcast_one_to_all(np.int32(len(payload))))
+        buf = np.zeros(n, np.uint8)
+        if payload:
+            buf[: len(payload)] = np.frombuffer(payload, np.uint8)
+        buf = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        strategy = Strategy.from_json(_json.loads(buf.tobytes().decode()))
+        logging.info(
+            "strategy %s synced across %d processes", strategy.id, jax.process_count()
+        )
         return strategy
 
     @staticmethod
