@@ -125,7 +125,13 @@ class AutoDist:
         """
         import jax
 
-        if jax.process_count() > 1:
+        shipped_id = ENV.AUTODIST_STRATEGY_ID.val
+        if jax.process_count() > 1 and not (not self.is_chief and shipped_id):
+            # Connected fleet without a coordinator-shipped strategy file:
+            # broadcast. A worker that *was* shipped an id (Coordinator
+            # relaunch, possibly with a hand-tuned strategy) must honor the
+            # file — rebuilding from the local builder could silently train
+            # a different strategy.
             return self._sync_strategy_multihost(model_item)
         if self.is_chief:
             strategy = self.strategy_builder.build(model_item, self.resource_spec)
@@ -161,6 +167,9 @@ class AutoDist:
         if jax.process_index() == 0:
             strategy = self.strategy_builder.build(model_item, self.resource_spec)
             strategy.serialize()  # audit trail on the chief host
+            # Children forked from the chief later (coordinator relaunch
+            # pattern) inherit the id, same as the single-process path.
+            os.environ[ENV.AUTODIST_STRATEGY_ID.name] = strategy.id
             payload = _json.dumps(strategy.to_json()).encode()
         else:
             payload = b""
@@ -170,6 +179,10 @@ class AutoDist:
             buf[: len(payload)] = np.frombuffer(payload, np.uint8)
         buf = np.asarray(multihost_utils.broadcast_one_to_all(buf))
         strategy = Strategy.from_json(_json.loads(buf.tobytes().decode()))
+        if jax.process_index() != 0:
+            # The serialized path references the chief's filesystem; blank
+            # it on receivers so nothing tries to read a nonexistent file.
+            strategy.path = ""
         logging.info(
             "strategy %s synced across %d processes", strategy.id, jax.process_count()
         )

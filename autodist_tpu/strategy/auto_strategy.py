@@ -9,9 +9,9 @@ reference's own benchmarks imply:
 - sparse-update variables present (embedding workloads: lm1b, NCF) →
   **Parallax** (dense→AllReduce, sparse→load-balanced PS) — the reference's
   showcase result for these models;
-- dense model with any variable large enough that its gradient dominates
-  all-reduce latency on the mesh's weakest link → **PartitionedAR**
-  (shard the big tensors, all-reduce the rest);
+- dense model whose byte budget is dominated by one variable (VGG-style
+  fat FC layers) → **PartitionedAR** (shard the big tensors, all-reduce
+  the rest);
 - otherwise → **AllReduce**, the right default on ICI-connected TPU chips
   (PS-style centralized reduction never wins on a torus).
 
@@ -41,8 +41,11 @@ class Auto(StrategyBuilder):
         self._chunk_size = chunk_size
 
     def _select(self, model_item: ModelItem, resource_spec: ResourceSpec) -> StrategyBuilder:
+        """Selection is model-shape driven (sparse presence, byte
+        distribution); the resource spec only matters insofar as a
+        single-chip cluster makes every choice equivalent."""
         if model_item.sparse_variables:
-            return Parallax()
+            return Parallax(chunk_size=self._chunk_size)
         trainable = model_item.trainable_variables
         total = sum(v.byte_size for v in trainable) or 1
         biggest = max((v.byte_size for v in trainable), default=0)

@@ -42,9 +42,11 @@ def _role_axis(var: VarItem) -> int | None:
     hay = parts[-2] if parts[-1] in ("kernel", "embedding", "w") and len(parts) >= 2 else parts[-1]
     if var.sparse_update or "embed" in hay:
         return 0                      # vocab/row axis
-    if any(m in hay for m in _ROW):
+    # Exact-token match: substring matching would misrole layers whose
+    # names merely contain a marker (e.g. "network" contains "wo").
+    if hay in _ROW:
         return rank - 2               # input features
-    if any(m in hay for m in _COLUMN):
+    if hay in _COLUMN:
         return rank - 1               # output features
     return rank - 1                   # default: column
 
@@ -60,11 +62,21 @@ class TensorParallel(StrategyBuilder):
     def build(self, model_item: ModelItem, resource_spec: ResourceSpec) -> Strategy:
         expr = self._new_strategy(resource_spec)
         mesh = resource_spec.mesh_shape(("data", "model"))
-        n = self._num_shards or mesh.get("model", 1)
-        if n <= 1:
+        mesh_n = mesh.get("model", 1)
+        if mesh_n <= 1:
             # No model axis: every chip is pure-DP; degrade to ZeRO-style
             # sharding over data (the lowering's shard axis fallback).
-            n = mesh.get("data", 1)
+            mesh_n = mesh.get("data", 1)
+        if self._num_shards and self._num_shards != mesh_n:
+            # The lowering shards by the actual mesh axis size; a different
+            # advisory count would pass divisibility here but silently land
+            # on a different axis (or replicate) downstream.
+            raise ValueError(
+                f"TensorParallel(num_shards={self._num_shards}) does not "
+                f"match the mesh shard axis size {mesh_n}; drop num_shards "
+                f"or fix the resource spec's mesh block"
+            )
+        n = mesh_n
         nodes = []
         for v in model_item.trainable_variables:
             axis = _role_axis(v)
