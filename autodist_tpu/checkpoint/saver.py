@@ -186,3 +186,13 @@ class Saver:
         """Most recent ``ckpt-<step>`` under ``directory``, or None."""
         ckpts = self._list_checkpoints()
         return os.path.join(self.directory, ckpts[-1]) if ckpts else None
+
+    def restore_latest(self, target: Any = None, shardings: Any = None) -> Optional[Any]:
+        """Restore the newest checkpoint, or None when the directory is
+        empty — the crash-resume primitive: ``state = saver.restore_latest(
+        target=state, shardings=plan_shardings) or step.init(params)``."""
+        path = self.latest_checkpoint()
+        if path is None:
+            return None
+        logging.info("resuming from %s", path)
+        return self.restore(path, target=target, shardings=shardings)

@@ -762,6 +762,19 @@ class DistributedTrainStep:
             tracing.dump_compiled("train_step", lowered, lowered.compile())
         return self._compiled
 
+    def init_or_restore(self, params, saver) -> TrainState:
+        """Fresh state, or the latest checkpoint when one exists — the
+        crash-resume entry point (the reference's closest fault-tolerance
+        mechanism was checkpoint/resume, SURVEY §5). The restored state is
+        re-sharded onto this run's plan, so resuming onto a different mesh
+        or strategy works like any cross-sharding restore.
+        """
+        state = self.init(params)
+        restored = saver.restore_latest(
+            target=jax.eval_shape(lambda: state), shardings=self._state_shardings
+        )
+        return restored if restored is not None else state
+
     def trace_step(self, state: TrainState, batch, name: str = "train_step"):
         """One profiled step -> TensorBoard trace dir (runner.py:64-75 analog).
 
