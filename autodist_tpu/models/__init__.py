@@ -15,6 +15,8 @@ from autodist_tpu.models.mlp import mlp_model
 from autodist_tpu.models.transformer import TransformerConfig, transformer_lm
 from autodist_tpu.models.resnet import resnet
 from autodist_tpu.models.vgg import vgg
+from autodist_tpu.models.densenet import densenet
+from autodist_tpu.models.inception import inception
 from autodist_tpu.models.lstm_lm import lstm_lm
 from autodist_tpu.models.ncf import neumf
 from autodist_tpu.models.moe import MoEConfig, moe_transformer
@@ -29,6 +31,8 @@ __all__ = [
     "transformer_lm",
     "resnet",
     "vgg",
+    "densenet",
+    "inception",
     "lstm_lm",
     "neumf",
     "MoEConfig",

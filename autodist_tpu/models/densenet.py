@@ -1,0 +1,135 @@
+"""DenseNet for ImageNet-scale benchmarks.
+
+One of the reference's four ImageNet benchmark CNNs
+(``/root/reference/examples/benchmark/imagenet.py:52-66`` exposes
+densenet121; perf page ``docs/usage/performance.md:7``). DenseNet stresses a
+different strategy axis than ResNet/VGG: thousands of small conv kernels and
+BN params (no single dominant tensor), so greedy byte-size load balancing
+(PSLoadBalancing) and collective group chunking matter more than
+partitioning.
+
+Dense blocks concatenate every prior feature map; each layer is
+BN→ReLU→1x1 conv (bottleneck, 4k channels)→BN→ReLU→3x3 conv (k = growth
+rate). Transitions halve channels (compression 0.5) and spatial dims.
+Compute runs bfloat16 on the MXU; normalization stats stay fp32.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from autodist_tpu.models import layers as L
+from autodist_tpu.models.spec import (ModelSpec, image_example_batch,
+                                      register_model)
+
+# depth -> layers per dense block (growth rate 32, compression 0.5)
+_CFG = {
+    121: [6, 12, 24, 16],
+    169: [6, 12, 32, 32],
+    201: [6, 12, 48, 32],
+}
+_GROWTH = 32
+
+
+def _fwd_flops(blocks, growth, image_size, num_classes) -> float:
+    """Analytic forward FLOPs (2*MACs, convs+head) for any config — keeps
+    MFU accounting honest when ``blocks``/``growth`` override the tables."""
+    sp = image_size // 2              # stem conv /2
+    f = 2 * 7 * 7 * 3 * 2 * growth * sp * sp
+    sp //= 2                          # stem maxpool
+    cin = 2 * growth
+    for bi, n in enumerate(blocks):
+        for _ in range(n):
+            f += 2 * cin * 4 * growth * sp * sp          # 1x1 bottleneck
+            f += 2 * 9 * 4 * growth * growth * sp * sp   # 3x3 conv
+            cin += growth
+        if bi < len(blocks) - 1:
+            f += 2 * cin * (cin // 2) * sp * sp          # transition 1x1
+            cin //= 2
+            sp //= 2                                     # transition avgpool
+    return float(f + 2 * cin * num_classes)
+
+
+def init_params(rng, depth: int, num_classes: int, blocks=None,
+                growth: int = _GROWTH) -> Dict[str, Any]:
+    blocks = blocks or _CFG[depth]
+    n_layers = sum(blocks)
+    keys = iter(jax.random.split(rng, 2 * n_layers + len(blocks) + 2))
+    params: Dict[str, Any] = {
+        "stem": {**L.conv_init(next(keys), 7, 7, 3, 2 * growth),
+                 "bn": L.batchnorm_init(2 * growth)},
+    }
+    cin = 2 * growth
+    for bi, n in enumerate(blocks):
+        for li in range(n):
+            params[f"block{bi}_layer{li}"] = {
+                "bn1": L.batchnorm_init(cin),
+                "conv1": L.conv_init(next(keys), 1, 1, cin, 4 * growth),
+                "bn2": L.batchnorm_init(4 * growth),
+                "conv2": L.conv_init(next(keys), 3, 3, 4 * growth, growth),
+            }
+            cin += growth
+        if bi < len(blocks) - 1:
+            cout = cin // 2
+            params[f"transition{bi}"] = {
+                "bn": L.batchnorm_init(cin),
+                "conv": L.conv_init(next(keys), 1, 1, cin, cout),
+            }
+            cin = cout
+    params["final_bn"] = L.batchnorm_init(cin)
+    params["head"] = L.dense_init(next(keys), cin, num_classes)
+    return params
+
+
+def _dense_layer(p, x, dtype):
+    y = jax.nn.relu(L.batchnorm(p["bn1"], x))
+    y = L.conv(p["conv1"], y, compute_dtype=dtype)
+    y = jax.nn.relu(L.batchnorm(p["bn2"], y))
+    y = L.conv(p["conv2"], y, compute_dtype=dtype)
+    # Channel-concat, not add: the DenseNet connectivity pattern.
+    return jnp.concatenate([x, y.astype(x.dtype)], axis=-1)
+
+
+def forward(params, images, depth: int, dtype=jnp.bfloat16, blocks=None):
+    blocks = blocks or _CFG[depth]
+    x = images.astype(dtype)
+    if images.shape[1] % 2 == 0 and images.shape[2] % 2 == 0:
+        x = L.space_to_depth_stem(params["stem"], x, dtype)
+    else:
+        x = L.conv(params["stem"], x, stride=2, compute_dtype=dtype)
+    x = jax.nn.relu(L.batchnorm(params["stem"]["bn"], x))
+    x = L.max_pool(x, 3, 2)
+    for bi, n in enumerate(blocks):
+        for li in range(n):
+            x = _dense_layer(params[f"block{bi}_layer{li}"], x, dtype)
+        if bi < len(blocks) - 1:
+            t = params[f"transition{bi}"]
+            x = jax.nn.relu(L.batchnorm(t["bn"], x))
+            x = L.conv(t["conv"], x, compute_dtype=dtype)
+            x = L.avg_pool(x, 2, 2)
+    x = jax.nn.relu(L.batchnorm(params["final_bn"], x))
+    x = x.mean(axis=(1, 2))  # global average pool
+    return L.dense(params["head"], x, compute_dtype=dtype).astype(jnp.float32)
+
+
+@register_model("densenet")
+def densenet(depth: int = 121, num_classes: int = 1000, image_size: int = 224,
+             blocks=None, growth: int = _GROWTH) -> ModelSpec:
+    """``blocks``/``growth`` override the depth table for smoke tests."""
+    if blocks is None and depth not in _CFG:
+        raise ValueError(f"unsupported densenet depth {depth}; valid: {sorted(_CFG)}")
+
+    def loss_fn(params, batch):
+        logits = forward(params, batch["images"], depth, blocks=blocks)
+        return L.softmax_xent(logits, batch["labels"])
+
+    return ModelSpec(
+        name=f"densenet{depth}",
+        init=lambda rng: init_params(rng, depth, num_classes, blocks, growth),
+        loss_fn=loss_fn,
+        example_batch=image_example_batch(image_size, num_classes),
+        apply=lambda p, images: forward(p, images, depth, blocks=blocks),
+        flops_per_example=3 * _fwd_flops(blocks or _CFG[depth], growth,
+                                         image_size, num_classes),
+    )

@@ -1,0 +1,238 @@
+"""Inception-V3 for ImageNet-scale benchmarks.
+
+One of the reference's four ImageNet benchmark CNNs
+(``/root/reference/examples/benchmark/imagenet.py:52-66`` exposes
+inceptionv3; perf page ``docs/usage/performance.md:7``). Inception is the
+heterogeneous-branch workload: per-stage parallel towers of 1x1 / factorized
+7x1+1x7 / 3x3 convs with very different byte sizes — a good stress of the
+load-balancing and group-chunking strategy policies.
+
+Faithful channel plan (stem → 3x InceptionA → ReductionA → 4x InceptionB →
+ReductionB → 2x InceptionC → global pool → FC). All convs are BN+ReLU
+("conv_bn"); SAME padding throughout so any input size that survives the
+/32 downsampling works (the canonical 299x299 included). The auxiliary
+classifier head is omitted — it exists for vanishing-gradient mitigation in
+fp32-era training, contributes nothing to throughput benchmarking, and the
+reference's vendored trainer likewise ran the main head only.
+Compute runs bfloat16 on the MXU; BN stats stay fp32.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from autodist_tpu.models import layers as L
+from autodist_tpu.models.spec import (ModelSpec, image_example_batch,
+                                      register_model)
+
+# approx fwd FLOPs per 299x299 image (2*MACs)
+_FWD_FLOPS = 5.7e9
+
+
+def _conv_bn_init(rng, kh, kw, cin, cout):
+    return {**L.conv_init(rng, kh, kw, cin, cout), "bn": L.batchnorm_init(cout)}
+
+
+def _conv_bn(p, x, stride=1, dtype=jnp.bfloat16):
+    y = L.conv(p, x, stride=stride, compute_dtype=dtype)
+    return jax.nn.relu(L.batchnorm(p["bn"], y)).astype(dtype)
+
+
+# ------------------------------------------------------------- block builders
+# Each builder returns (param-init fn, forward fn, out_channels). Channel
+# numbers follow the V3 paper (Szegedy et al. 2015, table 1).
+
+def _branch_init(rng, specs, w):
+    """specs: list of (name, [(kh,kw,cin,cout), ...]) conv chains. Channel
+    counts are scaled by ``w`` (width multiplier; identity at width=1) —
+    ``cin`` literals name pre-scale channels, so both ends go through w."""
+    params = {}
+    n = sum(len(chain) for _, chain in specs)
+    keys = iter(jax.random.split(rng, n))
+    for name, chain in specs:
+        for i, (kh, kw, cin, cout) in enumerate(chain):
+            params[f"{name}_{i}"] = _conv_bn_init(next(keys), kh, kw, w(cin), w(cout))
+    return params
+
+
+def _chain(params, name, n, x, dtype, strides=None):
+    for i in range(n):
+        s = strides[i] if strides else 1
+        x = _conv_bn(params[f"{name}_{i}"], x, stride=s, dtype=dtype)
+    return x
+
+
+def _inception_a_init(rng, cin, pool_ch, w):
+    return _branch_init(rng, [
+        ("b1x1", [(1, 1, cin, 64)]),
+        ("b5x5", [(1, 1, cin, 48), (5, 5, 48, 64)]),
+        ("b3x3dbl", [(1, 1, cin, 64), (3, 3, 64, 96), (3, 3, 96, 96)]),
+        ("bpool", [(1, 1, cin, pool_ch)]),
+    ], w)
+
+
+def _inception_a(p, x, dtype):
+    return jnp.concatenate([
+        _chain(p, "b1x1", 1, x, dtype),
+        _chain(p, "b5x5", 2, x, dtype),
+        _chain(p, "b3x3dbl", 3, x, dtype),
+        _chain(p, "bpool", 1, L.avg_pool(x, 3, 1), dtype),
+    ], axis=-1)  # 64+64+96+pool_ch
+
+
+def _reduction_a_init(rng, cin, w):
+    return _branch_init(rng, [
+        ("b3x3", [(3, 3, cin, 384)]),
+        ("b3x3dbl", [(1, 1, cin, 64), (3, 3, 64, 96), (3, 3, 96, 96)]),
+    ], w)
+
+
+def _reduction_a(p, x, dtype):
+    return jnp.concatenate([
+        _chain(p, "b3x3", 1, x, dtype, strides=[2]),
+        _chain(p, "b3x3dbl", 3, x, dtype, strides=[1, 1, 2]),
+        L.max_pool(x, 3, 2),
+    ], axis=-1)  # 384+96+cin
+
+
+def _inception_b_init(rng, cin, c7, w):
+    return _branch_init(rng, [
+        ("b1x1", [(1, 1, cin, 192)]),
+        ("b7x7", [(1, 1, cin, c7), (1, 7, c7, c7), (7, 1, c7, 192)]),
+        ("b7x7dbl", [(1, 1, cin, c7), (7, 1, c7, c7), (1, 7, c7, c7),
+                     (7, 1, c7, c7), (1, 7, c7, 192)]),
+        ("bpool", [(1, 1, cin, 192)]),
+    ], w)
+
+
+def _inception_b(p, x, dtype):
+    return jnp.concatenate([
+        _chain(p, "b1x1", 1, x, dtype),
+        _chain(p, "b7x7", 3, x, dtype),
+        _chain(p, "b7x7dbl", 5, x, dtype),
+        _chain(p, "bpool", 1, L.avg_pool(x, 3, 1), dtype),
+    ], axis=-1)  # 192*4 = 768
+
+
+def _reduction_b_init(rng, cin, w):
+    return _branch_init(rng, [
+        ("b3x3", [(1, 1, cin, 192), (3, 3, 192, 320)]),
+        ("b7x7x3", [(1, 1, cin, 192), (1, 7, 192, 192),
+                    (7, 1, 192, 192), (3, 3, 192, 192)]),
+    ], w)
+
+
+def _reduction_b(p, x, dtype):
+    return jnp.concatenate([
+        _chain(p, "b3x3", 2, x, dtype, strides=[1, 2]),
+        _chain(p, "b7x7x3", 4, x, dtype, strides=[1, 1, 1, 2]),
+        L.max_pool(x, 3, 2),
+    ], axis=-1)  # 320+192+cin
+
+
+def _inception_c_init(rng, cin, w):
+    return _branch_init(rng, [
+        ("b1x1", [(1, 1, cin, 320)]),
+        ("b3x3", [(1, 1, cin, 384)]),
+        ("b3x3_a", [(1, 3, 384, 384)]),
+        ("b3x3_b", [(3, 1, 384, 384)]),
+        ("b3x3dbl", [(1, 1, cin, 448), (3, 3, 448, 384)]),
+        ("b3x3dbl_a", [(1, 3, 384, 384)]),
+        ("b3x3dbl_b", [(3, 1, 384, 384)]),
+        ("bpool", [(1, 1, cin, 192)]),
+    ], w)
+
+
+def _inception_c(p, x, dtype):
+    y3 = _chain(p, "b3x3", 1, x, dtype)
+    ydbl = _chain(p, "b3x3dbl", 2, x, dtype)
+    return jnp.concatenate([
+        _chain(p, "b1x1", 1, x, dtype),
+        _chain(p, "b3x3_a", 1, y3, dtype),
+        _chain(p, "b3x3_b", 1, y3, dtype),
+        _chain(p, "b3x3dbl_a", 1, ydbl, dtype),
+        _chain(p, "b3x3dbl_b", 1, ydbl, dtype),
+        _chain(p, "bpool", 1, L.avg_pool(x, 3, 1), dtype),
+    ], axis=-1)  # 320+384*4+192 = 2048
+
+
+# --------------------------------------------------------------------- model
+def init_params(rng, num_classes: int, width: float = 1.0) -> Dict[str, Any]:
+    """``width`` scales every channel count; 1.0 is faithful V3. Exact
+    (non-rounding) scaling is required so per-branch sums match the concat
+    bookkeeping — every channel literal is a multiple of 16, so any multiple
+    of 1/16 works. Channel bookkeeping (``cin``) stays in pre-scale units —
+    ``w`` is applied exactly once, at each conv's init."""
+    def w(c: int) -> int:
+        v = c * width
+        if v != int(v) or v < 1:
+            raise ValueError(
+                f"width={width} does not scale channel count {c} to a positive "
+                "integer; use a multiple of 1/16")
+        return int(v)
+
+    keys = iter(jax.random.split(rng, 32))
+    params: Dict[str, Any] = {
+        "stem0": _conv_bn_init(next(keys), 3, 3, 3, w(32)),
+        "stem1": _conv_bn_init(next(keys), 3, 3, w(32), w(32)),
+        "stem2": _conv_bn_init(next(keys), 3, 3, w(32), w(64)),
+        "stem3": _conv_bn_init(next(keys), 1, 1, w(64), w(80)),
+        "stem4": _conv_bn_init(next(keys), 3, 3, w(80), w(192)),
+    }
+    cin = 192
+    for i, pool_ch in enumerate([32, 64, 64]):
+        params[f"mixed_a{i}"] = _inception_a_init(next(keys), cin, pool_ch, w)
+        cin = 64 + 64 + 96 + pool_ch
+    params["reduction_a"] = _reduction_a_init(next(keys), cin, w)
+    cin = 384 + 96 + cin
+    for i, c7 in enumerate([128, 160, 160, 192]):
+        params[f"mixed_b{i}"] = _inception_b_init(next(keys), cin, c7, w)
+        cin = 768
+    params["reduction_b"] = _reduction_b_init(next(keys), cin, w)
+    cin = 320 + 192 + cin
+    for i in range(2):
+        params[f"mixed_c{i}"] = _inception_c_init(next(keys), cin, w)
+        cin = 2048
+    params["head"] = L.dense_init(next(keys), w(2048), num_classes)
+    return params
+
+
+def forward(params, images, dtype=jnp.bfloat16):
+    x = images.astype(dtype)
+    x = _conv_bn(params["stem0"], x, stride=2, dtype=dtype)
+    x = _conv_bn(params["stem1"], x, dtype=dtype)
+    x = _conv_bn(params["stem2"], x, dtype=dtype)
+    x = L.max_pool(x, 3, 2)
+    x = _conv_bn(params["stem3"], x, dtype=dtype)
+    x = _conv_bn(params["stem4"], x, dtype=dtype)
+    x = L.max_pool(x, 3, 2)
+    for i in range(3):
+        x = _inception_a(params[f"mixed_a{i}"], x, dtype)
+    x = _reduction_a(params["reduction_a"], x, dtype)
+    for i in range(4):
+        x = _inception_b(params[f"mixed_b{i}"], x, dtype)
+    x = _reduction_b(params["reduction_b"], x, dtype)
+    for i in range(2):
+        x = _inception_c(params[f"mixed_c{i}"], x, dtype)
+    x = x.mean(axis=(1, 2))  # global average pool
+    return L.dense(params["head"], x, compute_dtype=dtype).astype(jnp.float32)
+
+
+@register_model("inception")
+def inception(num_classes: int = 1000, image_size: int = 299,
+              width: float = 1.0) -> ModelSpec:
+    """``width`` < 1 shrinks the net for smoke tests; any multiple of 1/16
+    scales every channel count exactly (enforced in ``init_params``)."""
+    def loss_fn(params, batch):
+        logits = forward(params, batch["images"])
+        return L.softmax_xent(logits, batch["labels"])
+
+    return ModelSpec(
+        name="inception_v3",
+        init=lambda rng: init_params(rng, num_classes, width),
+        loss_fn=loss_fn,
+        example_batch=image_example_batch(image_size, num_classes),
+        apply=lambda p, images: forward(p, images),
+        flops_per_example=3 * _FWD_FLOPS * (image_size / 299.0) ** 2 * width ** 2,
+    )

@@ -106,6 +106,58 @@ def conv(p, x, stride: int = 1, padding: str = "SAME", *, compute_dtype=None):
     )
 
 
+# ---------------------------------------------------------------------- pooling
+def max_pool(x, window: int, stride: int, padding: str = "SAME"):
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, window, window, 1), (1, stride, stride, 1), padding
+    )
+
+
+def avg_pool(x, window: int, stride: int, padding: str = "SAME"):
+    """Count-normalized average pool: border windows divide by the number of
+    valid elements, not window², matching TF/reference semantics under SAME
+    padding. The count map is shape-static, so XLA constant-folds it."""
+    dims, strides = (1, window, window, 1), (1, stride, stride, 1)
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
+    if padding == "VALID":
+        return summed / (window * window)
+    counts = lax.reduce_window(
+        jnp.ones((1, x.shape[1], x.shape[2], 1), x.dtype),
+        0.0, lax.add, dims, strides, padding,
+    )
+    return summed / counts
+
+
+def space_to_depth_stem(stem_conv, images, dtype):
+    """Weight-equivalent MXU-friendly stem: 7x7/s2 conv on 3 channels →
+    4x4/s1 conv on 12 channels over 2x2-space-to-depth input.
+
+    The 7x7 kernel reads input rows r ∈ [-2, 4] around each output center;
+    padded to 8 taps those land in 4 blocks of 2, so the padded kernel
+    reshapes exactly to [4, 4, 12, cout]. The 3-channel original keeps
+    125/128 MXU lanes idle; 12 channels is 4x denser. (MLPerf ResNet's
+    standard TPU transform; requires even H and W.)
+    """
+    b, h, w, c = images.shape
+    x = images.reshape(b, h // 2, 2, w // 2, 2, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+
+    k = stem_conv["kernel"]                      # [7, 7, 3, cout]
+    k = jnp.pad(k, ((0, 1), (0, 1), (0, 0), (0, 0)))       # [8, 8, 3, cout]
+    kh, kw, cin, cout = k.shape
+    k = k.reshape(kh // 2, 2, kw // 2, 2, cin, cout)
+    k = k.transpose(0, 2, 1, 3, 4, 5).reshape(kh // 2, kw // 2, 4 * cin, cout)
+
+    x = x.astype(dtype)
+    return lax.conv_general_dilated(
+        x, k.astype(dtype),
+        window_strides=(1, 1),
+        # block-space receptive field is blocks [i-1, i+2]: pad 1 low, 2 high
+        padding=((1, 2), (1, 2)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
 # -------------------------------------------------------------------- batchnorm
 def batchnorm_init(dim: int):
     return {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))}

@@ -11,7 +11,8 @@ import jax
 import jax.numpy as jnp
 
 from autodist_tpu.models import layers as L
-from autodist_tpu.models.spec import ModelSpec, register_model
+from autodist_tpu.models.spec import (ModelSpec, image_example_batch,
+                                      register_model)
 
 # depth -> (block kind, stage sizes, fwd FLOPs @ 224x224)
 _CONFIGS: Dict[int, Tuple[str, List[int], float]] = {
@@ -105,47 +106,15 @@ def init_params(rng, depth: int, num_classes: int, width: int = 64) -> Dict[str,
     return params
 
 
-def _space_to_depth_stem(stem_conv, images, dtype):
-    """Weight-equivalent MXU-friendly stem: 7x7/s2 conv on 3 channels →
-    4x4/s1 conv on 12 channels over 2x2-space-to-depth input.
-
-    The 7x7 kernel reads input rows r ∈ [-2, 4] around each output center;
-    padded to 8 taps those land in 4 blocks of 2, so the padded kernel
-    reshapes exactly to [4, 4, 12, cout]. The 3-channel original keeps
-    125/128 MXU lanes idle; 12 channels is 4x denser. (MLPerf ResNet's
-    standard TPU transform.)
-    """
-    b, h, w, c = images.shape
-    x = images.reshape(b, h // 2, 2, w // 2, 2, c)
-    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
-
-    k = stem_conv["kernel"]                      # [7, 7, 3, cout]
-    k = jnp.pad(k, ((0, 1), (0, 1), (0, 0), (0, 0)))       # [8, 8, 3, cout]
-    kh, kw, cin, cout = k.shape
-    k = k.reshape(kh // 2, 2, kw // 2, 2, cin, cout)
-    k = k.transpose(0, 2, 1, 3, 4, 5).reshape(kh // 2, kw // 2, 4 * cin, cout)
-
-    x = x.astype(dtype)
-    return jax.lax.conv_general_dilated(
-        x, k.astype(dtype),
-        window_strides=(1, 1),
-        # block-space receptive field is blocks [i-1, i+2]: pad 1 low, 2 high
-        padding=((1, 2), (1, 2)),
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-    )
-
-
 def forward(params, images, depth: int, dtype=jnp.bfloat16, stem_s2d: bool = True):
     """images [B, H, W, 3] -> logits [B, num_classes]."""
     kind, stages, _ = _lookup(depth)
     if stem_s2d and images.shape[1] % 2 == 0 and images.shape[2] % 2 == 0:
-        x = _space_to_depth_stem(params["stem"]["conv"], images, dtype)
+        x = L.space_to_depth_stem(params["stem"]["conv"], images, dtype)
     else:
         x = L.conv(params["stem"]["conv"], images, stride=2, compute_dtype=dtype)
     x = jax.nn.relu(L.batchnorm(params["stem"]["bn"], x))
-    x = jax.lax.reduce_window(
-        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
-    )
+    x = L.max_pool(x, 3, 2)
     block = _basic_block if kind == "basic" else _bottleneck
     for si, n_blocks in enumerate(stages):
         for bi in range(n_blocks):
@@ -155,24 +124,21 @@ def forward(params, images, depth: int, dtype=jnp.bfloat16, stem_s2d: bool = Tru
     return L.dense(params["head"], x).astype(jnp.float32)
 
 
+# Back-compat alias: the transform now lives in layers.py.
+_space_to_depth_stem = L.space_to_depth_stem
+
+
 @register_model("resnet")
 def resnet(depth: int = 50, num_classes: int = 1000, image_size: int = 224) -> ModelSpec:
     def loss_fn(params, batch):
         return L.softmax_xent(forward(params, batch["images"], depth), batch["labels"])
-
-    def example_batch(batch_size: int):
-        images = jnp.linspace(
-            0.0, 1.0, batch_size * image_size * image_size * 3
-        ).reshape(batch_size, image_size, image_size, 3)
-        labels = (jnp.arange(batch_size) % num_classes).astype(jnp.int32)
-        return {"images": images, "labels": labels}
 
     _, _, fwd_flops = _lookup(depth)
     return ModelSpec(
         name=f"resnet{depth}",
         init=lambda rng: init_params(rng, depth, num_classes),
         loss_fn=loss_fn,
-        example_batch=example_batch,
+        example_batch=image_example_batch(image_size, num_classes),
         apply=lambda p, x: forward(p, x, depth),
         flops_per_example=3.0 * fwd_flops * (image_size / 224.0) ** 2,
     )

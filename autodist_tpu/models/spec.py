@@ -31,6 +31,20 @@ class ModelSpec:
     flops_per_example: Optional[float] = None
 
 
+def image_example_batch(image_size: int, num_classes: int):
+    """Deterministic synthetic NHWC image batch factory shared by the CNN zoo."""
+    def example_batch(batch_size: int):
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        return {
+            "images": rng.standard_normal(
+                (batch_size, image_size, image_size, 3)).astype(np.float32),
+            "labels": rng.integers(0, num_classes, (batch_size,)).astype(np.int32),
+        }
+    return example_batch
+
+
 def register_model(name: str):
     def deco(factory: Callable[..., ModelSpec]):
         _MODEL_REGISTRY[name] = factory

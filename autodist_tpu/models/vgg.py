@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 
 from autodist_tpu.models import layers as L
-from autodist_tpu.models.spec import ModelSpec, register_model
+from autodist_tpu.models.spec import (ModelSpec, image_example_batch,
+                                      register_model)
 
 # depth -> conv channels per stage ('M' = 2x2 maxpool)
 _CFG: Dict[int, List] = {
@@ -81,21 +82,11 @@ def vgg(depth: int = 16, num_classes: int = 1000, image_size: int = 224) -> Mode
         logits = forward(params, batch["images"], depth)
         return L.softmax_xent(logits, batch["labels"])
 
-    def example_batch(batch_size: int):
-        import numpy as np
-
-        rng = np.random.default_rng(0)
-        return {
-            "images": rng.standard_normal(
-                (batch_size, image_size, image_size, 3)).astype(np.float32),
-            "labels": rng.integers(0, num_classes, (batch_size,)).astype(np.int32),
-        }
-
     return ModelSpec(
         name=f"vgg{depth}",
         init=lambda rng: init_params(rng, depth, num_classes, image_size),
         loss_fn=loss_fn,
-        example_batch=example_batch,
+        example_batch=image_example_batch(image_size, num_classes),
         apply=lambda p, images: forward(p, images, depth),
         flops_per_example=3 * _FLOPS[depth] * (image_size / 224.0) ** 2,
     )

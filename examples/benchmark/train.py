@@ -34,6 +34,8 @@ MODELS = {
     "resnet50": ("resnet", {"depth": 50, "image_size": 224}, "images"),
     "resnet101": ("resnet", {"depth": 101, "image_size": 224}, "images"),
     "vgg16": ("vgg", {"depth": 16, "image_size": 224}, "images"),
+    "densenet121": ("densenet", {"depth": 121, "image_size": 224}, "images"),
+    "inceptionv3": ("inception", {"image_size": 299}, "images"),
     "bert_base": ("bert_base", {}, "tokens"),
     "transformer": ("transformer", {}, "tokens"),
     "lm1b": ("lstm_lm", {}, "tokens"),

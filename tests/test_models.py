@@ -18,6 +18,8 @@ SMALL = {
     "bert_base": dict(vocab_size=128, num_layers=2, d_model=32, num_heads=4,
                       d_ff=64, max_seq_len=16),
     "resnet": dict(depth=18, num_classes=10, image_size=32),
+    "densenet": dict(num_classes=10, image_size=32, blocks=[2, 2], growth=8),
+    "inception": dict(num_classes=10, image_size=64, width=0.25),
     "lstm_lm": dict(vocab_size=64, embed_dim=16, hidden=32, num_layers=1, seq_len=8),
     "ncf": dict(num_users=40, num_items=24, mf_dim=8, mlp_dims=(16, 16, 8)),
 }
