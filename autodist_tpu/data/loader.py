@@ -139,7 +139,7 @@ class DataLoader:
         q = deque()
         for b in it:
             q.append(self._shard(b))
-            if len(q) >= depth:
+            if len(q) > depth:  # keep `depth` transfers in flight past the yielded one
                 yield q.popleft()
         while q:
             yield q.popleft()

@@ -41,10 +41,47 @@ def _peak_flops(device) -> tuple:
     return DEFAULT_PEAK, False
 
 
+def _preflight(timeout_s: float = 180.0) -> bool:
+    """True if the accelerator answers a trivial op within ``timeout_s``.
+
+    The axon tunnel can wedge persistently (e.g. after a transfer raced an
+    in-flight dispatch in some earlier process); a hung bench run reports
+    nothing at all, so probe in a subprocess and fail fast with an error
+    line instead.
+    """
+    import subprocess
+
+    probe = (
+        "import jax, jax.numpy as jnp; "
+        "print(float((jnp.ones((128,128)) @ jnp.ones((128,128))).sum()))"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", probe], timeout=timeout_s,
+            capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    if r.returncode != 0:
+        # A *failing* (not hanging) probe is some other problem — surface it
+        # and let the parent hit it visibly rather than silently downgrading
+        # to the CPU smoke config with a misleading "wedged" message.
+        print(r.stderr[-2000:], file=sys.stderr)
+    return True
+
+
 def main() -> None:
     from autodist_tpu.api import AutoDist
     from autodist_tpu.models import get_model
     import autodist_tpu.strategy as S
+
+    # Probe BEFORE touching the backend here: when the tunnel is wedged even
+    # jax.devices() blocks forever, so the parent must not initialize until
+    # a subprocess proves the platform answers. On probe failure fall back
+    # to the CPU smoke measurement rather than hanging or reporting nothing.
+    accel_ok = _preflight()
+    if not accel_ok:
+        jax.config.update("jax_platforms", "cpu")
 
     dev = jax.devices()[0]
     on_accel = dev.platform != "cpu"
@@ -107,6 +144,10 @@ def main() -> None:
         "seq_len": seq,
         "loss": round(float(metrics["loss"][-1]), 4),
     }
+    if not accel_ok:
+        result["error"] = (
+            "accelerator unresponsive (tunnel wedged); CPU smoke fallback"
+        )
     print(json.dumps(result))
 
 

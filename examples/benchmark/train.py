@@ -109,8 +109,11 @@ def main():
     # Warmup: at least one window (covers compile) plus whatever --warmup
     # asks for, rounded up to whole windows; timed windows fill the rest of
     # --steps, rounded DOWN so the run never overshoots the requested count.
-    warm_windows = max(1, -(-args.warmup // window))
-    timed_windows = max(1, args.steps // window - warm_windows)
+    # >= 2 windows (1 warmup + 1 timed); the floor only overshoots --steps
+    # in the degenerate --steps 1 case.
+    total_windows = max(2, args.steps // window)
+    warm_windows = min(max(1, -(-args.warmup // window)), total_windows - 1)
+    timed_windows = total_windows - warm_windows
     state, metrics = step.run(state, next_batch(), window)
     first_loss = float(metrics["loss"][0])
     for _ in range(warm_windows - 1):
