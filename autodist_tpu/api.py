@@ -220,6 +220,7 @@ class AutoDist:
         expert_names: Sequence[str] = (),
         donate_state: bool = True,
         host_offload: bool = False,
+        grad_accum_steps: int = 1,
     ) -> DistributedTrainStep:
         """Capture → strategy → compile → lower (autodist.py:139-150).
 
@@ -228,6 +229,8 @@ class AutoDist:
         ``host_offload=True`` parks PS-synchronized parameters + optimizer
         slots in pinned host memory, streaming through HBM per step (the
         reference's params-on-CPU placement, ps_strategy.py:38-55).
+        ``grad_accum_steps=k`` microbatches each step k-ways (activation
+        memory ÷ k, same update for batch-mean losses).
         """
         if isinstance(optimizer, OptimizerSpec):
             opt_spec, tx = optimizer, optimizer.make()
@@ -251,7 +254,10 @@ class AutoDist:
             compiled, model_item, self.mesh, host_offload=host_offload
         ).transform()
         logging.debug("sharding plan:\n%s", plan.describe())
-        step = DistributedTrainStep(plan, loss_fn, tx, has_aux=has_aux, donate_state=donate_state)
+        step = DistributedTrainStep(
+            plan, loss_fn, tx, has_aux=has_aux, donate_state=donate_state,
+            grad_accum_steps=grad_accum_steps,
+        )
         self._built, self._strategy, self._model_item = step, compiled, model_item
         return step
 

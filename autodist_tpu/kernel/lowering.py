@@ -479,16 +479,25 @@ class DistributedTrainStep:
         optimizer: optax.GradientTransformation,
         has_aux: bool = False,
         donate_state: bool = True,
+        grad_accum_steps: int = 1,
     ):
         self.plan = plan
         self.loss_fn = loss_fn
         self.tx = optimizer
         self.has_aux = has_aux
         self._donate = donate_state
+        if grad_accum_steps < 1:
+            raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+        self._accum = grad_accum_steps
         self._compiled = None
         self._compiled_runs: Dict[Any, Any] = {}
         self._state_shardings = None
         self._compressors = self._resolve_compressors(plan)
+        if self._accum > 1 and self._compressors:
+            raise ValueError(
+                "grad_accum_steps > 1 is not supported together with "
+                "gradient compression (the compressed sync owns the "
+                "grad computation)")
         self._stale = {
             name: p.staleness
             for name, p in plan.var_plans.items()
@@ -633,6 +642,9 @@ class DistributedTrainStep:
             state = _stream(state, host_shardings, device_shardings)
         if self._compressors:
             loss, aux, grads, new_comp = self._compressed_grads(state, batch)
+        elif self._accum > 1:
+            loss, aux, grads = self._accumulated_grads(state.params, batch)
+            new_comp = state.comp_state
         else:
             if self.has_aux:
                 (loss, aux), grads = jax.value_and_grad(self.loss_fn, has_aux=True)(
@@ -657,6 +669,84 @@ class DistributedTrainStep:
         if aux is not None:
             metrics["aux"] = aux
         return new_state, metrics
+
+    # --------------------------------------------- gradient accumulation
+    def _accumulated_grads(self, params, batch):
+        """Microbatched gradients: split the batch dim into ``_accum``
+        slices, scan, and average — activation memory drops ~k× while the
+        update equals the full-batch step exactly (for batch-mean losses,
+        the zoo's convention). Loss and aux metrics come back averaged over
+        microbatches (so sum-style aux reports the per-micro mean, in f32). This is the memory side of what the
+        reference's per-variable ``ConditionalAccumulator`` did across
+        workers (ps_synchronizer.py:553-630), rendered as a deterministic
+        on-device loop.
+        """
+        k = self._accum
+        ax = data_axis(self.plan.mesh)
+        n = dict(zip(self.plan.mesh.axis_names, self.plan.mesh.devices.shape))[ax]
+
+        for leaf in jax.tree.leaves(batch):
+            shape = getattr(leaf, "shape", ())
+            # Rank-0 leaves replicate (same tolerance as batch_shardings);
+            # batched leaves must split evenly.
+            if len(shape) >= 1 and (shape[0] == 0 or shape[0] % k != 0):
+                raise ValueError(
+                    f"grad_accum_steps={k} requires every batched leaf's "
+                    f"leading dim to be divisible by {k}; got shape {shape}")
+
+        def to_micro(x):
+            # [B, ...] -> [k, B/k, ...]; keep the micro batch dim sharded on
+            # the data axis exactly where the plan would shard the full
+            # batch (one all-to-all on the feed, versus resharding the
+            # whole activation set every micro-step). Rank-0 leaves ride
+            # along broadcast, one copy per micro-step.
+            if getattr(x, "ndim", 0) < 1:
+                m = jnp.broadcast_to(jnp.asarray(x)[None], (k,))
+                return lax.with_sharding_constraint(
+                    m, NamedSharding(self.plan.mesh, P()))
+            m = x.reshape((k, x.shape[0] // k) + x.shape[1:])
+            if m.shape[1] % n == 0 and m.shape[1] > 0:
+                spec = P(None, ax)
+            else:
+                logging.warning(
+                    "grad_accum_steps=%d: micro batch dim %d not divisible "
+                    "by data-parallel degree %d — micro batches replicate "
+                    "and every device computes the full gradient redundantly",
+                    k, m.shape[1], n,
+                )
+                spec = P()
+            return lax.with_sharding_constraint(
+                m, NamedSharding(self.plan.mesh, spec))
+
+        micro_batches = jax.tree.map(to_micro, batch)
+
+        def body(carry, mb):
+            loss_acc, grads_acc, aux_acc = carry
+            if self.has_aux:
+                (loss, aux), grads = jax.value_and_grad(
+                    self.loss_fn, has_aux=True)(params, mb)
+                aux_acc = jax.tree.map(lambda a, x: a + x / k, aux_acc, aux)
+            else:
+                loss, grads = jax.value_and_grad(self.loss_fn)(params, mb)
+            grads_acc = jax.tree.map(lambda a, g: a + g / k, grads_acc, grads)
+            return (loss_acc + loss / k, grads_acc, aux_acc), None
+
+        zero_grads = jax.tree.map(jnp.zeros_like, params)
+        if self.has_aux:
+            micro0 = jax.tree.map(lambda x: x[0], micro_batches)
+            aux_shape = jax.eval_shape(lambda: self.loss_fn(params, micro0)[1])
+            # Accumulate aux in (at least) f32: ``a + x / k`` promotes int
+            # aux to float, and scan requires a dtype-stable carry.
+            zero_aux = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, jnp.promote_types(s.dtype, jnp.float32)),
+                aux_shape)
+        else:
+            zero_aux = None
+        (loss, grads, aux), _ = lax.scan(
+            body, (jnp.zeros((), jnp.float32), zero_grads, zero_aux),
+            micro_batches,
+        )
+        return loss, aux, grads
 
     # ------------------------------------------------- compressed grad sync
     def _data_only_spec(self, pspec: P, ax: str) -> P:

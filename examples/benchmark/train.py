@@ -54,6 +54,8 @@ def parse_args():
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--window", type=int, default=10,
                    help="steps per device-side scan window (1 = per-step dispatch)")
+    p.add_argument("--accum", type=int, default=1,
+                   help="gradient accumulation microbatches per step")
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--trace", action="store_true", help="profile one step to TensorBoard")
     p.add_argument("--model-kwargs", default="",
@@ -78,7 +80,8 @@ def main():
     params = model.init(jax.random.PRNGKey(0))
     example = model.example_batch(batch_size)
     step = autodist.build(
-        model.loss_fn, params, example, sparse_names=model.sparse_names
+        model.loss_fn, params, example, sparse_names=model.sparse_names,
+        grad_accum_steps=args.accum,
     )
     state = step.init(params)
 
