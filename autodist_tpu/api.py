@@ -32,6 +32,7 @@ import os
 from contextlib import contextmanager
 from typing import Any, Callable, Optional, Sequence, Union
 
+import jax
 import optax
 
 from autodist_tpu import const
@@ -43,6 +44,28 @@ from autodist_tpu.strategy import PSLoadBalancing, Strategy, StrategyBuilder, St
 from autodist_tpu.utils import logging
 
 _default_autodist: Optional["AutoDist"] = None
+
+
+# Non-factory jax.checkpoint_policies usable directly as a remat policy
+# (factories like save_only_these_names need arguments and are out of scope
+# for the string shorthand).
+_REMAT_POLICIES = (
+    "everything_saveable",
+    "nothing_saveable",
+    "dots_saveable",
+    "checkpoint_dots",
+    "dots_with_no_batch_dims_saveable",
+    "checkpoint_dots_with_no_batch_dims",
+)
+
+
+def _remat_policy(remat: Union[bool, str]):
+    if remat is True:
+        return None
+    if remat in _REMAT_POLICIES:
+        return getattr(jax.checkpoint_policies, remat)
+    raise ValueError(
+        f"unknown remat policy {remat!r}; use True or one of {_REMAT_POLICIES}")
 
 
 def get_default_autodist() -> Optional["AutoDist"]:
@@ -221,6 +244,7 @@ class AutoDist:
         donate_state: bool = True,
         host_offload: bool = False,
         grad_accum_steps: int = 1,
+        remat: Union[bool, str] = False,
     ) -> DistributedTrainStep:
         """Capture → strategy → compile → lower (autodist.py:139-150).
 
@@ -231,6 +255,11 @@ class AutoDist:
         reference's params-on-CPU placement, ps_strategy.py:38-55).
         ``grad_accum_steps=k`` microbatches each step k-ways (activation
         memory ÷ k, same update for batch-mean losses).
+        ``remat`` rematerializes the forward pass during backward
+        (``jax.checkpoint``): ``True`` saves nothing (max memory savings,
+        ~+1/3 FLOPs), or pass a ``jax.checkpoint_policies`` name (e.g.
+        ``"dots_saveable"``) to keep MXU outputs and recompute the rest —
+        the HBM-vs-FLOPs trade the TPU guide recommends.
         """
         if isinstance(optimizer, OptimizerSpec):
             opt_spec, tx = optimizer, optimizer.make()
@@ -254,6 +283,11 @@ class AutoDist:
             compiled, model_item, self.mesh, host_offload=host_offload
         ).transform()
         logging.debug("sharding plan:\n%s", plan.describe())
+        if remat:
+            # Wrap AFTER ModelItem capture: _detect_sparse cannot see through
+            # a remat2 equation, so sparse-update detection must run on the
+            # bare loss_fn.
+            loss_fn = jax.checkpoint(loss_fn, policy=_remat_policy(remat))
         step = DistributedTrainStep(
             plan, loss_fn, tx, has_aux=has_aux, donate_state=donate_state,
             grad_accum_steps=grad_accum_steps,
