@@ -491,6 +491,7 @@ class DistributedTrainStep:
         self._accum = grad_accum_steps
         self._compiled = None
         self._compiled_runs: Dict[Any, Any] = {}
+        self._compiled_eval: Dict[Any, Any] = {}
         self._state_shardings = None
         self._compressors = self._resolve_compressors(plan)
         if self._accum > 1 and self._compressors:
@@ -955,6 +956,50 @@ class DistributedTrainStep:
             )
             self._compiled_runs[key] = fn
         return fn(state, batch)
+
+    # ------------------------------------------------------------ evaluation
+    def evaluate(self, state: TrainState, batch):
+        """Loss (+aux) on a batch without gradients or state mutation — the
+        reference's "fetch tensors without train ops" path
+        (remapper.py:125-185: non-train fetches ran against the master
+        replica). Params stay in their plan shardings; the batch shards on
+        the data axis (replicating ragged leaves — eval tails needn't
+        divide the mesh); nothing is donated. Compiles are cached per batch
+        structure/shape.
+        """
+        key = (jax.tree.structure(batch), tuple(
+            (getattr(x, "shape", ()), str(getattr(x, "dtype", type(x))))
+            for x in jax.tree.leaves(batch)))
+        fn = self._compiled_eval.get(key)
+        if fn is None:
+            if self._state_shardings is None:
+                self._state_shardings = self.plan.state_shardings(
+                    jax.eval_shape(lambda: state))
+
+            if self.plan.has_offload:
+                p_shapes = jax.eval_shape(lambda: state).params
+                host_sh = self.plan.params_shardings(p_shapes)
+                dev_sh = self.plan.params_shardings(p_shapes, device_view=True)
+            else:
+                host_sh = dev_sh = None
+
+            def eval_fn(params, b):
+                if host_sh is not None:
+                    params = _stream(params, host_sh, dev_sh)
+                out = self.loss_fn(params, b)
+                if self.has_aux:
+                    loss, aux = out
+                    return {"loss": loss, "aux": aux}
+                return {"loss": out}
+
+            fn = jax.jit(
+                eval_fn,
+                in_shardings=(self._state_shardings.params,
+                              self.plan.batch_shardings(batch, strict=False)),
+                out_shardings=None,
+            )
+            self._compiled_eval[key] = fn
+        return fn(state.params, batch)
 
     def init_or_restore(self, params, saver) -> TrainState:
         """Fresh state, or the latest checkpoint when one exists — the

@@ -476,3 +476,74 @@ class TestGradAccumulation:
             assert abs(float(m["aux"]["correct"]) - 8.0) < 1e-6
         finally:
             AutoDist.reset_default()
+
+
+class TestEvaluate:
+    def test_evaluate_matches_loss_and_leaves_state(self):
+        import numpy as np
+        from autodist_tpu.api import AutoDist
+        from autodist_tpu.models import get_model
+
+        spec = get_model("mlp")
+        params = spec.init(jax.random.PRNGKey(0))
+        batch = spec.example_batch(16)
+        AutoDist.reset_default()
+        try:
+            ad = AutoDist()
+            step = ad.build(spec.loss_fn, params, batch)
+            st = step.init(params)
+            before = jax.device_get(st.params)
+            m = step.evaluate(st, batch)
+            want = float(spec.loss_fn(params, jax.tree.map(jnp.asarray, batch)))
+            np.testing.assert_allclose(float(m["loss"]), want, rtol=1e-5)
+            after = jax.device_get(st.params)
+            for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+                np.testing.assert_array_equal(a, b)  # state untouched, undonated
+            # still usable for training afterwards
+            st, tm = step(st, batch)
+            assert np.isfinite(float(tm["loss"]))
+        finally:
+            AutoDist.reset_default()
+
+    def test_evaluate_with_offload_plan(self):
+        import numpy as np
+        from autodist_tpu.api import AutoDist
+        from autodist_tpu.models import get_model
+
+        spec = get_model("mlp")
+        params = spec.init(jax.random.PRNGKey(0))
+        batch = spec.example_batch(16)
+        AutoDist.reset_default()
+        try:
+            ad = AutoDist(strategy_builder=PS())
+            step = ad.build(spec.loss_fn, params, batch, host_offload=True)
+            st = step.init(params)
+            m = step.evaluate(st, batch)
+            assert np.isfinite(float(m["loss"]))
+        finally:
+            AutoDist.reset_default()
+
+    def test_evaluate_ragged_tail_batch(self):
+        """A final validation batch whose size doesn't divide the mesh must
+        replicate, not raise — and recompile per shape, not collide."""
+        import numpy as np
+        from autodist_tpu.api import AutoDist
+        from autodist_tpu.models import get_model
+
+        spec = get_model("mlp")
+        params = spec.init(jax.random.PRNGKey(0))
+        batch = spec.example_batch(16)
+        AutoDist.reset_default()
+        try:
+            ad = AutoDist()
+            step = ad.build(spec.loss_fn, params, batch)
+            st = step.init(params)
+            m16 = step.evaluate(st, batch)
+            tail = jax.tree.map(lambda x: x[:10], batch)  # 10 % 8 != 0
+            m10 = step.evaluate(st, tail)
+            assert np.isfinite(float(m16["loss"]))
+            assert np.isfinite(float(m10["loss"]))
+            want = float(spec.loss_fn(params, jax.tree.map(jnp.asarray, tail)))
+            np.testing.assert_allclose(float(m10["loss"]), want, rtol=1e-5)
+        finally:
+            AutoDist.reset_default()
