@@ -59,6 +59,8 @@ class Saver:
     def __init__(self, directory: Optional[str] = None, max_to_keep: int = 0):
         self.directory = directory or const.DEFAULT_CHECKPOINT_DIR
         self.max_to_keep = max_to_keep
+        self._pending = None        # in-flight async write thread
+        self._pending_error = None  # its failure, re-raised from wait()
 
     def _list_checkpoints(self):
         """``ckpt-<step>`` entries under ``directory``, step-ascending."""
@@ -72,32 +74,41 @@ class Saver:
         )
 
     # ------------------------------------------------------------------ save
-    def save(self, tree: Any, path: Optional[str] = None, step: Optional[int] = None) -> str:
+    def save(self, tree: Any, path: Optional[str] = None, step: Optional[int] = None,
+             block: bool = True) -> str:
         """Write ``tree`` to ``path`` (default ``<directory>/ckpt-<step>``).
 
         On multi-host only process 0 writes (after global assembly); all
         processes return the same path.
+
+        ``block=False`` overlaps the file IO with training: leaves are
+        fetched to host *on the calling thread* (mandatory — the train step
+        donates its state buffers, so the device values must be captured
+        before the next step runs), then written by a background thread.
+        Call :meth:`wait` (or any restore/latest query, which waits
+        implicitly) before relying on the files. Async applies only
+        single-process: multi-host saves keep the write→barrier ordering.
         """
+        self.wait()  # one write at a time, ordered — async OR blocking
         if path is None:
             # Step-less saves land in ckpt-0 so latest_checkpoint()/_gc see
             # them; a bare "ckpt" dir would be invisible to both.
             path = os.path.join(self.directory, f"ckpt-{step or 0}")
         leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
-        entries: Dict[str, dict] = {}
-        is_writer = jax.process_index() == 0
-        for p, leaf in leaves:
-            name = _path_to_name(p)
-            value = _to_host(leaf)
-            entries[name] = {"shape": list(value.shape), "dtype": str(value.dtype)}
-            if is_writer:
-                fpath = os.path.join(path, name + ".npy")
-                os.makedirs(os.path.dirname(fpath), exist_ok=True)
-                np.save(fpath, value)
-        if is_writer:
-            meta = {"format_version": _FORMAT_VERSION, "step": step, "entries": entries}
-            with open(os.path.join(path, "metadata.json"), "w", encoding="utf-8") as f:
-                json.dump(meta, f, indent=2, sort_keys=True)
-            self._gc()
+        host_leaves = [(_path_to_name(p), _to_host(leaf)) for p, leaf in leaves]
+
+        if not block and jax.process_count() == 1:
+            import threading
+
+            # Non-daemon: a normal interpreter exit waits for the write
+            # instead of killing it mid-file.
+            self._pending = threading.Thread(
+                target=self._write_guarded, args=(path, step, host_leaves)
+            )
+            self._pending.start()
+            return path
+
+        self._write(path, step, host_leaves)
         if jax.process_count() > 1:
             # Barrier: no process may see `path` as "saved" until the writer
             # has finished metadata.json (otherwise a non-writer's immediate
@@ -105,8 +116,49 @@ class Saver:
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices(f"autodist_tpu:save:{path}")
-        logging.info("saved checkpoint with %d arrays -> %s", len(entries), path)
         return path
+
+    def _write(self, path: str, step: Optional[int], host_leaves) -> None:
+        """Write atomically: stage into ``<path>.tmp`` and rename, so a
+        killed writer never leaves a metadata-less ckpt dir that
+        ``restore_latest`` would trip over."""
+        import shutil
+
+        entries: Dict[str, dict] = {}
+        is_writer = jax.process_index() == 0
+        tmp = path + f".tmp-{os.getpid()}"
+        for name, value in host_leaves:
+            entries[name] = {"shape": list(value.shape), "dtype": str(value.dtype)}
+            if is_writer:
+                fpath = os.path.join(tmp, name + ".npy")
+                os.makedirs(os.path.dirname(fpath), exist_ok=True)
+                np.save(fpath, value)
+        if is_writer:
+            meta = {"format_version": _FORMAT_VERSION, "step": step, "entries": entries}
+            os.makedirs(tmp, exist_ok=True)
+            with open(os.path.join(tmp, "metadata.json"), "w", encoding="utf-8") as f:
+                json.dump(meta, f, indent=2, sort_keys=True)
+            if os.path.exists(path):
+                shutil.rmtree(path)
+            os.rename(tmp, path)
+            self._gc()
+        logging.info("saved checkpoint with %d arrays -> %s", len(entries), path)
+
+    def _write_guarded(self, path: str, step: Optional[int], host_leaves) -> None:
+        try:
+            self._write(path, step, host_leaves)
+        except BaseException as e:  # re-raised from wait()
+            self._pending_error = e
+
+    def wait(self) -> None:
+        """Block until any in-flight async save has fully written; re-raise
+        its failure here rather than letting a torn save pass silently."""
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+        err, self._pending_error = self._pending_error, None
+        if err is not None:
+            raise RuntimeError("async checkpoint save failed") from err
 
     def _gc(self) -> None:
         if self.max_to_keep <= 0:
@@ -120,6 +172,8 @@ class Saver:
     def restore(self, path: str, target: Any = None, shardings: Any = None) -> Any:
         """Load a checkpoint.
 
+        Waits for any in-flight async save first.
+
         With ``target`` (a pytree of arrays or ShapeDtypeStructs), leaves are
         matched by pytree-path name — extra checkpoint entries are ignored,
         missing ones raise. With ``shardings`` (same structure), each loaded
@@ -127,6 +181,7 @@ class Saver:
         cross-sharding restore happens. Without ``target``, the nested-dict
         structure is rebuilt from the stored names.
         """
+        self.wait()
         meta = self.read_metadata(path)
         entries = meta["entries"]
         if target is None:
@@ -183,7 +238,10 @@ class Saver:
             return json.load(f)
 
     def latest_checkpoint(self) -> Optional[str]:
-        """Most recent ``ckpt-<step>`` under ``directory``, or None."""
+        """Most recent ``ckpt-<step>`` under ``directory``, or None.
+
+        Waits for any in-flight async save first."""
+        self.wait()
         ckpts = self._list_checkpoints()
         return os.path.join(self.directory, ckpts[-1]) if ckpts else None
 

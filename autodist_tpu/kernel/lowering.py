@@ -977,9 +977,11 @@ class DistributedTrainStep:
                     jax.eval_shape(lambda: state))
 
             if self.plan.has_offload:
-                p_shapes = jax.eval_shape(lambda: state).params
-                host_sh = self.plan.params_shardings(p_shapes)
-                dev_sh = self.plan.params_shardings(p_shapes, device_view=True)
+                # Host view == the plan shardings already frozen in
+                # _state_shardings; only the device view needs computing.
+                host_sh = self._state_shardings.params
+                dev_sh = self.plan.params_shardings(
+                    jax.eval_shape(lambda: state).params, device_view=True)
             else:
                 host_sh = dev_sh = None
 

@@ -189,3 +189,82 @@ def test_saved_model_roundtrip(tmp_path):
     serve = load_saved_model(d)
     got = serve(np.asarray(x))
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-6)
+
+
+def test_async_save_round_trip(tmp_path):
+    """block=False must capture device values at call time (donation-safe):
+    training on after the save must not change what was written."""
+    import numpy as np
+    from autodist_tpu.api import AutoDist
+    from autodist_tpu.checkpoint import Saver
+    from autodist_tpu.models import get_model
+
+    spec = get_model("mlp")
+    params = spec.init(jax.random.PRNGKey(0))
+    batch = spec.example_batch(16)
+    AutoDist.reset_default()
+    try:
+        ad = AutoDist()
+        step = ad.build(spec.loss_fn, params, batch)
+        st = step.init(params)
+        st, _ = step(st, batch)
+        snapshot = jax.device_get(st.params)
+        saver = Saver(directory=str(tmp_path))
+        path = saver.save(st, step=1, block=False)
+        # keep training: donates/overwrites the state buffers immediately
+        for _ in range(3):
+            st, _ = step(st, batch)
+        saver.wait()
+        restored = saver.restore(path)
+        # compare by name through the restored nested dict
+        flat_snap, _ = jax.tree_util.tree_flatten_with_path(snapshot)
+        for p, want in flat_snap:
+            node = restored["params"]
+            for key in [str(getattr(k, "key", getattr(k, "idx", k))) for k in p]:
+                node = node[key]
+            np.testing.assert_array_equal(np.asarray(want), node)
+    finally:
+        AutoDist.reset_default()
+
+
+def test_async_save_visible_to_latest_checkpoint(tmp_path):
+    from autodist_tpu.checkpoint import Saver
+
+    saver = Saver(directory=str(tmp_path))
+    saver.save({"w": jnp.ones((4,))}, step=7, block=False)
+    # latest_checkpoint waits for the in-flight write
+    latest = saver.latest_checkpoint()
+    assert latest is not None and latest.endswith("ckpt-7")
+
+
+def test_async_save_failure_surfaces_in_wait(tmp_path, monkeypatch):
+    import numpy as np
+    import pytest as _pytest
+    from autodist_tpu.checkpoint import Saver
+    from autodist_tpu.checkpoint import saver as saver_mod
+
+    saver = Saver(directory=str(tmp_path))
+
+    def boom(*a, **kw):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(saver_mod.np, "save", boom)
+    saver.save({"w": jnp.ones((4,))}, step=1, block=False)
+    with _pytest.raises(RuntimeError, match="async checkpoint save failed"):
+        saver.wait()
+    # failure is not sticky
+    monkeypatch.undo()
+    saver.save({"w": jnp.ones((4,))}, step=2, block=False)
+    assert saver.latest_checkpoint().endswith("ckpt-2")
+
+
+def test_torn_write_invisible(tmp_path):
+    """Only fully-written (renamed) ckpt dirs are visible: a leftover tmp
+    staging dir must not be picked up by latest_checkpoint."""
+    import os
+    from autodist_tpu.checkpoint import Saver
+
+    saver = Saver(directory=str(tmp_path))
+    saver.save({"w": jnp.ones((4,))}, step=1)
+    os.makedirs(os.path.join(str(tmp_path), "ckpt-2.tmp-12345"))
+    assert saver.latest_checkpoint().endswith("ckpt-1")
