@@ -95,11 +95,14 @@ class Saver:
             # them; a bare "ckpt" dir would be invisible to both.
             path = os.path.join(self.directory, f"ckpt-{step or 0}")
         leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
-        host_leaves = [(_path_to_name(p), _to_host(leaf)) for p, leaf in leaves]
 
         if not block and jax.process_count() == 1:
             import threading
 
+            # Async must materialize every leaf NOW (donation safety); the
+            # blocking path below streams one leaf at a time instead, so
+            # peak host memory stays ~one leaf.
+            host_leaves = [(_path_to_name(p), _to_host(leaf)) for p, leaf in leaves]
             # Non-daemon: a normal interpreter exit waits for the write
             # instead of killing it mid-file.
             self._pending = threading.Thread(
@@ -108,7 +111,8 @@ class Saver:
             self._pending.start()
             return path
 
-        self._write(path, step, host_leaves)
+        self._write(path, step,
+                    ((_path_to_name(p), _to_host(leaf)) for p, leaf in leaves))
         if jax.process_count() > 1:
             # Barrier: no process may see `path` as "saved" until the writer
             # has finished metadata.json (otherwise a non-writer's immediate
@@ -122,11 +126,18 @@ class Saver:
         """Write atomically: stage into ``<path>.tmp`` and rename, so a
         killed writer never leaves a metadata-less ckpt dir that
         ``restore_latest`` would trip over."""
+        import glob
         import shutil
 
         entries: Dict[str, dict] = {}
         is_writer = jax.process_index() == 0
         tmp = path + f".tmp-{os.getpid()}"
+        if is_writer:
+            # Sweep leftovers of earlier killed writers (full-checkpoint-
+            # sized garbage that _list_checkpoints deliberately ignores).
+            for stale in glob.glob(path + ".tmp-*") + glob.glob(path + ".old-*"):
+                if stale != tmp:
+                    shutil.rmtree(stale, ignore_errors=True)
         for name, value in host_leaves:
             entries[name] = {"shape": list(value.shape), "dtype": str(value.dtype)}
             if is_writer:
@@ -138,9 +149,14 @@ class Saver:
             os.makedirs(tmp, exist_ok=True)
             with open(os.path.join(tmp, "metadata.json"), "w", encoding="utf-8") as f:
                 json.dump(meta, f, indent=2, sort_keys=True)
+            # Overwrite without a window where NO complete checkpoint
+            # exists: move the old dir aside, swap the new one in, then
+            # drop the old.
+            old = path + f".old-{os.getpid()}"
             if os.path.exists(path):
-                shutil.rmtree(path)
+                os.rename(path, old)
             os.rename(tmp, path)
+            shutil.rmtree(old, ignore_errors=True)
             self._gc()
         logging.info("saved checkpoint with %d arrays -> %s", len(entries), path)
 

@@ -1,0 +1,76 @@
+"""Long-context training via sequence parallelism — ring attention over a
+``seq`` mesh axis.
+
+The reference framework was data-parallel only
+(``/root/reference/docs/design/architecture.rst:49-51``); long sequences are
+new capability here. This example trains a transformer LM whose attention
+runs as a ppermute ring over the sequence axis: each device holds a
+``seq_len / seq_par`` slice of every sequence, K/V blocks rotate around the
+ring, and softmax is accumulated online — activation memory per device
+scales with the *slice*, not the sequence.
+
+Run (virtual mesh works anywhere):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/long_context.py --seq-len 512 --seq-par 4
+
+On a TPU pod slice, point ``--resource-spec`` at your cluster yml and the
+same script spans hosts (the `seq` axis rides ICI).
+"""
+import argparse
+
+import jax
+import numpy as np
+
+import autodist_tpu as ad
+from autodist_tpu.models import get_model
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seq-len", type=int, default=512)
+    p.add_argument("--seq-par", type=int, default=4,
+                   help="devices along the seq axis (ring size)")
+    p.add_argument("--batch-size", type=int, default=4)
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--resource-spec", default="")
+    p.add_argument("--impl", choices=["ring", "ulysses"], default="ring")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    n_dev = jax.device_count()
+    if n_dev % args.seq_par:
+        raise SystemExit(f"--seq-par {args.seq_par} must divide {n_dev} devices")
+
+    mesh_shape = {"data": n_dev // args.seq_par, "seq": args.seq_par}
+    spec_kw = (
+        dict(resource_spec_file=args.resource_spec) if args.resource_spec else
+        dict(resource_spec=ad.ResourceSpec(resource_dict={
+            "nodes": [{"address": "localhost", "chips": n_dev, "chief": True}],
+            "mesh": mesh_shape,
+        }))
+    )
+    autodist = ad.AutoDist(strategy_builder=ad.strategy.AllReduce(),
+                           mesh_axes=tuple(mesh_shape), **spec_kw)
+
+    model = get_model(
+        "transformer",
+        vocab_size=1024, num_layers=2, d_model=128, num_heads=8, d_ff=256,
+        max_seq_len=args.seq_len, attention_impl=args.impl,
+    )
+    params = model.init(jax.random.PRNGKey(0))
+    batch = model.example_batch(args.batch_size * mesh_shape["data"])
+
+    step = autodist.build(model.loss_fn, params, batch)
+    state = step.init(params)
+    state, metrics = step.run(state, batch, args.steps)
+    losses = np.asarray(metrics["loss"])
+    print(f"mesh={mesh_shape} impl={args.impl} seq_len={args.seq_len}  "
+          f"loss {losses[0]:.4f} -> {losses[-1]:.4f} over {args.steps} steps")
+    assert losses[-1] < losses[0], "loss did not decrease"
+
+
+if __name__ == "__main__":
+    main()

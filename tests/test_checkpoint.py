@@ -268,3 +268,21 @@ def test_torn_write_invisible(tmp_path):
     saver.save({"w": jnp.ones((4,))}, step=1)
     os.makedirs(os.path.join(str(tmp_path), "ckpt-2.tmp-12345"))
     assert saver.latest_checkpoint().endswith("ckpt-1")
+
+
+def test_overwrite_sweeps_orphans_and_keeps_a_checkpoint(tmp_path):
+    """Re-saving the same step swaps atomically (old aside, new in) and
+    sweeps tmp/old leftovers from killed writers."""
+    import os
+    from autodist_tpu.checkpoint import Saver
+
+    saver = Saver(directory=str(tmp_path))
+    saver.save({"w": jnp.ones((4,))}, step=5)
+    # simulate a killed writer's leftovers
+    os.makedirs(os.path.join(str(tmp_path), "ckpt-5.tmp-99999"))
+    os.makedirs(os.path.join(str(tmp_path), "ckpt-5.old-99999"))
+    saver.save({"w": jnp.full((4,), 2.0)}, step=5)
+    entries = sorted(os.listdir(tmp_path))
+    assert entries == ["ckpt-5"], entries
+    restored = saver.restore(os.path.join(str(tmp_path), "ckpt-5"))
+    assert float(restored["w"][0]) == 2.0

@@ -72,3 +72,15 @@ def test_launcher_cli_runs_trivial_command(tmp_path):
     ])
     assert code == 0
     assert marker.read_text() == "yes"
+
+
+def test_long_context_example(monkeypatch, capsys):
+    import runpy
+
+    monkeypatch.setattr(sys, "argv", [
+        "long_context.py", "--seq-len", "32", "--seq-par", "4",
+        "--batch-size", "2", "--steps", "4",
+    ])
+    runpy.run_path("/root/repo/examples/long_context.py", run_name="__main__")
+    out = capsys.readouterr().out
+    assert "impl=ring" in out and "->" in out
