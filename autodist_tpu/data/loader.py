@@ -120,6 +120,13 @@ class DataLoader:
         it = self._iter_native() if self.engine == "native" else self._iter_python()
         if self.plan is None:
             return it
+        import jax
+
+        if (jax.process_count() > 1 and not self.drop_remainder
+                and self.n_rows % self.batch_size):
+            raise ValueError(
+                "multi-host DataLoader requires drop_remainder=True: a "
+                "ragged final batch cannot assemble into a global array")
         if self.device_prefetch > 0:
             return self._iter_device_prefetch(it, self.device_prefetch)
         return (self._shard(b) for b in it)
@@ -145,9 +152,11 @@ class DataLoader:
             yield q.popleft()
 
     def _shard(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
-        import jax
-
-        return jax.device_put(batch, self.plan.batch_shardings(batch, strict=False))
+        """This process's batch is its local slice of the global batch —
+        the plan dispatches: single-process device_put vs multi-host
+        assembly (each host loads 1/P of the data, the reference's
+        per-worker feed-splitting contract in reverse)."""
+        return self.plan.global_batch_from_local(batch)
 
     def _iter_python(self):
         total = None if self.epochs < 0 else self.epochs

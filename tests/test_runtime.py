@@ -172,6 +172,20 @@ class TestCoordinator:
         assert "chief survived" not in res.stdout
 
 
+def _scrubbed_cpu_env():
+    """Fleet env without the host's accelerator plugin (sitecustomize on
+    PYTHONPATH, JAX_/XLA_/TPU_ vars): the 2-process tests must really run
+    on CPU."""
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith(("JAX_", "XLA_", "PALLAS_", "AXON", "TPU_"))
+        and k != "PYTHONPATH"
+    }
+    env["PYTHONPATH"] = "/root/repo"
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
 @pytest.mark.integration
 def test_two_process_cpu_cluster(tmp_path):
     """Full multi-controller path: 2 local processes, jax.distributed,
@@ -211,13 +225,7 @@ def test_two_process_cpu_cluster(tmp_path):
 
     # Scrubbed env: drop the host's default accelerator platform (e.g. a TPU
     # plugin sitecustomize on PYTHONPATH) so the fleet really runs on CPU.
-    env = {
-        k: v for k, v in os.environ.items()
-        if not k.startswith(("JAX_", "XLA_", "PALLAS_", "AXON", "TPU_"))
-        and k != "PYTHONPATH"
-    }
-    env["PYTHONPATH"] = "/root/repo"
-    env["JAX_PLATFORMS"] = "cpu"
+    env = _scrubbed_cpu_env()
     env["AUTODIST_TEST_CKPT_DIR"] = str(tmp_path / "ckpt")
     code = _launch_local_fleet(
         [sys.executable, str(script)], 2, coordinator_port=15999, base_env=env
@@ -270,14 +278,69 @@ def test_two_process_autodist_training(tmp_path):
     """))
     from autodist_tpu.runtime.launcher import _launch_local_fleet
 
-    env = {
-        k: v for k, v in os.environ.items()
-        if not k.startswith(("JAX_", "XLA_", "PALLAS_", "AXON", "TPU_"))
-        and k != "PYTHONPATH"
-    }
-    env["PYTHONPATH"] = "/root/repo"
-    env["JAX_PLATFORMS"] = "cpu"
+    env = _scrubbed_cpu_env()
     code = _launch_local_fleet(
         [sys.executable, str(script)], 2, coordinator_port=15997, base_env=env
+    )
+    assert code == 0
+
+
+@pytest.mark.integration
+def test_two_process_dataloader_feed(tmp_path):
+    """DataLoader on multi-host: each process loads only its slice; the
+    loader assembles global sharded batches via the plan (the remapper
+    feed contract in reverse). Windowed training over the loader works."""
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        from autodist_tpu.runtime.launcher import initialize_from_env
+        initialize_from_env()
+        import jax
+        import numpy as np
+        from autodist_tpu.api import AutoDist
+        from autodist_tpu.data import DataLoader
+        from autodist_tpu.model_item import OptimizerSpec
+        import autodist_tpu.strategy as S
+
+        assert jax.process_count() == 2
+        ad = AutoDist(strategy_builder=S.AllReduce())
+
+        def loss_fn(params, batch):
+            return ((batch["x"] @ params["w"]) ** 2).mean()
+
+        params = {"w": np.ones((4, 2), np.float32)}
+        example = {"x": np.zeros((8, 4), np.float32)}  # global batch 8
+        step = ad.build(loss_fn, params, example,
+                        optimizer=OptimizerSpec("sgd", {"learning_rate": 0.1}))
+        state = step.init(params)
+
+        # Each process owns half the dataset rows (16 of 32).
+        full = np.arange(32 * 4, dtype=np.float32).reshape(32, 4) / 128.0
+        local = full[jax.process_index() * 16:(jax.process_index() + 1) * 16]
+        loader = DataLoader({"x": local}, batch_size=4, epochs=1,
+                            shuffle=False, plan=step.plan)
+        batches = list(loader)
+        assert len(batches) == 4, len(batches)
+        b0 = batches[0]
+        assert b0["x"].shape == (8, 4), b0["x"].shape  # global = 2x local
+        # Global batch 0 row content: process 0 rows 0-3 then process 1
+        # rows 16-19 (deterministic order, shuffle off). The array spans
+        # both processes, so assemble it for the value check.
+        from jax.experimental import multihost_utils
+        got = multihost_utils.process_allgather(b0["x"], tiled=True)
+        want = np.concatenate([full[0:4], full[16:20]])
+        np.testing.assert_allclose(got, want)
+
+        state, metrics = step.run(state, b0, 2)
+        assert np.isfinite(float(metrics["loss"][-1]))
+        print("OK", jax.process_index(), flush=True)
+    """))
+    from autodist_tpu.runtime.launcher import _launch_local_fleet
+
+    env = _scrubbed_cpu_env()
+    code = _launch_local_fleet(
+        [sys.executable, str(script)], 2, coordinator_port=15995, base_env=env
     )
     assert code == 0
