@@ -271,7 +271,9 @@ class AutoDist:
 
         model_item = ModelItem.from_params(
             params,
-            optimizer_spec=opt_spec if opt_spec.name != "custom" else None,
+            # "custom" (raw optax) flows through so planners know the slot
+            # count is unknown and must assume the conservative worst case.
+            optimizer_spec=opt_spec,
             loss_fn=loss_fn,
             example_batch=example_batch,
             sparse_names=sparse_names,

@@ -51,6 +51,22 @@ DEFAULT_ICI_BANDWIDTH_GBPS = 900.0
 DEFAULT_DCN_BANDWIDTH_GBPS = 50.0
 DEFAULT_CHIPS_PER_HOST = 4
 
+# Per-chip HBM capacity (GB) and bandwidth (GB/s) by accelerator generation —
+# public figures, used by the strategy cost model for memory-feasibility and
+# weight-update-time estimates. Longest-prefix match on the accelerator name;
+# a `tpu: {hbm_gb, hbm_gb_per_s}` spec entry overrides.
+HBM_BY_ACCELERATOR = {
+    "v5litepod": (16.0, 819.0),
+    "v5 lite": (16.0, 819.0),
+    "v5e": (16.0, 819.0),
+    "v5p": (95.0, 2765.0),
+    "v6e": (32.0, 1640.0),
+    "v4": (32.0, 1228.0),
+    "v3": (16.0, 900.0),
+    "v2": (8.0, 700.0),
+}
+DEFAULT_HBM = (16.0, 819.0)
+
 
 class DeviceType(Enum):
     """Device kinds (reference: resource_spec.py DeviceType{CPU,GPU})."""
@@ -101,12 +117,37 @@ class TPUTopology:
     topology: Optional[Tuple[int, ...]] = None  # e.g. (2, 2, 2)
     ici_bandwidth_gbps: float = DEFAULT_ICI_BANDWIDTH_GBPS
     dcn_bandwidth_gbps: float = DEFAULT_DCN_BANDWIDTH_GBPS
+    hbm_gb: Optional[float] = None              # per-chip HBM capacity override
+    hbm_gb_per_s: Optional[float] = None  # per-chip HBM bandwidth override (GB/s)
 
     @property
     def num_chips(self) -> Optional[int]:
         if self.topology is None:
             return None
         return int(math.prod(self.topology))
+
+    def _hbm_defaults(self) -> Tuple[float, float]:
+        kind = self.accelerator.lower()
+        for key in sorted(HBM_BY_ACCELERATOR, key=len, reverse=True):
+            if kind.startswith(key):
+                return HBM_BY_ACCELERATOR[key]
+        return DEFAULT_HBM
+
+    @property
+    def hbm_bytes(self) -> float:
+        """Per-chip HBM capacity in bytes (spec override or generation table)."""
+        gb = self.hbm_gb if self.hbm_gb is not None else self._hbm_defaults()[0]
+        return gb * 1e9
+
+    @property
+    def hbm_bandwidth_bytes(self) -> float:
+        """Per-chip HBM bandwidth in bytes/s."""
+        gbs = (
+            self.hbm_gb_per_s
+            if self.hbm_gb_per_s is not None
+            else self._hbm_defaults()[1]
+        )
+        return gbs * 1e9
 
 
 def _parse_topology(s) -> Tuple[int, ...]:
@@ -168,6 +209,10 @@ class ResourceSpec:
             ici_bandwidth_gbps=float(tpu.get("ici_bandwidth_gbps", DEFAULT_ICI_BANDWIDTH_GBPS)),
             dcn_bandwidth_gbps=float(
                 tpu.get("dcn_bandwidth_gbps", d.get("network_bandwidth", DEFAULT_DCN_BANDWIDTH_GBPS))
+            ),
+            hbm_gb=float(tpu["hbm_gb"]) if "hbm_gb" in tpu else None,
+            hbm_gb_per_s=(
+                float(tpu["hbm_gb_per_s"]) if "hbm_gb_per_s" in tpu else None
             ),
         )
         mesh = d.get("mesh")
@@ -306,6 +351,12 @@ class ResourceSpec:
                 **({"topology": "x".join(map(str, self._tpu.topology))} if self._tpu.topology else {}),
                 "ici_bandwidth_gbps": self._tpu.ici_bandwidth_gbps,
                 "dcn_bandwidth_gbps": self._tpu.dcn_bandwidth_gbps,
+                **({"hbm_gb": self._tpu.hbm_gb} if self._tpu.hbm_gb is not None else {}),
+                **(
+                    {"hbm_gb_per_s": self._tpu.hbm_gb_per_s}
+                    if self._tpu.hbm_gb_per_s is not None
+                    else {}
+                ),
             },
             **({"mesh": dict(self._mesh_override)} if self._mesh_override else {}),
         }

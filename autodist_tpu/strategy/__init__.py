@@ -6,6 +6,7 @@ Mirrors the reference strategy package (``/root/reference/autodist/strategy/``)
 from autodist_tpu.strategy.all_reduce_strategy import AllReduce
 from autodist_tpu.strategy.auto_strategy import Auto
 from autodist_tpu.strategy.base import StrategyBuilder, StrategyCompiler
+from autodist_tpu.strategy.cost_model import CostModel, StrategyCost
 from autodist_tpu.strategy.ir import (
     AllReduceSpec,
     AllReduceSynchronizer,
@@ -47,6 +48,8 @@ __all__ = [
     "AllReduce",
     "Auto",
     "BUILTIN_BUILDERS",
+    "CostModel",
+    "StrategyCost",
     "from_name",
     "AllReduceSpec",
     "AllReduceSynchronizer",

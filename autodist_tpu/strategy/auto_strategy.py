@@ -3,17 +3,22 @@
 The reference's headline performance claim is that *the best strategy
 differs per model* (``/root/reference/docs/usage/performance.md:14``) — but
 it ships no selector; users choose by hand (the default is plain
-PSLoadBalancing, ``autodist.py:70``). ``Auto`` encodes the selection the
-reference's own benchmarks imply:
+PSLoadBalancing, ``autodist.py:70``). ``Auto`` closes that loop in two
+stages:
 
-- sparse-update variables present (embedding workloads: lm1b, NCF) →
-  **Parallax** (dense→AllReduce, sparse→load-balanced PS) — the reference's
-  showcase result for these models;
-- dense model whose byte budget is dominated by one variable (VGG-style
-  fat FC layers) → **PartitionedAR** (shard the big tensors, all-reduce
-  the rest);
-- otherwise → **AllReduce**, the right default on ICI-connected TPU chips
-  (PS-style centralized reduction never wins on a torus).
+1. **Structural dispatch**: sparse-update variables present (embedding
+   workloads: lm1b, NCF) → **Parallax** (dense→AllReduce, sparse→
+   load-balanced PS). This mirrors the reference's own dispatch, which is
+   also structural — by gradient *type*, not size
+   (``parallax_strategy.py:52-69``) — and the advantage of the sparse path
+   grows with vocabulary size.
+2. **Analytical cost ranking** (dense candidates): build AllReduce,
+   PartitionedAR, PSLoadBalancing and both PS residency variants, estimate
+   each one's per-step sync + weight-update time and per-chip memory with
+   :class:`~autodist_tpu.strategy.cost_model.CostModel`, and pick the
+   fastest strategy that fits HBM. When nothing fits, the smallest-footprint
+   candidate wins (with a warning) — a model too big to replicate selects a
+   sharded strategy automatically.
 
 The decision is recorded in the emitted strategy's id path like any other
 builder, so workers replay it without re-analysis.
@@ -24,40 +29,73 @@ from autodist_tpu.model_item import ModelItem
 from autodist_tpu.resource_spec import ResourceSpec
 from autodist_tpu.strategy.all_reduce_strategy import AllReduce
 from autodist_tpu.strategy.base import StrategyBuilder
+from autodist_tpu.strategy.cost_model import CostModel
 from autodist_tpu.strategy.ir import Strategy
 from autodist_tpu.strategy.parallax_strategy import Parallax
 from autodist_tpu.strategy.partitioned_all_reduce_strategy import PartitionedAR
+from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
+from autodist_tpu.strategy.ps_strategy import PS
 from autodist_tpu.utils import logging
-
-# A tensor whose all-reduce serialization cost exceeds this fraction of the
-# total gradient bytes is "dominant" — partitioning it overlaps its sync.
-_DOMINANT_FRACTION = 0.5
 
 
 class Auto(StrategyBuilder):
-    """Analyze (model × resources) and delegate to the best fit."""
+    """Analyze (model × resources) and emit the best-fit strategy."""
 
-    def __init__(self, chunk_size: int = 128):
+    def __init__(self, chunk_size: int = 128, cost_model: bool = True):
         self._chunk_size = chunk_size
+        self._use_cost_model = cost_model
 
-    def _select(self, model_item: ModelItem, resource_spec: ResourceSpec) -> StrategyBuilder:
-        """Selection is model-shape driven (sparse presence, byte
-        distribution); the resource spec only matters insofar as a
-        single-chip cluster makes every choice equivalent."""
+    def _dense_candidates(self):
+        return [
+            ("AllReduce", AllReduce(chunk_size=self._chunk_size)),
+            ("PartitionedAR", PartitionedAR(chunk_size=self._chunk_size)),
+            ("PSLoadBalancing", PSLoadBalancing()),
+            ("PS(zero3)", PS(local_proxy_variable=False)),
+            ("PS(zero1)", PS(local_proxy_variable=True)),
+        ]
+
+    def build(self, model_item: ModelItem, resource_spec: ResourceSpec) -> Strategy:
         if model_item.sparse_variables:
-            return Parallax(chunk_size=self._chunk_size)
+            chosen = Parallax(chunk_size=self._chunk_size)
+            strategy = chosen.build(model_item, resource_spec)
+            if self._use_cost_model:
+                cost = CostModel(model_item, resource_spec).strategy_cost(strategy)
+                logging.info("Auto → Parallax (sparse dispatch): %s", cost.describe())
+            else:
+                logging.info("Auto → Parallax (sparse dispatch)")
+            return strategy
+
+        if not self._use_cost_model:
+            return self._heuristic(model_item, resource_spec)
+
+        model = CostModel(model_item, resource_spec)
+        built = [
+            (name, b.build(model_item, resource_spec))
+            for name, b in self._dense_candidates()
+        ]
+        ranked = model.rank(built)
+        for name, cost in ranked:
+            logging.info("Auto candidate %-16s %s", name, cost.describe())
+        best_name, best_cost = ranked[0]
+        if not best_cost.feasible:
+            logging.warning(
+                "Auto: no candidate fits per-chip HBM (%.2f GB usable); "
+                "choosing smallest footprint %s (%.2f GB)",
+                best_cost.hbm_bytes / 1e9, best_name,
+                best_cost.per_chip_bytes / 1e9,
+            )
+        logging.info("Auto strategy selected %s", best_name)
+        return dict(built)[best_name]
+
+    # Pre-cost-model selection, kept for comparison/debugging
+    # (Auto(cost_model=False)).
+    def _heuristic(self, model_item: ModelItem, resource_spec: ResourceSpec) -> Strategy:
         trainable = model_item.trainable_variables
         total = sum(v.byte_size for v in trainable) or 1
         biggest = max((v.byte_size for v in trainable), default=0)
-        if biggest / total >= _DOMINANT_FRACTION and len(trainable) > 1:
-            return PartitionedAR()
-        return AllReduce(chunk_size=self._chunk_size)
-
-    def build(self, model_item: ModelItem, resource_spec: ResourceSpec) -> Strategy:
-        chosen = self._select(model_item, resource_spec)
-        logging.info(
-            "Auto strategy selected %s (%d vars, %d sparse, %.1f MB)",
-            type(chosen).__name__, len(model_item.variables),
-            len(model_item.sparse_variables), model_item.total_bytes / 1e6,
-        )
+        if biggest / total >= 0.5 and len(trainable) > 1:
+            chosen: StrategyBuilder = PartitionedAR(chunk_size=self._chunk_size)
+        else:
+            chosen = AllReduce(chunk_size=self._chunk_size)
+        logging.info("Auto (heuristic) selected %s", type(chosen).__name__)
         return chosen.build(model_item, resource_spec)

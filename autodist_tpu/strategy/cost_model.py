@@ -1,0 +1,316 @@
+"""Analytical cost model for strategy selection (chief-side planning).
+
+The reference ships no selector: its performance page *claims* the best
+strategy differs per model (``/root/reference/docs/usage/performance.md:14``)
+but users pick builders by hand, and the only machine-readable resource hint
+is per-node ``network_bandwidth`` (``resource_spec.py:209-215``). This module
+closes that loop for the TPU build: given a built :class:`Strategy`, a
+:class:`ModelItem` and a :class:`ResourceSpec`, it estimates
+
+- **synchronization time** per step — gradient bytes over ICI / DCN
+  bandwidths, with ring / hierarchical all-reduce cost formulas and
+  PS-destination NIC serialization;
+- **weight-update time** per step — optimizer HBM traffic (params + grads +
+  slots, divided by each variable's residency shard count);
+- **per-chip memory** — params + optimizer slots + a transient gradient
+  buffer, checked against the chip generation's HBM capacity.
+
+Compute (forward/backward) time is deliberately *excluded*: under pure data
+parallelism every candidate strategy runs identical per-chip FLOPs, so it
+cannot change the ranking; for partitioned (tensor-parallel) variables the
+sharded matmul's activation synchronization is charged instead
+(:data:`DEFAULT_ACT_BYTES` per use). All estimates mirror the lowering
+semantics in ``kernel/lowering.py`` (which mesh axis shards a variable, when
+divisibility forces replication, ZeRO-1 vs ZeRO-3 residency for PS vars).
+
+Units are bytes and seconds throughout; bandwidths come from the
+ResourceSpec (Gbps on the wire, GB/s for HBM).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from autodist_tpu.model_item import ModelItem, VarItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.ir import (
+    AllReduceSynchronizer,
+    NodeConfig,
+    PSSynchronizer,
+    Strategy,
+)
+
+# Dispatch latency per collective (seconds). ICI collectives are
+# compiler-scheduled; DCN ones cross host NICs.
+ICI_LATENCY_S = 5e-6
+DCN_LATENCY_S = 100e-6
+
+# Activation bytes synchronized per tensor-parallel (partitioned) variable per
+# step (forward + backward each pay one collective). A planning placeholder —
+# the real figure is batch-dependent and unknown at strategy-build time.
+DEFAULT_ACT_BYTES = 1 << 20
+
+# Fraction of an embedding table's rows a step touches (sparse PS wire bytes).
+DEFAULT_SPARSE_TOUCH = 0.05
+
+# Fraction of HBM usable for state; the rest is reserved for activations,
+# XLA scratch and infeed buffers.
+HBM_USABLE_FRACTION = 0.75
+
+# Wire-size multiplier per gradient compressor (kernel/compressor.py registry).
+# bf16 cast halves fp32 wire bytes; PowerSGD sends rank-k factors.
+COMPRESSOR_WIRE_FACTOR = {
+    "NoneCompressor": 1.0,
+    "HorovodCompressor": 0.5,
+    "HorovodCompressorEF": 0.5,
+    "PowerSGDCompressor": 0.1,
+}
+
+# Optimizer-slot count per parameter byte (optax state residency). Unknown
+# optimizers — including "custom" (a raw optax transform whose state shape we
+# cannot see) — assume the adam-class worst case of 2 so the HBM feasibility
+# check stays conservative.
+OPTIMIZER_SLOT_FACTOR = {
+    "sgd": 0.0,
+    "momentum": 1.0,
+    "adam": 2.0,
+    "adamw": 2.0,
+    "adagrad": 1.0,
+    "rmsprop": 1.0,
+    "lamb": 2.0,
+    "lion": 1.0,
+    "adafactor": 1.0,  # row/col factors are near-free; count conservatively
+}
+
+
+@dataclass
+class StrategyCost:
+    """Estimated per-step cost of one strategy on one cluster."""
+
+    comm_s: float          # gradient/param synchronization (wire) time
+    update_s: float        # optimizer HBM traffic time
+    latency_s: float       # per-collective dispatch latency
+    act_sync_s: float      # tensor-parallel activation synchronization
+    per_chip_bytes: float  # resident state: params + slots + grad buffer
+    hbm_bytes: float       # usable per-chip capacity (already derated)
+    n_collectives: int
+
+    @property
+    def total_s(self) -> float:
+        return self.comm_s + self.update_s + self.latency_s + self.act_sync_s
+
+    @property
+    def feasible(self) -> bool:
+        return self.per_chip_bytes <= self.hbm_bytes
+
+    def describe(self) -> str:
+        return (
+            f"total {self.total_s * 1e3:.3f} ms "
+            f"(comm {self.comm_s * 1e3:.3f}, update {self.update_s * 1e3:.3f}, "
+            f"lat {self.latency_s * 1e3:.3f}, act {self.act_sync_s * 1e3:.3f}) "
+            f"mem {self.per_chip_bytes / 1e9:.2f}/{self.hbm_bytes / 1e9:.2f} GB "
+            f"{'ok' if self.feasible else 'OVER'}"
+        )
+
+
+class CostModel:
+    """Estimate per-step time and memory for candidate strategies.
+
+    Mirrors ``kernel/lowering.py`` residency rules: a partition request
+    shards over the mesh's data axis (Auto's meshes are pure-DP) when the
+    axis divides evenly, PS dense vars get ZeRO-1 (proxy) or ZeRO-3
+    (no-proxy) residency, PS sparse vars are row-sharded.
+    """
+
+    def __init__(
+        self,
+        model_item: ModelItem,
+        resource_spec: ResourceSpec,
+        *,
+        act_bytes: float = DEFAULT_ACT_BYTES,
+        sparse_touch_fraction: float = DEFAULT_SPARSE_TOUCH,
+    ):
+        self.model_item = model_item
+        self.spec = resource_spec
+        self.act_bytes = float(act_bytes)
+        self.sparse_touch = float(sparse_touch_fraction)
+
+        self.n = max(resource_spec.num_chips, 1)
+        self.m = max(resource_spec.num_nodes, 1)
+        self.chips_per_node = max(self.n // self.m, 1)
+        self.bw_ici = resource_spec.ici_bandwidth * 1e9 / 8.0
+        self.bw_dcn = resource_spec.network_bandwidth * 1e9 / 8.0
+        self.hbm_bw = resource_spec.tpu.hbm_bandwidth_bytes
+        self.hbm_cap = resource_spec.tpu.hbm_bytes * HBM_USABLE_FRACTION
+        self.latency = ICI_LATENCY_S if self.m == 1 else DCN_LATENCY_S
+        self.slot_factor = OPTIMIZER_SLOT_FACTOR.get(
+            model_item.optimizer_spec.name, 2.0
+        )
+
+    # ----------------------------------------------------------- primitives
+    def allreduce_s(self, nbytes: float) -> float:
+        """Ring all-reduce of ``nbytes`` over all chips; hierarchical
+        (reduce-scatter on ICI, all-reduce shards on DCN) across hosts."""
+        if self.n <= 1:
+            return 0.0
+        if self.m == 1:
+            return 2.0 * nbytes * (self.n - 1) / self.n / self.bw_ici
+        c = self.chips_per_node
+        intra = 2.0 * nbytes * (c - 1) / c / self.bw_ici if c > 1 else 0.0
+        inter = 2.0 * (nbytes / c) * (self.m - 1) / self.m / self.bw_dcn
+        return intra + inter
+
+    def _oneway_s(self, nbytes: float) -> float:
+        """All-gather / reduce-scatter (half an all-reduce)."""
+        return self.allreduce_s(nbytes) / 2.0
+
+    def _sharded(self, var: VarItem, axis: Optional[int]) -> int:
+        """Residency shard count the lowering would realize: the data-axis
+        size when the requested (or fallback) axis divides evenly, else 1."""
+        if self.n <= 1 or not var.shape:
+            return 1
+        if axis is not None and var.shape[axis] % self.n == 0 and var.shape[axis] >= self.n:
+            return self.n
+        # lowering `_fallback_axis`: largest evenly-divisible axis
+        cands = [d for d in var.shape if d % self.n == 0 and d >= self.n]
+        return self.n if (axis is not None and cands) else 1
+
+    def _update_axis_shards(self, var: VarItem) -> int:
+        """`_weight_update_spec` parity: slot sharding for PS vars."""
+        if self.n <= 1 or not var.shape:
+            return 1
+        cands = [d for d in var.shape if d % self.n == 0 and d >= self.n]
+        return self.n if cands else 1
+
+    # ------------------------------------------------------------ node costs
+    def _node_cost(self, node: NodeConfig, var: VarItem) -> Tuple[
+        float, float, float, float, float, int, Dict[str, float]
+    ]:
+        """(comm_s, update_s, act_s, param_bytes, slot+grad bytes,
+        n_collectives, ps_host_loads) for one variable."""
+        B = float(var.byte_size)
+        sync = node.synchronizer
+        update_traffic_factor = 3.0 + 2.0 * self.slot_factor  # param rw + grad r + slots rw
+        ps_loads: Dict[str, float] = {}
+
+        if isinstance(sync, AllReduceSynchronizer):
+            wire = B * COMPRESSOR_WIRE_FACTOR.get(sync.compressor, 1.0)
+            comm = self.allreduce_s(wire)
+            part_axis = node.active_partition_axis
+            shards = self._sharded(var, part_axis) if part_axis is not None else 1
+            update = update_traffic_factor * B / shards / self.hbm_bw
+            # Tensor-parallel activation sync: forward + backward each pay
+            # one all-gather over the sharded matmul's activations. The shard
+            # axis is the data axis here (Auto meshes are pure-DP), which
+            # spans hosts on multi-node specs — _oneway_s models that
+            # hierarchy (ICI intra-node, DCN across).
+            act = (
+                2.0 * (self.latency + self._oneway_s(self.act_bytes))
+                if shards > 1 else 0.0
+            )
+            params = B / shards
+            extra = self.slot_factor * B / shards + B  # slots + transient grad
+            n_coll = 1
+            return comm, update, act, params, extra, n_coll, ps_loads
+
+        assert isinstance(sync, PSSynchronizer)
+        if var.sparse_update:
+            wire = B * self.sparse_touch
+            # forward row gather + backward scatter-add of touched rows
+            comm = 2.0 * self._oneway_s(wire)
+            # lowering parity: row-sharded only when axis 0 divides evenly,
+            # else the dense weight-update axis decides residency
+            if var.shape and var.shape[0] % self.n == 0 and var.shape[0] >= self.n:
+                shards = self.n
+            else:
+                shards = self._update_axis_shards(var)
+            update = update_traffic_factor * B * self.sparse_touch / shards / self.hbm_bw
+            params = B / shards
+            extra = self.slot_factor * B / shards + wire
+        else:
+            upd_shards = self._update_axis_shards(var)
+            if sync.local_replication:
+                # ZeRO-1: replicated param, sharded update; grads all-reduce
+                # then the owner shard's update is re-broadcast.
+                comm = self.allreduce_s(B) + self._oneway_s(B)
+                params = B
+            else:
+                # ZeRO-3: sharded param; reduce-scatter grads + all-gather
+                # params on use (forward + backward).
+                comm = self._oneway_s(B) + 2.0 * self._oneway_s(B)
+                params = B / upd_shards
+            update = update_traffic_factor * B / upd_shards / self.hbm_bw
+            extra = self.slot_factor * B / upd_shards + B
+        # Multi-node PS: the destination host's NIC serializes this var's
+        # cross-host traffic (reference: all workers push to one PS CPU).
+        if self.m > 1:
+            dest = sync.reduction_destination or "chief"
+            host = dest.split(":", 1)[0]
+            wire_dcn = (B * self.sparse_touch) if var.sparse_update else B
+            ps_loads[host] = 2.0 * (self.m - 1) * wire_dcn / self.bw_dcn
+        act = 0.0
+        n_coll = 2  # push + pull round
+        return comm, update, act, params, extra, n_coll, ps_loads
+
+    # -------------------------------------------------------------- strategy
+    def strategy_cost(self, strategy: Strategy) -> StrategyCost:
+        comm = update = act = params_bytes = extra_bytes = 0.0
+        groups: set = set()
+        n_ps_coll = 0
+        host_loads: Dict[str, float] = {}
+        for node in strategy.node_config:
+            try:
+                var = self.model_item.var(node.var_name)
+            except KeyError:
+                continue
+            c, u, a, p, e, n_coll, loads = self._node_cost(node, var)
+            comm += c
+            update += u
+            act += a
+            params_bytes += p
+            extra_bytes += e
+            for h, load in loads.items():
+                host_loads[h] = host_loads.get(h, 0.0) + load
+            sync = node.synchronizer
+            if isinstance(sync, AllReduceSynchronizer):
+                leaf_groups = (
+                    [p.synchronizer.group for p in node.part_config
+                     if isinstance(p.synchronizer, AllReduceSynchronizer)]
+                    or [sync.group]
+                )
+                groups.update(leaf_groups)
+            else:
+                n_ps_coll += n_coll
+        # PS destination NIC serialization dominates the hierarchical
+        # all-reduce estimate for those vars; charge the slower of the two.
+        if host_loads:
+            comm = max(comm, max(host_loads.values()))
+        n_collectives = len(groups) + n_ps_coll
+        latency = n_collectives * self.latency
+        per_chip = params_bytes + extra_bytes
+        return StrategyCost(
+            comm_s=comm,
+            update_s=update,
+            latency_s=latency,
+            act_sync_s=act,
+            per_chip_bytes=per_chip,
+            hbm_bytes=self.hbm_cap,
+            n_collectives=n_collectives,
+        )
+
+    def rank(
+        self, candidates: Sequence[Tuple[str, Strategy]]
+    ) -> List[Tuple[str, StrategyCost]]:
+        """Cost each candidate; feasible ones first, each tier by time.
+
+        When nothing fits, the least-over-budget candidate ranks first so the
+        caller still gets the best available answer (with a warning upstream).
+        """
+        costed = [(name, self.strategy_cost(s)) for name, s in candidates]
+        return sorted(
+            costed,
+            key=lambda nc: (
+                not nc[1].feasible,
+                nc[1].total_s if nc[1].feasible else nc[1].per_chip_bytes,
+            ),
+        )

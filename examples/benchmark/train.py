@@ -20,9 +20,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 
 import jax
 import numpy as np
+
+# Allow `python examples/benchmark/train.py` straight from a repo checkout
+# (script dir, not the repo root, lands on sys.path in that invocation).
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
 
 import autodist_tpu as ad
 from autodist_tpu.data import DataLoader

@@ -10,6 +10,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import os as _os, sys as _sys
+# Allow `python examples/<name>.py` straight from a repo checkout.
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
+
 import autodist_tpu as ad
 
 TRUE_W, TRUE_B = 3.0, 2.0

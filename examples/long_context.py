@@ -22,6 +22,10 @@ import argparse
 import jax
 import numpy as np
 
+import os as _os, sys as _sys
+# Allow `python examples/<name>.py` straight from a repo checkout.
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
+
 import autodist_tpu as ad
 from autodist_tpu.models import get_model
 
