@@ -128,10 +128,14 @@ class ModelItem:
         variables: Sequence[VarItem],
         optimizer_spec: Optional[OptimizerSpec] = None,
         params_treedef=None,
+        batch_size: Optional[int] = None,
     ):
         self._variables = list(variables)
         self.optimizer_spec = optimizer_spec or OptimizerSpec()
         self._params_treedef = params_treedef
+        # Leading dim of the captured example batch (None when no batch was
+        # traced) — planners use it to size activation estimates.
+        self.batch_size = batch_size
 
     # ----------------------------------------------------------- constructors
     @classmethod
@@ -167,7 +171,26 @@ class ModelItem:
                 VarItem(name=name, shape=shape, dtype=dtype, trainable=trainable,
                         sparse_update=sparse, expert=expert)
             )
-        return cls(variables, optimizer_spec=optimizer_spec, params_treedef=treedef)
+        batch_size = None
+        if example_batch is not None:
+            # The batch dim is the leading dim *shared* by the batch's
+            # arrays; a first-sorted non-batch leaf (an attention mask's
+            # (seq, seq), a (seq,) positions vector) must not win. Majority
+            # vote over leading dims, smallest on ties.
+            from collections import Counter
+
+            dims = Counter(
+                int(getattr(leaf, "shape", ())[0])
+                for leaf in jax.tree_util.tree_leaves(example_batch)
+                if getattr(leaf, "shape", ())
+            )
+            if dims:
+                top = max(dims.values())
+                batch_size = min(d for d, c in dims.items() if c == top)
+        return cls(
+            variables, optimizer_spec=optimizer_spec, params_treedef=treedef,
+            batch_size=batch_size,
+        )
 
     @staticmethod
     def _detect_sparse(loss_fn: Callable, params, example_batch) -> set:
@@ -273,6 +296,7 @@ class ModelItem:
                 for v in self._variables
             ],
             "optimizer": {"name": self.optimizer_spec.name, "kwargs": self.optimizer_spec.kwargs},
+            **({"batch_size": self.batch_size} if self.batch_size is not None else {}),
         }
 
     @classmethod
@@ -290,6 +314,7 @@ class ModelItem:
                 for v in d.get("variables", [])
             ],
             optimizer_spec=OptimizerSpec(**d.get("optimizer", {})),
+            batch_size=d.get("batch_size"),
         )
 
     def serialize(self, path: str) -> str:

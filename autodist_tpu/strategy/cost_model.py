@@ -18,8 +18,10 @@ closes that loop for the TPU build: given a built :class:`Strategy`, a
 Compute (forward/backward) time is deliberately *excluded*: under pure data
 parallelism every candidate strategy runs identical per-chip FLOPs, so it
 cannot change the ranking; for partitioned (tensor-parallel) variables the
-sharded matmul's activation synchronization is charged instead
-(:data:`DEFAULT_ACT_BYTES` per use). All estimates mirror the lowering
+sharded matmul's activation synchronization is charged instead —
+``batch_size × shape[-1] × 2`` bytes when the ModelItem captured a batch,
+an explicit ``act_bytes`` calibration when given, else
+:data:`DEFAULT_ACT_BYTES`. All estimates mirror the lowering
 semantics in ``kernel/lowering.py`` (which mesh axis shards a variable, when
 divisibility forces replication, ZeRO-1 vs ZeRO-3 residency for PS vars).
 
@@ -46,9 +48,11 @@ ICI_LATENCY_S = 5e-6
 DCN_LATENCY_S = 100e-6
 
 # Activation bytes synchronized per tensor-parallel (partitioned) variable per
-# step (forward + backward each pay one collective). A planning placeholder —
-# the real figure is batch-dependent and unknown at strategy-build time.
+# step (forward + backward each pay one collective). Fallback when the
+# ModelItem carries no captured batch size; with one, the estimate becomes
+# batch_size × var.shape[-1] × 2 (bf16 activations).
 DEFAULT_ACT_BYTES = 1 << 20
+ACT_BYTES_PER_ELEMENT = 2  # bf16 activations
 
 # Fraction of an embedding table's rows a step touches (sparse PS wire bytes).
 DEFAULT_SPARSE_TOUCH = 0.05
@@ -127,12 +131,14 @@ class CostModel:
         model_item: ModelItem,
         resource_spec: ResourceSpec,
         *,
-        act_bytes: float = DEFAULT_ACT_BYTES,
+        act_bytes: Optional[float] = None,
         sparse_touch_fraction: float = DEFAULT_SPARSE_TOUCH,
     ):
         self.model_item = model_item
         self.spec = resource_spec
-        self.act_bytes = float(act_bytes)
+        # None = derive from the captured batch (or DEFAULT_ACT_BYTES); an
+        # explicit calibration always wins.
+        self.act_bytes = float(act_bytes) if act_bytes is not None else None
         self.sparse_touch = float(sparse_touch_fraction)
 
         self.n = max(resource_spec.num_chips, 1)
@@ -175,6 +181,18 @@ class CostModel:
         cands = [d for d in var.shape if d % self.n == 0 and d >= self.n]
         return self.n if (axis is not None and cands) else 1
 
+    def _act_bytes_for(self, var: VarItem) -> float:
+        """Activation bytes one TP collective moves for this variable: the
+        sharded matmul's output is ~(batch, var.shape[-1]). An explicit
+        ``act_bytes`` calibration wins; otherwise derive from the captured
+        batch, falling back to the fixed planning default."""
+        if self.act_bytes is not None:
+            return self.act_bytes
+        bs = self.model_item.batch_size
+        if bs and var.shape:
+            return float(bs) * float(var.shape[-1]) * ACT_BYTES_PER_ELEMENT
+        return DEFAULT_ACT_BYTES
+
     def _update_axis_shards(self, var: VarItem) -> int:
         """`_weight_update_spec` parity: slot sharding for PS vars."""
         if self.n <= 1 or not var.shape:
@@ -205,7 +223,7 @@ class CostModel:
             # spans hosts on multi-node specs — _oneway_s models that
             # hierarchy (ICI intra-node, DCN across).
             act = (
-                2.0 * (self.latency + self._oneway_s(self.act_bytes))
+                2.0 * (self.latency + self._oneway_s(self._act_bytes_for(var)))
                 if shards > 1 else 0.0
             )
             params = B / shards

@@ -184,6 +184,66 @@ class TestAutoIntegration:
         assert all(isinstance(n.synchronizer, AllReduceSynchronizer) for n in s.node_config)
 
 
+class TestActCalibration:
+    def test_batch_size_captured_and_roundtripped(self):
+        params = {"w": np.zeros((64, 64), np.float32)}
+        item = ModelItem.from_params(
+            params,
+            loss_fn=lambda p, b: (b["x"] @ p["w"]).mean(),
+            example_batch={"x": np.zeros((32, 64), np.float32)},
+        )
+        assert item.batch_size == 32
+        assert ModelItem.from_json(item.to_json()).batch_size == 32
+        assert ModelItem.from_params(params).batch_size is None
+
+    def test_batch_dim_majority_vote_beats_first_sorted_leaf(self):
+        # {"attention_mask": (512, 512), "input_ids": (8, 512), "labels":
+        # (8,)}: tree_leaves sorts the mask first, but the shared batch dim
+        # is 8 (majority), not the mask's seq dim.
+        params = {"w": np.zeros((512, 64), np.float32)}
+        item = ModelItem.from_params(
+            params,
+            loss_fn=lambda p, b: (b["input_ids"] @ p["w"]).mean(),
+            example_batch={
+                "attention_mask": np.zeros((512, 512), np.float32),
+                "input_ids": np.zeros((8, 512), np.float32),
+                "labels": np.zeros((8,), np.float32),
+            },
+        )
+        assert item.batch_size == 8
+
+    def test_explicit_act_bytes_overrides_batch_estimate(self):
+        params = {"big": np.zeros((25088, 4096), np.float32)}
+        item = ModelItem.from_params(
+            params,
+            loss_fn=lambda p, b: (b["x"] @ p["big"]).mean(),
+            example_batch={"x": np.zeros((128, 25088), np.float32)},
+        )
+        spec = _single()
+        s = PartitionedAR().build(item, spec)
+        calibrated = CostModel(item, spec, act_bytes=64.0).strategy_cost(s)
+        derived = CostModel(item, spec).strategy_cost(s)
+        assert calibrated.act_sync_s < derived.act_sync_s
+
+    def test_act_term_scales_with_captured_batch(self):
+        # Same model, 8x the batch → 8x the TP activation bytes → a larger
+        # act_sync_s on the partitioned candidate.
+        def make(bs):
+            params = {"big": np.zeros((25088, 4096), np.float32)}
+            return ModelItem.from_params(
+                params,
+                loss_fn=lambda p, b: (b["x"] @ p["big"]).mean(),
+                example_batch={"x": np.zeros((bs, 25088), np.float32)},
+            )
+
+        spec = _single()
+        small = CostModel(make(16), spec).strategy_cost(
+            PartitionedAR().build(make(16), spec))
+        large = CostModel(make(128), spec).strategy_cost(
+            PartitionedAR().build(make(128), spec))
+        assert large.act_sync_s > small.act_sync_s
+
+
 class TestSlotFactor:
     def test_raw_optax_optimizer_assumes_worst_case_slots(self):
         # AutoDist.build with a raw optax transform records name "custom";

@@ -172,6 +172,20 @@ class TestCoordinator:
         assert "chief survived" not in res.stdout
 
 
+def _free_port() -> int:
+    """An OS-assigned free TCP port for a test fleet's coordinator.
+
+    Fixed ports collide when two checkouts run this suite concurrently on
+    one machine (observed: Gloo rendezvous timing out against the *other*
+    run's coordinator); bind-and-release keeps each fleet isolated.
+    """
+    import socket
+
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def _scrubbed_cpu_env():
     """Fleet env without the host's accelerator plugin (sitecustomize on
     PYTHONPATH, JAX_/XLA_/TPU_ vars): the 2-process tests must really run
@@ -228,7 +242,7 @@ def test_two_process_cpu_cluster(tmp_path):
     env = _scrubbed_cpu_env()
     env["AUTODIST_TEST_CKPT_DIR"] = str(tmp_path / "ckpt")
     code = _launch_local_fleet(
-        [sys.executable, str(script)], 2, coordinator_port=15999, base_env=env
+        [sys.executable, str(script)], 2, coordinator_port=_free_port(), base_env=env
     )
     assert code == 0
 
@@ -280,7 +294,7 @@ def test_two_process_autodist_training(tmp_path):
 
     env = _scrubbed_cpu_env()
     code = _launch_local_fleet(
-        [sys.executable, str(script)], 2, coordinator_port=15997, base_env=env
+        [sys.executable, str(script)], 2, coordinator_port=_free_port(), base_env=env
     )
     assert code == 0
 
@@ -341,6 +355,6 @@ def test_two_process_dataloader_feed(tmp_path):
 
     env = _scrubbed_cpu_env()
     code = _launch_local_fleet(
-        [sys.executable, str(script)], 2, coordinator_port=15995, base_env=env
+        [sys.executable, str(script)], 2, coordinator_port=_free_port(), base_env=env
     )
     assert code == 0
