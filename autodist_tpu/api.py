@@ -33,6 +33,7 @@ from contextlib import contextmanager
 from typing import Any, Callable, Optional, Sequence, Union
 
 import jax
+import jax.numpy as jnp
 import optax
 
 from autodist_tpu import const
@@ -296,6 +297,125 @@ class AutoDist:
         )
         self._built, self._strategy, self._model_item = step, compiled, model_item
         return step
+
+    # ----------------------------------------------------------------- tune
+    def tune(
+        self,
+        loss_fn: Callable,
+        params: Any,
+        example_batch: Any,
+        candidates: Optional[Sequence] = None,
+        window: int = 8,
+        **build_kwargs,
+    ) -> DistributedTrainStep:
+        """Measured strategy selection: build each candidate strategy, time a
+        short device-side window of real training steps, keep the fastest.
+
+        The analytical :class:`~autodist_tpu.strategy.cost_model.CostModel`
+        behind :class:`~autodist_tpu.strategy.Auto` *predicts*; ``tune``
+        *measures* — the empirical complement the reference project pointed
+        at (its performance page shows the best strategy differs per model,
+        ``docs/usage/performance.md:14``, but ships no way to find it).
+        Compiles every candidate, so expect ~N× the normal build latency;
+        infeasible or non-compiling candidates are skipped with a warning.
+
+        ``candidates``: ``[(name, StrategyBuilder), ...]``; defaults to the
+        Auto dense slate (+ Parallax, which degenerates to AllReduce on
+        dense-only models). Multi-process fleets select by cost model over
+        the same candidates instead of timing — the ranking is deterministic
+        from (model × spec), so every process elects the same winner, which
+        per-host timings could not guarantee.
+        """
+        import time
+
+        from autodist_tpu.strategy import (
+            AllReduce,
+            CostModel,
+            PS,
+            PSLoadBalancing,
+            Parallax,
+            PartitionedAR,
+        )
+
+        if candidates is None:
+            candidates = [
+                ("AllReduce", AllReduce()),
+                ("PartitionedAR", PartitionedAR()),
+                ("PSLoadBalancing", PSLoadBalancing()),
+                ("PS(zero3)", PS(local_proxy_variable=False)),
+                ("PS(zero1)", PS(local_proxy_variable=True)),
+                ("Parallax", Parallax()),
+            ]
+
+        if jax.process_count() > 1:
+            logging.warning(
+                "tune() on a multi-process fleet: ranking the candidates by "
+                "cost model instead of timing (per-host timings cannot elect "
+                "a winner safely)"
+            )
+            opt = build_kwargs.get("optimizer")
+            opt_spec = (
+                opt if isinstance(opt, OptimizerSpec)
+                else OptimizerSpec("custom") if opt is not None
+                else None
+            )
+            item = ModelItem.from_params(
+                params, optimizer_spec=opt_spec, loss_fn=loss_fn,
+                example_batch=example_batch,
+                sparse_names=build_kwargs.get("sparse_names", ()),
+            )
+            cm = CostModel(item, self.resource_spec)
+            ranked = cm.rank(
+                [(n, b.build(item, self.resource_spec)) for n, b in candidates]
+            )
+            best_name = ranked[0][0]
+            logging.info("tune (cost model) selected %s", best_name)
+            self.strategy_builder = dict(candidates)[best_name]
+            return self.build(loss_fn, params, example_batch, **build_kwargs)
+
+        def _sync(tree) -> None:
+            # Scalar fetch, not block_until_ready: reliable on every
+            # platform including tunneled devices (docs/performance.md).
+            leaf = jax.tree_util.tree_leaves(tree)[0]
+            float(jnp.asarray(leaf).ravel()[0])
+
+        results = []
+        for name, builder in candidates:
+            self.strategy_builder = builder
+            try:
+                step = self.build(loss_fn, params, example_batch, **build_kwargs)
+                state = step.init(params)
+                state, _ = step.run(state, example_batch, window)  # compile+warm
+                _sync(state.params)
+                t0 = time.perf_counter()
+                state, _ = step.run(state, example_batch, window)
+                _sync(state.params)
+                dt = (time.perf_counter() - t0) / window
+            except Exception as e:  # noqa: BLE001 - candidate-level isolation
+                logging.warning("tune: candidate %s failed (%s); skipped", name, e)
+                continue
+            finally:
+                # Free this candidate's device train state before the next
+                # one's init(): holding both transiently doubles HBM and
+                # would make near-capacity models fail every candidate after
+                # the first (electing the first, not the fastest).
+                state = None  # noqa: F841
+            results.append((name, dt, builder, step, self._strategy, self._model_item))
+            logging.info("tune: %-16s %.3f ms/step", name, dt * 1e3)
+        if not results:
+            raise RuntimeError("tune(): every candidate strategy failed to build/run")
+        results.sort(key=lambda r: r[1])
+        best_name, best_dt, best_builder, best_step, best_strategy, best_item = results[0]
+        logging.info("tune selected %s (%.3f ms/step)", best_name, best_dt * 1e3)
+        # Leave every selection-visible surface pointing at the WINNER, not
+        # the last candidate tried: the builder (future build() calls) and
+        # the strategy id env (coordinator-relaunched workers load by it).
+        self.strategy_builder = best_builder
+        os.environ[ENV.AUTODIST_STRATEGY_ID.name] = best_strategy.id
+        self._built, self._strategy, self._model_item = (
+            best_step, best_strategy, best_item,
+        )
+        return best_step
 
     # ------------------------------------------------------------- accessors
     @property

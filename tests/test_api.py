@@ -170,3 +170,88 @@ def test_remat_bad_policy_rejected():
             ad.build(spec.loss_fn, params, batch, remat="dots_savable")
     finally:
         AutoDist.reset_default()
+
+
+class TestTune:
+    """Measured strategy selection (the empirical half of Auto's cost model)."""
+
+    def test_tune_picks_a_candidate_and_trains_correctly(self):
+        a = ad.AutoDist()
+        params, batch = make_model()
+        step = a.tune(loss_fn, params, batch, window=2)
+        assert a.strategy is not None
+        # The winner must still train with exact single-device semantics.
+        state = step.init(params)
+        state, metrics = step(state, batch)
+        g = jax.grad(loss_fn)(params, batch)
+        expect = jax.tree.map(lambda p, gg: p - 0.01 * gg, params, g)
+        got = jax.device_get(state.params)
+        np.testing.assert_allclose(got["w"], expect["w"], rtol=1e-5)
+        np.testing.assert_allclose(got["b"], expect["b"], rtol=1e-5)
+
+    def test_tune_leaves_winner_on_every_surface(self):
+        # The builder (future build() calls) and the strategy-id env
+        # (coordinator-relaunched workers) must reflect the WINNER, not the
+        # last candidate tried.
+        a = ad.AutoDist()
+        params, batch = make_model()
+        a.tune(loss_fn, params, batch, window=2)
+        assert os.environ[ENV.AUTODIST_STRATEGY_ID.name] == a.strategy.id
+        rebuilt = a.strategy_builder.build(a.model_item, a.resource_spec)
+        assert [type(n.synchronizer) for n in rebuilt.node_config] == [
+            type(n.synchronizer) for n in a.strategy.node_config
+        ]
+
+    def test_tune_custom_candidates_and_failure_isolation(self):
+        from autodist_tpu.strategy import AllReduce, StrategyBuilder
+
+        class Exploding(StrategyBuilder):
+            def build(self, model_item, resource_spec):
+                raise ValueError("boom")
+
+        a = ad.AutoDist()
+        params, batch = make_model()
+        step = a.tune(
+            loss_fn, params, batch, window=2,
+            candidates=[("boom", Exploding()), ("AR", AllReduce())],
+        )
+        assert step is not None  # exploding candidate skipped, AR measured
+
+    def test_tune_multiprocess_ranks_by_cost_model_over_given_candidates(self, monkeypatch):
+        # On a fleet the winner must come from the *passed* slate via the
+        # deterministic cost model, never from timings or a different slate.
+        from autodist_tpu.strategy import PS, PSLoadBalancing
+        import autodist_tpu.api as api_mod
+
+        a = ad.AutoDist()  # spec snapshots the real 8-device runtime first
+        monkeypatch.setattr(api_mod.jax, "process_count", lambda: 2)
+        # Only the selection logic is under test — stand in for the
+        # runtime broadcast (needs a real 2-process fleet, covered by the
+        # integration tests) with a chief-side build.
+        monkeypatch.setattr(
+            a, "_sync_strategy_multihost",
+            lambda item: a.strategy_builder.build(item, a.resource_spec),
+        )
+        params, batch = make_model()
+        step = a.tune(
+            loss_fn, params, batch,
+            candidates=[("PSLB", PSLoadBalancing()),
+                        ("PS1", PS(local_proxy_variable=True))],
+        )
+        assert step is not None
+        from autodist_tpu.strategy.ir import PSSynchronizer
+        assert all(isinstance(n.synchronizer, PSSynchronizer)
+                   for n in a.strategy.node_config)
+
+    def test_tune_all_candidates_fail_raises(self):
+        from autodist_tpu.strategy import StrategyBuilder
+
+        class Exploding(StrategyBuilder):
+            def build(self, model_item, resource_spec):
+                raise ValueError("boom")
+
+        a = ad.AutoDist()
+        params, batch = make_model()
+        with pytest.raises(RuntimeError, match="every candidate"):
+            a.tune(loss_fn, params, batch, window=2,
+                   candidates=[("boom", Exploding())])
