@@ -365,9 +365,15 @@ class AutoDist:
                 sparse_names=build_kwargs.get("sparse_names", ()),
             )
             cm = CostModel(item, self.resource_spec)
-            ranked = cm.rank(
-                [(n, b.build(item, self.resource_spec)) for n, b in candidates]
-            )
+            built = []
+            for n, b in candidates:
+                try:
+                    built.append((n, b.build(item, self.resource_spec)))
+                except Exception as e:  # noqa: BLE001 - candidate isolation
+                    logging.warning("tune: candidate %s failed (%s); skipped", n, e)
+            if not built:
+                raise RuntimeError("tune(): every candidate strategy failed to build")
+            ranked = cm.rank(built)
             best_name = ranked[0][0]
             logging.info("tune (cost model) selected %s", best_name)
             self.strategy_builder = dict(candidates)[best_name]
@@ -379,7 +385,7 @@ class AutoDist:
             leaf = jax.tree_util.tree_leaves(tree)[0]
             float(jnp.asarray(leaf).ravel()[0])
 
-        results = []
+        best = None  # (name, dt, builder, step, strategy, model_item)
         for name, builder in candidates:
             self.strategy_builder = builder
             try:
@@ -400,12 +406,14 @@ class AutoDist:
                 # would make near-capacity models fail every candidate after
                 # the first (electing the first, not the fastest).
                 state = None  # noqa: F841
-            results.append((name, dt, builder, step, self._strategy, self._model_item))
             logging.info("tune: %-16s %.3f ms/step", name, dt * 1e3)
-        if not results:
+            # Keep only the running best — a losing step's compiled device
+            # programs are dead weight for the rest of the sweep.
+            if best is None or dt < best[1]:
+                best = (name, dt, builder, step, self._strategy, self._model_item)
+        if best is None:
             raise RuntimeError("tune(): every candidate strategy failed to build/run")
-        results.sort(key=lambda r: r[1])
-        best_name, best_dt, best_builder, best_step, best_strategy, best_item = results[0]
+        best_name, best_dt, best_builder, best_step, best_strategy, best_item = best
         logging.info("tune selected %s (%.3f ms/step)", best_name, best_dt * 1e3)
         # Leave every selection-visible surface pointing at the WINNER, not
         # the last candidate tried: the builder (future build() calls) and

@@ -18,6 +18,11 @@ name surgery + ``SaveSliceInfo`` shard merging. Here:
   code path as same-sharding restore.
 
 Layout: ``<dir>/metadata.json`` + one ``.npy`` per leaf in nested dirs.
+
+Pad-and-mask plans (non-divisible shard axes) store parameters padded; save
+``step.logical_state(state)`` — identity for unpadded plans — so the
+checkpoint always holds logical shapes, and ``step.init_or_restore``
+re-pads on load.
 """
 from __future__ import annotations
 

@@ -77,6 +77,13 @@ class VarPlan:
     # streaming through HBM inside the step — the TPU rendering of the
     # reference parking PS variables on host CPUs (ps_strategy.py:38-55).
     offload: bool = False
+    # Pad-and-mask sharding (SURVEY §7.4 item 5): when a requested shard
+    # axis divides no axis evenly (e.g. GPT-2's prime vocab 50257), the
+    # parameter is STORED zero-padded to this shape so XLA's equal-shard
+    # requirement holds; the loss sees the sliced logical view, so padded
+    # entries get zero gradients and elementwise optimizers keep them at
+    # zero. None = storage is the logical shape.
+    storage_shape: Optional[Tuple[int, ...]] = None
 
 
 @struct.dataclass
@@ -202,6 +209,12 @@ class GraphTransformer:
                 )
             return ok
 
+        def padded_storage(axis: int) -> Tuple[int, ...]:
+            shape = list(var.shape)
+            shape[axis] = -(-shape[axis] // n_shard) * n_shard  # ceil multiple
+            return tuple(shape)
+
+        storage_shape: Optional[Tuple[int, ...]] = None
         expert_ax = const.MESH_AXIS_EXPERT
         n_expert = mesh_shape.get(expert_ax, 1)
         part_axis = node.active_partition_axis
@@ -232,8 +245,31 @@ class GraphTransformer:
             )
             pspec = _spec_with_axis(rank, fb, shard_ax)
             update_pspec = pspec
+        elif part_axis is not None and rank > 0 and var.shape[part_axis] > n_shard:
+            # No axis divides at all (e.g. a prime-sized dim): pad-and-mask
+            # on the requested axis — store the parameter zero-padded to the
+            # next multiple of the mesh axis, shard that, slice the logical
+            # view for compute (SURVEY §7.4 item 5). Axes smaller than the
+            # mesh degree keep replicating: padding them yields degenerate
+            # sub-element shards for pure overhead.
+            storage_shape = padded_storage(part_axis)
+            logging.debug(
+                "var %s: no divisible axis for %d shards; padding axis %d "
+                "%d→%d and sharding it",
+                var.name, n_shard, part_axis, var.shape[part_axis],
+                storage_shape[part_axis],
+            )
+            pspec = _spec_with_axis(rank, part_axis, shard_ax)
+            update_pspec = pspec
         elif kind is SyncKind.PS and var.sparse_update and rank > 0 and divisible(0):
             # PS sparse path: row-sharded embedding (axis 0).
+            pspec = _spec_with_axis(rank, 0, shard_ax)
+            update_pspec = pspec
+        elif kind is SyncKind.PS and var.sparse_update and rank > 0 and var.shape[0] > n_shard:
+            # Sparse tables need axis-0 (row) sharding for the gather/scatter
+            # path regardless of divisibility — pad the rows (the GPT-2
+            # prime-vocab case: 50257 rows divide nothing).
+            storage_shape = padded_storage(0)
             pspec = _spec_with_axis(rank, 0, shard_ax)
             update_pspec = pspec
         elif kind is SyncKind.PS and rank > 0:
@@ -266,6 +302,7 @@ class GraphTransformer:
             # Reference parity: PS destinations are host CPUs; offload is
             # opt-in because HBM residency is usually faster on TPU.
             offload=self.host_offload and kind is SyncKind.PS,
+            storage_shape=storage_shape,
         )
 
     @staticmethod
@@ -315,6 +352,75 @@ class ShardingPlan:
     def has_offload(self) -> bool:
         return any(p.offload for p in self.var_plans.values())
 
+    @property
+    def has_padding(self) -> bool:
+        return any(p.storage_shape is not None for p in self.var_plans.values())
+
+    def _resize_state_tree(self, tree, to_storage: bool) -> Any:
+        """Map padded↔logical shapes across any state-like pytree.
+
+        Leaves are matched by var-name path suffix (the same rule
+        ``opt_shardings`` uses, so params, optax slots and staleness buffers
+        all match); a matched leaf whose *trailing* dims equal the source
+        shape is padded/sliced on those dims, leading (buffer) dims pass
+        through. Trace-safe (jnp.pad / lax.slice), so the storage→logical
+        direction runs inside the jitted step. Identity without padding.
+        """
+        if not self.has_padding:
+            return tree
+        names = sorted(self.var_plans, key=len, reverse=True)
+
+        def leaf_fn(path, leaf):
+            leaf_name = _path_name(path)
+            for n in names:
+                if leaf_name != n and not leaf_name.endswith("/" + n):
+                    continue
+                plan = self.var_plans[n]
+                if plan.storage_shape is None:
+                    return leaf
+                logical, storage = tuple(plan.var.shape), tuple(plan.storage_shape)
+                src = logical if to_storage else storage
+                dst = storage if to_storage else logical
+                shape = tuple(getattr(leaf, "shape", ()))
+                r = len(src)
+                if len(shape) < r or shape[-r:] != src:
+                    return leaf
+                lead = shape[:-r]
+                if to_storage:
+                    pads = [(0, 0)] * len(lead) + [
+                        (0, d - s) for d, s in zip(dst, src)
+                    ]
+                    return jnp.pad(jnp.asarray(leaf), pads)
+                return lax.slice(
+                    jnp.asarray(leaf),
+                    [0] * len(shape),
+                    list(lead) + list(dst),
+                )
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(leaf_fn, tree)
+
+    def pad_params(self, params) -> Any:
+        """Logical → storage view: zero-pad every leaf whose plan shards a
+        non-divisible axis. No-op (identity tree) without padding."""
+        return self._resize_state_tree(params, to_storage=True)
+
+    def unpad_params(self, params) -> Any:
+        """Storage → logical view: slice padded leaves back to the shapes the
+        user's model defines."""
+        return self._resize_state_tree(params, to_storage=False)
+
+    def pad_state(self, state) -> Any:
+        """Logical → storage view across a full state tree (params, optimizer
+        slots, staleness buffers)."""
+        return self._resize_state_tree(state, to_storage=True)
+
+    def unpad_state(self, state) -> Any:
+        """Storage → logical view across a full state tree — what checkpoints
+        should contain so they restore into any sharding (the reference's
+        original-name/shape contract, checkpoint/saver.py:50-57)."""
+        return self._resize_state_tree(state, to_storage=False)
+
     # ------------------------------------------------------------- shardings
     def params_shardings(self, params, device_view: bool = False) -> Any:
         """Pytree of NamedShardings matching ``params`` (matched by path).
@@ -352,7 +458,10 @@ class ShardingPlan:
             for n in names:
                 if leaf_name == n or leaf_name.endswith("/" + n):
                     plan = self.var_plans[n]
-                    if tuple(getattr(leaf, "shape", ())) == tuple(plan.var.shape):
+                    # Slots mirror the *storage* shape when the param is
+                    # padded (optax init runs on the padded tree).
+                    expect = plan.storage_shape or tuple(plan.var.shape)
+                    if tuple(getattr(leaf, "shape", ())) == tuple(expect):
                         spec = plan.update_pspec
                         offload = plan.offload and not device_view
                     break
@@ -482,7 +591,16 @@ class DistributedTrainStep:
         grad_accum_steps: int = 1,
     ):
         self.plan = plan
-        self.loss_fn = loss_fn
+        # Under pad-and-mask sharding the step's param tree is the padded
+        # STORAGE view; the user's loss always sees the sliced logical view.
+        # Slicing's autodiff transpose zero-pads the gradients, so padded
+        # entries never move (elementwise optimizers; factored ones like
+        # adafactor mix padding zeros into their row/col statistics — a
+        # small, documented perturbation).
+        if plan.has_padding:
+            self.loss_fn = lambda p, b: loss_fn(plan.unpad_params(p), b)
+        else:
+            self.loss_fn = loss_fn
         self.tx = optimizer
         self.has_aux = has_aux
         self._donate = donate_state
@@ -560,6 +678,9 @@ class DistributedTrainStep:
             lambda x: jnp.array(x, copy=True) if isinstance(x, jax.Array) else jnp.asarray(x),
             params,
         )
+        # Pad-and-mask storage view (no-op without padded plans). jnp.pad
+        # also makes a copy, satisfying the donation-safety contract above.
+        params = self.plan.pad_params(params)
         state = TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -570,6 +691,22 @@ class DistributedTrainStep:
         shardings = self.plan.state_shardings(jax.eval_shape(lambda: state))
         self._state_shardings = shardings
         return jax.device_put(state, shardings)
+
+    def logical_params(self, state: TrainState):
+        """The user-shaped parameter view of a train state — identical to
+        ``state.params`` except under pad-and-mask sharding, where the padded
+        storage is sliced back to the model's shapes."""
+        return self.plan.unpad_params(state.params)
+
+    def logical_state(self, state: TrainState) -> TrainState:
+        """Checkpoint view of a train state: every leaf (params, optimizer
+        slots, staleness buffers) in its logical shape. Identity when the
+        plan has no padding, so ``saver.save(step.logical_state(state))`` is
+        always the right call — the written checkpoint restores into any
+        sharding, padded or not (the reference's original-name/shape
+        contract, checkpoint/saver.py:50-57). ``init_or_restore`` re-pads on
+        the way back in."""
+        return self.plan.unpad_state(state)
 
     def _init_comp_state(self):
         """Compressor persistence: {"<var>": {"local": ..., "shared": ...}}.
@@ -1008,13 +1145,22 @@ class DistributedTrainStep:
         crash-resume entry point (the reference's closest fault-tolerance
         mechanism was checkpoint/resume, SURVEY §5). The restored state is
         re-sharded onto this run's plan, so resuming onto a different mesh
-        or strategy works like any cross-sharding restore.
+        or strategy works like any cross-sharding restore. Checkpoints hold
+        *logical* shapes (write them with
+        ``saver.save(step.logical_state(state))``); a padded plan re-pads
+        the loaded leaves into its storage view here.
         """
         state = self.init(params)
-        restored = saver.restore_latest(
-            target=jax.eval_shape(lambda: state), shardings=self._state_shardings
-        )
-        return restored if restored is not None else state
+        if not self.plan.has_padding:
+            restored = saver.restore_latest(
+                target=jax.eval_shape(lambda: state), shardings=self._state_shardings
+            )
+            return restored if restored is not None else state
+        logical_shapes = jax.eval_shape(self.plan.unpad_state, state)
+        restored = saver.restore_latest(target=logical_shapes)
+        if restored is None:
+            return state
+        return jax.device_put(self.plan.pad_state(restored), self._state_shardings)
 
     def trace_step(self, state: TrainState, batch, name: str = "train_step"):
         """One profiled step -> TensorBoard trace dir (runner.py:64-75 analog).

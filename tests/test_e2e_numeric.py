@@ -118,7 +118,7 @@ def run_distributed(builder, loss_fn, params, batch, opt_spec, sparse=False):
     step = DistributedTrainStep(plan, loss_fn, opt_spec.make())
     state = step.init(params)
     new_state, metrics = step(state, batch)
-    return new_state, metrics
+    return step, new_state, metrics
 
 
 @pytest.mark.parametrize("builder", ALL_BUILDERS, ids=IDS)
@@ -126,10 +126,10 @@ def test_dense_sgd_step_matches_single_device(builder):
     params, batch = dense_params(), dense_batch()
     opt = OptimizerSpec("sgd", {"learning_rate": 0.05})
     expected = reference_step(dense_loss, params, batch, opt.make())
-    new_state, metrics = run_distributed(builder, dense_loss, params, batch, opt)
+    step, new_state, metrics = run_distributed(builder, dense_loss, params, batch, opt)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6),
-        jax.device_get(new_state.params),
+        jax.device_get(step.logical_params(new_state)),
         jax.device_get(expected),
     )
     # Loss metric equals the full-batch loss at the *old* params.
@@ -144,10 +144,10 @@ def test_embedding_sparse_step_matches_single_device(builder):
     params, batch = embed_params(), embed_batch()
     opt = OptimizerSpec("sgd", {"learning_rate": 0.1})
     expected = reference_step(embed_loss, params, batch, opt.make())
-    new_state, _ = run_distributed(builder, embed_loss, params, batch, opt, sparse=True)
+    step, new_state, _ = run_distributed(builder, embed_loss, params, batch, opt, sparse=True)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6),
-        jax.device_get(new_state.params),
+        jax.device_get(step.logical_params(new_state)),
         jax.device_get(expected),
     )
 

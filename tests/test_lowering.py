@@ -144,8 +144,9 @@ def test_opt_shardings_match_slots(model, rs):
 
 
 class TestUnevenPartitionFallback:
-    """Non-divisible partition axes shard a divisible axis instead of
-    replicating (the XLA-legal rendering of UnevenPartitionedPS's intent)."""
+    """Non-divisible partition axes shard a divisible axis when one exists,
+    and pad-and-mask the requested axis when none does (the XLA-legal
+    renderings of UnevenPartitionedPS's intent, SURVEY §7.4 item 5)."""
 
     def _plan_for(self, shape, mesh_shape, builder=None):
         import numpy as np
@@ -172,11 +173,174 @@ class TestUnevenPartitionFallback:
         plan = self._plan_for((10, 256), {"data": 1, "model": 8})
         assert plan.var_plans["w"].pspec == P(None, "model")
 
-    def test_no_divisible_axis_replicates(self):
+    def test_no_divisible_axis_pads_requested_axis(self):
         from jax.sharding import PartitionSpec as P
 
+        # Neither 10 nor 6 divides by 8: store (16, 6), shard the requested
+        # axis 0, slice the logical (10, 6) view for compute.
         plan = self._plan_for((10, 6), {"data": 1, "model": 8})
-        assert plan.var_plans["w"].pspec == P()
+        vp = plan.var_plans["w"]
+        assert vp.pspec == P("model", None)
+        assert vp.storage_shape == (16, 6)
+        assert plan.has_padding
+
+    def test_axis_smaller_than_mesh_degree_still_replicates(self):
+        from jax.sharding import PartitionSpec as P
+
+        # Every axis < 8: padding would give degenerate sub-element shards,
+        # so the var replicates (storage is the logical shape).
+        plan = self._plan_for((6, 4), {"data": 1, "model": 8})
+        vp = plan.var_plans["w"]
+        assert vp.pspec == P()
+        assert vp.storage_shape is None
+        assert not plan.has_padding
+
+    def test_padded_checkpoint_roundtrips_across_shardings(self, tmp_path):
+        """logical_state → save → restore into (a) the padded run via
+        init_or_restore, (b) an unpartitioned target — the reference's
+        checkpoint interchange contract under pad-and-mask."""
+        import jax
+        import numpy as np
+        import optax
+        from autodist_tpu.checkpoint import Saver
+        from autodist_tpu.kernel import DistributedTrainStep
+
+        plan = self._plan_for((10, 6), {"data": 1, "model": 8})
+
+        def loss_fn(params, batch):
+            return ((batch["x"] @ params["w"]) ** 2).mean()
+
+        rng = np.random.RandomState(3)
+        params = {"w": rng.randn(10, 6).astype(np.float32)}
+        batch = {"x": rng.randn(4, 10).astype(np.float32)}
+        step = DistributedTrainStep(plan, loss_fn, optax.adam(1e-2))
+        state = step.init(params)
+        for _ in range(2):
+            state, _ = step(state, batch)
+
+        saver = Saver(directory=str(tmp_path / "ck"))
+        logical = step.logical_state(state)
+        logical_w = np.asarray(jax.device_get(logical.params["w"]))
+        # Every logical leaf carries user shapes (incl. adam slots).
+        for path, leaf in jax.tree_util.tree_flatten_with_path(logical)[0]:
+            assert 16 not in getattr(leaf, "shape", ()), path
+        saver.save(logical, step=2)
+        saver.wait()
+
+        # (a) resume into the padded run: trains on identically.
+        resumed = step.init_or_restore(params, saver)
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(resumed.params["w"])),
+            np.asarray(jax.device_get(state.params["w"])), rtol=1e-6)
+        s1, m1 = step(resumed, batch)   # donates resumed
+        s2, m2 = step(state, batch)     # donates state
+        np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
+
+        # (b) restore into an unpartitioned single-device target.
+        target = jax.eval_shape(lambda: logical)
+        loaded = saver.restore_latest(target=target)
+        np.testing.assert_allclose(np.asarray(loaded.params["w"]), logical_w, rtol=1e-6)
+
+    def test_padded_step_matches_single_device_oracle(self):
+        import jax
+        import numpy as np
+        from autodist_tpu.kernel import DistributedTrainStep
+        import optax
+
+        plan = self._plan_for((10, 6), {"data": 1, "model": 8})
+
+        def loss_fn(params, batch):
+            return ((batch["x"] @ params["w"]) ** 2).mean()
+
+        rng = np.random.RandomState(0)
+        params = {"w": rng.randn(10, 6).astype(np.float32)}
+        batch = {"x": rng.randn(4, 10).astype(np.float32)}
+        step = DistributedTrainStep(plan, loss_fn, optax.sgd(0.05))
+        state = step.init(params)
+        assert state.params["w"].shape == (16, 6)  # storage view
+        state, m = step(state, batch)
+
+        g = jax.grad(loss_fn)(params, batch)
+        expect = params["w"] - 0.05 * np.asarray(g["w"])
+        logical = step.logical_params(state)
+        np.testing.assert_allclose(np.asarray(logical["w"]), expect, rtol=1e-5)
+        # Padded rows never move off zero.
+        storage = np.asarray(jax.device_get(state.params["w"]))
+        np.testing.assert_array_equal(storage[10:], np.zeros((6, 6), np.float32))
+
+    def test_padded_adam_multi_step_keeps_padding_at_zero(self):
+        # Adam's update is 0/(sqrt(0)+eps)=0 for always-zero grads, so the
+        # mask needs no explicit re-zeroing across steps.
+        import jax
+        import numpy as np
+        import optax
+        from autodist_tpu.kernel import DistributedTrainStep
+
+        plan = self._plan_for((10, 6), {"data": 1, "model": 8})
+
+        def loss_fn(params, batch):
+            return ((batch["x"] @ params["w"]) ** 2).mean()
+
+        rng = np.random.RandomState(2)
+        params = {"w": rng.randn(10, 6).astype(np.float32)}
+        batch = {"x": rng.randn(4, 10).astype(np.float32)}
+        step = DistributedTrainStep(plan, loss_fn, optax.adam(1e-2))
+        state = step.init(params)
+        for _ in range(3):
+            state, _ = step(state, batch)
+        storage = np.asarray(jax.device_get(state.params["w"]))
+        np.testing.assert_array_equal(storage[10:], 0.0)
+
+        # Oracle: plain optax on the unpadded params.
+        tx = optax.adam(1e-2)
+        p, o = params, tx.init(params)
+        for _ in range(3):
+            g = jax.grad(loss_fn)(p, batch)
+            u, o = tx.update(g, o, p)
+            p = optax.apply_updates(p, u)
+        np.testing.assert_allclose(
+            np.asarray(step.logical_params(state)["w"]),
+            np.asarray(p["w"]), rtol=2e-5, atol=1e-6)
+
+    def test_prime_vocab_embedding_row_shards_with_padding(self):
+        """The GPT-2 case: a prime row count divides nothing; the sparse PS
+        path must still row-shard (padded) and train to the dense oracle."""
+        import jax
+        import numpy as np
+        import optax
+        from autodist_tpu.kernel import DistributedTrainStep, GraphTransformer, build_mesh
+        from autodist_tpu.model_item import ModelItem
+        from autodist_tpu.resource_spec import ResourceSpec
+        from autodist_tpu.strategy import Parallax, StrategyCompiler
+        from jax.sharding import PartitionSpec as P
+
+        VOCAB, EDIM = 13, 8  # 13 is prime
+
+        def loss_fn(params, batch):
+            emb = params["table"][batch["ids"]]
+            return (emb ** 2).mean()
+
+        rng = np.random.RandomState(1)
+        params = {"table": rng.randn(VOCAB, EDIM).astype(np.float32)}
+        batch = {"ids": np.array([[0, 3, 12, 7]] * 8, np.int32)}
+        item = ModelItem.from_params(params, loss_fn=loss_fn, example_batch=batch)
+        assert item.var("table").sparse_update  # jaxpr detection worked
+        spec = ResourceSpec(resource_dict={
+            "nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+        mesh = build_mesh(spec, axes=("data",))
+        strategy = StrategyCompiler(item).compile(Parallax().build(item, spec))
+        plan = GraphTransformer(strategy, item, mesh).transform()
+        vp = plan.var_plans["table"]
+        assert vp.storage_shape == (16, EDIM)
+        assert vp.pspec == P("data", None)
+
+        step = DistributedTrainStep(plan, loss_fn, optax.sgd(0.1))
+        state = step.init(params)
+        state, m = step(state, batch)
+        g = jax.grad(loss_fn)(params, batch)
+        expect = params["table"] - 0.1 * np.asarray(g["table"])
+        np.testing.assert_allclose(
+            np.asarray(step.logical_params(state)["table"]), expect, rtol=1e-5)
 
     def test_fallback_step_executes(self):
         import jax
