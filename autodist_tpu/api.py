@@ -328,24 +328,11 @@ class AutoDist:
         """
         import time
 
-        from autodist_tpu.strategy import (
-            AllReduce,
-            CostModel,
-            PS,
-            PSLoadBalancing,
-            Parallax,
-            PartitionedAR,
-        )
+        from autodist_tpu.strategy import CostModel
+        from autodist_tpu.strategy.cost_model import candidate_slate
 
         if candidates is None:
-            candidates = [
-                ("AllReduce", AllReduce()),
-                ("PartitionedAR", PartitionedAR()),
-                ("PSLoadBalancing", PSLoadBalancing()),
-                ("PS(zero3)", PS(local_proxy_variable=False)),
-                ("PS(zero1)", PS(local_proxy_variable=True)),
-                ("Parallax", Parallax()),
-            ]
+            candidates = candidate_slate()
 
         if jax.process_count() > 1:
             logging.warning(

@@ -33,8 +33,6 @@ from autodist_tpu.strategy.cost_model import CostModel
 from autodist_tpu.strategy.ir import Strategy
 from autodist_tpu.strategy.parallax_strategy import Parallax
 from autodist_tpu.strategy.partitioned_all_reduce_strategy import PartitionedAR
-from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
-from autodist_tpu.strategy.ps_strategy import PS
 from autodist_tpu.utils import logging
 
 
@@ -46,13 +44,9 @@ class Auto(StrategyBuilder):
         self._use_cost_model = cost_model
 
     def _dense_candidates(self):
-        return [
-            ("AllReduce", AllReduce(chunk_size=self._chunk_size)),
-            ("PartitionedAR", PartitionedAR(chunk_size=self._chunk_size)),
-            ("PSLoadBalancing", PSLoadBalancing()),
-            ("PS(zero3)", PS(local_proxy_variable=False)),
-            ("PS(zero1)", PS(local_proxy_variable=True)),
-        ]
+        from autodist_tpu.strategy.cost_model import candidate_slate
+
+        return candidate_slate(chunk_size=self._chunk_size, include_sparse=False)
 
     def build(self, model_item: ModelItem, resource_spec: ResourceSpec) -> Strategy:
         if model_item.sparse_variables:

@@ -87,6 +87,44 @@ OPTIMIZER_SLOT_FACTOR = {
 }
 
 
+def candidate_slate(
+    chunk_size: int = 128, include_sparse: bool = True, full: bool = False
+) -> List[Tuple[str, object]]:
+    """The shared candidate list behind Auto, ``AutoDist.tune`` and the
+    explain CLI — one definition so the three surfaces can never recommend
+    from different slates. ``include_sparse`` adds Parallax (Auto handles
+    sparse structurally and omits it); ``full=True`` appends the remaining
+    builders (random-axis / PS-partitioning variants) for exhaustive
+    explain tables."""
+    from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+    from autodist_tpu.strategy.parallax_strategy import Parallax
+    from autodist_tpu.strategy.partitioned_all_reduce_strategy import PartitionedAR
+    from autodist_tpu.strategy.partitioned_ps_strategy import PartitionedPS
+    from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
+    from autodist_tpu.strategy.ps_strategy import PS
+    from autodist_tpu.strategy.random_axis_partition_all_reduce_strategy import (
+        RandomAxisPartitionAR,
+    )
+    from autodist_tpu.strategy.uneven_partition_ps_strategy import UnevenPartitionedPS
+
+    slate: List[Tuple[str, object]] = [
+        ("AllReduce", AllReduce(chunk_size=chunk_size)),
+        ("PartitionedAR", PartitionedAR(chunk_size=chunk_size)),
+        ("PSLoadBalancing", PSLoadBalancing()),
+        ("PS(zero3)", PS(local_proxy_variable=False)),
+        ("PS(zero1)", PS(local_proxy_variable=True)),
+    ]
+    if include_sparse:
+        slate.append(("Parallax", Parallax(chunk_size=chunk_size)))
+    if full:
+        slate.extend([
+            ("RandomAxisPartitionAR", RandomAxisPartitionAR(chunk_size=chunk_size)),
+            ("PartitionedPS", PartitionedPS()),
+            ("UnevenPartitionedPS", UnevenPartitionedPS()),
+        ])
+    return slate
+
+
 @dataclass
 class StrategyCost:
     """Estimated per-step cost of one strategy on one cluster."""

@@ -1,0 +1,134 @@
+"""Explain strategy selection for a model on a cluster, from the CLI.
+
+The reference left strategy choice to the user with only qualitative
+guidance ("the best strategy differs per model",
+``/root/reference/docs/usage/performance.md:14``). This tool prints what the
+:class:`~autodist_tpu.strategy.cost_model.CostModel` predicts for every
+builder on a concrete (model × cluster) pair — per-step sync/update/latency
+time, per-chip memory vs HBM, feasibility — so the choice is auditable
+before any chip time is spent::
+
+    python -m autodist_tpu.strategy.explain --model bert_base
+    python -m autodist_tpu.strategy.explain --model lstm_lm \
+        --resource-spec spec.yml --batch-size 256
+
+Zoo model names come from ``autodist_tpu.models``; ``--model-kwargs`` passes
+factory overrides as ``k=v`` pairs (ints/floats auto-coerced).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional, Tuple
+
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.cost_model import CostModel, candidate_slate
+
+
+def _coerce(v: str):
+    for cast in (int, float):
+        try:
+            return cast(v)
+        except ValueError:
+            continue
+    return v
+
+
+def explain(
+    model_item: ModelItem,
+    resource_spec: ResourceSpec,
+    candidates: Optional[List[Tuple[str, object]]] = None,
+    out=None,
+) -> List[Tuple[str, object]]:
+    """Rank candidate builders for (model × cluster); print a table.
+
+    Returns the ranked ``[(name, StrategyCost), ...]`` (best first) so
+    callers can act on it programmatically.
+    """
+    out = out if out is not None else sys.stdout
+    cm = CostModel(model_item, resource_spec)
+    built = []
+    # The full slate (tune/Auto's shared candidates + the remaining
+    # builders) — explain shows everything, flagged by feasibility.
+    for name, builder in candidates or candidate_slate(full=True):
+        try:
+            built.append((name, builder.build(model_item, resource_spec)))
+        except Exception as e:  # noqa: BLE001 - keep explaining the rest
+            print(f"{name:22s} failed to build: {e}", file=out)
+    ranked = cm.rank(built)
+    print(
+        f"\n{resource_spec!r}\n"
+        f"model: {len(model_item.variables)} vars, "
+        f"{len(model_item.sparse_variables)} sparse, "
+        f"{model_item.total_bytes / 1e6:.1f} MB params, "
+        f"optimizer={model_item.optimizer_spec.name}\n",
+        file=out,
+    )
+    header = (
+        f"{'strategy':22s} {'total':>10s} {'comm':>10s} {'update':>9s} "
+        f"{'latency':>9s} {'act':>9s} {'mem/chip':>10s} {'fits':>5s}"
+    )
+    print(header, file=out)
+    print("-" * len(header), file=out)
+    for name, cost in ranked:
+        print(
+            f"{name:22s} {cost.total_s * 1e3:8.3f}ms {cost.comm_s * 1e3:8.3f}ms "
+            f"{cost.update_s * 1e3:7.3f}ms {cost.latency_s * 1e3:7.3f}ms "
+            f"{cost.act_sync_s * 1e3:7.3f}ms {cost.per_chip_bytes / 1e9:8.2f}GB "
+            f"{'yes' if cost.feasible else 'NO':>5s}",
+            file=out,
+        )
+    if ranked and not ranked[0][1].feasible:
+        print(
+            f"\nWARNING: no candidate fits per-chip HBM "
+            f"({ranked[0][1].hbm_bytes / 1e9:.2f} GB usable) — showing the "
+            f"least-over-budget candidate; expect OOM without a bigger "
+            f"chip, more shards, or host offload.",
+            file=out,
+        )
+    best = ranked[0][0] if ranked else "(none)"
+    print(f"\nrecommended: {best}", file=out)
+    return ranked
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m autodist_tpu.strategy.explain",
+        description="Rank strategy builders for a model on a cluster (cost model).",
+    )
+    p.add_argument("--model", required=True, help="zoo model name (e.g. bert_base, resnet, lstm_lm)")
+    p.add_argument("--model-kwargs", default="", help='comma "k=v" factory overrides')
+    p.add_argument("--resource-spec", default="", help="cluster yml (default: local devices)")
+    p.add_argument("--batch-size", type=int, default=32, help="planning batch size")
+    args = p.parse_args(argv)
+
+    from autodist_tpu.models import get_model
+
+    kwargs = {}
+    if args.model_kwargs:
+        for pair in args.model_kwargs.split(","):
+            k, v = pair.split("=", 1)
+            kwargs[k.strip()] = _coerce(v.strip())
+    spec = get_model(args.model, **kwargs)
+    import jax
+
+    params = spec.init(jax.random.PRNGKey(0))
+    batch = spec.example_batch(args.batch_size)
+    # Same capture as build()/the benchmark runner: force-marked sparse and
+    # expert names must reach the ranking, not just jaxpr-detected ones.
+    item = ModelItem.from_params(
+        params, loss_fn=spec.loss_fn, example_batch=batch,
+        sparse_names=spec.sparse_names, expert_names=spec.expert_names,
+    )
+    rs = (
+        ResourceSpec(args.resource_spec)
+        if args.resource_spec
+        else ResourceSpec.from_local_devices()
+    )
+    explain(item, rs)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
