@@ -1094,6 +1094,50 @@ class DistributedTrainStep:
             self._compiled_runs[key] = fn
         return fn(state, batch)
 
+    # ------------------------------------------------------------------ fit
+    def fit(
+        self,
+        state: TrainState,
+        batches,
+        steps: Optional[int] = None,
+        eval_batch=None,
+        eval_every: int = 0,
+        log_every: int = 0,
+    ):
+        """Keras-``model.fit``-shaped training loop over an iterable of
+        batches (a :class:`~autodist_tpu.data.DataLoader` or any batch
+        iterator) — parity for the reference's patched ``model.fit`` path
+        (``patch.py:96-116``, exercised by its integration case c7).
+
+        Returns ``(state, history)`` where ``history["loss"]`` is the
+        per-step loss and ``history["eval_loss"]`` the periodic eval losses
+        (``eval_every`` > 0 with ``eval_batch``). For throughput-critical
+        loops prefer ``run()`` (device-side windows); ``fit`` dispatches one
+        step per batch, which is what a fresh-data training loop needs.
+        """
+        import itertools
+
+        history = {"loss": []}
+        if eval_every and eval_batch is not None:
+            history["eval_loss"] = []
+        # islice, not a break-on-index loop: breaking after enumerate() has
+        # pulled the batch would silently consume (and discard) one extra
+        # batch from a shared iterator per capped fit() call.
+        if steps is not None:
+            batches = itertools.islice(batches, steps)
+        for i, batch in enumerate(batches):
+            state, metrics = self(state, batch)
+            loss = float(metrics["loss"])
+            history["loss"].append(loss)
+            if log_every and (i + 1) % log_every == 0:
+                logging.info("fit step %d: loss=%.6f", i + 1, loss)
+            if eval_every and eval_batch is not None and (i + 1) % eval_every == 0:
+                ev_loss = float(self.evaluate(state, eval_batch)["loss"])
+                history["eval_loss"].append(ev_loss)
+                if log_every:
+                    logging.info("fit step %d: eval_loss=%.6f", i + 1, ev_loss)
+        return state, history
+
     # ------------------------------------------------------------ evaluation
     def evaluate(self, state: TrainState, batch):
         """Loss (+aux) on a batch without gradients or state mutation — the

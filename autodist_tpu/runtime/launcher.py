@@ -35,6 +35,28 @@ from autodist_tpu.runtime.coordinator import Coordinator
 from autodist_tpu.utils import logging
 
 
+def _scrub_role_vars(env: dict) -> dict:
+    """Drop the framework's role/strategy vars from an environment.
+
+    Any earlier chief-side ``build()`` in the calling process exports
+    ``AUTODIST_STRATEGY_ID`` into ``os.environ`` (and a stale
+    ``AUTODIST_WORKER`` can linger the same way); a freshly launched
+    process inheriting them is misrouted onto the coordinator-shipped-
+    strategy path, waiting for a file that was never shipped while the
+    chief blocks in the runtime broadcast. Launchers must set role vars
+    explicitly; behavior knobs (log level, testing flags) and user vars
+    pass through.
+    """
+    role_vars = {
+        ENV.AUTODIST_WORKER.name,
+        ENV.AUTODIST_STRATEGY_ID.name,
+        ENV.AUTODIST_COORDINATOR.name,
+        ENV.AUTODIST_NUM_PROCESSES.name,
+        ENV.AUTODIST_PROCESS_ID.name,
+    }
+    return {k: v for k, v in env.items() if k not in role_vars}
+
+
 def launch(
     resource_spec: ResourceSpec,
     argv: Sequence[str],
@@ -63,7 +85,7 @@ def launch(
         ENV.AUTODIST_NUM_PROCESSES.name: str(cluster.num_processes),
         ENV.AUTODIST_PROCESS_ID.name: "0",
     }
-    chief = subprocess.Popen(argv, env={**os.environ, **env})
+    chief = subprocess.Popen(argv, env={**_scrub_role_vars(dict(os.environ)), **env})
     code = chief.wait()
     if code == 0:
         coordinator.join()
@@ -77,12 +99,16 @@ def _launch_local_fleet(
 ) -> int:
     """Emulate an n-host cluster on one machine (testing path).
 
-    ``base_env`` overrides the inherited environment entirely (tests use it
-    to pin ``JAX_PLATFORMS=cpu`` regardless of the host's default backend).
+    ``base_env`` replaces the inherited environment (tests use it to pin
+    ``JAX_PLATFORMS=cpu`` regardless of the host's default backend) —
+    except the framework role vars, which are scrubbed from either source
+    and set explicitly below (see :func:`_scrub_role_vars`).
     """
     port = coordinator_port or const.DEFAULT_COORDINATOR_PORT
     coord = f"127.0.0.1:{port}"
-    inherited = dict(os.environ) if base_env is None else dict(base_env)
+    inherited = _scrub_role_vars(
+        dict(os.environ) if base_env is None else dict(base_env)
+    )
     procs: List[subprocess.Popen] = []
     for pid_idx in range(1, n):
         env = {

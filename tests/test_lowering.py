@@ -711,3 +711,58 @@ class TestEvaluate:
             np.testing.assert_allclose(float(m10["loss"]), want, rtol=1e-5)
         finally:
             AutoDist.reset_default()
+
+
+class TestFit:
+    """model.fit-shaped loop (reference Keras-fit parity, case c7)."""
+
+    def _setup(self):
+        import numpy as np
+        import optax
+        from autodist_tpu.kernel import DistributedTrainStep, GraphTransformer, build_mesh
+        from autodist_tpu.model_item import ModelItem
+        from autodist_tpu.resource_spec import ResourceSpec
+        from autodist_tpu.strategy import AllReduce, StrategyCompiler
+
+        def loss_fn(params, batch):
+            return ((batch["x"] @ params["w"] - batch["y"]) ** 2).mean()
+
+        rng = np.random.RandomState(0)
+        params = {"w": rng.randn(8, 2).astype(np.float32)}
+        spec = ResourceSpec(resource_dict={
+            "nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+        item = ModelItem.from_params(params)
+        strategy = StrategyCompiler(item).compile(AllReduce().build(item, spec))
+        plan = GraphTransformer(strategy, item, build_mesh(spec)).transform()
+        step = DistributedTrainStep(plan, loss_fn, optax.sgd(0.05))
+
+        def batches(n):
+            r = np.random.RandomState(7)
+            for _ in range(n):
+                x = r.randn(16, 8).astype(np.float32)
+                yield {"x": x, "y": (x @ np.ones((8, 2), np.float32))}
+
+        return step, params, batches
+
+    def test_fit_trains_and_records_history(self):
+        step, params, batches = self._setup()
+        state = step.init(params)
+        state, history = step.fit(state, batches(20))
+        assert len(history["loss"]) == 20
+        assert history["loss"][-1] < history["loss"][0]  # it learned
+        assert int(state.step) == 20
+
+    def test_fit_steps_cap_and_periodic_eval(self):
+        import numpy as np
+
+        step, params, batches = self._setup()
+        state = step.init(params)
+        eval_batch = next(iter(batches(1)))
+        # A shared iterator: the steps cap must not consume an extra batch.
+        it = iter(batches(50))
+        state, history = step.fit(
+            state, it, steps=10, eval_batch=eval_batch, eval_every=5)
+        assert len(history["loss"]) == 10
+        assert len(history["eval_loss"]) == 2
+        assert np.isfinite(history["eval_loss"][-1])
+        assert len(list(it)) == 40  # exactly 10 were consumed, not 11

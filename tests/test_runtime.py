@@ -293,10 +293,50 @@ def test_two_process_autodist_training(tmp_path):
     from autodist_tpu.runtime.launcher import _launch_local_fleet
 
     env = _scrubbed_cpu_env()
+    # Regression: any earlier chief-side build() in the parent process
+    # exports AUTODIST_STRATEGY_ID into os.environ; a fleet inheriting it
+    # sent workers down the coordinator-shipped-strategy path (waiting 60s
+    # for a never-shipped file) while the chief hung in the runtime
+    # broadcast. The launcher must scrub role vars from the base env.
+    env[ENV.AUTODIST_STRATEGY_ID.name] = "20990101T000000-stale-id-from-parent"
     code = _launch_local_fleet(
         [sys.executable, str(script)], 2, coordinator_port=_free_port(), base_env=env
     )
     assert code == 0
+
+
+def test_fleet_launcher_scrubs_inherited_role_vars():
+    """Unit-level pin of the same contract (no fleet spin-up): the env a
+    fleet child receives must not carry the parent's role/strategy vars."""
+    import autodist_tpu.runtime.launcher as launcher_mod
+
+    captured = []
+
+    class FakeProc:
+        def __init__(self, argv, env=None, **kw):
+            captured.append(env)
+        def wait(self, timeout=None):
+            return 0
+
+    orig = launcher_mod.subprocess.Popen
+    launcher_mod.subprocess.Popen = FakeProc
+    try:
+        base = {
+            "PATH": "/usr/bin",
+            ENV.AUTODIST_STRATEGY_ID.name: "stale",
+            ENV.AUTODIST_WORKER.name: "10.0.0.9",
+            "AUTODIST_MIN_LOG_LEVEL": "DEBUG",   # behavior knob: must survive
+            "AUTODIST_TEST_CKPT_DIR": "/tmp/x",  # user var: must survive
+        }
+        launcher_mod._launch_local_fleet(["true"], 2, 15900, base_env=base)
+    finally:
+        launcher_mod.subprocess.Popen = orig
+    assert captured
+    for env in captured:
+        assert env.get(ENV.AUTODIST_STRATEGY_ID.name) != "stale"
+        assert env.get("AUTODIST_MIN_LOG_LEVEL") == "DEBUG"
+        assert env.get("AUTODIST_TEST_CKPT_DIR") == "/tmp/x"
+        assert env.get(ENV.AUTODIST_WORKER.name) != "10.0.0.9"
 
 
 @pytest.mark.integration
