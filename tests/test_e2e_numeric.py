@@ -105,8 +105,8 @@ def reference_step(loss_fn, params, batch, tx):
     return optax.apply_updates(params, updates)
 
 
-def run_distributed(builder, loss_fn, params, batch, opt_spec, sparse=False):
-    rs = ResourceSpec(resource_dict={"nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+def run_distributed(builder, loss_fn, params, batch, opt_spec, sparse=False, rs=None):
+    rs = rs or ResourceSpec(resource_dict={"nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
     mi = ModelItem.from_params(
         params, optimizer_spec=opt_spec, loss_fn=loss_fn, example_batch=batch
     )
@@ -192,3 +192,29 @@ def test_hlo_dump_available():
     state = step.init(params)
     text = step.lower_text(state, batch)
     assert "stablehlo" in text or "module" in text
+
+
+def test_heterogeneous_node_chips_match_single_device():
+    """SURVEY §7.4 item 6: the reference's weighted-average case
+    (c0.py:105-118) arose from workers with unequal GPU counts. Here chips
+    are the replica unit, so a 3+5-chip cluster still yields exactly the
+    full-batch gradient — each chip averages its equal batch share and the
+    mesh mean weights every example once. Assert that explicitly on a
+    heterogeneous spec."""
+    rs_het = ResourceSpec(resource_dict={"nodes": [
+        {"address": "10.0.0.1", "chips": 3, "chief": True},
+        {"address": "10.0.0.2", "chips": 5},
+    ]})
+    assert rs_het.num_chips == 8  # matches the virtual mesh
+    params, batch = dense_params(), dense_batch()
+    opt = OptimizerSpec("sgd", {"learning_rate": 0.05})
+    expected = reference_step(dense_loss, params, batch, opt.make())
+
+    step, new_state, _ = run_distributed(
+        AllReduce(), dense_loss, params, batch, opt, rs=rs_het)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6),
+        jax.device_get(step.logical_params(new_state)),
+        jax.device_get(expected),
+    )
