@@ -17,11 +17,15 @@ closes that loop for the TPU build: given a built :class:`Strategy`, a
 
 Compute (forward/backward) time is deliberately *excluded*: under pure data
 parallelism every candidate strategy runs identical per-chip FLOPs, so it
-cannot change the ranking; for partitioned (tensor-parallel) variables the
-sharded matmul's activation synchronization is charged instead —
-``batch_size × shape[-1] × 2`` bytes when the ModelItem captured a batch,
+cannot change the ranking. Parameter sharding is charged by its rendering:
+on the data axis (pure-DP meshes) it is ZeRO — parameter all-gathers in
+forward and backward plus a gradient reduce-scatter, 1.5× the plain
+all-reduce wire, traded for 1/n residency; on a non-trivial model axis it
+is tensor parallelism — per-shard gradients reduced over the data group
+plus an activation all-gather over the model group per use
+(``batch_size × shape[-1] × 2`` bytes when the ModelItem captured a batch,
 an explicit ``act_bytes`` calibration when given, else
-:data:`DEFAULT_ACT_BYTES`. All estimates mirror the lowering
+:data:`DEFAULT_ACT_BYTES`). All estimates mirror the lowering
 semantics in ``kernel/lowering.py`` (which mesh axis shards a variable, when
 divisibility forces replication, ZeRO-1 vs ZeRO-3 residency for PS vars).
 
@@ -159,9 +163,11 @@ class CostModel:
     """Estimate per-step time and memory for candidate strategies.
 
     Mirrors ``kernel/lowering.py`` residency rules: a partition request
-    shards over the mesh's data axis (Auto's meshes are pure-DP) when the
-    axis divides evenly, PS dense vars get ZeRO-1 (proxy) or ZeRO-3
-    (no-proxy) residency, PS sparse vars are row-sharded.
+    shards over the mesh's model axis when the spec's ``mesh:`` override
+    makes it non-trivial, else ZeRO-style over the data axis (Auto's meshes
+    are pure-DP); gradients reduce over the data axis; PS dense vars get
+    ZeRO-1 (proxy) or ZeRO-3 (no-proxy) residency; PS sparse vars are
+    row-sharded (pad-and-mask when rows don't divide).
     """
 
     def __init__(
@@ -182,6 +188,15 @@ class CostModel:
         self.n = max(resource_spec.num_chips, 1)
         self.m = max(resource_spec.num_nodes, 1)
         self.chips_per_node = max(self.n // self.m, 1)
+        # Mesh-aware group sizes (identical to self.n on pure-DP meshes,
+        # which is what Auto builds): gradients reduce over the DATA axis;
+        # variable partitioning rides the MODEL axis when the spec's mesh
+        # override makes it non-trivial (lowering `_shard_axis_name`),
+        # else it is ZeRO-style over the data axis.
+        mesh_shape = resource_spec.mesh_shape(("data", "model"))
+        self.n_data = max(int(mesh_shape.get("data", 1)), 1)
+        self.n_model = max(int(mesh_shape.get("model", 1)), 1)
+        self.n_shard = self.n_model if self.n_model > 1 else self.n_data
         self.bw_ici = resource_spec.ici_bandwidth * 1e9 / 8.0
         self.bw_dcn = resource_spec.network_bandwidth * 1e9 / 8.0
         self.hbm_bw = resource_spec.tpu.hbm_bandwidth_bytes
@@ -192,32 +207,60 @@ class CostModel:
         )
 
     # ----------------------------------------------------------- primitives
-    def allreduce_s(self, nbytes: float) -> float:
-        """Ring all-reduce of ``nbytes`` over all chips; hierarchical
-        (reduce-scatter on ICI, all-reduce shards on DCN) across hosts."""
-        if self.n <= 1:
+    def allreduce_s(self, nbytes: float, participants: Optional[int] = None) -> float:
+        """Ring all-reduce of ``nbytes`` over the gradient-reduction group
+        (the data axis by default); hierarchical (reduce-scatter on ICI,
+        all-reduce shards on DCN) across hosts."""
+        p = participants if participants is not None else self.n_data
+        if p <= 1:
             return 0.0
-        if self.m == 1:
-            return 2.0 * nbytes * (self.n - 1) / self.n / self.bw_ici
-        c = self.chips_per_node
+        if self.m == 1 or p <= self.chips_per_node:
+            # Single host, or a group small enough to live inside one host
+            # (mesh_utils maps minor axes onto intra-node ICI): pure ICI ring.
+            return 2.0 * nbytes * (p - 1) / p / self.bw_ici
+        c = max(p // self.m, 1)
         intra = 2.0 * nbytes * (c - 1) / c / self.bw_ici if c > 1 else 0.0
         inter = 2.0 * (nbytes / c) * (self.m - 1) / self.m / self.bw_dcn
         return intra + inter
 
-    def _oneway_s(self, nbytes: float) -> float:
+    def _group_latency(self, participants: int) -> float:
+        """Dispatch latency for a collective over ``participants`` chips:
+        ICI-class when the group fits inside one host."""
+        if self.m == 1 or participants <= self.chips_per_node:
+            return ICI_LATENCY_S
+        return DCN_LATENCY_S
+
+    def _oneway_s(self, nbytes: float, participants: Optional[int] = None) -> float:
         """All-gather / reduce-scatter (half an all-reduce)."""
-        return self.allreduce_s(nbytes) / 2.0
+        return self.allreduce_s(nbytes, participants) / 2.0
 
     def _sharded(self, var: VarItem, axis: Optional[int]) -> int:
-        """Residency shard count the lowering would realize: the data-axis
+        """Residency shard count the lowering would realize: the shard-axis
         size when the requested (or fallback) axis divides evenly, else 1."""
-        if self.n <= 1 or not var.shape:
+        k = self.n_shard
+        if k <= 1 or not var.shape or axis is None:
             return 1
-        if axis is not None and var.shape[axis] % self.n == 0 and var.shape[axis] >= self.n:
-            return self.n
-        # lowering `_fallback_axis`: largest evenly-divisible axis
-        cands = [d for d in var.shape if d % self.n == 0 and d >= self.n]
-        return self.n if (axis is not None and cands) else 1
+        if var.shape[axis] % k == 0 and var.shape[axis] >= k:
+            return k
+        # lowering `_fallback_axis`: largest evenly-divisible axis; then
+        # pad-and-mask on the requested axis when it exceeds the mesh degree.
+        if any(d % k == 0 and d >= k for d in var.shape) or var.shape[axis] > k:
+            return k
+        return 1
+
+    def _residency_bytes(self, var: VarItem, axis: Optional[int], shards: int) -> float:
+        """Stored bytes of the variable: the zero-padded storage size when
+        pad-and-mask sharding applies (lowering stores ceil-multiples of the
+        shard axis), else the logical size."""
+        B = float(var.byte_size)
+        if shards <= 1 or axis is None or not var.shape:
+            return B
+        if var.shape[axis] % shards == 0 or any(
+            d % shards == 0 and d >= shards for d in var.shape
+        ):
+            return B  # exact shard or divisible-fallback axis: no padding
+        padded = -(-var.shape[axis] // shards) * shards
+        return B * padded / var.shape[axis]
 
     def _act_bytes_for(self, var: VarItem) -> float:
         """Activation bytes one TP collective moves for this variable: the
@@ -232,11 +275,13 @@ class CostModel:
         return DEFAULT_ACT_BYTES
 
     def _update_axis_shards(self, var: VarItem) -> int:
-        """`_weight_update_spec` parity: slot sharding for PS vars."""
-        if self.n <= 1 or not var.shape:
+        """`_weight_update_spec` parity: slot sharding for PS vars rides the
+        data axis."""
+        k = self.n_data
+        if k <= 1 or not var.shape:
             return 1
-        cands = [d for d in var.shape if d % self.n == 0 and d >= self.n]
-        return self.n if cands else 1
+        cands = [d for d in var.shape if d % k == 0 and d >= k]
+        return k if cands else 1
 
     # ------------------------------------------------------------ node costs
     def _node_cost(self, node: NodeConfig, var: VarItem) -> Tuple[
@@ -250,22 +295,36 @@ class CostModel:
         ps_loads: Dict[str, float] = {}
 
         if isinstance(sync, AllReduceSynchronizer):
-            wire = B * COMPRESSOR_WIRE_FACTOR.get(sync.compressor, 1.0)
-            comm = self.allreduce_s(wire)
             part_axis = node.active_partition_axis
-            shards = self._sharded(var, part_axis) if part_axis is not None else 1
-            update = update_traffic_factor * B / shards / self.hbm_bw
-            # Tensor-parallel activation sync: forward + backward each pay
-            # one all-gather over the sharded matmul's activations. The shard
-            # axis is the data axis here (Auto meshes are pure-DP), which
-            # spans hosts on multi-node specs — _oneway_s models that
-            # hierarchy (ICI intra-node, DCN across).
-            act = (
-                2.0 * (self.latency + self._oneway_s(self._act_bytes_for(var)))
-                if shards > 1 else 0.0
-            )
-            params = B / shards
-            extra = self.slot_factor * B / shards + B  # slots + transient grad
+            shards = self._sharded(var, part_axis)
+            res = self._residency_bytes(var, part_axis, shards)
+            wire = res * COMPRESSOR_WIRE_FACTOR.get(sync.compressor, 1.0)
+            act = 0.0
+            if shards <= 1:
+                # Plain DP: one gradient all-reduce over the data group.
+                comm = self.allreduce_s(wire)
+            elif self.n_model > 1:
+                # Model-axis tensor parallelism (lowering _shard_axis_name:
+                # any non-trivial model axis wins): each chip holds a
+                # 1/shards gradient slice, reduced over the data group; the
+                # split matmul pays an activation all-gather over the model
+                # group in forward and backward.
+                comm = self.allreduce_s(wire / shards)
+                act = 2.0 * (
+                    self._group_latency(self.n_shard)
+                    + self._oneway_s(self._act_bytes_for(var), self.n_shard)
+                )
+            else:
+                # Data-axis parameter sharding (ZeRO rendering): params are
+                # all-gathered for compute at FULL size (compressors shrink
+                # only gradients), forward + backward, and grads
+                # reduce-scattered — ~1.5x the plain all-reduce wire, traded
+                # for 1/n residency. No activation term: compute is not
+                # split.
+                comm = self._oneway_s(wire) + 2.0 * self._oneway_s(res)
+            update = update_traffic_factor * res / shards / self.hbm_bw
+            params = res / shards
+            extra = self.slot_factor * res / shards + res  # slots + grad buffer
             n_coll = 1
             return comm, update, act, params, extra, n_coll, ps_loads
 
@@ -274,29 +333,42 @@ class CostModel:
             wire = B * self.sparse_touch
             # forward row gather + backward scatter-add of touched rows
             comm = 2.0 * self._oneway_s(wire)
-            # lowering parity: row-sharded only when axis 0 divides evenly,
-            # else the dense weight-update axis decides residency
-            if var.shape and var.shape[0] % self.n == 0 and var.shape[0] >= self.n:
-                shards = self.n
+            # lowering parity: row-sharded (over the shard axis, padding if
+            # needed) whenever the table has at least axis-size rows, else
+            # the dense weight-update axis decides residency
+            if var.shape and self.n_shard > 1 and var.shape[0] >= self.n_shard:
+                shards = self.n_shard
+                res = self._residency_bytes(var, 0, shards)
             else:
                 shards = self._update_axis_shards(var)
+                res = B
             update = update_traffic_factor * B * self.sparse_touch / shards / self.hbm_bw
-            params = B / shards
-            extra = self.slot_factor * B / shards + wire
+            params = res / shards
+            extra = self.slot_factor * res / shards + wire
         else:
-            upd_shards = self._update_axis_shards(var)
-            if sync.local_replication:
+            part_axis = node.active_partition_axis
+            if part_axis is not None:
+                # Explicitly partitioned PS var (PartitionedPS /
+                # UnevenPartitionedPS): lowering shards param + update on
+                # the requested axis (padding when nothing divides), taking
+                # precedence over the proxy residency knob.
+                upd_shards = self._sharded(var, part_axis)
+                res = self._residency_bytes(var, part_axis, upd_shards)
+            else:
+                upd_shards = self._update_axis_shards(var)
+                res = B
+            if sync.local_replication and part_axis is None:
                 # ZeRO-1: replicated param, sharded update; grads all-reduce
                 # then the owner shard's update is re-broadcast.
                 comm = self.allreduce_s(B) + self._oneway_s(B)
                 params = B
             else:
-                # ZeRO-3: sharded param; reduce-scatter grads + all-gather
-                # params on use (forward + backward).
-                comm = self._oneway_s(B) + 2.0 * self._oneway_s(B)
-                params = B / upd_shards
-            update = update_traffic_factor * B / upd_shards / self.hbm_bw
-            extra = self.slot_factor * B / upd_shards + B
+                # ZeRO-3 / partitioned: sharded param; reduce-scatter grads
+                # + all-gather params on use (forward + backward).
+                comm = self._oneway_s(res) + 2.0 * self._oneway_s(res)
+                params = res / upd_shards
+            update = update_traffic_factor * res / upd_shards / self.hbm_bw
+            extra = self.slot_factor * res / upd_shards + res
         # Multi-node PS: the destination host's NIC serializes this var's
         # cross-host traffic (reference: all workers push to one PS CPU).
         if self.m > 1:

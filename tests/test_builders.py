@@ -229,13 +229,36 @@ class TestAutoStrategy:
         assert isinstance(by_name["embed"], PSSynchronizer)
         assert isinstance(by_name["dense"], AllReduceSynchronizer)
 
-    def test_dominant_tensor_gets_partitioned(self):
+    def test_dominant_tensor_heuristic_partitions_cost_model_weighs(self):
         from autodist_tpu.strategy import Auto
+        from autodist_tpu.strategy.ir import AllReduceSynchronizer
 
         item = self._item({"big_fc": (25088, 4096), "small": (64, 64)})
-        s = Auto().build(item, self._spec())
+        # Heuristic mode keeps the reference-benchmark-implied policy:
+        # dominant tensor → PartitionedAR.
+        s = Auto(cost_model=False).build(item, self._spec())
         parts = {n.var_name: n.partitioner for n in s.node_config}
         assert parts["big_fc"]  # partitioned
+        # The cost model weighs the ZeRO comm tax instead: a model that
+        # fits replicated keeps plain AllReduce...
+        s = Auto().build(item, self._spec())
+        assert all(
+            isinstance(n.synchronizer, AllReduceSynchronizer) and not n.partitioner
+            for n in s.node_config
+        )
+        # ...and a chip it does NOT fit picks a sharded-residency strategy.
+        from autodist_tpu.resource_spec import ResourceSpec
+
+        tight = ResourceSpec(resource_dict={
+            "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+            "tpu": {"hbm_gb": 0.6},
+        })
+        s = Auto().build(item, tight)
+        all_plain_ar = all(
+            isinstance(n.synchronizer, AllReduceSynchronizer) and not n.partitioner
+            for n in s.node_config
+        )
+        assert not all_plain_ar
 
     def test_uniform_dense_gets_allreduce(self):
         from autodist_tpu.strategy import Auto

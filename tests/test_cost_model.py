@@ -98,9 +98,21 @@ class TestRanking:
         ranked = cm.rank([(n, b.build(item, spec)) for n, b in cands])
         return [n for n, _ in ranked]
 
-    def test_dominant_tensor_prefers_partitioned_ar(self):
+    def test_dominant_tensor_that_fits_prefers_plain_allreduce(self):
+        # Pure-DP parameter sharding is ZeRO: 1.5x the all-reduce wire for
+        # 1/n residency. When the model fits replicated, the comm tax isn't
+        # worth it — plain AllReduce must win even with one dominant tensor.
         names = self._rank_names(_item({"big": (25088, 4096), "small": (64, 64)}), _single())
-        assert names[0] == "PAR"
+        assert names[0] == "AR"
+
+    def test_dominant_tensor_under_memory_pressure_prefers_sharded(self):
+        # The same model on a chip it doesn't fit: only sharded-residency
+        # candidates are feasible, so one of them must rank first.
+        names = self._rank_names(
+            _item({"big": (25088, 4096), "small": (64, 64)}, opt="adam"),
+            _single(hbm_gb=1.5),
+        )
+        assert names[0] != "AR"
 
     def test_uniform_dense_prefers_allreduce(self):
         names = self._rank_names(_item({f"w{i}": (256, 256) for i in range(8)}), _single())
@@ -133,6 +145,88 @@ class TestRanking:
         parallax = cm.strategy_cost(Parallax().build(item, spec))
         ar = cm.strategy_cost(AllReduce().build(item, spec))
         assert parallax.comm_s < ar.comm_s
+
+
+class TestMeshOverride:
+    def test_model_axis_changes_shard_and_reduction_groups(self):
+        # mesh {data:4, model:2}: gradients reduce over 4 chips, variables
+        # partition 2-ways on the model axis — mirroring lowering, not the
+        # flat 8-chip assumption.
+        item = _item({"w": (256, 256)})
+        spec = ResourceSpec(resource_dict={
+            "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+            "mesh": {"data": 4, "model": 2},
+            "tpu": {"ici_bandwidth_gbps": 800.0},
+        })
+        cm = CostModel(item, spec)
+        assert cm.n_data == 4 and cm.n_shard == 2
+        bw = 800.0e9 / 8
+        assert cm.allreduce_s(1e9) == pytest.approx(2 * 1e9 * 3 / 4 / bw)
+        par = cm.strategy_cost(PartitionedAR().build(item, spec))
+        pure = CostModel(item, _single())
+        par_pure = pure.strategy_cost(PartitionedAR().build(item, _single()))
+        # 2-way residency leaves more bytes per chip than 8-way.
+        assert par.per_chip_bytes > par_pure.per_chip_bytes
+
+    def test_equal_axes_still_classified_as_tensor_parallel(self):
+        # mesh {data:2, model:2}: lowering shards on the model axis (any
+        # non-trivial model axis wins), so the cost model must charge the
+        # TP activation term — not the ZeRO rendering.
+        item = _item({"w": (256, 256)})
+        spec = ResourceSpec(resource_dict={
+            "nodes": [{"address": "localhost", "chips": 4, "chief": True}],
+            "mesh": {"data": 2, "model": 2},
+        })
+        cost = CostModel(item, spec).strategy_cost(PartitionedAR().build(item, spec))
+        assert cost.act_sync_s > 0
+
+    def test_compressor_does_not_shrink_zero_param_gathers(self):
+        # ZeRO rendering: grads compress on the wire, parameter all-gathers
+        # do not — total comm must shrink by less than the wire factor.
+        item = _item({"w": (25088, 4096), "w2": (64, 64)})
+        spec = _single()
+        from autodist_tpu.strategy.ir import AllReduceSynchronizer
+
+        s_plain = PartitionedAR().build(item, spec)
+        s_comp = PartitionedAR().build(item, spec)
+        for n in s_comp.node_config:
+            n.synchronizer = AllReduceSynchronizer(
+                compressor="PowerSGDCompressor", group=n.synchronizer.group)
+        plain = CostModel(item, spec).strategy_cost(s_plain)
+        comp = CostModel(item, spec).strategy_cost(s_comp)
+        assert comp.comm_s > plain.comm_s * COMPRESSOR_WIRE_FACTOR["PowerSGDCompressor"]
+        assert comp.comm_s > plain.comm_s * 2 / 3  # param gathers dominate
+
+    def test_intra_node_model_group_rides_ici_on_multihost(self):
+        # 2 hosts x 4 chips, model group of 2 fits inside a host: its
+        # collectives must be charged at ICI bandwidth/latency, not DCN.
+        item = _item({"w": (256, 256)})
+        spec = ResourceSpec(resource_dict={
+            "nodes": [{"address": "10.0.0.1", "chips": 4, "chief": True},
+                      {"address": "10.0.0.2", "chips": 4}],
+            "mesh": {"data": 4, "model": 2},
+        })
+        cm = CostModel(item, spec)
+        bw_ici = spec.ici_bandwidth * 1e9 / 8
+        assert cm.allreduce_s(1e6, participants=2) == pytest.approx(
+            2 * 1e6 * (1 / 2) / bw_ici)
+        from autodist_tpu.strategy.cost_model import ICI_LATENCY_S
+        assert cm._group_latency(2) == ICI_LATENCY_S
+
+    def test_padded_residency_counted(self):
+        # (10, 6) over an 8-way shard axis: lowering pads to (16, 6) and
+        # shards 8 ways; the cost model must count /8 residency, not
+        # replication.
+        item = _item({"w": (10, 6)})
+        spec = _single()
+        cm = CostModel(item, spec)
+        from autodist_tpu.strategy import UnevenPartitionedPS
+
+        cost = cm.strategy_cost(UnevenPartitionedPS().build(item, spec))
+        # Storage is the PADDED shape (16, 6): residency and the grad buffer
+        # count padded bytes, divided 8 ways for the param share.
+        padded = 16 * 6 * 4
+        assert cost.per_chip_bytes == pytest.approx(padded / 8 + padded)
 
 
 class TestFeasibility:
@@ -219,15 +313,18 @@ class TestActCalibration:
             loss_fn=lambda p, b: (b["x"] @ p["big"]).mean(),
             example_batch={"x": np.zeros((128, 25088), np.float32)},
         )
-        spec = _single()
+        spec = ResourceSpec(resource_dict={
+            "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+            "mesh": {"data": 4, "model": 2},  # model-axis TP has the act term
+        })
         s = PartitionedAR().build(item, spec)
         calibrated = CostModel(item, spec, act_bytes=64.0).strategy_cost(s)
         derived = CostModel(item, spec).strategy_cost(s)
         assert calibrated.act_sync_s < derived.act_sync_s
 
     def test_act_term_scales_with_captured_batch(self):
-        # Same model, 8x the batch → 8x the TP activation bytes → a larger
-        # act_sync_s on the partitioned candidate.
+        # Model-axis TP (the rendering with an activation term): 8x the
+        # batch → 8x the activation bytes → a larger act_sync_s.
         def make(bs):
             params = {"big": np.zeros((25088, 4096), np.float32)}
             return ModelItem.from_params(
@@ -236,11 +333,15 @@ class TestActCalibration:
                 example_batch={"x": np.zeros((bs, 25088), np.float32)},
             )
 
-        spec = _single()
+        spec = ResourceSpec(resource_dict={
+            "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+            "mesh": {"data": 4, "model": 2},
+        })
         small = CostModel(make(16), spec).strategy_cost(
             PartitionedAR().build(make(16), spec))
         large = CostModel(make(128), spec).strategy_cost(
             PartitionedAR().build(make(128), spec))
+        assert small.act_sync_s > 0
         assert large.act_sync_s > small.act_sync_s
 
 
