@@ -53,19 +53,29 @@ DEFAULT_CHIPS_PER_HOST = 4
 
 # Per-chip HBM capacity (GB) and bandwidth (GB/s) by accelerator generation —
 # public figures, used by the strategy cost model for memory-feasibility and
-# weight-update-time estimates. Longest-prefix match on the accelerator name;
+# weight-update-time estimates. Longest-substring match on the accelerator
+# name (so jax ``device_kind`` strings like "TPU v5 lite" resolve too);
 # a `tpu: {hbm_gb, hbm_gb_per_s}` spec entry overrides.
 HBM_BY_ACCELERATOR = {
     "v5litepod": (16.0, 819.0),
     "v5 lite": (16.0, 819.0),
     "v5e": (16.0, 819.0),
     "v5p": (95.0, 2765.0),
+    # Bare "v5" (real v5p device_kind is "TPU v5") must come after the longer
+    # lite variants in match precedence; longest-substring-first ensures that.
+    "v5": (95.0, 2765.0),
+    "v6 lite": (32.0, 1640.0),
     "v6e": (32.0, 1640.0),
+    "v6": (32.0, 1640.0),
     "v4": (32.0, 1228.0),
     "v3": (16.0, 900.0),
     "v2": (8.0, 700.0),
 }
-DEFAULT_HBM = (16.0, 819.0)
+# Unknown/unspecified accelerator: assume the smallest-HBM generation so the
+# cost model's feasibility check is conservative — an optimistic default
+# certifies strategies that OOM at runtime, the exact failure the check
+# exists to prevent.
+DEFAULT_HBM = min(HBM_BY_ACCELERATOR.values())
 
 
 class DeviceType(Enum):
@@ -111,9 +121,15 @@ class NodeSpec:
 
 @dataclass
 class TPUTopology:
-    """Physical slice description: accelerator kind + ICI torus shape."""
+    """Physical slice description: accelerator kind + ICI torus shape.
 
-    accelerator: str = "v5p"
+    ``accelerator=None`` means "unspecified": HBM planning figures fall back
+    to the smallest known generation (conservative), and callers that can see
+    the runtime (``ResourceSpec.from_local_devices``) fill it in from jax's
+    ``device_kind``.
+    """
+
+    accelerator: Optional[str] = None
     topology: Optional[Tuple[int, ...]] = None  # e.g. (2, 2, 2)
     ici_bandwidth_gbps: float = DEFAULT_ICI_BANDWIDTH_GBPS
     dcn_bandwidth_gbps: float = DEFAULT_DCN_BANDWIDTH_GBPS
@@ -127,9 +143,11 @@ class TPUTopology:
         return int(math.prod(self.topology))
 
     def _hbm_defaults(self) -> Tuple[float, float]:
+        if self.accelerator is None:
+            return DEFAULT_HBM
         kind = self.accelerator.lower()
         for key in sorted(HBM_BY_ACCELERATOR, key=len, reverse=True):
-            if kind.startswith(key):
+            if key in kind:
                 return HBM_BY_ACCELERATOR[key]
         return DEFAULT_HBM
 
@@ -204,7 +222,9 @@ class ResourceSpec:
 
         tpu = d.get("tpu", {}) or {}
         self._tpu = TPUTopology(
-            accelerator=str(tpu.get("accelerator", "v5p")),
+            accelerator=(
+                str(tpu["accelerator"]) if tpu.get("accelerator") is not None else None
+            ),
             topology=_parse_topology(tpu["topology"]) if "topology" in tpu else None,
             ici_bandwidth_gbps=float(tpu.get("ici_bandwidth_gbps", DEFAULT_ICI_BANDWIDTH_GBPS)),
             dcn_bandwidth_gbps=float(
@@ -327,18 +347,28 @@ class ResourceSpec:
     # ------------------------------------------------------- constructors/io
     @classmethod
     def from_local_devices(cls) -> "ResourceSpec":
-        """Build a spec from the current JAX runtime (single- or multi-host)."""
+        """Build a spec from the current JAX runtime (single- or multi-host).
+
+        Reads the accelerator generation from the runtime's ``device_kind``
+        (e.g. "TPU v5 lite") so HBM-feasibility planning uses the real chip's
+        capacity instead of the conservative unspecified-accelerator default.
+        """
         import jax  # local import: keep L0 importable without jax configured
 
         n_proc = jax.process_count()
         local = jax.local_device_count()
+        d = {}
+        dev0 = jax.devices()[0]
+        if dev0.platform == "tpu":
+            d["tpu"] = {"accelerator": str(dev0.device_kind)}
         if n_proc == 1:
-            return cls(resource_dict={"nodes": [{"address": "localhost", "chips": local, "chief": True}]})
-        nodes = [
-            {"address": f"process-{p}", "chips": local, "chief": p == 0}
-            for p in range(n_proc)
-        ]
-        return cls(resource_dict={"nodes": nodes})
+            d["nodes"] = [{"address": "localhost", "chips": local, "chief": True}]
+        else:
+            d["nodes"] = [
+                {"address": f"process-{p}", "chips": local, "chief": p == 0}
+                for p in range(n_proc)
+            ]
+        return cls(resource_dict=d)
 
     def to_dict(self) -> dict:
         return {
@@ -347,7 +377,11 @@ class ResourceSpec:
                 for n in self._nodes
             ],
             "tpu": {
-                "accelerator": self._tpu.accelerator,
+                **(
+                    {"accelerator": self._tpu.accelerator}
+                    if self._tpu.accelerator is not None
+                    else {}
+                ),
                 **({"topology": "x".join(map(str, self._tpu.topology))} if self._tpu.topology else {}),
                 "ici_bandwidth_gbps": self._tpu.ici_bandwidth_gbps,
                 "dcn_bandwidth_gbps": self._tpu.dcn_bandwidth_gbps,

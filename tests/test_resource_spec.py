@@ -140,3 +140,46 @@ def test_from_local_devices():
     rs = ResourceSpec.from_local_devices()
     assert rs.num_chips == 8  # conftest forces 8 host-platform devices
     assert rs.is_single_node
+
+
+def test_unspecified_accelerator_gets_conservative_hbm():
+    # ADVICE r1 (medium): an unspecified accelerator must NOT default to the
+    # largest-HBM generation — the feasibility check would certify strategies
+    # that OOM on smaller chips. Smallest known generation (v2: 8 GB) wins.
+    rs = ResourceSpec(resource_dict={})
+    assert rs.tpu.accelerator is None
+    assert rs.tpu.hbm_bytes == pytest.approx(8.0e9)
+
+
+def test_device_kind_style_names_resolve():
+    # jax device_kind strings ("TPU v4", "TPU v5 lite") are substrings, not
+    # prefixes — the table lookup must still land on the right generation.
+    from autodist_tpu.resource_spec import TPUTopology
+
+    assert TPUTopology(accelerator="TPU v4").hbm_bytes == pytest.approx(32.0e9)
+    assert TPUTopology(accelerator="TPU v5 lite").hbm_bytes == pytest.approx(16.0e9)
+    assert TPUTopology(accelerator="TPU v5p").hbm_bytes == pytest.approx(95.0e9)
+    assert TPUTopology(accelerator="mystery-chip").hbm_bytes == pytest.approx(8.0e9)
+
+
+def test_from_local_devices_cpu_mesh_leaves_accelerator_unset():
+    # On the CPU test mesh there is no TPU device_kind to read; the spec must
+    # stay conservative rather than inventing a generation.
+    rs = ResourceSpec.from_local_devices()
+    assert rs.tpu.accelerator is None
+
+
+def test_real_device_kind_strings_for_newer_generations():
+    # Real device_kind strings: v5p reports "TPU v5", Trillium "TPU v6 lite".
+    from autodist_tpu.resource_spec import TPUTopology
+
+    assert TPUTopology(accelerator="TPU v5").hbm_bytes == pytest.approx(95.0e9)
+    assert TPUTopology(accelerator="TPU v6 lite").hbm_bytes == pytest.approx(32.0e9)
+    assert TPUTopology(accelerator="TPU v6e").hbm_bytes == pytest.approx(32.0e9)
+
+
+def test_empty_accelerator_key_stays_unset():
+    rs = ResourceSpec(resource_dict={"tpu": {"accelerator": None}})
+    assert rs.tpu.accelerator is None
+    assert "accelerator" not in rs.to_dict()["tpu"]
+    assert rs.fingerprint() == ResourceSpec(resource_dict={}).fingerprint()
