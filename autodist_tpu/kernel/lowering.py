@@ -24,9 +24,12 @@ insert the collectives:
   axis (``NamedSharding``); XLA all-gathers on use and reduce-scatters the
   gradient — a *true* tensor-parallel upgrade of the reference's
   variable-only partitioning (``docs/design/kernels.md:11-17``).
-- sparse-update PS variables (embeddings) → row-sharded on axis 0, keeping
-  the PS sparse-path capability (``ps_synchronizer.py:473-532``) with
-  gather/scatter collectives instead of SparseConditionalAccumulators.
+- sparse-update variables (embeddings) → row-sharded on axis 0 under both
+  PS and AllReduce, keeping the PS sparse-path capability
+  (``ps_synchronizer.py:473-532``) and the sparse-AllReduce wire contract
+  (``all_reduce_synchronizer.py:129-169``: sync cost scales with touched
+  rows) with gather/scatter collectives instead of
+  SparseConditionalAccumulators / collective all-gathers.
 """
 from __future__ import annotations
 
@@ -69,7 +72,6 @@ class VarPlan:
     compressor: str = "NoneCompressor"
     group: int = 0
     staleness: int = 0
-    sync: bool = True
     reduction_destination: str = ""
     local_replication: bool = False
     num_shards: int = 1
@@ -184,12 +186,19 @@ class GraphTransformer:
         if isinstance(sync, AllReduceSynchronizer):
             kind = SyncKind.ALL_REDUCE
             compressor, group = sync.compressor, sync.group
-            staleness, sync_flag, dest, proxy = 0, True, "", False
+            staleness, dest, proxy = 0, "", False
         else:
             assert isinstance(sync, PSSynchronizer)
+            if not sync.sync:
+                # Builders already reject async PS (base.check_sync_supported);
+                # this guards hand-built / deserialized strategies so the knob
+                # is never silently ignored.
+                from autodist_tpu.strategy.base import check_sync_supported
+
+                check_sync_supported(False)
             kind = SyncKind.PS
             compressor, group = "NoneCompressor", 0
-            staleness, sync_flag = sync.staleness, sync.sync
+            staleness = sync.staleness
             dest, proxy = sync.reduction_destination, sync.local_replication
 
         mesh_shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
@@ -261,11 +270,19 @@ class GraphTransformer:
             )
             pspec = _spec_with_axis(rank, part_axis, shard_ax)
             update_pspec = pspec
-        elif kind is SyncKind.PS and var.sparse_update and rank > 0 and divisible(0):
-            # PS sparse path: row-sharded embedding (axis 0).
+        elif var.sparse_update and rank > 0 and divisible(0):
+            # Sparse path (PS *and* AllReduce): row-sharded embedding
+            # (axis 0). Under PS this is the reference's sharded sparse
+            # table (ps_synchronizer.py:473-532); under AllReduce it is the
+            # TPU rendering of the reference's sparse all-gather sync
+            # (all_reduce_synchronizer.py:129-169) — GSPMD turns the lookup
+            # and its scatter-add gradient into tokens-sized collectives,
+            # so sync wire scales with touched rows, never with the table
+            # (a dense psum of the full table gradient is what a replicated
+            # sparse var would cost).
             pspec = _spec_with_axis(rank, 0, shard_ax)
             update_pspec = pspec
-        elif kind is SyncKind.PS and var.sparse_update and rank > 0 and var.shape[0] > n_shard:
+        elif var.sparse_update and rank > 0 and var.shape[0] > n_shard:
             # Sparse tables need axis-0 (row) sharding for the gather/scatter
             # path regardless of divisibility — pad the rows (the GPT-2
             # prime-vocab case: 50257 rows divide nothing).
@@ -295,7 +312,6 @@ class GraphTransformer:
             compressor=compressor,
             group=group,
             staleness=staleness,
-            sync=sync_flag,
             reduction_destination=dest,
             local_replication=proxy,
             num_shards=node.num_shards,
@@ -887,14 +903,6 @@ class DistributedTrainStep:
         return loss, aux, grads
 
     # ------------------------------------------------- compressed grad sync
-    def _data_only_spec(self, pspec: P, ax: str) -> P:
-        """Restrict a PartitionSpec to the data axis (other axes stay under
-        GSPMD-auto inside the partially-manual shard_map)."""
-        return P(*[
-            ax if (e == ax or (isinstance(e, (tuple, list)) and ax in e)) else None
-            for e in pspec
-        ])
-
     def _compressed_grads(self, state: TrainState, batch):
         """Gradient sync with compression around the data-axis psum.
 
@@ -925,15 +933,15 @@ class DistributedTrainStep:
         mesh = Mesh(mesh.devices.reshape(-1), (ax,))
         compressors = self._compressors
 
-        def spec_for_param(path, leaf):
-            name = _path_name(path)
-            plan = self.plan.var_plans.get(name)
-            return self._data_only_spec(plan.pspec if plan else P(), ax)
-
-        p_leaves, p_treedef = jax.tree_util.tree_flatten_with_path(state.params)
-        param_specs = jax.tree_util.tree_unflatten(
-            p_treedef, [spec_for_param(path, leaf) for path, leaf in p_leaves]
-        )
+        # Every parameter enters the manual region REPLICATED over the data
+        # axis (shard_map all-gathers data-sharded leaves at entry): the
+        # user's loss indexes and matmuls against full-shaped parameters, so
+        # feeding a data-row-sliced leaf (e.g. a row-sharded embedding, or a
+        # ZeRO-sharded kernel) would silently compute garbage — jnp.take
+        # clamps out-of-range ids instead of failing. Grads exit replicated
+        # too (each instance psums the full gradient); GSPMD reshards them
+        # onto the plan's update shardings at the region boundary.
+        param_specs = jax.tree_util.tree_map(lambda _: P(), state.params)
 
         def spec_for_batch(leaf):
             shape = tuple(getattr(leaf, "shape", ()))

@@ -8,8 +8,13 @@ fusion in the shard_map lowering path and is advisory under pure GSPMD
 (XLA fuses collectives itself).
 
 Unlike the reference (sparse + multi-node unsupported, docstring
-all_reduce_strategy.py:28-29), sparse variables are handled natively via
-all-gather of (indices, values).
+all_reduce_strategy.py:28-29), sparse-update variables are supported: the
+lowering row-shards them over the mesh (kernel/lowering.py sparse branch),
+so GSPMD emits tokens-sized gather/scatter collectives for the lookup and
+its gradient — the wire-cost contract of the reference's sparse all-gather
+of (indices, values) (all_reduce_synchronizer.py:129-169), without ever
+all-reducing a dense table-shaped gradient. Compressor/group knobs apply to
+dense variables only, as in the reference.
 """
 from autodist_tpu.model_item import ModelItem
 from autodist_tpu.resource_spec import ResourceSpec

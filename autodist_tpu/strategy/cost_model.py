@@ -283,6 +283,32 @@ class CostModel:
         cands = [d for d in var.shape if d % k == 0 and d >= k]
         return k if cands else 1
 
+    def _sparse_cost(
+        self, var: VarItem, update_traffic_factor: float
+    ) -> Tuple[float, float, float, float, int]:
+        """(comm_s, update_s, param_bytes, extra_bytes, shards) for a
+        row-sharded sparse table — the lowering's sparse branch, which
+        applies under both PS and AllReduce synchronizers.
+
+        Wire: forward row gather + backward scatter-add of touched rows.
+        Residency: row-sharded (over the shard axis, padding if needed)
+        whenever the table has at least axis-size rows, else the dense
+        weight-update axis decides residency.
+        """
+        B = float(var.byte_size)
+        wire = B * self.sparse_touch
+        comm = 2.0 * self._oneway_s(wire)
+        if var.shape and self.n_shard > 1 and var.shape[0] >= self.n_shard:
+            shards = self.n_shard
+            res = self._residency_bytes(var, 0, shards)
+        else:
+            shards = self._update_axis_shards(var)
+            res = B
+        update = update_traffic_factor * B * self.sparse_touch / shards / self.hbm_bw
+        params = res / shards
+        extra = self.slot_factor * res / shards + wire
+        return comm, update, params, extra, shards
+
     # ------------------------------------------------------------ node costs
     def _node_cost(self, node: NodeConfig, var: VarItem) -> Tuple[
         float, float, float, float, float, int, Dict[str, float]
@@ -296,6 +322,15 @@ class CostModel:
 
         if isinstance(sync, AllReduceSynchronizer):
             part_axis = node.active_partition_axis
+            if var.sparse_update and part_axis is None:
+                # Lowering parity: the sparse branch row-shards under
+                # AllReduce exactly like PS (kernel/lowering.py sparse
+                # branch), so the wire is tokens-scaled gather/scatter —
+                # never a dense full-table all-reduce.
+                comm, update, params, extra, _ = self._sparse_cost(
+                    var, update_traffic_factor
+                )
+                return comm, update, 0.0, params, extra, 1, ps_loads
             shards = self._sharded(var, part_axis)
             res = self._residency_bytes(var, part_axis, shards)
             wire = res * COMPRESSOR_WIRE_FACTOR.get(sync.compressor, 1.0)
@@ -330,21 +365,9 @@ class CostModel:
 
         assert isinstance(sync, PSSynchronizer)
         if var.sparse_update:
-            wire = B * self.sparse_touch
-            # forward row gather + backward scatter-add of touched rows
-            comm = 2.0 * self._oneway_s(wire)
-            # lowering parity: row-sharded (over the shard axis, padding if
-            # needed) whenever the table has at least axis-size rows, else
-            # the dense weight-update axis decides residency
-            if var.shape and self.n_shard > 1 and var.shape[0] >= self.n_shard:
-                shards = self.n_shard
-                res = self._residency_bytes(var, 0, shards)
-            else:
-                shards = self._update_axis_shards(var)
-                res = B
-            update = update_traffic_factor * B * self.sparse_touch / shards / self.hbm_bw
-            params = res / shards
-            extra = self.slot_factor * res / shards + wire
+            comm, update, params, extra, shards = self._sparse_cost(
+                var, update_traffic_factor
+            )
         else:
             part_axis = node.active_partition_axis
             if part_axis is not None:

@@ -43,7 +43,11 @@ class PSSynchronizer:
 
     reduction_destination: str = ""  # DeviceSpec string, e.g. "10.0.0.1:CPU:0"
     local_replication: bool = False  # proxy-variable analog: keep a device-local cached copy
-    sync: bool = True                # synchronous updates (async/staleness otherwise)
+    # Serialization parity with the reference proto (synchronizers.proto:28);
+    # sync=False (async PS) has no SPMD rendering and is REJECTED at build
+    # and lowering time (strategy/base.check_sync_supported) — use
+    # staleness=K for bounded-staleness semantics.
+    sync: bool = True
     staleness: int = 0               # bounded staleness in steps (0 = fully sync)
 
 

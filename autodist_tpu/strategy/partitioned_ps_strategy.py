@@ -9,6 +9,7 @@ from autodist_tpu.resource_spec import ResourceSpec
 from autodist_tpu.strategy.base import (
     StrategyBuilder,
     byte_size_load_fn,
+    check_sync_supported,
     min_divisor_shards,
     part_name,
     reduction_devices,
@@ -26,11 +27,10 @@ class PartitionedPS(StrategyBuilder):
     """
 
     def __init__(self, local_proxy_variable: bool = False, sync: bool = True, staleness: int = 0):
+        check_sync_supported(sync)
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
-        if staleness > 0:
-            assert sync, "If staleness is positive, sync has to be set true."
         self.loads: Dict[str, float] = {}
 
     def build(self, model_item: ModelItem, resource_spec: ResourceSpec) -> Strategy:

@@ -4,7 +4,12 @@ from typing import Dict
 
 from autodist_tpu.model_item import ModelItem, VarItem
 from autodist_tpu.resource_spec import ResourceSpec
-from autodist_tpu.strategy.base import StrategyBuilder, byte_size_load_fn, reduction_devices
+from autodist_tpu.strategy.base import (
+    StrategyBuilder,
+    byte_size_load_fn,
+    check_sync_supported,
+    reduction_devices,
+)
 from autodist_tpu.strategy.ir import NodeConfig, PSSynchronizer, Strategy
 
 
@@ -12,11 +17,10 @@ class PSLoadBalancing(StrategyBuilder):
     """Greedy bin-packing of variables onto reduction destinations by bytes."""
 
     def __init__(self, local_proxy_variable: bool = False, sync: bool = True, staleness: int = 0):
+        check_sync_supported(sync)
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
-        if staleness > 0:
-            assert sync, "If staleness is positive, sync has to be set true."
         self.loads: Dict[str, float] = {}
 
     def build(self, model_item: ModelItem, resource_spec: ResourceSpec) -> Strategy:

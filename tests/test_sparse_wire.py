@@ -1,0 +1,129 @@
+"""Sparse gradient sync wire cost: touched rows, never the table.
+
+The reference all-gathers (indices, values) for sparse grads under
+AllReduce (``all_reduce_synchronizer.py:129-169``) so sync wire scales
+with rows actually touched. The TPU rendering row-shards sparse tables;
+these tests inspect the compiled HLO and assert no collective moves a
+table-shaped operand — the failure mode VERDICT r1 flagged (a replicated
+sparse var under AllReduce psums the full dense table gradient).
+"""
+import re
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from autodist_tpu.kernel.lowering import DistributedTrainStep, GraphTransformer
+from autodist_tpu.kernel.mesh import build_mesh
+from autodist_tpu.model_item import ModelItem, OptimizerSpec
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+from autodist_tpu.strategy.parallax_strategy import Parallax
+from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
+
+VOCAB, EDIM, BATCH = 4096, 16, 64
+TABLE_ELEMS = VOCAB * EDIM
+
+_COLLECTIVES = (
+    "all-reduce(",
+    "all-gather(",
+    "reduce-scatter(",
+    "all-to-all(",
+    "collective-permute(",
+)
+
+
+def _embed_loss(params, batch):
+    ids, y = batch
+    x = jnp.take(params["embedding"], ids, axis=0)
+    pred = (x @ params["w"]).squeeze(-1)
+    return jnp.mean((pred - y) ** 2)
+
+
+def _setup(builder):
+    k = jax.random.PRNGKey(0)
+    params = {
+        "embedding": jax.random.normal(k, (VOCAB, EDIM)),
+        "w": jax.random.normal(k, (EDIM, 1)),
+    }
+    batch = (
+        jax.random.randint(k, (BATCH,), 0, VOCAB),
+        jax.random.normal(k, (BATCH,)),
+    )
+    rs = ResourceSpec(
+        resource_dict={"nodes": [{"address": "localhost", "chips": 8, "chief": True}]}
+    )
+    opt = OptimizerSpec("sgd", {"learning_rate": 0.1})
+    mi = ModelItem.from_params(
+        params, optimizer_spec=opt, loss_fn=_embed_loss, example_batch=batch
+    )
+    from autodist_tpu.strategy.base import StrategyCompiler
+
+    strategy = StrategyCompiler(mi).compile(builder.build(mi, rs))
+    plan = GraphTransformer(strategy, mi, build_mesh(rs)).transform()
+    step = DistributedTrainStep(plan, _embed_loss, opt.make())
+    state = step.init(params)
+    return step, state, batch, plan
+
+
+def _collective_sizes(hlo_text):
+    """Element count of every collective's largest array in the program."""
+    sizes = []
+    for line in hlo_text.splitlines():
+        if "=" not in line or not any(op in line for op in _COLLECTIVES):
+            continue
+        # Result shapes sit between '=' and the op name, e.g.
+        #   %all-reduce.3 = (f32[4096,16]{1,0}, f32[]) all-reduce(...)
+        lhs = line.split("=", 1)[1]
+        shapes = re.findall(r"[a-z][0-9a-z]*\[([0-9,]*)\]", lhs)
+        for s in shapes:
+            dims = [int(d) for d in s.split(",") if d]
+            n = 1
+            for d in dims:
+                n *= d
+            sizes.append(n)
+    return sizes
+
+
+@pytest.mark.parametrize(
+    "builder", [AllReduce(), PSLoadBalancing(), Parallax()],
+    ids=["AllReduce", "PSLoadBalancing", "Parallax"],
+)
+def test_no_table_sized_collective(builder):
+    step, state, batch, plan = _setup(builder)
+    table_plan = plan.plan_for("embedding")
+    # The table must actually be row-sharded for the wire claim to hold.
+    assert table_plan.pspec[0] is not None, table_plan
+    hlo = step._compile(state, batch).lower(state, batch).compile().as_text()
+    sizes = _collective_sizes(hlo)
+    assert sizes, "expected gradient-sync collectives in the compiled step"
+    # Every collective payload must be far below the table size: sync wire
+    # scales with touched rows (<= BATCH), not VOCAB. The per-shard bound
+    # (TABLE/8) would already prove no full-table collective; tokens-scale
+    # collectives are smaller still.
+    assert max(sizes) < TABLE_ELEMS // 4, (
+        f"table-sized collective found: max {max(sizes)} elems "
+        f"(table={TABLE_ELEMS}); sizes={sorted(sizes, reverse=True)[:6]}"
+    )
+
+
+def test_replicated_table_would_psum_full_table():
+    # Control experiment: force the old lowering (replicated sparse var) and
+    # confirm the dense full-table all-reduce appears — i.e. the assertion
+    # above is actually detecting the failure mode, not vacuously true.
+    step, state, batch, plan = _setup(AllReduce())
+    from jax.sharding import PartitionSpec as P
+
+    tp = plan.plan_for("embedding")
+    tp.pspec = P()
+    tp.update_pspec = P()
+    step2 = DistributedTrainStep(plan, _embed_loss, OptimizerSpec("sgd", {"learning_rate": 0.1}).make())
+    k = jax.random.PRNGKey(0)
+    params = {
+        "embedding": jax.random.normal(k, (VOCAB, EDIM)),
+        "w": jax.random.normal(k, (EDIM, 1)),
+    }
+    state2 = step2.init(params)
+    hlo = step2._compile(state2, batch).lower(state2, batch).compile().as_text()
+    sizes = _collective_sizes(hlo)
+    assert sizes and max(sizes) >= TABLE_ELEMS
