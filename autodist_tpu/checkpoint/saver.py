@@ -6,29 +6,38 @@ replicated) run restores into a vanilla single-node graph and vice versa
 ``tests/checkpoint/test_partitionedPS_saver.py``). The mechanism there was
 name surgery + ``SaveSliceInfo`` shard merging. Here:
 
-- **save**: every leaf of the state pytree is materialized as the full
-  logical array (``np.asarray`` on a sharded ``jax.Array`` assembles all
-  shards; on multi-host, non-addressable arrays are all-gathered first) and
-  written to ``<dir>/<pytree-path>.npy`` — the pytree path *is* the original
-  single-device name, so no mapping table is needed.
-- **restore**: leaves are loaded by name and ``device_put`` with the
-  *destination's* shardings — re-partitioning on load replaces
+- **save**: leaves are written to ``<dir>/<pytree-path>*.npy`` — the pytree
+  path *is* the original single-device name, so no mapping table is needed.
+  A sharded ``jax.Array`` is written as ONE FILE PER SHARD BLOCK, each by
+  the process that owns the block's first device, so no process ever
+  materializes the full logical array and hosts write in parallel (the
+  orbax/OCDBT-style scheme; the reference's analog was ``SaveSliceInfo``
+  shards, partitioner.py:292-308). Replicated / host leaves are written
+  whole by their owner process. ``metadata.json`` records each entry's
+  logical shape plus the block layout in logical coordinates.
+- **restore**: leaves are loaded by name. With destination shardings, each
+  process reads ONLY the file regions overlapping its addressable shards
+  (``np.load(mmap_mode="r")`` + ``jax.make_array_from_callback``) — a
+  parallel, partial read; re-partitioning on load replaces
   ``SaveSliceInfo``. Restoring a PartitionedPS-trained checkpoint into an
   unpartitioned model (or a differently-sized mesh) is therefore the same
   code path as same-sharding restore.
 
-Layout: ``<dir>/metadata.json`` + one ``.npy`` per leaf in nested dirs.
+Layout: ``<dir>/metadata.json`` + per-leaf ``<name>.npy`` (whole) or
+``<name>.shard<j>.npy`` (block ``j``) files in nested dirs. Multi-host
+saves assume a shared filesystem (as the reference's NFS saver case c10
+did).
 
-Pad-and-mask plans (non-divisible shard axes) store parameters padded; save
-``step.logical_state(state)`` — identity for unpadded plans — so the
-checkpoint always holds logical shapes, and ``step.init_or_restore``
-re-pads on load.
+Pad-and-mask plans (non-divisible shard axes) store parameters padded;
+save through ``step.save(saver, state)`` (or pass
+``step.logical_state(state)`` yourself) so the checkpoint always holds
+logical shapes, and ``step.init_or_restore`` re-pads on load.
 """
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -37,19 +46,52 @@ from autodist_tpu import const
 from autodist_tpu.model_item import _path_to_name
 from autodist_tpu.utils import logging
 
-_FORMAT_VERSION = 1
+_FORMAT_VERSION = 2
 
 
 def _to_host(leaf) -> np.ndarray:
     """Full logical value of a (possibly sharded) array on the host."""
     if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
-        # Multi-host: assemble the global value before writing. tiled=True
-        # reassembles shards into the global shape (the default would stack
-        # a leading per-process dim — and is rejected for global arrays).
+        # Multi-host fallback (only for leaves without a block layout):
+        # assemble the global value before writing. tiled=True reassembles
+        # shards into the global shape.
         from jax.experimental import multihost_utils
 
         leaf = multihost_utils.process_allgather(leaf, tiled=True)
     return np.asarray(leaf)
+
+
+def _norm_block(index, shape) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """A device's index (tuple of slices) → (start, stop) in logical coords."""
+    start, stop = [], []
+    for sl, dim in zip(index, shape):
+        start.append(0 if sl.start is None else int(sl.start))
+        stop.append(dim if sl.stop is None else int(sl.stop))
+    return tuple(start), tuple(stop)
+
+
+def _block_layout(leaf: jax.Array):
+    """Unique shard blocks of ``leaf`` with their writer processes.
+
+    Returns ``[(start, stop, writer_process, local_shard_or_None), ...]``
+    sorted by start coordinates — identical on every process (the layout
+    derives from the sharding alone, the same cross-process-agreement trick
+    the reference used for collective keys)."""
+    imap = leaf.sharding.devices_indices_map(leaf.shape)
+    blocks: Dict[Tuple, Dict[str, Any]] = {}
+    for dev, index in imap.items():
+        start, stop = _norm_block(index, leaf.shape)
+        b = blocks.setdefault((start, stop), {"min_id": None, "writer": None})
+        if b["min_id"] is None or dev.id < b["min_id"]:
+            b["min_id"] = dev.id
+            b["writer"] = dev.process_index
+    local = {}
+    for shard in leaf.addressable_shards:
+        local[_norm_block(shard.index, leaf.shape)] = shard
+    return [
+        (start, stop, blocks[(start, stop)]["writer"], local.get((start, stop)))
+        for start, stop in sorted(blocks)
+    ]
 
 
 class Saver:
@@ -79,12 +121,67 @@ class Saver:
         )
 
     # ------------------------------------------------------------------ save
+    def _collect(self, tree) -> Tuple[Dict[str, dict], List[Tuple[str, Any]]]:
+        """(metadata entries for ALL leaves, files THIS process writes).
+
+        Entries are identical on every process; the file list covers only
+        blocks whose writer is this process (block writer = owner of the
+        block's lowest-id device), so hosts write disjoint files in
+        parallel and nothing is globally assembled.
+
+        File values stay LAZY (device shard objects / original leaves): the
+        blocking write path converts one at a time so peak host memory is
+        ~one shard; the async path materializes everything up front for
+        donation safety (see :meth:`save`).
+        """
+        leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+        proc = jax.process_index()
+        entries: Dict[str, dict] = {}
+        local_files: List[Tuple[str, Any]] = []
+        for p, leaf in leaves:
+            name = _path_to_name(p)
+            if isinstance(leaf, jax.Array) and getattr(leaf, "sharding", None) is not None:
+                layout = _block_layout(leaf)
+                entry: Dict[str, Any] = {
+                    "shape": list(leaf.shape),
+                    "dtype": str(leaf.dtype),
+                }
+                if len(layout) == 1:
+                    # One block == replicated or single-device: plain file,
+                    # written by the block's owner process (no allgather).
+                    _, _, writer, shard = layout[0]
+                    if writer == proc:
+                        local_files.append((name + ".npy", shard.data))
+                else:
+                    entry["shards"] = []
+                    for j, (start, stop, writer, shard) in enumerate(layout):
+                        fname = f"{name}.shard{j}.npy"
+                        entry["shards"].append(
+                            {"start": list(start), "stop": list(stop), "file": fname}
+                        )
+                        if writer == proc:
+                            assert shard is not None, (
+                                f"{name}: writer process {proc} holds no shard "
+                                f"for block {start}:{stop}"
+                            )
+                            local_files.append((fname, shard.data))
+                entries[name] = entry
+            else:
+                shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+                dtype = getattr(leaf, "dtype", None) or np.asarray(leaf).dtype
+                entries[name] = {"shape": list(shape), "dtype": str(np.dtype(dtype))}
+                if proc == 0:
+                    local_files.append((name + ".npy", leaf))
+        return entries, local_files
+
     def save(self, tree: Any, path: Optional[str] = None, step: Optional[int] = None,
              block: bool = True) -> str:
         """Write ``tree`` to ``path`` (default ``<directory>/ckpt-<step>``).
 
-        On multi-host only process 0 writes (after global assembly); all
-        processes return the same path.
+        On multi-host every process writes its own shard blocks (shared
+        filesystem assumed); process 0 writes whole-array leaves and the
+        metadata, and performs the atomic swap. All processes return the
+        same path after a completion barrier.
 
         ``block=False`` overlaps the file IO with training: leaves are
         fetched to host *on the calling thread* (mandatory — the train step
@@ -99,57 +196,66 @@ class Saver:
             # Step-less saves land in ckpt-0 so latest_checkpoint()/_gc see
             # them; a bare "ckpt" dir would be invisible to both.
             path = os.path.join(self.directory, f"ckpt-{step or 0}")
-        leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+        entries, local_files = self._collect(tree)
 
         if not block and jax.process_count() == 1:
             import threading
 
-            # Async must materialize every leaf NOW (donation safety); the
-            # blocking path below streams one leaf at a time instead, so
-            # peak host memory stays ~one leaf.
-            host_leaves = [(_path_to_name(p), _to_host(leaf)) for p, leaf in leaves]
+            # Async must materialize every leaf NOW (donation safety: the
+            # train step donates its state buffers, so device values must
+            # be captured before the next step runs); the blocking path
+            # streams one file at a time instead, so peak host memory
+            # stays ~one shard.
+            local_files = [(f, _to_host(v)) for f, v in local_files]
             # Non-daemon: a normal interpreter exit waits for the write
             # instead of killing it mid-file.
             self._pending = threading.Thread(
-                target=self._write_guarded, args=(path, step, host_leaves)
+                target=self._write_guarded, args=(path, step, entries, local_files)
             )
             self._pending.start()
             return path
 
-        self._write(path, step,
-                    ((_path_to_name(p), _to_host(leaf)) for p, leaf in leaves))
-        if jax.process_count() > 1:
-            # Barrier: no process may see `path` as "saved" until the writer
-            # has finished metadata.json (otherwise a non-writer's immediate
-            # restore races a half-written checkpoint).
-            from jax.experimental import multihost_utils
-
-            multihost_utils.sync_global_devices(f"autodist_tpu:save:{path}")
+        self._write(path, step, entries, local_files)
         return path
 
-    def _write(self, path: str, step: Optional[int], host_leaves) -> None:
-        """Write atomically: stage into ``<path>.tmp`` and rename, so a
-        killed writer never leaves a metadata-less ckpt dir that
-        ``restore_latest`` would trip over."""
+    def _write(self, path: str, step: Optional[int], entries: Dict[str, dict],
+               local_files: Sequence[Tuple[str, np.ndarray]]) -> None:
+        """Write atomically: stage into a tmp dir and rename, so a killed
+        writer never leaves a metadata-less ckpt dir that
+        ``restore_latest`` would trip over. Multi-host: all processes stage
+        into the SAME tmp dir (deterministic name), with barriers around
+        the stage → metadata → swap sequence."""
         import glob
         import shutil
 
-        entries: Dict[str, dict] = {}
-        is_writer = jax.process_index() == 0
-        tmp = path + f".tmp-{os.getpid()}"
-        if is_writer:
+        multi = jax.process_count() > 1
+        is_chief = jax.process_index() == 0
+        # Multi-host needs one shared stage dir; single-process keeps the
+        # pid suffix so two independent savers cannot collide.
+        tmp = path + (".tmp" if multi else f".tmp-{os.getpid()}")
+
+        def barrier(tag: str) -> None:
+            if multi:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(f"autodist_tpu:save:{tag}:{path}")
+
+        if is_chief:
             # Sweep leftovers of earlier killed writers (full-checkpoint-
             # sized garbage that _list_checkpoints deliberately ignores).
-            for stale in glob.glob(path + ".tmp-*") + glob.glob(path + ".old-*"):
+            for stale in glob.glob(path + ".tmp*") + glob.glob(path + ".old-*"):
                 if stale != tmp:
                     shutil.rmtree(stale, ignore_errors=True)
-        for name, value in host_leaves:
-            entries[name] = {"shape": list(value.shape), "dtype": str(value.dtype)}
-            if is_writer:
-                fpath = os.path.join(tmp, name + ".npy")
-                os.makedirs(os.path.dirname(fpath), exist_ok=True)
-                np.save(fpath, value)
-        if is_writer:
+            os.makedirs(tmp, exist_ok=True)
+        barrier("staged-dir")  # nobody writes before the sweep/mkdir
+        for fname, value in local_files:
+            fpath = os.path.join(tmp, fname)
+            os.makedirs(os.path.dirname(fpath), exist_ok=True)
+            # Host conversion happens here, one file at a time (lazy values
+            # from _collect), bounding peak host memory at ~one shard.
+            np.save(fpath, _to_host(value))
+        barrier("files-written")  # metadata only after every block landed
+        if is_chief:
             meta = {"format_version": _FORMAT_VERSION, "step": step, "entries": entries}
             os.makedirs(tmp, exist_ok=True)
             with open(os.path.join(tmp, "metadata.json"), "w", encoding="utf-8") as f:
@@ -163,11 +269,12 @@ class Saver:
             os.rename(tmp, path)
             shutil.rmtree(old, ignore_errors=True)
             self._gc()
+        barrier("swapped")  # no process may see `path` before the swap
         logging.info("saved checkpoint with %d arrays -> %s", len(entries), path)
 
-    def _write_guarded(self, path: str, step: Optional[int], host_leaves) -> None:
+    def _write_guarded(self, path, step, entries, local_files) -> None:
         try:
-            self._write(path, step, host_leaves)
+            self._write(path, step, entries, local_files)
         except BaseException as e:  # re-raised from wait()
             self._pending_error = e
 
@@ -190,6 +297,63 @@ class Saver:
             shutil.rmtree(os.path.join(self.directory, stale), ignore_errors=True)
 
     # --------------------------------------------------------------- restore
+    @staticmethod
+    def _read_region(path: str, name: str, entry: dict,
+                     start: Sequence[int], stop: Sequence[int]) -> np.ndarray:
+        """Read the logical region [start, stop) of an entry, touching only
+        the shard files that overlap it (mmap'd, partial reads)."""
+        req_shape = tuple(b - a for a, b in zip(start, stop))
+        shards = entry.get("shards")
+        if shards is None:
+            data = np.load(os.path.join(path, name + ".npy"), mmap_mode="r")
+            region = data[tuple(slice(a, b) for a, b in zip(start, stop))]
+            return np.asarray(region)
+        out: Optional[np.ndarray] = None
+        for sh in shards:
+            s_start, s_stop = sh["start"], sh["stop"]
+            lo = [max(a, sa) for a, sa in zip(start, s_start)]
+            hi = [min(b, sb) for b, sb in zip(stop, s_stop)]
+            if any(a >= b for a, b in zip(lo, hi)):
+                continue
+            data = np.load(os.path.join(path, sh["file"]), mmap_mode="r")
+            src = tuple(slice(a - sa, b - sa) for a, b, sa in zip(lo, hi, s_start))
+            if tuple(lo) == tuple(start) and tuple(hi) == tuple(stop):
+                # Exact cover by one shard: no assembly buffer needed.
+                return np.asarray(data[src])
+            if out is None:
+                out = np.empty(req_shape, dtype=np.dtype(entry["dtype"]))
+            dst = tuple(slice(a - ra, b - ra) for a, b, ra in zip(lo, hi, start))
+            out[dst] = data[src]
+        if out is None:
+            raise ValueError(
+                f"checkpoint entry {name!r}: no shard overlaps region "
+                f"{start}:{stop} — corrupt block layout"
+            )
+        return out
+
+    def _load_entry(self, path: str, name: str, entry: dict,
+                    sharding=None, dtype=None) -> Any:
+        """One entry → host ndarray, or a sharded jax.Array when a
+        destination sharding is given (each process reads only the regions
+        its devices need). ``dtype`` casts per-region on read
+        (cross-precision restore stays a partial, parallel read)."""
+        shape = tuple(entry["shape"])
+
+        def region(start, stop):
+            value = self._read_region(path, name, entry, start, stop)
+            if dtype is not None and value.dtype != np.dtype(dtype):
+                value = value.astype(np.dtype(dtype))
+            return value
+
+        if sharding is None:
+            return region((0,) * len(shape), shape)
+
+        def cb(index):
+            start, stop = _norm_block(index, shape)
+            return region(start, stop)
+
+        return jax.make_array_from_callback(shape, sharding, cb)
+
     def restore(self, path: str, target: Any = None, shardings: Any = None) -> Any:
         """Load a checkpoint.
 
@@ -198,9 +362,10 @@ class Saver:
         With ``target`` (a pytree of arrays or ShapeDtypeStructs), leaves are
         matched by pytree-path name — extra checkpoint entries are ignored,
         missing ones raise. With ``shardings`` (same structure), each loaded
-        leaf is ``device_put`` onto its destination sharding, which is where
-        cross-sharding restore happens. Without ``target``, the nested-dict
-        structure is rebuilt from the stored names.
+        leaf lands directly in its destination sharding — every process
+        reads only the blocks its devices own, which is where cross-sharding
+        restore happens. Without ``target``, the nested-dict structure is
+        rebuilt from the stored names as host numpy arrays.
         """
         self.wait()
         meta = self.read_metadata(path)
@@ -213,12 +378,12 @@ class Saver:
                     "host numpy arrays"
                 )
             out: Dict[str, Any] = {}
-            for name in entries:
+            for name, entry in entries.items():
                 node = out
                 parts = name.split("/")
                 for part in parts[:-1]:
                     node = node.setdefault(part, {})
-                node[parts[-1]] = np.load(os.path.join(path, name + ".npy"))
+                node[parts[-1]] = self._load_entry(path, name, entry)
             return out
         leaves, treedef = jax.tree_util.tree_flatten_with_path(target)
         shard_leaves = (
@@ -234,22 +399,33 @@ class Saver:
                     f"checkpoint {path} has no entry {name!r} "
                     f"(has: {sorted(entries)[:8]}...)"
                 )
-            value = np.load(os.path.join(path, name + ".npy"))
-            want_shape = tuple(getattr(leaf, "shape", value.shape))
-            if tuple(value.shape) != want_shape:
+            entry = entries[name]
+            want_shape = tuple(getattr(leaf, "shape", tuple(entry["shape"])))
+            if tuple(entry["shape"]) != want_shape:
                 raise ValueError(
-                    f"checkpoint entry {name!r} has shape {value.shape}, "
-                    f"target wants {want_shape} — checkpoints store the "
-                    f"logical (unpartitioned) tensor, so this is a real "
-                    f"model mismatch, not a sharding difference"
+                    f"checkpoint entry {name!r} has shape "
+                    f"{tuple(entry['shape'])}, target wants {want_shape} — "
+                    f"checkpoints store the logical (unpartitioned) tensor, "
+                    f"so this is a real model mismatch, not a sharding "
+                    f"difference. If this state came from a pad-and-mask "
+                    f"plan, save it with step.save(saver, state) (or pass "
+                    f"step.logical_state(state)) so padded storage shapes "
+                    f"never reach the checkpoint."
                 )
+            # Cross-precision restore (e.g. f32 checkpoint into a bf16 run)
+            # casts to the destination, like the shape contract: the target
+            # defines the run's signature. The cast rides the block-wise
+            # read, so it stays a partial, parallel load.
             want_dtype = getattr(leaf, "dtype", None)
-            if want_dtype is not None and value.dtype != np.dtype(want_dtype):
-                # Cross-precision restore (e.g. f32 checkpoint into a bf16
-                # run) casts to the destination, like the shape contract:
-                # the target defines the run's signature.
-                value = value.astype(np.dtype(want_dtype))
-            out_leaves.append(jax.device_put(value, shard) if shard is not None else value)
+            cast = (
+                np.dtype(want_dtype)
+                if want_dtype is not None
+                and np.dtype(entry["dtype"]) != np.dtype(want_dtype)
+                else None
+            )
+            out_leaves.append(
+                self._load_entry(path, name, entry, sharding=shard, dtype=cast)
+            )
         return jax.tree_util.tree_unflatten(treedef, out_leaves)
 
     # ------------------------------------------------------------- utilities

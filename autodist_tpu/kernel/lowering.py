@@ -675,7 +675,11 @@ class DistributedTrainStep:
                 logging.warning(
                     "compressor %s on %s ignored: var is sharded over the data "
                     "axis (sparse/ZeRO path has no gradient all-reduce to "
-                    "compress)", p.compressor, name,
+                    "compress). NOTE: with any compressor active this var "
+                    "enters the compressed grad region replicated, so its "
+                    "sync pays full-size (table-scale) wire — avoid "
+                    "compressors on embedding-heavy AllReduce models",
+                    p.compressor, name,
                 )
                 continue
             out[name] = get_compressor(p.compressor)
@@ -1191,6 +1195,18 @@ class DistributedTrainStep:
             )
             self._compiled_eval[key] = fn
         return fn(state.params, batch)
+
+    def save(self, saver, state: TrainState, path: Optional[str] = None,
+             step: Optional[int] = None, block: bool = True) -> str:
+        """Checkpoint ``state`` in its LOGICAL shapes — the safe way to save
+        a train state (ADVICE r1: a plain ``saver.save(state)`` under a
+        pad-and-mask plan would write padded storage shapes that no other
+        plan could restore). Defaults the checkpoint step to the state's
+        own step counter. ``init_or_restore`` is the matching load."""
+        if step is None:
+            step = int(state.step)
+        return saver.save(self.logical_state(state), path=path, step=step,
+                          block=block)
 
     def init_or_restore(self, params, saver) -> TrainState:
         """Fresh state, or the latest checkpoint when one exists — the

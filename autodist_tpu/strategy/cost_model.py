@@ -323,6 +323,26 @@ class CostModel:
         if isinstance(sync, AllReduceSynchronizer):
             part_axis = node.active_partition_axis
             if var.sparse_update and part_axis is None:
+                compressed = (
+                    sync.compressor not in ("", "NoneCompressor")
+                    and self.n_model == 1
+                )
+                if compressed:
+                    # Lowering parity for the compressed path: an active
+                    # compressor routes the whole grad computation through
+                    # the data-manual shard_map, which feeds every param in
+                    # REPLICATED — the table all-gathers in and its dense
+                    # gradient psums at full size (_compressed_grads),
+                    # erasing the sparse wire savings. Price that honestly
+                    # rather than reporting tokens-scaled comm for a
+                    # table-scaled program. (On non-pure-DP meshes
+                    # compression is disabled and the sparse path below
+                    # applies.)
+                    comm = self._oneway_s(B) + self.allreduce_s(B)
+                    update = update_traffic_factor * B / self.hbm_bw
+                    params = B  # materialized replicated inside the step
+                    extra = self.slot_factor * B + B
+                    return comm, update, 0.0, params, extra, 1, ps_loads
                 # Lowering parity: the sparse branch row-shards under
                 # AllReduce exactly like PS (kernel/lowering.py sparse
                 # branch), so the wire is tokens-scaled gather/scatter —

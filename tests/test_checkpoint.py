@@ -286,3 +286,115 @@ def test_overwrite_sweeps_orphans_and_keeps_a_checkpoint(tmp_path):
     assert entries == ["ckpt-5"], entries
     restored = saver.restore(os.path.join(str(tmp_path), "ckpt-5"))
     assert float(restored["w"][0]) == 2.0
+
+
+class TestShardedLayout:
+    """v2 format: sharded arrays write one file per shard block, written by
+    the block owner, and restore reads only each device's regions — no
+    process ever assembles a full logical array (VERDICT r1 next #5)."""
+
+    def test_sharded_leaf_writes_block_files(self, tmp_path):
+        step, params = build_step(PartitionedPS())
+        state = step.init(params)
+        saver = Saver(directory=str(tmp_path))
+        path = step.save(saver, state)
+        meta = Saver.read_metadata(path)
+        w = meta["entries"]["params/w"]
+        assert "shards" in w and len(w["shards"]) > 1
+        for sh in w["shards"]:
+            assert os.path.exists(os.path.join(path, sh["file"]))
+        # Blocks tile the logical shape exactly.
+        rows = sorted((sh["start"][0], sh["stop"][0]) for sh in w["shards"])
+        assert rows[0][0] == 0 and rows[-1][1] == w["shape"][0]
+        for (_, stop_prev), (start_next, _) in zip(rows, rows[1:]):
+            assert stop_prev == start_next
+
+    def test_sharded_save_never_assembles_globally(self, tmp_path, monkeypatch):
+        import autodist_tpu.checkpoint.saver as saver_mod
+
+        step, params = build_step(PartitionedPS())
+        state = step.init(params)
+
+        orig = saver_mod._to_host
+
+        def guarded(leaf):
+            if isinstance(leaf, jax.Array) and not leaf.sharding.is_fully_replicated \
+                    and leaf.ndim > 0 and len(leaf.sharding.device_set) > 1:
+                raise AssertionError(
+                    f"sharded leaf {leaf.shape} took the global-assembly path"
+                )
+            return orig(leaf)
+
+        monkeypatch.setattr(saver_mod, "_to_host", guarded)
+        saver = Saver(directory=str(tmp_path))
+        path = step.save(saver, state)
+        # And the restore round-trips through the block reader.
+        restored = saver.restore(
+            path,
+            target=jax.eval_shape(lambda: state),
+            shardings=step.plan.state_shardings(jax.eval_shape(lambda: state)),
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6
+            ),
+            jax.device_get(restored.params),
+            jax.device_get(state.params),
+        )
+
+    def test_sharded_restores_into_unsharded_and_back(self, tmp_path):
+        step, params = build_step(PartitionedPS())
+        state = step.init(params)
+        batch = make_batch()
+        state, _ = step(state, batch)
+        saver = Saver(directory=str(tmp_path))
+        path = step.save(saver, state)
+        # Plain-host restore (vanilla single-device view) assembles blocks.
+        plain = saver.restore(path)
+        np.testing.assert_allclose(
+            plain["params"]["w"], np.asarray(step.logical_params(state)["w"]),
+            rtol=1e-6,
+        )
+        # And an AllReduce (replicated) run restores the same checkpoint.
+        step2, _ = build_step(AllReduce())
+        state2 = step2.init(params)
+        restored = step2.init_or_restore(params, saver)
+        np.testing.assert_allclose(
+            np.asarray(restored.params["w"]),
+            np.asarray(step.logical_params(state)["w"]),
+            rtol=1e-6,
+        )
+
+    def test_step_save_helper_uses_logical_shapes(self, tmp_path):
+        # Pad-and-mask plan: step.save writes logical shapes; a raw
+        # saver.save(state) writes padded storage, and restoring it then
+        # fails with the actionable step.save hint (ADVICE r1 item 4).
+        from autodist_tpu.model_item import ModelItem
+        from autodist_tpu.resource_spec import ResourceSpec
+        from autodist_tpu.strategy import UnevenPartitionedPS
+
+        spec = ResourceSpec(resource_dict={
+            "nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+        mesh = build_mesh(spec, axes=("data",))
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (10, 6))}
+
+        def ploss(p, b):
+            return jnp.mean((b[0] @ p["w"].T - b[1]) ** 2)
+
+        mi = ModelItem.from_params(
+            params, optimizer_spec=OptimizerSpec("sgd", {"learning_rate": 0.1}))
+        strategy = StrategyCompiler(mi).compile(UnevenPartitionedPS().build(mi, spec))
+        plan = GraphTransformer(strategy, mi, mesh).transform()
+        assert plan.has_padding
+        pstep = DistributedTrainStep(plan, ploss, optax.sgd(0.1))
+        state = pstep.init(params)
+
+        saver = Saver(directory=str(tmp_path / "good"))
+        path = pstep.save(saver, state)
+        assert tuple(Saver.read_metadata(path)["entries"]["params/w"]["shape"]) == (10, 6)
+
+        bad_saver = Saver(directory=str(tmp_path / "bad"))
+        bad_path = bad_saver.save(state, step=7)
+        logical = jax.eval_shape(pstep.plan.unpad_state, jax.eval_shape(lambda: state))
+        with pytest.raises(ValueError, match="step.save"):
+            bad_saver.restore(bad_path, target=logical)

@@ -398,3 +398,18 @@ class TestHBMTable:
         rt = ResourceSpec(resource_dict=spec.to_dict())
         assert rt.tpu.hbm_bytes == pytest.approx(32.0e9)
         assert rt.fingerprint() == spec.fingerprint()
+
+
+def test_compressed_sparse_allreduce_priced_table_scale():
+    # With a compressor active (pure-DP mesh), the compressed shard_map
+    # feeds the table in replicated and psums its dense gradient — the cost
+    # model must price table-scale wire, not tokens-scale (r2 review).
+    from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+
+    item = _item({"emb": (1 << 18, 64), "w": (64, 64)}, sparse=("emb",))
+    spec = _single()
+    cm = CostModel(item, spec)
+    plain = cm.strategy_cost(AllReduce().build(item, spec))
+    compressed = cm.strategy_cost(
+        AllReduce(compressor="HorovodCompressor").build(item, spec))
+    assert compressed.comm_s > plain.comm_s * 5

@@ -398,3 +398,78 @@ def test_two_process_dataloader_feed(tmp_path):
         [sys.executable, str(script)], 2, coordinator_port=_free_port(), base_env=env
     )
     assert code == 0
+
+
+@pytest.mark.integration
+def test_two_process_sharded_checkpoint(tmp_path):
+    """v2 sharded checkpoints on a real 2-process fleet: each process
+    writes only its own shard blocks (no process-0 global assembly —
+    process_allgather is rigged to fail), and the sharded restore reads
+    back block-wise into the same sharding (VERDICT r1 next #5)."""
+    script = tmp_path / "ckpt.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        from autodist_tpu.runtime.launcher import initialize_from_env
+        initialize_from_env()
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        assert jax.process_count() == 2
+
+        mesh = Mesh(np.array(jax.devices()).reshape(4), ("data",))
+        sharding = NamedSharding(mesh, P("data", None))
+        local = np.arange(8, dtype=np.float32).reshape(2, 4) + 10 * jax.process_index()
+        x = jax.make_array_from_process_local_data(sharding, local, (4, 4))
+        replicated = jax.device_put(
+            np.float32(3.5), NamedSharding(mesh, P()))
+
+        # Any global-assembly fallback on a distributed array leaf is a
+        # failure: every jax.Array must ride the block layout. (The save
+        # barrier itself legitimately uses collectives, so the guard sits
+        # on the saver's assembly helper, not on process_allgather.)
+        import autodist_tpu.checkpoint.saver as saver_mod
+        _orig_to_host = saver_mod._to_host
+        def _banned(leaf):
+            # Local shard conversion is fine; assembling a globally-sharded
+            # array (the process_allgather branch) is the failure mode.
+            if hasattr(leaf, "sharding") and not leaf.is_fully_addressable:
+                raise AssertionError("_to_host on a non-addressable array: "
+                                     "a sharded leaf took the "
+                                     "global-assembly path")
+            return _orig_to_host(leaf)
+        saver_mod._to_host = _banned
+
+        from autodist_tpu.checkpoint import Saver
+        saver = Saver(directory=os.environ["AUTODIST_TEST_CKPT_DIR"])
+        path = saver.save({"w": x, "c": replicated}, step=2)
+
+        meta = Saver.read_metadata(path)
+        shards = meta["entries"]["w"]["shards"]
+        assert len(shards) == 4, meta
+        for sh in shards:
+            assert os.path.exists(os.path.join(path, sh["file"]))
+
+        # Sharded restore: block-wise reads into the destination sharding.
+        target = {"w": jax.ShapeDtypeStruct((4, 4), np.float32),
+                  "c": jax.ShapeDtypeStruct((), np.float32)}
+        restored = saver.restore(path, target=target,
+                                 shardings={"w": sharding,
+                                            "c": NamedSharding(mesh, P())})
+        got_local = {tuple(int(v) for v in (s.index[0].start or 0,)):
+                     np.asarray(s.data) for s in restored["w"].addressable_shards}
+        for s in x.addressable_shards:
+            key = (int(s.index[0].start or 0),)
+            np.testing.assert_array_equal(got_local[key], np.asarray(s.data))
+        assert float(restored["c"]) == 3.5
+        print("OK", jax.process_index(), flush=True)
+    """))
+    from autodist_tpu.runtime.launcher import _launch_local_fleet
+
+    env = _scrubbed_cpu_env()
+    env["AUTODIST_TEST_CKPT_DIR"] = str(tmp_path / "ckpt")
+    code = _launch_local_fleet(
+        [sys.executable, str(script)], 2, coordinator_port=_free_port(), base_env=env
+    )
+    assert code == 0
