@@ -647,26 +647,16 @@ class DistributedTrainStep:
         vars not sharded over the data axis (matching the reference, where
         compressors exist only on the dense AllReduce path,
         compressor.py:146-201); others are skipped with a warning.
+        Model/seq/expert-sharded vars compress fine: the compressed sync is
+        manual over the data axis only, with other mesh axes left to GSPMD
+        (partial-manual shard_map).
         """
         from autodist_tpu.kernel.compressor import get_compressor
 
         ax = data_axis(plan.mesh)
         sizes = dict(zip(plan.mesh.axis_names, plan.mesh.devices.shape))
-        if any(v > 1 for k, v in sizes.items() if k != ax):
-            # The compressed sync runs in a shard_map manual over the data
-            # axis; partially-manual mode (non-data axes left to GSPMD)
-            # check-fails inside XLA's SPMD partitioner ("invalid binary
-            # instruction opcode copy"), so compression is only supported on
-            # pure-DP meshes, where the shard_map can run over a flattened
-            # data-only mesh view instead.
-            if any(p.compressor not in ("", "NoneCompressor")
-                   for p in plan.var_plans.values()):
-                logging.warning(
-                    "gradient compression disabled: mesh %s has non-data axes "
-                    ">1 and XLA cannot partition the compressed sync "
-                    "(partial-manual shard_map limitation)", sizes,
-                )
-            return {}
+        mixed_mesh = any(v > 1 for k, v in sizes.items() if k != ax)
+        platform = plan.mesh.devices.flat[0].platform
         out = {}
         for name, p in plan.var_plans.items():
             if p.compressor in ("", "NoneCompressor"):
@@ -682,7 +672,25 @@ class DistributedTrainStep:
                     p.compressor, name,
                 )
                 continue
-            out[name] = get_compressor(p.compressor)
+            comp = get_compressor(p.compressor)
+            if (
+                mixed_mesh
+                and platform == "cpu"
+                and getattr(comp, "wire_dtype", None) not in (None, jnp.float32)
+            ):
+                # XLA's CPU pipeline (AllReducePromotion/ChangeOpDataType)
+                # check-fails cloning a bf16 all-reduce inside a
+                # partial-manual region ("Invalid binary instruction opcode
+                # copy"). TPU handles bf16 collectives natively; on the CPU
+                # test backend keep the semantics and drop only the wire
+                # narrowing.
+                logging.warning(
+                    "compressor %s on %s: bf16 collective unsupported by the "
+                    "CPU backend inside a partial-manual region; wire stays "
+                    "f32 here (TPU runs the narrow wire)", p.compressor, name,
+                )
+                comp.wire_dtype = jnp.float32
+            out[name] = comp
         return out
 
     # ------------------------------------------------------------------ init
@@ -916,7 +924,10 @@ class DistributedTrainStep:
         compress → psum → decompress sequence (so the collective itself runs
         on compressed payloads — the reference wrapped
         ``collective_ops.all_reduce`` the same way). Model/other mesh axes
-        stay GSPMD-auto, so tensor-parallel vars keep their shardings.
+        stay GSPMD-auto (partial-manual mode), so tensor-parallel vars keep
+        their shardings; on a pure-DP mesh the region runs fully manual over
+        a flat data-only mesh view (identical device order), which keeps the
+        long-tested full-manual lowering on the bench path.
 
         Assumes ``loss_fn`` computes a *mean* over the batch (the reference's
         merge=Add final=Div semantics, all_reduce_synchronizer.py:100-126).
@@ -926,15 +937,9 @@ class DistributedTrainStep:
         mesh = self.plan.mesh
         ax = data_axis(mesh)
         n = dict(zip(mesh.axis_names, mesh.devices.shape))[ax]
-        if n != mesh.devices.size:
-            raise AssertionError(
-                "compressed sync requires a pure-DP mesh "
-                "(enforced in _resolve_compressors)")
-        # Run the shard_map over a flat data-only view of the mesh: fully
-        # manual mode sidesteps the XLA partial-manual partitioner crash, and
-        # with every non-data axis singleton the device order (and therefore
-        # every array's layout) is unchanged.
-        mesh = Mesh(mesh.devices.reshape(-1), (ax,))
+        if n == mesh.devices.size:
+            # Pure DP: flat full-manual view, device order unchanged.
+            mesh = Mesh(mesh.devices.reshape(-1), (ax,))
         compressors = self._compressors
 
         # Every parameter enters the manual region REPLICATED over the data

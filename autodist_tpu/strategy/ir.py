@@ -52,7 +52,15 @@ class PSSynchronizer:
 
 
 class AllReduceSpec:
-    """Transport hint for the all-reduce (reference: AUTO|NCCL|RING)."""
+    """Transport hint for the all-reduce (reference: AUTO|NCCL|RING).
+
+    ADVISORY on TPU: the reference chose a collective implementation per
+    group (NCCL vs RING); under XLA the transport follows the topology —
+    collectives over mesh axes mapped onto the ICI torus ride ICI, and
+    cross-slice axes ride DCN. The honored analog is the resource spec's
+    ``ici_bandwidth_gbps``/``dcn_bandwidth_gbps`` + the mesh construction
+    (``kernel/mesh.py`` maps minor axes onto intra-host ICI), which the
+    cost model's hierarchical all-reduce formula consumes."""
 
     AUTO = "AUTO"
     ICI = "ICI"    # intra-slice interconnect collectives
@@ -62,11 +70,21 @@ class AllReduceSpec:
 
 @dataclass(frozen=True)
 class AllReduceSynchronizer:
-    """All-reduce sync config (synchronizers.proto:35-57)."""
+    """All-reduce sync config (synchronizers.proto:35-57).
+
+    ``group`` (the reference's scoped-allocator fusion id,
+    all_reduce_strategy.py:60-68) is ADVISORY on TPU: XLA's
+    AllReduceCombiner already merges per-variable gradient all-reduces
+    into a handful of variadic collectives (currently exactly one),
+    independent of grouping — ``tests/test_group_fusion.py`` re-proves the
+    fusion on every run for chunk_size 4 and 128 alike; evidence
+    discussion in ``docs/group_fusion.md``. The id is still
+    captured/serialized for reference-config compatibility and used as
+    the bucket key by any future manual sync path."""
 
     spec: str = AllReduceSpec.AUTO
     compressor: str = "NoneCompressor"  # see kernel/compressor.py registry
-    group: int = 0                      # collective fusion group id
+    group: int = 0                      # collective fusion group id (advisory)
 
     def __post_init__(self):
         if self.spec not in AllReduceSpec.VALID:

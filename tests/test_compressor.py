@@ -224,3 +224,134 @@ def test_compressed_path_with_sparse_embedding_matches_oracle():
     np.testing.assert_allclose(
         np.asarray(got["w"]), np.asarray(expected["w"]), rtol=2e-2, atol=2e-2
     )
+
+
+@pytest.mark.parametrize("name", ["HorovodCompressor", "HorovodCompressorEF",
+                                  "PowerSGDCompressor"])
+def test_compression_on_data_model_mesh(name):
+    """Compression must survive a mixed data×model mesh (VERDICT r1 next
+    #7): the compressed sync runs partial-manual over the data axis with
+    the model axis left to GSPMD, instead of silently disabling itself."""
+    import numpy as np
+    import optax
+    from autodist_tpu.kernel.lowering import DistributedTrainStep, GraphTransformer
+    from autodist_tpu.kernel.mesh import build_mesh
+    from autodist_tpu.model_item import ModelItem, OptimizerSpec
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+    from autodist_tpu.strategy.base import StrategyCompiler
+
+    def loss_fn(params, batch):
+        x, y = batch
+        h = jnp.tanh(x @ params["w1"])
+        out = h @ params["w2"]
+        return jnp.mean((out[:, 0] - y) ** 2)
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(7), 3)
+    params = {
+        "w1": jax.random.normal(k1, (16, 32)) * 0.3,
+        "w2": jax.random.normal(k2, (32, 16)) * 0.3,
+    }
+    batch = (jax.random.normal(k3, (32, 16)), jax.random.normal(k1, (32,)))
+    rs = ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+        "mesh": {"data": 4, "model": 2},
+    })
+    opt = OptimizerSpec("sgd", {"learning_rate": 0.1})
+    mi = ModelItem.from_params(
+        params, optimizer_spec=opt, loss_fn=loss_fn, example_batch=batch)
+    strategy = StrategyCompiler(mi).compile(
+        AllReduce(compressor=name).build(mi, rs))
+    plan = GraphTransformer(strategy, mi, build_mesh(rs, axes=("data", "model"))).transform()
+    step = DistributedTrainStep(plan, loss_fn, opt.make())
+    # The compressors must actually be active — not silently dropped.
+    assert set(step._compressors) == {"w1", "w2"}
+    state = step.init(params)
+    new_state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+    # Oracle: single-device step. On the CPU backend the cast compressors
+    # fall back to f32 wire (XLA CPU cannot compile bf16 collectives in a
+    # partial-manual region), so Horovod* match tightly; PowerSGD is a
+    # genuine low-rank approximation — only sanity-check trajectory.
+    tx = opt.make()
+    grads = jax.grad(loss_fn)(params, batch)
+    updates, _ = tx.update(grads, tx.init(params), params)
+    expected = optax.apply_updates(params, updates)
+    got = jax.device_get(step.logical_params(new_state))
+    if name != "PowerSGDCompressor":
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            got, jax.device_get(expected))
+    else:
+        state2, metrics2 = step(new_state, batch)
+        assert float(metrics2["loss"]) < float(metrics["loss"]) * 1.05
+
+
+def test_compression_on_data_model_mesh_with_tp_sharded_vars():
+    """Partitioned AllReduce vars (param sharded on the model axis) keep
+    their shardings through the partial-manual compressed region."""
+    import numpy as np
+    from autodist_tpu.kernel.lowering import DistributedTrainStep, GraphTransformer
+    from autodist_tpu.kernel.mesh import build_mesh
+    from autodist_tpu.model_item import ModelItem, OptimizerSpec
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy.base import StrategyCompiler
+    from autodist_tpu.strategy.ir import AllReduceSynchronizer, NodeConfig
+    from autodist_tpu.strategy.base import StrategyBuilder
+
+    def loss_fn(params, batch):
+        x, y = batch
+        h = jnp.tanh(x @ params["w1"])
+        out = h @ params["w2"]
+        return jnp.mean((out[:, 0] - y) ** 2)
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(9), 3)
+    params = {
+        "w1": jax.random.normal(k1, (16, 32)) * 0.3,
+        "w2": jax.random.normal(k2, (32, 16)) * 0.3,
+    }
+    batch = (jax.random.normal(k3, (32, 16)), jax.random.normal(k1, (32,)))
+    rs = ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+        "mesh": {"data": 4, "model": 2},
+    })
+    opt = OptimizerSpec("sgd", {"learning_rate": 0.1})
+    mi = ModelItem.from_params(
+        params, optimizer_spec=opt, loss_fn=loss_fn, example_batch=batch)
+
+    class _TPCompressed(StrategyBuilder):
+        def build(self, model_item, resource_spec):
+            s = self._new_strategy(resource_spec)
+            s.node_config = [
+                NodeConfig(
+                    var_name=v.name,
+                    synchronizer=AllReduceSynchronizer(
+                        compressor="HorovodCompressorEF"),
+                    partitioner=("1,2" if v.name == "w1" else "2,1"),
+                )
+                for v in model_item.trainable_variables
+            ]
+            return s
+
+    strategy = StrategyCompiler(mi).compile(_TPCompressed().build(mi, rs))
+    plan = GraphTransformer(strategy, mi, build_mesh(rs, axes=("data", "model"))).transform()
+    from jax.sharding import PartitionSpec as P
+    assert plan.plan_for("w1").pspec == P(None, "model")
+    assert plan.plan_for("w2").pspec == P("model", None)
+    step = DistributedTrainStep(plan, loss_fn, opt.make())
+    assert set(step._compressors) == {"w1", "w2"}
+    state = step.init(params)
+    new_state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    import optax
+    tx = opt.make()
+    grads = jax.grad(loss_fn)(params, batch)
+    updates, _ = tx.update(grads, tx.init(params), params)
+    expected = optax.apply_updates(params, updates)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        jax.device_get(step.logical_params(new_state)),
+        jax.device_get(expected))
