@@ -321,50 +321,26 @@ class AutoDist:
 
         ``candidates``: ``[(name, StrategyBuilder), ...]``; defaults to the
         Auto dense slate (+ Parallax, which degenerates to AllReduce on
-        dense-only models). Multi-process fleets select by cost model over
-        the same candidates instead of timing — the ranking is deterministic
-        from (model × spec), so every process elects the same winner, which
-        per-host timings could not guarantee.
+        dense-only models). On a multi-process fleet every process times
+        every candidate in lockstep (the candidates' collectives keep the
+        fleet synchronized), the CHIEF's measurements decide, and the
+        winner's index is broadcast over the runtime — so the election is
+        both *measured* and fleet-consistent, the same broadcast contract
+        the strategy handoff uses (``_sync_strategy_multihost``).
         """
         import time
 
-        from autodist_tpu.strategy import CostModel
+        import numpy as np
+
         from autodist_tpu.strategy.cost_model import candidate_slate
 
         if candidates is None:
             candidates = candidate_slate()
-
-        if jax.process_count() > 1:
-            logging.warning(
-                "tune() on a multi-process fleet: ranking the candidates by "
-                "cost model instead of timing (per-host timings cannot elect "
-                "a winner safely)"
-            )
-            opt = build_kwargs.get("optimizer")
-            opt_spec = (
-                opt if isinstance(opt, OptimizerSpec)
-                else OptimizerSpec("custom") if opt is not None
-                else None
-            )
-            item = ModelItem.from_params(
-                params, optimizer_spec=opt_spec, loss_fn=loss_fn,
-                example_batch=example_batch,
-                sparse_names=build_kwargs.get("sparse_names", ()),
-            )
-            cm = CostModel(item, self.resource_spec)
-            built = []
-            for n, b in candidates:
-                try:
-                    built.append((n, b.build(item, self.resource_spec)))
-                except Exception as e:  # noqa: BLE001 - candidate isolation
-                    logging.warning("tune: candidate %s failed (%s); skipped", n, e)
-            if not built:
-                raise RuntimeError("tune(): every candidate strategy failed to build")
-            ranked = cm.rank(built)
-            best_name = ranked[0][0]
-            logging.info("tune (cost model) selected %s", best_name)
-            self.strategy_builder = dict(candidates)[best_name]
-            return self.build(loss_fn, params, example_batch, **build_kwargs)
+        multi = jax.process_count() > 1
+        if multi:
+            # The feed contract depends only on (batch, process count) —
+            # fail it once, loudly, before paying any candidate builds.
+            self._check_fleet_batch(example_batch)
 
         def _sync(tree) -> None:
             # Scalar fetch, not block_until_ready: reliable on every
@@ -372,20 +348,29 @@ class AutoDist:
             leaf = jax.tree_util.tree_leaves(tree)[0]
             float(jnp.asarray(leaf).ravel()[0])
 
-        best = None  # (name, dt, builder, step, strategy, model_item)
+        results = []  # (name, dt) per candidate; inf when it failed here
+        best = None   # single-process: (name, dt, builder, step, strategy, item)
         for name, builder in candidates:
             self.strategy_builder = builder
             try:
                 step = self.build(loss_fn, params, example_batch, **build_kwargs)
+                bench_batch = (
+                    self._fleet_bench_batch(step.plan, example_batch)
+                    if multi else example_batch
+                )
                 state = step.init(params)
-                state, _ = step.run(state, example_batch, window)  # compile+warm
+                state, _ = step.run(state, bench_batch, window)  # compile+warm
                 _sync(state.params)
                 t0 = time.perf_counter()
-                state, _ = step.run(state, example_batch, window)
+                state, _ = step.run(state, bench_batch, window)
                 _sync(state.params)
                 dt = (time.perf_counter() - t0) / window
             except Exception as e:  # noqa: BLE001 - candidate-level isolation
+                # SPMD failures are deterministic (every process compiles
+                # the same program), so the fleet fails candidates
+                # together and the results lists stay aligned.
                 logging.warning("tune: candidate %s failed (%s); skipped", name, e)
+                results.append((name, float("inf")))
                 continue
             finally:
                 # Free this candidate's device train state before the next
@@ -394,10 +379,45 @@ class AutoDist:
                 # the first (electing the first, not the fastest).
                 state = None  # noqa: F841
             logging.info("tune: %-16s %.3f ms/step", name, dt * 1e3)
-            # Keep only the running best — a losing step's compiled device
-            # programs are dead weight for the rest of the sweep.
-            if best is None or dt < best[1]:
+            results.append((name, dt))
+            if multi:
+                # The winner is rebuilt after the election; holding every
+                # candidate's compiled programs would waste HBM meanwhile.
+                step = None  # noqa: F841
+            elif best is None or dt < best[1]:
+                # Keep only the running best — a losing step's compiled
+                # device programs are dead weight for the rest of the sweep.
                 best = (name, dt, builder, step, self._strategy, self._model_item)
+
+        if multi:
+            from jax.experimental import multihost_utils
+
+            dts = np.array([dt for _, dt in results], np.float64)
+            # Chief's measurements decide; the broadcast makes the election
+            # identical on every process even when local timings disagree.
+            idx = int(multihost_utils.broadcast_one_to_all(np.int32(
+                int(np.argmin(dts)) if np.isfinite(dts).any() else -1
+            )))
+            if idx < 0:
+                raise RuntimeError(
+                    "tune(): every candidate strategy failed to build/run")
+            if not np.isfinite(results[idx][1]):
+                # The chief's winner failed on THIS process (host-local
+                # OOM/transient): rebuilding would re-raise while the rest
+                # of the fleet waits in the broadcast — fail diagnosably
+                # instead of hanging the fleet.
+                raise RuntimeError(
+                    f"tune(): fleet elected {results[idx][0]!r} but that "
+                    f"candidate failed on process {jax.process_index()} — "
+                    f"see the per-candidate warning above for the cause")
+            best_name = results[idx][0]
+            logging.info(
+                "tune (fleet) selected %s — chief-measured; local %.3f ms/step",
+                best_name, results[idx][1] * 1e3,
+            )
+            self.strategy_builder = dict(candidates)[best_name]
+            return self.build(loss_fn, params, example_batch, **build_kwargs)
+
         if best is None:
             raise RuntimeError("tune(): every candidate strategy failed to build/run")
         best_name, best_dt, best_builder, best_step, best_strategy, best_item = best
@@ -411,6 +431,50 @@ class AutoDist:
             best_step, best_strategy, best_item,
         )
         return best_step
+
+    @staticmethod
+    def _check_fleet_batch(example_batch) -> None:
+        """Pre-sweep validation of the fleet feed contract (see
+        :meth:`_fleet_bench_batch`), so a bad batch fails once with the
+        real cause instead of failing every candidate after a full build."""
+        import numpy as np
+
+        pc = jax.process_count()
+        for leaf in jax.tree.leaves(example_batch):
+            shape = tuple(np.shape(leaf))
+            if len(shape) >= 1 and shape[0] > 0 and shape[0] % pc != 0:
+                raise ValueError(
+                    f"tune() on a {pc}-process fleet needs every batched "
+                    f"leaf's leading dim divisible by {pc}; got {shape}"
+                )
+
+    @staticmethod
+    def _fleet_bench_batch(plan: ShardingPlan, example_batch):
+        """Global example batch → fleet-fed global arrays for timing.
+
+        On a multi-process fleet a raw host batch cannot be fed to a
+        sharded jit (numpy + non-addressable shardings is rejected); the
+        feed contract is per-process local slices assembled via
+        ``plan.global_batch_from_local``. Every process holds the same
+        global example, so each takes its row slice.
+        """
+        import numpy as np
+
+        pi, pc = jax.process_index(), jax.process_count()
+
+        def to_local(x):
+            arr = np.asarray(x)
+            if arr.ndim >= 1 and arr.shape[0] > 0:
+                if arr.shape[0] % pc != 0:
+                    raise ValueError(
+                        f"tune() on a {pc}-process fleet needs every batched "
+                        f"leaf's leading dim divisible by {pc}; got {arr.shape}"
+                    )
+                k = arr.shape[0] // pc
+                return arr[pi * k:(pi + 1) * k]
+            return arr
+
+        return plan.global_batch_from_local(jax.tree.map(to_local, example_batch))
 
     # ------------------------------------------------------------- accessors
     @property

@@ -217,30 +217,53 @@ class TestTune:
         )
         assert step is not None  # exploding candidate skipped, AR measured
 
-    def test_tune_multiprocess_ranks_by_cost_model_over_given_candidates(self, monkeypatch):
-        # On a fleet the winner must come from the *passed* slate via the
-        # deterministic cost model, never from timings or a different slate.
-        from autodist_tpu.strategy import PS, PSLoadBalancing
+    def test_tune_multiprocess_elects_chief_measured_winner(self, monkeypatch):
+        # On a fleet the election must be MEASURED (not cost-model ranked,
+        # VERDICT r1 next #8) and fleet-consistent: the chief's winner index
+        # rides broadcast_one_to_all, then the winner is rebuilt through the
+        # normal strategy-broadcast path.
+        from autodist_tpu.strategy import AllReduce, StrategyBuilder
         import autodist_tpu.api as api_mod
+
+        class Exploding(StrategyBuilder):
+            def build(self, model_item, resource_spec):
+                raise ValueError("boom")
 
         a = ad.AutoDist()  # spec snapshots the real 8-device runtime first
         monkeypatch.setattr(api_mod.jax, "process_count", lambda: 2)
-        # Only the selection logic is under test — stand in for the
-        # runtime broadcast (needs a real 2-process fleet, covered by the
-        # integration tests) with a chief-side build.
+        # Only the selection logic is under test — stand in for the runtime
+        # broadcasts (a real 2-process fleet covers them in the integration
+        # tests): strategy handoff becomes a chief-side build, and the
+        # winner-index broadcast echoes the chief's local value.
         monkeypatch.setattr(
             a, "_sync_strategy_multihost",
             lambda item: a.strategy_builder.build(item, a.resource_spec),
         )
+        broadcasts = []
+        from jax.experimental import multihost_utils
+
+        def echo(x):
+            broadcasts.append(int(x))
+            return x
+
+        monkeypatch.setattr(multihost_utils, "broadcast_one_to_all", echo)
+        # The real per-process feed assembly needs a real fleet (covered by
+        # test_runtime.py::test_two_process_measured_tune_elects_same_winner).
+        monkeypatch.setattr(
+            ad.AutoDist, "_fleet_bench_batch",
+            staticmethod(lambda plan, b: b),
+        )
         params, batch = make_model()
         step = a.tune(
-            loss_fn, params, batch,
-            candidates=[("PSLB", PSLoadBalancing()),
-                        ("PS1", PS(local_proxy_variable=True))],
+            loss_fn, params, batch, window=2,
+            candidates=[("boom", Exploding()), ("AR", AllReduce())],
         )
         assert step is not None
-        from autodist_tpu.strategy.ir import PSSynchronizer
-        assert all(isinstance(n.synchronizer, PSSynchronizer)
+        # The election went through the broadcast with the measured winner
+        # (index 1 — the only candidate that ran).
+        assert broadcasts == [1]
+        from autodist_tpu.strategy.ir import AllReduceSynchronizer
+        assert all(isinstance(n.synchronizer, AllReduceSynchronizer)
                    for n in a.strategy.node_config)
 
     def test_tune_all_candidates_fail_raises(self):
