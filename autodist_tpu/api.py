@@ -189,7 +189,16 @@ class AutoDist:
         from jax.experimental import multihost_utils
 
         if jax.process_index() == 0:
-            strategy = self.strategy_builder.build(model_item, self.resource_spec)
+            try:
+                strategy = self.strategy_builder.build(model_item, self.resource_spec)
+            except Exception:
+                # Only the chief builds — a build failure here is NOT
+                # SPMD-deterministic, and the workers are already waiting in
+                # the length broadcast below. Ship a -1 sentinel so every
+                # process raises in lockstep instead of the workers pairing
+                # this broadcast with some later one (protocol desync).
+                multihost_utils.broadcast_one_to_all(np.int32(-1))
+                raise
             strategy.serialize()  # audit trail on the chief host
             # Children forked from the chief later (coordinator relaunch
             # pattern) inherit the id, same as the single-process path.
@@ -198,6 +207,10 @@ class AutoDist:
         else:
             payload = b""
         n = int(multihost_utils.broadcast_one_to_all(np.int32(len(payload))))
+        if n < 0:
+            raise RuntimeError(
+                "strategy build failed on the chief — see the chief's "
+                "traceback for the cause")
         buf = np.zeros(n, np.uint8)
         if payload:
             buf[: len(payload)] = np.frombuffer(payload, np.uint8)
@@ -366,9 +379,13 @@ class AutoDist:
                 _sync(state.params)
                 dt = (time.perf_counter() - t0) / window
             except Exception as e:  # noqa: BLE001 - candidate-level isolation
-                # SPMD failures are deterministic (every process compiles
-                # the same program), so the fleet fails candidates
-                # together and the results lists stay aligned.
+                # Fleet alignment: chief-only build failures ship a sentinel
+                # through the strategy broadcast so every process raises (and
+                # lands here) for the same candidate; compile/run failures
+                # are SPMD-deterministic (same program everywhere). Either
+                # way the results lists stay index-aligned, and the
+                # election below only considers candidates that succeeded
+                # on every process.
                 logging.warning("tune: candidate %s failed (%s); skipped", name, e)
                 results.append((name, float("inf")))
                 continue
@@ -393,23 +410,25 @@ class AutoDist:
             from jax.experimental import multihost_utils
 
             dts = np.array([dt for _, dt in results], np.float64)
-            # Chief's measurements decide; the broadcast makes the election
-            # identical on every process even when local timings disagree.
-            idx = int(multihost_utils.broadcast_one_to_all(np.int32(
-                int(np.argmin(dts)) if np.isfinite(dts).any() else -1
-            )))
-            if idx < 0:
+            # Fleet-wide election in one collective: allgather every
+            # process's timing vector (identical result everywhere), keep
+            # only candidates that succeeded on EVERY process, then pick
+            # the chief's fastest among those. Deterministic on all
+            # processes with no follow-up broadcast, and a candidate that
+            # failed anywhere can never be elected — so the winner rebuild
+            # below cannot diverge. (A failure *inside* a candidate's
+            # collectives still hangs like any SPMD program would; this
+            # protects the host-side stages around them.)
+            all_dts = np.asarray(
+                multihost_utils.process_allgather(dts)
+            ).reshape(jax.process_count(), len(results))
+            fleet_valid = np.isfinite(all_dts).all(axis=0)
+            if not fleet_valid.any():
                 raise RuntimeError(
-                    "tune(): every candidate strategy failed to build/run")
-            if not np.isfinite(results[idx][1]):
-                # The chief's winner failed on THIS process (host-local
-                # OOM/transient): rebuilding would re-raise while the rest
-                # of the fleet waits in the broadcast — fail diagnosably
-                # instead of hanging the fleet.
-                raise RuntimeError(
-                    f"tune(): fleet elected {results[idx][0]!r} but that "
-                    f"candidate failed on process {jax.process_index()} — "
-                    f"see the per-candidate warning above for the cause")
+                    "tune(): every candidate strategy failed to build/run "
+                    "on at least one process")
+            chief_dts = np.where(fleet_valid, all_dts[0], np.inf)
+            idx = int(np.argmin(chief_dts))
             best_name = results[idx][0]
             logging.info(
                 "tune (fleet) selected %s — chief-measured; local %.3f ms/step",
@@ -457,19 +476,16 @@ class AutoDist:
         feed contract is per-process local slices assembled via
         ``plan.global_batch_from_local``. Every process holds the same
         global example, so each takes its row slice.
+        (:meth:`_check_fleet_batch` owns the divisibility validation.)
         """
         import numpy as np
 
         pi, pc = jax.process_index(), jax.process_count()
+        AutoDist._check_fleet_batch(example_batch)
 
         def to_local(x):
             arr = np.asarray(x)
             if arr.ndim >= 1 and arr.shape[0] > 0:
-                if arr.shape[0] % pc != 0:
-                    raise ValueError(
-                        f"tune() on a {pc}-process fleet needs every batched "
-                        f"leaf's leading dim divisible by {pc}; got {arr.shape}"
-                    )
                 k = arr.shape[0] // pc
                 return arr[pi * k:(pi + 1) * k]
             return arr

@@ -515,20 +515,29 @@ class ShardingPlan:
         if jax.process_count() == 1:
             return jax.device_put(local_batch, self.batch_shardings(local_batch, strict=False))
 
+        import numpy as np
+
         n_proc = jax.process_count()
 
-        def leaf_to_global(leaf, sharding):
-            import numpy as np
+        def global_shape_of(x) -> Tuple[int, ...]:
+            shape = tuple(np.shape(x))
+            if not shape:  # rank-0: replicated, same value on every process
+                return shape
+            return (shape[0] * n_proc,) + shape[1:]
 
+        def leaf_to_global(leaf, sharding):
             arr = np.asarray(leaf)
-            global_shape = (arr.shape[0] * n_proc,) + arr.shape[1:]
-            return jax.make_array_from_process_local_data(sharding, arr, global_shape)
+            if arr.ndim == 0:
+                # Replicated scalar: every process holds the same value;
+                # make_array_from_process_local_data has no dim to concat.
+                return jax.make_array_from_callback((), sharding, lambda _: arr)
+            return jax.make_array_from_process_local_data(
+                sharding, arr, global_shape_of(arr))
 
         shardings = self.batch_shardings(
             jax.tree_util.tree_map(
                 lambda x: jax.ShapeDtypeStruct(
-                    (getattr(x, "shape", (0,))[0] * n_proc,) + tuple(getattr(x, "shape", (0,))[1:]),
-                    getattr(x, "dtype", None),
+                    global_shape_of(x), getattr(x, "dtype", None) or np.asarray(x).dtype
                 ),
                 local_batch,
             ),

@@ -117,6 +117,41 @@ class NodeSpec:
     chips: int = DEFAULT_CHIPS_PER_HOST
     cpus: int = 1
     chief: bool = False
+    ssh_config: str = ""  # name of an ``ssh:`` entry (reference parity)
+
+
+@dataclass
+class SSHConfig:
+    """Per-host SSH parameters for the coordinator's remote launch
+    (reference: ``resource_spec.py`` SSHConfig/SSHConfigMap — username,
+    key_file, port, python venv; ``:291-331``). Only the fields the
+    subprocess-ssh transport consumes are kept."""
+
+    user: str = ""
+    port: int = 22
+    key_file: str = ""
+    python_venv: str = ""  # sourced before the remote re-exec
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SSHConfig":
+        return cls(
+            user=str(d.get("user", d.get("username", ""))),
+            port=int(d.get("port", 22)),
+            key_file=str(d.get("key_file", "")),
+            python_venv=str(d.get("python_venv", "")),
+        )
+
+    def to_dict(self) -> dict:
+        out = {}
+        if self.user:
+            out["user"] = self.user
+        if self.port != 22:
+            out["port"] = self.port
+        if self.key_file:
+            out["key_file"] = self.key_file
+        if self.python_venv:
+            out["python_venv"] = self.python_venv
+        return out
 
 
 @dataclass
@@ -196,6 +231,7 @@ class ResourceSpec:
         self._nodes: List[NodeSpec] = []
         self._tpu = TPUTopology()
         self._mesh_override: Optional[Dict[str, int]] = None
+        self._ssh_configs: Dict[str, SSHConfig] = {}
         self._parse(self._raw)
         self._validate()
 
@@ -209,8 +245,19 @@ class ResourceSpec:
                     chips=int(chips),
                     cpus=int(entry.get("cpus", 1)),
                     chief=bool(entry.get("chief", False)),
+                    ssh_config=str(entry.get("ssh_config", "")),
                 )
             )
+        # Reference-shaped ssh block: either a map of named configs
+        # ({"conf1": {...}}, nodes reference by ssh_config) or one flat
+        # config applying to every node (stored under "").
+        ssh = d.get("ssh", {}) or {}
+        if ssh and all(isinstance(v, dict) for v in ssh.values()):
+            self._ssh_configs = {
+                str(k): SSHConfig.from_dict(v) for k, v in ssh.items()
+            }
+        elif ssh:
+            self._ssh_configs = {"": SSHConfig.from_dict(ssh)}
         if not self._nodes:
             # Single-host default: one loopback node.
             self._nodes.append(NodeSpec(address="localhost", chief=True))
@@ -264,6 +311,14 @@ class ResourceSpec:
             raise ValueError(
                 f"tpu.topology implies {topo_chips} chips but nodes declare {self.num_chips}"
             )
+        # Dangling ssh_config references fail HERE, not mid-launch after
+        # some workers are already running.
+        for n in self._nodes:
+            if n.ssh_config and n.ssh_config not in self._ssh_configs:
+                raise ValueError(
+                    f"node {n.address!r} names ssh_config {n.ssh_config!r} "
+                    f"but the spec's ssh block has {sorted(self._ssh_configs)}"
+                )
 
     # ------------------------------------------------------------- properties
     @property
@@ -314,6 +369,20 @@ class ResourceSpec:
         """Host CPU devices — PS-style reduction destinations live here."""
         ordered = sorted(self._nodes, key=lambda n: (not n.chief, n.address))
         return [DeviceSpec(n.address, DeviceType.CPU, 0) for n in ordered]
+
+    def ssh_config_for(self, address: str) -> Optional[SSHConfig]:
+        """SSH parameters for one host: the node's named ``ssh_config``
+        entry, else the spec-wide flat config, else None (reference
+        SSHConfigMap resolution, resource_spec.py:291-331)."""
+        node = next((n for n in self._nodes if n.address == address), None)
+        if node is not None and node.ssh_config:
+            if node.ssh_config not in self._ssh_configs:
+                raise ValueError(
+                    f"node {address!r} names ssh_config {node.ssh_config!r} "
+                    f"but the spec's ssh block has {sorted(self._ssh_configs)}"
+                )
+            return self._ssh_configs[node.ssh_config]
+        return self._ssh_configs.get("")
 
     @property
     def network_bandwidth(self) -> float:
@@ -373,9 +442,22 @@ class ResourceSpec:
     def to_dict(self) -> dict:
         return {
             "nodes": [
-                {"address": n.address, "chips": n.chips, "cpus": n.cpus, "chief": n.chief}
+                {
+                    "address": n.address, "chips": n.chips, "cpus": n.cpus,
+                    "chief": n.chief,
+                    **({"ssh_config": n.ssh_config} if n.ssh_config else {}),
+                }
                 for n in self._nodes
             ],
+            **(
+                {
+                    "ssh": {
+                        k: v.to_dict() for k, v in self._ssh_configs.items()
+                    } if "" not in self._ssh_configs
+                    else self._ssh_configs[""].to_dict()
+                }
+                if self._ssh_configs else {}
+            ),
             "tpu": {
                 **(
                     {"accelerator": self._tpu.accelerator}

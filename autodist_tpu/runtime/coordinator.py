@@ -92,16 +92,41 @@ class Coordinator:
             stdout=None, stderr=None,
         )
 
+    def _ssh_parts(self, address: str):
+        """(option args, target) honoring the spec's ssh config for this
+        host (reference SSHConfig: username/port/key_file,
+        resource_spec.py:291-331)."""
+        cfg = self.cluster.resource_spec.ssh_config_for(address)
+        opts = ["-o", "StrictHostKeyChecking=no"]
+        target = address
+        if cfg is not None:
+            if cfg.port and cfg.port != 22:
+                opts += ["-p", str(cfg.port)]
+            if cfg.key_file:
+                opts += ["-i", cfg.key_file]
+            if cfg.user:
+                target = f"{cfg.user}@{address}"
+        return opts, target, cfg
+
     def _launch_remote(self, address: str, env: Dict[str, str]) -> subprocess.Popen:
+        opts, target, cfg = self._ssh_parts(address)
         exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
-        cmd = f"cd {shlex.quote(os.getcwd())} && {exports} {' '.join(shlex.quote(a) for a in self.argv)}"
+        venv = (
+            f". {shlex.quote(cfg.python_venv)}/bin/activate && "
+            if cfg is not None and cfg.python_venv else ""
+        )
+        cmd = (
+            f"{venv}cd {shlex.quote(os.getcwd())} && {exports} "
+            f"{' '.join(shlex.quote(a) for a in self.argv)}"
+        )
         if ENV.AUTODIST_DEBUG_REMOTE.val:
             # Parity with AUTODIST_DEBUG_REMOTE (reference cluster.py:340-341):
-            # print instead of executing, for manual debugging.
-            logging.info("[debug-remote] ssh %s %s", address, cmd)
+            # print instead of executing, for manual debugging. The printed
+            # line is the exact replayable command, options included.
+            logging.info("[debug-remote] ssh %s %s %s", " ".join(opts), target, cmd)
             return subprocess.Popen(["true"])
         return subprocess.Popen(
-            ["ssh", "-o", "StrictHostKeyChecking=no", address, cmd],
+            ["ssh", *opts, target, cmd],
             start_new_session=True,
         )
 
@@ -113,13 +138,16 @@ class Coordinator:
         path = os.path.join(const.DEFAULT_STRATEGY_DIR, strategy_id)
         if not os.path.exists(path) or ENV.AUTODIST_DEBUG_REMOTE.val:
             return
+        opts, target, cfg = self._ssh_parts(address)
+        # scp spells the port flag -P (capital), unlike ssh.
+        scp_opts = ["-P" if o == "-p" else o for o in opts]
         subprocess.run(
-            ["ssh", "-o", "StrictHostKeyChecking=no", address,
+            ["ssh", *opts, target,
              f"mkdir -p {shlex.quote(const.DEFAULT_STRATEGY_DIR)}"],
             check=True,
         )
         subprocess.run(
-            ["scp", "-o", "StrictHostKeyChecking=no", path, f"{address}:{path}"],
+            ["scp", *scp_opts, path, f"{target}:{path}"],
             check=True,
         )
 

@@ -239,14 +239,17 @@ class TestTune:
             a, "_sync_strategy_multihost",
             lambda item: a.strategy_builder.build(item, a.resource_spec),
         )
-        broadcasts = []
+        import numpy as np
         from jax.experimental import multihost_utils
 
-        def echo(x):
-            broadcasts.append(int(x))
-            return x
+        gathered = []
 
-        monkeypatch.setattr(multihost_utils, "broadcast_one_to_all", echo)
+        def fake_allgather(x):
+            gathered.append(np.asarray(x))
+            # Pretend the second process measured the same timings.
+            return np.tile(np.asarray(x)[None], (2, 1))
+
+        monkeypatch.setattr(multihost_utils, "process_allgather", fake_allgather)
         # The real per-process feed assembly needs a real fleet (covered by
         # test_runtime.py::test_two_process_measured_tune_elects_same_winner).
         monkeypatch.setattr(
@@ -259,9 +262,10 @@ class TestTune:
             candidates=[("boom", Exploding()), ("AR", AllReduce())],
         )
         assert step is not None
-        # The election went through the broadcast with the measured winner
-        # (index 1 — the only candidate that ran).
-        assert broadcasts == [1]
+        # The election went through the timing allgather, and the failed
+        # candidate (inf everywhere) lost to the measured one.
+        assert len(gathered) == 1
+        assert np.isinf(gathered[0][0]) and np.isfinite(gathered[0][1])
         from autodist_tpu.strategy.ir import AllReduceSynchronizer
         assert all(isinstance(n.synchronizer, AllReduceSynchronizer)
                    for n in a.strategy.node_config)

@@ -541,7 +541,11 @@ def test_two_process_measured_tune_elects_same_winner(tmp_path):
         env=env, stdout=sp.PIPE, stderr=sp.STDOUT, text=True, timeout=900,
     )
     assert proc.returncode == 0, proc.stdout[-4000:]
-    elected = [l for l in proc.stdout.splitlines() if l.startswith("ELECTED")]
+    # Regex, not line-splitting: the two processes' prints can interleave
+    # on one line in the merged stream.
+    import re
+
+    elected = re.findall(r"ELECTED (\d) (\S+) (\S+?)(?=ELECTED|\s|$)", proc.stdout)
     assert len(elected) == 2, proc.stdout[-4000:]
-    winners = {l.split(" ", 2)[2] for l in elected}
+    winners = {(builder, kinds) for _, builder, kinds in elected}
     assert len(winners) == 1, f"processes elected different winners: {elected}"

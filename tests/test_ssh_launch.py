@@ -1,0 +1,233 @@
+"""The coordinator's SSH branch, driven for real (VERDICT r1 next #9).
+
+The reference exercised its SSH launch against a 2-container sshd matrix
+(``Jenkinsfile:93-131``). Two renderings here:
+
+- **stub transport** (always runs): real ``Coordinator`` code path —
+  option construction, strategy shipping, remote re-exec, env contract,
+  monitor/join — through ``ssh``/``scp`` shims on PATH that execute the
+  command locally. Nothing inside the coordinator is mocked.
+- **real sshd** (opt-in, auto-skipped when no ``sshd`` binary exists,
+  e.g. this container): same flow against a throwaway sshd on 127.0.0.1
+  with generated host/user keys, reaching it via the spec's ``ssh:``
+  config (port + key_file), like the reference's port-12345 containers.
+"""
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from autodist_tpu import const
+from autodist_tpu.model_item import ModelItem, OptimizerSpec
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.runtime.cluster import Cluster
+from autodist_tpu.runtime.coordinator import Coordinator
+from autodist_tpu.strategy import AllReduce
+
+pytestmark = pytest.mark.integration
+
+
+def _make_strategy():
+    import numpy as np
+
+    item = ModelItem.from_params(
+        {"w": np.zeros((4, 2), np.float32)},
+        optimizer_spec=OptimizerSpec("sgd", {"learning_rate": 0.1}),
+    )
+    spec = ResourceSpec(resource_dict={
+        "nodes": [
+            {"address": "10.99.0.1", "chips": 1, "chief": True},
+            {"address": "10.99.0.2", "chips": 1},
+        ],
+    })
+    strategy = AllReduce().build(item, spec)
+    strategy.serialize()
+    return spec, strategy
+
+
+def _write_stub_transport(bin_dir, log_path):
+    """``ssh``/``scp`` shims that record their argv and run locally.
+
+    Layout of the coordinator's calls:
+      ssh [opts...] <target> <cmd>   -> run <cmd> in a local shell
+      scp [opts...] <src> <tgt:path> -> copy locally (skip same-file)
+    Options all take either no value or one value; the first argument not
+    consumed by an option is the target.
+    """
+    bin_dir.mkdir(parents=True, exist_ok=True)
+    ssh = bin_dir / "ssh"
+    ssh.write_text(textwrap.dedent(f"""\
+        #!/bin/sh
+        echo "ssh $@" >> {log_path}
+        while [ $# -gt 0 ]; do
+          case "$1" in
+            -o|-p|-i) shift 2 ;;
+            -*) shift ;;
+            *) break ;;
+          esac
+        done
+        # $1 = target (possibly user@host), $2 = command
+        shift
+        exec sh -c "$1"
+    """))
+    scp = bin_dir / "scp"
+    scp.write_text(textwrap.dedent(f"""\
+        #!/bin/sh
+        echo "scp $@" >> {log_path}
+        while [ $# -gt 0 ]; do
+          case "$1" in
+            -o|-P|-i) shift 2 ;;
+            -*) shift ;;
+            *) break ;;
+          esac
+        done
+        src="$1"
+        dest="${{2#*:}}"
+        [ "$src" = "$dest" ] || cp "$src" "$dest"
+    """))
+    ssh.chmod(0o755)
+    scp.chmod(0o755)
+
+
+def test_ssh_branch_end_to_end_with_stub_transport(tmp_path, monkeypatch):
+    log_path = tmp_path / "transport.log"
+    _write_stub_transport(tmp_path / "bin", log_path)
+    monkeypatch.setenv("PATH", f"{tmp_path / 'bin'}:{os.environ['PATH']}")
+
+    proof = tmp_path / "proof.json"
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(f"""\
+        import json, os
+        # The worker sees the role-env contract and the shipped strategy.
+        sid = os.environ["AUTODIST_STRATEGY_ID"]
+        spath = os.path.join({const.DEFAULT_STRATEGY_DIR!r}, sid)
+        json.dump({{
+            "worker": os.environ["AUTODIST_WORKER"],
+            "process_id": os.environ["AUTODIST_PROCESS_ID"],
+            "num": os.environ["AUTODIST_NUM_PROCESSES"],
+            "strategy_file_exists": os.path.exists(spath),
+            "cwd": os.getcwd(),
+        }}, open({str(proof)!r}, "w"))
+    """))
+
+    spec, strategy = _make_strategy()
+    cluster = Cluster(spec)
+    coord = Coordinator(cluster, strategy, argv=[sys.executable, str(worker)])
+    coord.launch_clients()
+    coord.join()
+    assert not coord.any_failed
+
+    got = json.load(open(proof))
+    assert got["worker"] == "10.99.0.2"
+    assert got["num"] == "2"
+    assert got["process_id"] == "1"  # chief-first ordering
+    assert got["strategy_file_exists"]
+    assert got["cwd"] == os.getcwd()
+
+    log = log_path.read_text()
+    # Shipping: mkdir over ssh, then scp of the strategy file; launch: one
+    # more ssh carrying the re-exec command with the env exports.
+    assert "mkdir -p" in log
+    assert f"scp" in log and strategy.id in log
+    assert "AUTODIST_WORKER=10.99.0.2" in log
+
+
+def test_ssh_config_flags_reach_the_transport(tmp_path, monkeypatch):
+    log_path = tmp_path / "transport.log"
+    _write_stub_transport(tmp_path / "bin", log_path)
+    monkeypatch.setenv("PATH", f"{tmp_path / 'bin'}:{os.environ['PATH']}")
+
+    key = tmp_path / "id_test"
+    key.write_text("not-a-real-key")
+    spec = ResourceSpec(resource_dict={
+        "nodes": [
+            {"address": "10.99.0.1", "chips": 1, "chief": True},
+            {"address": "10.99.0.2", "chips": 1, "ssh_config": "worker"},
+        ],
+        "ssh": {"worker": {"user": "tpu", "port": 2222,
+                           "key_file": str(key)}},
+    })
+    # Round-trips (reference spec shape).
+    rt = ResourceSpec(resource_dict=spec.to_dict())
+    cfg = rt.ssh_config_for("10.99.0.2")
+    assert (cfg.user, cfg.port, cfg.key_file) == ("tpu", 2222, str(key))
+    assert rt.ssh_config_for("10.99.0.1") is None
+
+    worker = tmp_path / "worker.py"
+    worker.write_text("print('hi')\n")
+    cluster = Cluster(spec)
+    coord = Coordinator(cluster, None, argv=[sys.executable, str(worker)])
+    coord.launch_clients()
+    coord.join()
+    log = log_path.read_text()
+    assert "-p 2222" in log
+    assert f"-i {key}" in log
+    assert "tpu@10.99.0.2" in log
+
+
+@pytest.mark.skipif(shutil.which("sshd") is None, reason="no sshd binary")
+def test_ssh_branch_against_real_sshd(tmp_path, monkeypatch):
+    """Reference Jenkinsfile:93-131 distilled: a throwaway sshd on a high
+    port + key auth, reached through the spec's ssh config."""
+    import autodist_tpu.resource_spec as rs_mod
+
+    host_key = tmp_path / "host_key"
+    user_key = tmp_path / "user_key"
+    subprocess.run(["ssh-keygen", "-q", "-t", "ed25519", "-N", "", "-f",
+                    str(host_key)], check=True)
+    subprocess.run(["ssh-keygen", "-q", "-t", "ed25519", "-N", "", "-f",
+                    str(user_key)], check=True)
+    auth = tmp_path / "authorized_keys"
+    auth.write_text((user_key.with_suffix(".pub")).read_text())
+    auth.chmod(0o600)
+    port = 0
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    sshd_cfg = tmp_path / "sshd_config"
+    sshd_cfg.write_text(textwrap.dedent(f"""\
+        Port {port}
+        ListenAddress 127.0.0.1
+        HostKey {host_key}
+        AuthorizedKeysFile {auth}
+        PasswordAuthentication no
+        StrictModes no
+        PidFile {tmp_path}/sshd.pid
+    """))
+    sshd = subprocess.Popen(
+        [shutil.which("sshd"), "-D", "-f", str(sshd_cfg)],
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        time.sleep(1.0)
+        # Loopback is normally rejected for multi-node specs; the whole
+        # point here is dialing a local sshd, so relax it for the test.
+        monkeypatch.setattr(rs_mod, "_LOOPBACK_ADDRESSES", ())
+        import autodist_tpu.runtime.coordinator as coord_mod
+
+        monkeypatch.setattr(coord_mod, "_is_local", lambda a: False)
+        spec = ResourceSpec(resource_dict={
+            "nodes": [
+                {"address": socket.gethostname(), "chips": 1, "chief": True},
+                {"address": "127.0.0.1", "chips": 1, "ssh_config": "w"},
+            ],
+            "ssh": {"w": {"port": port, "key_file": str(user_key)}},
+        })
+        proof = tmp_path / "proof.txt"
+        worker = tmp_path / "worker.py"
+        worker.write_text(
+            f"import os; open({str(proof)!r}, 'w').write("
+            f"os.environ['AUTODIST_WORKER'])\n")
+        cluster = Cluster(spec)
+        coord = Coordinator(cluster, None, argv=[sys.executable, str(worker)])
+        coord.launch_clients()
+        coord.join()
+        assert proof.read_text() == "127.0.0.1"
+    finally:
+        sshd.terminate()
