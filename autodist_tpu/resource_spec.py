@@ -373,14 +373,10 @@ class ResourceSpec:
     def ssh_config_for(self, address: str) -> Optional[SSHConfig]:
         """SSH parameters for one host: the node's named ``ssh_config``
         entry, else the spec-wide flat config, else None (reference
-        SSHConfigMap resolution, resource_spec.py:291-331)."""
+        SSHConfigMap resolution, resource_spec.py:291-331). Dangling
+        references were rejected by ``_validate`` at construction."""
         node = next((n for n in self._nodes if n.address == address), None)
         if node is not None and node.ssh_config:
-            if node.ssh_config not in self._ssh_configs:
-                raise ValueError(
-                    f"node {address!r} names ssh_config {node.ssh_config!r} "
-                    f"but the spec's ssh block has {sorted(self._ssh_configs)}"
-                )
             return self._ssh_configs[node.ssh_config]
         return self._ssh_configs.get("")
 

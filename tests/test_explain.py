@@ -80,3 +80,30 @@ def test_explain_isolates_failing_builder():
     ranked = explain(item, spec, candidates=[("boom", Boom()), ("AR", AllReduce())], out=out)
     assert [n for n, _ in ranked] == ["AR"]
     assert "failed to build" in out.getvalue()
+
+
+def test_explain_measured_and_calibrated_columns():
+    import io
+
+    from autodist_tpu.strategy.cost_model import Calibration
+
+    params = {"w": np.zeros((256, 256), np.float32)}
+    item = ModelItem.from_params(params)
+    spec = ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+    out = io.StringIO()
+    calib = Calibration(base_s=5e-3, scale=2.0, device="TPU v5 lite", n_points=4)
+    ranked = explain(
+        item, spec, out=out,
+        measured={"AllReduce": 6.5e-3},
+        calibration=calib,
+    )
+    text = out.getvalue()
+    assert "measured" in text and "calib" in text
+    assert "6.500ms" in text          # the measured entry rendered
+    assert "TPU v5 lite" in text      # calibration provenance line
+    # Candidates without a measurement show a placeholder, not a crash.
+    assert "—" in text
+    # Calibrated column = base + scale * analytical total for the winner.
+    name, cost = ranked[0]
+    assert f"{(5e-3 + 2.0 * cost.total_s) * 1e3:8.3f}ms" in text
