@@ -303,7 +303,7 @@ class AutoDist:
         ).transform()
         logging.debug("sharding plan:\n%s", plan.describe())
         if remat:
-            # Wrap AFTER ModelItem capture: _detect_sparse cannot see through
+            # Wrap AFTER ModelItem capture: _trace_analysis cannot see through
             # a remat2 equation, so sparse-update detection must run on the
             # bare loss_fn.
             loss_fn = jax.checkpoint(loss_fn, policy=_remat_policy(remat))

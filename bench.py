@@ -1,15 +1,19 @@
-"""Benchmark: BERT-base train-step throughput + MFU on the local chip.
+"""Benchmark: train-step throughput + MFU on the local chip.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 The reference publishes no numeric tables (BASELINE.md), so ``vs_baseline``
 is measured MFU / 0.50, the BASELINE.json north-star target (>=50% MFU).
 
-Runs the flagship BERT-base MLM workload through the full AutoDist pipeline
-(AllReduce strategy) on whatever devices are visible — the real TPU chip
-under the driver, or CPU (tiny config) for local smoke runs.
+Default workload is the flagship BERT-base MLM through the full AutoDist
+pipeline (AllReduce strategy) on whatever devices are visible — the real
+TPU chip under the driver, or CPU (tiny config) for local smoke runs.
+``python bench.py --model resnet`` measures the ResNet-50 image workload
+instead (BASELINE.json's second named target); docs/performance.md records
+the per-round sweep.
 """
 from __future__ import annotations
 
+import argparse
 import json
 import sys
 import time
@@ -75,6 +79,10 @@ def main() -> None:
     from autodist_tpu.models import get_model
     import autodist_tpu.strategy as S
 
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", choices=("bert", "resnet"), default="bert")
+    args = ap.parse_args()
+
     # Probe BEFORE touching the backend here: when the tunnel is wedged even
     # jax.devices() blocks forever, so the parent must not initialize until
     # a subprocess proves the platform answers. On probe failure fall back
@@ -85,17 +93,28 @@ def main() -> None:
 
     dev = jax.devices()[0]
     on_accel = dev.platform != "cpu"
-    if on_accel:
-        candidate_batches, steps = (64, 128), 20
-        model_kw = dict(max_seq_len=128)
-    else:  # CPU smoke: shrink so the line still prints quickly
-        candidate_batches, steps = (8,), 3
-        model_kw = dict(
-            vocab_size=512, num_layers=2, d_model=64, num_heads=4,
-            d_ff=128, max_seq_len=32,
-        )
+    if args.model == "resnet":
+        if on_accel:
+            candidate_batches, steps = (128, 256), 20
+            model_kw = dict()
+        else:
+            candidate_batches, steps = (8,), 3
+            model_kw = dict(depth=18, image_size=32, num_classes=10)
+        spec = get_model("resnet", **model_kw)
+        metric_name, unit_per = "resnet50_mfu", "images"
+    else:
+        if on_accel:
+            candidate_batches, steps = (64, 128), 20
+            model_kw = dict(max_seq_len=128)
+        else:  # CPU smoke: shrink so the line still prints quickly
+            candidate_batches, steps = (8,), 3
+            model_kw = dict(
+                vocab_size=512, num_layers=2, d_model=64, num_heads=4,
+                d_ff=128, max_seq_len=32,
+            )
+        spec = get_model("bert_base", **model_kw)
+        metric_name, unit_per = "bert_base_mfu", "tokens"
 
-    spec = get_model("bert_base", **model_kw)
     params = spec.init(jax.random.PRNGKey(0))
 
     # The whole window runs as ONE device program (lax.scan inside
@@ -105,13 +124,19 @@ def main() -> None:
     # platforms (axon tunnel) block_until_ready returns before remote
     # execution finishes, so a device->host fetch is the only trustworthy
     # barrier. Batch size is swept (the throughput-vs-batch curve is not
-    # monotone on one chip); the best tokens/sec wins.
+    # monotone on one chip); the best throughput wins.
     def measure(bs):
         AutoDist.reset_default()
         ad = AutoDist(strategy_builder=S.AllReduce())
         batch = spec.example_batch(bs)
         step = ad.build(spec.loss_fn, params, batch)
         state = step.init(params)
+        # Pin the batch in HBM (the "compute" methodology,
+        # docs/performance.md): image-sized host feeds otherwise measure
+        # the tunnel, not the chip. Token feeds are tiny but pinning is
+        # equally correct for them.
+        batch = jax.device_put(batch, step.plan.batch_shardings(batch))
+        jax.block_until_ready(batch)
         state, metrics = step.run(state, batch, steps)  # warmup/compile
         float(metrics["loss"][-1])
         trials = []
@@ -136,8 +161,9 @@ def main() -> None:
     batch_size = min(results, key=lambda bs: results[bs][0] / bs)
     dt, last_loss = results[batch_size]
 
-    seq = spec.config.max_seq_len
-    tokens_per_sec = batch_size * seq * steps / dt
+    seq = spec.config.max_seq_len if args.model == "bert" else 1
+    examples_per_sec = batch_size * steps / dt
+    units_per_sec = examples_per_sec * seq
     flops_per_step = spec.flops_per_example * batch_size
     achieved = flops_per_step * steps / dt
     n_chips = jax.device_count()
@@ -146,19 +172,20 @@ def main() -> None:
     mfu = achieved / peak if on_accel else float("nan")
 
     result = {
-        "metric": "bert_base_mfu" if on_accel else "bert_base_tokens_per_sec_cpu_smoke",
-        "value": round(mfu, 4) if on_accel else round(tokens_per_sec, 1),
-        "unit": "mfu" if on_accel else "tokens/sec",
+        "metric": metric_name if on_accel else f"{metric_name}_cpu_smoke",
+        "value": round(mfu, 4) if on_accel else round(units_per_sec, 1),
+        "unit": "mfu" if on_accel else f"{unit_per}/sec",
         "vs_baseline": round(mfu / TARGET_MFU, 4) if on_accel else None,
-        "tokens_per_sec_per_chip": round(tokens_per_sec / n_chips, 1),
+        f"{unit_per}_per_sec_per_chip": round(units_per_sec / n_chips, 1),
         "achieved_tflops_per_chip": round(achieved / n_chips / 1e12, 2),
         "device": getattr(dev, "device_kind", dev.platform),
         "peak_tflops_assumed": None if peak_detected else round(DEFAULT_PEAK / 1e12),
         "n_chips": n_chips,
         "batch_size": batch_size,
-        "seq_len": seq,
         "loss": round(last_loss, 4),
     }
+    if args.model == "bert":
+        result["seq_len"] = seq
     if not accel_ok:
         result["error"] = (
             "accelerator unresponsive (tunnel wedged); CPU smoke fallback"

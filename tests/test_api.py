@@ -137,7 +137,7 @@ def test_remat_matches_baseline():
 
 def test_remat_preserves_sparse_detection():
     """remat must wrap AFTER model capture: embedding gathers must still be
-    detected sparse (the remat2 jaxpr is opaque to _detect_sparse)."""
+    detected sparse (the remat2 jaxpr is opaque to _trace_analysis)."""
     from autodist_tpu.api import AutoDist
     from autodist_tpu.models import get_model
 

@@ -89,3 +89,19 @@ def test_space_to_depth_stem_exactly_equivalent():
     want = L.conv(stem, img, stride=2, compute_dtype=jnp.float32)
     got = _space_to_depth_stem(stem, img, jnp.float32)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_batchnorm_high_mean_low_variance_no_nan():
+    # One-pass E[x2]-E[x]2 cancels catastrophically for near-constant
+    # high-mean channels; the clamp must keep rsqrt finite (r2 review).
+    import numpy as np
+    from autodist_tpu.models import layers as L
+
+    x = jnp.full((16, 8, 8, 4), 100.0, jnp.float32) + \
+        jax.random.normal(jax.random.PRNGKey(0), (16, 8, 8, 4)) * 1e-3
+    p = L.batchnorm_init(4)
+    y = L.batchnorm(p, x)
+    assert np.isfinite(np.asarray(y)).all()
+    x2 = jnp.full((4, 2, 2, 1), 255.0, jnp.float32)  # exactly constant
+    y2 = L.batchnorm(L.batchnorm_init(1), x2)
+    assert np.isfinite(np.asarray(y2)).all()
