@@ -495,7 +495,9 @@ class ShardingPlan:
             shape = tuple(getattr(leaf, "shape", ()))
             if len(shape) >= 1 and shape[0] % n == 0 and shape[0] > 0:
                 return self._sharding(P(ax))
-            if len(shape) >= 1 and shape[0] % n != 0 and strict:
+            # Leading dim 1 is a deliberate broadcast leaf (attention
+            # masks, per-feature constants): replicate without complaint.
+            if len(shape) >= 1 and shape[0] > 1 and shape[0] % n != 0 and strict:
                 raise ValueError(
                     f"global batch dim {shape[0]} not divisible by data-parallel "
                     f"degree {n}"
@@ -637,11 +639,6 @@ class DistributedTrainStep:
         self._compiled_eval: Dict[Any, Any] = {}
         self._state_shardings = None
         self._compressors = self._resolve_compressors(plan)
-        if self._accum > 1 and self._compressors:
-            raise ValueError(
-                "grad_accum_steps > 1 is not supported together with "
-                "gradient compression (the compressed sync owns the "
-                "grad computation)")
         self._stale = {
             name: p.staleness
             for name, p in plan.var_plans.items()
@@ -976,9 +973,24 @@ class DistributedTrainStep:
             ],
         )
 
-        loss_fn, has_aux = self.loss_fn, self.has_aux
+        loss_fn, has_aux, k = self.loss_fn, self.has_aux, self._accum
+        if k > 1:
+            # Validate (and later microbatch) ONLY the leaves the region
+            # data-shards; replicated leaves (broadcast masks, scalars —
+            # the spec_for_batch P() cases) ride through whole.
+            for leaf in jax.tree.leaves(batch):
+                shape = tuple(getattr(leaf, "shape", ()))
+                if (
+                    len(shape) >= 1 and shape[0] > 0 and shape[0] % n == 0
+                    and (shape[0] // n) % k != 0
+                ):
+                    raise ValueError(
+                        f"grad_accum_steps={k} with compression requires each "
+                        f"data shard's batch slice (global {shape[0]} / "
+                        f"{n} shards) to split into {k} microbatches; got "
+                        f"shape {shape}")
 
-        def local_fn(params, local_batch, comp_state):
+        def local_grads(params, local_batch):
             if has_aux:
                 (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                     params, local_batch
@@ -986,6 +998,53 @@ class DistributedTrainStep:
             else:
                 loss, grads = jax.value_and_grad(loss_fn)(params, local_batch)
                 aux = None
+            return loss, aux, grads
+
+        # Which leaves arrive data-sliced inside the manual region (the
+        # others — broadcast masks, scalars — arrive whole and must not be
+        # split along their leading dim).
+        sharded_leaf = jax.tree_util.tree_map(
+            lambda s: s == P(ax), batch_specs
+        )
+
+        def local_fn(params, local_batch, comp_state):
+            if k > 1:
+                # Microbatch INSIDE the manual region: accumulate local-mean
+                # grads over a scan, then compress + psum once — activation
+                # memory ÷ k with a single compressed collective per step.
+                def to_micro(x, is_sharded):
+                    if is_sharded and getattr(x, "ndim", 0) >= 1:
+                        return x.reshape((k, x.shape[0] // k) + x.shape[1:])
+                    return jnp.broadcast_to(
+                        jnp.asarray(x)[None],
+                        (k,) + tuple(getattr(x, "shape", ())))
+
+                micro = jax.tree.map(to_micro, local_batch, sharded_leaf)
+                zero_grads = jax.tree.map(jnp.zeros_like, params)
+                if has_aux:
+                    micro0 = jax.tree.map(lambda x: x[0], micro)
+                    aux_shape = jax.eval_shape(
+                        lambda: loss_fn(params, micro0)[1])
+                    zero_aux = jax.tree.map(
+                        lambda s: jnp.zeros(
+                            s.shape, jnp.promote_types(s.dtype, jnp.float32)),
+                        aux_shape)
+                else:
+                    zero_aux = None
+
+                def body(carry, mb):
+                    l_acc, g_acc, a_acc = carry
+                    l, a, g = local_grads(params, mb)
+                    g_acc = jax.tree.map(lambda A, G: A + G / k, g_acc, g)
+                    if a is not None:
+                        a_acc = jax.tree.map(lambda A, X: A + X / k, a_acc, a)
+                    return (l_acc + l / k, g_acc, a_acc), None
+
+                (loss, grads, aux), _ = lax.scan(
+                    body, (jnp.zeros((), jnp.float32), zero_grads, zero_aux),
+                    micro)
+            else:
+                loss, aux, grads = local_grads(params, local_batch)
             loss = lax.psum(loss, ax) / n
             if aux is not None:
                 aux = jax.tree.map(lambda x: lax.psum(x, ax) / n, aux)

