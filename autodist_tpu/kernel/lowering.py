@@ -859,9 +859,12 @@ class DistributedTrainStep:
 
         for leaf in jax.tree.leaves(batch):
             shape = getattr(leaf, "shape", ())
-            # Rank-0 leaves replicate (same tolerance as batch_shardings);
-            # batched leaves must split evenly.
-            if len(shape) >= 1 and (shape[0] == 0 or shape[0] % k != 0):
+            # Rank-0 and broadcast (leading-dim-1) leaves replicate — the
+            # same tolerance as batch_shardings; batched leaves must split
+            # evenly.
+            if len(shape) >= 1 and shape[0] > 1 and (
+                shape[0] == 0 or shape[0] % k != 0
+            ):
                 raise ValueError(
                     f"grad_accum_steps={k} requires every batched leaf's "
                     f"leading dim to be divisible by {k}; got shape {shape}")
@@ -870,10 +873,11 @@ class DistributedTrainStep:
             # [B, ...] -> [k, B/k, ...]; keep the micro batch dim sharded on
             # the data axis exactly where the plan would shard the full
             # batch (one all-to-all on the feed, versus resharding the
-            # whole activation set every micro-step). Rank-0 leaves ride
-            # along broadcast, one copy per micro-step.
-            if getattr(x, "ndim", 0) < 1:
-                m = jnp.broadcast_to(jnp.asarray(x)[None], (k,))
+            # whole activation set every micro-step). Rank-0 and broadcast
+            # leaves ride along whole, one copy per micro-step.
+            shape = tuple(getattr(x, "shape", ()))
+            if len(shape) < 1 or shape[0] <= 1:
+                m = jnp.broadcast_to(jnp.asarray(x)[None], (k,) + shape)
                 return lax.with_sharding_constraint(
                     m, NamedSharding(self.plan.mesh, P()))
             m = x.reshape((k, x.shape[0] // k) + x.shape[1:])
@@ -892,28 +896,39 @@ class DistributedTrainStep:
 
         micro_batches = jax.tree.map(to_micro, batch)
 
-        def body(carry, mb):
-            loss_acc, grads_acc, aux_acc = carry
+        def grads_fn(p, mb):
             if self.has_aux:
                 (loss, aux), grads = jax.value_and_grad(
-                    self.loss_fn, has_aux=True)(params, mb)
-                aux_acc = jax.tree.map(lambda a, x: a + x / k, aux_acc, aux)
-            else:
-                loss, grads = jax.value_and_grad(self.loss_fn)(params, mb)
-            grads_acc = jax.tree.map(lambda a, g: a + g / k, grads_acc, grads)
-            return (loss_acc + loss / k, grads_acc, aux_acc), None
+                    self.loss_fn, has_aux=True)(p, mb)
+                return loss, aux, grads
+            loss, grads = jax.value_and_grad(self.loss_fn)(p, mb)
+            return loss, None, grads
 
+        return self._scan_accumulate(grads_fn, params, micro_batches, k)
+
+    def _scan_accumulate(self, grads_fn, params, micro_batches, k):
+        """Shared microbatch-accumulation core (plain and compressed paths):
+        scan ``grads_fn`` over the leading ``k`` dim, averaging loss, aux
+        (promoted to ≥f32 — ``a + x/k`` needs a dtype-stable carry) and
+        grads."""
         zero_grads = jax.tree.map(jnp.zeros_like, params)
         if self.has_aux:
             micro0 = jax.tree.map(lambda x: x[0], micro_batches)
             aux_shape = jax.eval_shape(lambda: self.loss_fn(params, micro0)[1])
-            # Accumulate aux in (at least) f32: ``a + x / k`` promotes int
-            # aux to float, and scan requires a dtype-stable carry.
             zero_aux = jax.tree.map(
                 lambda s: jnp.zeros(s.shape, jnp.promote_types(s.dtype, jnp.float32)),
                 aux_shape)
         else:
             zero_aux = None
+
+        def body(carry, mb):
+            loss_acc, grads_acc, aux_acc = carry
+            loss, aux, grads = grads_fn(params, mb)
+            grads_acc = jax.tree.map(lambda a, g: a + g / k, grads_acc, grads)
+            if aux is not None:
+                aux_acc = jax.tree.map(lambda a, x: a + x / k, aux_acc, aux)
+            return (loss_acc + loss / k, grads_acc, aux_acc), None
+
         (loss, grads, aux), _ = lax.scan(
             body, (jnp.zeros((), jnp.float32), zero_grads, zero_aux),
             micro_batches,
@@ -1010,8 +1025,9 @@ class DistributedTrainStep:
         def local_fn(params, local_batch, comp_state):
             if k > 1:
                 # Microbatch INSIDE the manual region: accumulate local-mean
-                # grads over a scan, then compress + psum once — activation
-                # memory ÷ k with a single compressed collective per step.
+                # grads over a scan (the shared _scan_accumulate core), then
+                # compress + psum once — activation memory ÷ k with a single
+                # compressed collective per step.
                 def to_micro(x, is_sharded):
                     if is_sharded and getattr(x, "ndim", 0) >= 1:
                         return x.reshape((k, x.shape[0] // k) + x.shape[1:])
@@ -1020,29 +1036,8 @@ class DistributedTrainStep:
                         (k,) + tuple(getattr(x, "shape", ())))
 
                 micro = jax.tree.map(to_micro, local_batch, sharded_leaf)
-                zero_grads = jax.tree.map(jnp.zeros_like, params)
-                if has_aux:
-                    micro0 = jax.tree.map(lambda x: x[0], micro)
-                    aux_shape = jax.eval_shape(
-                        lambda: loss_fn(params, micro0)[1])
-                    zero_aux = jax.tree.map(
-                        lambda s: jnp.zeros(
-                            s.shape, jnp.promote_types(s.dtype, jnp.float32)),
-                        aux_shape)
-                else:
-                    zero_aux = None
-
-                def body(carry, mb):
-                    l_acc, g_acc, a_acc = carry
-                    l, a, g = local_grads(params, mb)
-                    g_acc = jax.tree.map(lambda A, G: A + G / k, g_acc, g)
-                    if a is not None:
-                        a_acc = jax.tree.map(lambda A, X: A + X / k, a_acc, a)
-                    return (l_acc + l / k, g_acc, a_acc), None
-
-                (loss, grads, aux), _ = lax.scan(
-                    body, (jnp.zeros((), jnp.float32), zero_grads, zero_aux),
-                    micro)
+                loss, aux, grads = self._scan_accumulate(
+                    local_grads, params, micro, k)
             else:
                 loss, aux, grads = local_grads(params, local_batch)
             loss = lax.psum(loss, ax) / n

@@ -52,9 +52,37 @@ def build_mesh(
     axis_names = tuple(shape.keys())
     dims = [shape[ax] for ax in axis_names]
     if devices and devices[0].platform == "tpu":
-        try:
-            from jax.experimental import mesh_utils
+        from jax.experimental import mesh_utils
 
+        slice_ids = {getattr(d, "slice_index", None) for d in devices}
+        slice_ids.discard(None)
+        n_slices = max(len(slice_ids), 1)
+        if n_slices > 1 and dims[0] % n_slices == 0:
+            # Multi-slice pod: only the DATA (outermost) axis crosses
+            # DCN — its gradient all-reduce tolerates the slower hops
+            # via hierarchical reduce-scatter — while model/seq/expert
+            # axes stay inside a slice so their per-layer collectives
+            # ride ICI (the scaling-book layout; the reference's analog
+            # was `network_bandwidth` steering PS placement).
+            try:
+                dcn = [n_slices] + [1] * (len(dims) - 1)
+                ici = [dims[0] // n_slices] + list(dims[1:])
+                mesh_devices = mesh_utils.create_hybrid_device_mesh(
+                    ici, dcn, devices=devices
+                )
+                return Mesh(mesh_devices, axis_names)
+            except Exception as e:  # noqa: BLE001 - ICI-aware path still next
+                logging.warning(
+                    "create_hybrid_device_mesh failed (%s); falling back to "
+                    "create_device_mesh", e,
+                )
+        elif n_slices > 1:
+            logging.warning(
+                "multi-slice runtime (%d slices) but data axis %d does "
+                "not divide by the slice count — model-axis collectives "
+                "may cross DCN", n_slices, dims[0],
+            )
+        try:
             mesh_devices = mesh_utils.create_device_mesh(dims, devices=devices)
             return Mesh(mesh_devices, axis_names)
         except Exception as e:  # noqa: BLE001 - fall back to naive order
