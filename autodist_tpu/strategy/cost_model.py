@@ -109,11 +109,17 @@ def candidate_slate(
     from autodist_tpu.strategy.random_axis_partition_all_reduce_strategy import (
         RandomAxisPartitionAR,
     )
+    from autodist_tpu.strategy.tensor_parallel_strategy import TensorParallel
     from autodist_tpu.strategy.uneven_partition_ps_strategy import UnevenPartitionedPS
 
     slate: List[Tuple[str, object]] = [
         ("AllReduce", AllReduce(chunk_size=chunk_size)),
         ("PartitionedAR", PartitionedAR(chunk_size=chunk_size)),
+        # Megatron axis pairing: the winner on model-axis meshes for
+        # transformer-shaped models; degrades to ZeRO-style data-axis
+        # sharding on pure-DP meshes, where the ranking judges it like the
+        # PS variants.
+        ("TensorParallel", TensorParallel()),
         ("PSLoadBalancing", PSLoadBalancing()),
         ("PS(zero3)", PS(local_proxy_variable=False)),
         ("PS(zero1)", PS(local_proxy_variable=True)),

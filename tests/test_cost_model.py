@@ -496,3 +496,29 @@ class TestExpertCosting:
         # bytes per chip under the expert mesh vs full bytes under pure DP.
         assert cost_d.per_chip_bytes - cost_e.per_chip_bytes >= (
             0.7 * expert_bytes * (1 - 1 / 4))
+
+
+def test_slate_includes_tensor_parallel_and_it_ranks_on_model_mesh():
+    # r2: the shared slate offers TensorParallel; on a data×model mesh with
+    # a transformer-shaped ModelItem it must at least rank feasibly (the
+    # activation-vs-residency tradeoff decides the winner per model).
+    from autodist_tpu.strategy.cost_model import candidate_slate
+
+    names = [n for n, _ in candidate_slate()]
+    assert "TensorParallel" in names
+    item = _item({f"l{i}/{r}": (1024, 4096) if r == "fc1" else (4096, 1024)
+                  for i in range(4) for r in ("fc1", "fc2")}, opt="adam")
+    spec = ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+        "mesh": {"data": 2, "model": 4},
+    })
+    cm = CostModel(item, spec)
+    ranked = cm.rank([
+        (n, b.build(item, spec)) for n, b in candidate_slate()
+    ])
+    by_name = dict(ranked)
+    assert "TensorParallel" in by_name
+    tp = by_name["TensorParallel"]
+    assert tp.feasible
+    # TP's residency is sharded: well below the replicated AllReduce row.
+    assert tp.per_chip_bytes < by_name["AllReduce"].per_chip_bytes
