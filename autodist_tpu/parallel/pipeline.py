@@ -119,7 +119,10 @@ def pipeline_apply(
     usually the right trade at large microbatch counts.
     """
     if remat_stages:
-        stage_fn = jax.checkpoint(stage_fn)
+        # prevent_cse=False: the checkpointed stage only ever runs inside
+        # lax.scan bodies (the tick loop / the sequential fallback), where
+        # the CSE-prevention barrier is unnecessary overhead.
+        stage_fn = jax.checkpoint(stage_fn, prevent_cse=False)
     if mesh is None:
         from autodist_tpu.api import get_default_autodist
 

@@ -97,7 +97,9 @@ def ring_attention_local(
             return lax.cond(kv_idx <= r, attend, lambda args: args, (m, l, acc))
         return attend((m, l, acc))
 
-    @jax.checkpoint
+    # prevent_cse=False: this body runs only inside lax.scan, where the
+    # CSE barrier is unnecessary overhead (same note in pipeline.py).
+    @functools.partial(jax.checkpoint, prevent_cse=False)
     def step(carry, t):
         k_t, v_t, m, l, acc = carry
         m, l, acc = attend_step(t, k_t, v_t, m, l, acc)
