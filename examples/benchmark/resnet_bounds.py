@@ -1,0 +1,87 @@
+"""ResNet-50 bound experiments: isolate remaining non-MXU cost.
+
+Usage (on the bench chip)::
+
+    python examples/benchmark/resnet_bounds.py base 128 20
+    python examples/benchmark/resnet_bounds.py nostats 128 20
+    python examples/benchmark/resnet_bounds.py avgstem 128 20
+
+Each variant prints ms/step, img/s and MFU for a windowed run with the
+batch pinned in HBM (docs/performance.md "compute" methodology). The
+bounds quantify how much of the remaining step time the BN statistics
+reductions and the maxpool backward (SelectAndScatter) account for —
+the per-op evidence behind the conv-net ceiling discussion in
+docs/performance.md.
+
+Variants (current repo BN = one-pass bf16-normalize is the baseline):
+  base          — repo as-is
+  nostats       — BN without batch statistics (scale/bias only): bounds the
+                  cost of the stats reductions
+  avgstem       — stem max_pool replaced by avg_pool: bounds the
+                  SelectAndScatter (maxpool backward) cost
+  bf16feed      — batch pinned in HBM as bf16 (halves image read traffic)
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from autodist_tpu.kernel.lowering import DistributedTrainStep, GraphTransformer
+from autodist_tpu.kernel.mesh import build_mesh
+from autodist_tpu.model_item import ModelItem, OptimizerSpec
+from autodist_tpu.models import get_model
+from autodist_tpu.models import layers as L
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+from autodist_tpu.strategy.base import StrategyCompiler
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "base"
+BATCH = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+WINDOW = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+PEAK = 197e12
+
+
+def bn_nostats(p, x, eps=1e-5):
+    return x * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+
+if VARIANT == "nostats":
+    L.batchnorm = bn_nostats
+elif VARIANT == "avgstem":
+    orig_max_pool = L.max_pool
+    L.max_pool = lambda x, w, s, padding="SAME": L.avg_pool(x, w, s, padding)
+
+spec = get_model("resnet")
+params = spec.init(jax.random.PRNGKey(0))
+batch = spec.example_batch(BATCH)
+if VARIANT == "bf16feed":
+    batch = {"images": batch["images"].astype(jnp.bfloat16),
+             "labels": batch["labels"]}
+
+rs = ResourceSpec.from_local_devices()
+mi = ModelItem.from_params(
+    params, optimizer_spec=OptimizerSpec("sgd", {"learning_rate": 0.1}),
+    loss_fn=spec.loss_fn, example_batch=batch)
+strategy = StrategyCompiler(mi).compile(AllReduce().build(mi, rs))
+plan = GraphTransformer(strategy, mi, build_mesh(rs, axes=("data",))).transform()
+step = DistributedTrainStep(plan, spec.loss_fn, optax.sgd(0.1))
+state = step.init(params)
+batch = jax.device_put(batch, step.plan.batch_shardings(batch))
+jax.block_until_ready(batch)
+
+state, m = step.run(state, batch, WINDOW)
+float(m["loss"][-1])
+best = None
+for _ in range(2):
+    t0 = time.perf_counter()
+    state, m = step.run(state, batch, WINDOW)
+    float(m["loss"][-1])
+    dt = (time.perf_counter() - t0) / WINDOW
+    best = dt if best is None else min(best, dt)
+img_s = BATCH / best
+flops = spec.flops_per_example * BATCH / best
+print(f"VARIANT {VARIANT} b{BATCH} w{WINDOW}: {best*1e3:.2f} ms/step  "
+      f"{img_s:.0f} img/s  {flops/1e12:.1f} TFLOP/s  MFU={flops/PEAK:.3f}")
