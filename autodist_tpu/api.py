@@ -34,6 +34,7 @@ from typing import Any, Callable, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from autodist_tpu import const
@@ -150,8 +151,6 @@ class AutoDist:
         - coordinator-launched workers (reference SSH-relaunch model):
           load by ``AUTODIST_STRATEGY_ID`` from the shipped file.
         """
-        import jax
-
         shipped_id = ENV.AUTODIST_STRATEGY_ID.val
         if jax.process_count() > 1 and not (not self.is_chief and shipped_id):
             # Connected fleet without a coordinator-shipped strategy file:
@@ -187,8 +186,6 @@ class AutoDist:
         """
         import json as _json
 
-        import jax
-        import numpy as np
         from jax.experimental import multihost_utils
 
         if jax.process_index() == 0:
@@ -346,9 +343,7 @@ class AutoDist:
         """
         import time
 
-        import numpy as np
-
-        from autodist_tpu.strategy.cost_model import candidate_slate
+        from autodist_tpu.strategy.cost_model import CostModel, candidate_slate
 
         if candidates is None:
             candidates = candidate_slate()
@@ -404,8 +399,6 @@ class AutoDist:
             try:
                 # Cost the exact strategy just timed (self._strategy is the
                 # one build() compiled — on a fleet, the chief-broadcast one).
-                from autodist_tpu.strategy.cost_model import CostModel
-
                 predicted[name] = CostModel(
                     self._model_item, self.resource_spec
                 ).strategy_cost(self._strategy)
@@ -517,8 +510,6 @@ class AutoDist:
         """Pre-sweep validation of the fleet feed contract (see
         :meth:`_fleet_bench_batch`), so a bad batch fails once with the
         real cause instead of failing every candidate after a full build."""
-        import numpy as np
-
         pc = jax.process_count()
         for leaf in jax.tree.leaves(example_batch):
             shape = tuple(np.shape(leaf))
@@ -539,8 +530,6 @@ class AutoDist:
         global example, so each takes its row slice.
         (:meth:`_check_fleet_batch` owns the divisibility validation.)
         """
-        import numpy as np
-
         pi, pc = jax.process_index(), jax.process_count()
         AutoDist._check_fleet_batch(example_batch)
 
@@ -576,8 +565,6 @@ class AutoDist:
         JAX functions are already traceable — this only adds sharding
         constraints + compile caching.
         """
-        import jax
-
         jitted = jax.jit(fn)
 
         def wrapper(*args):

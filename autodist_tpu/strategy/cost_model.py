@@ -34,8 +34,12 @@ ResourceSpec (Gbps on the wire, GB/s for HBM).
 """
 from __future__ import annotations
 
+import json
+import os
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from autodist_tpu.model_item import ModelItem, VarItem
 from autodist_tpu.resource_spec import ResourceSpec
@@ -169,8 +173,6 @@ class Calibration:
         (measurement noise dominating) also falls back to ``scale = 1`` so
         calibrated predictions never invert the analytical ranking.
         """
-        import numpy as np
-
         pred = np.asarray(predicted, np.float64)
         meas = np.asarray(measured, np.float64)
         ok = np.isfinite(pred) & np.isfinite(meas)
@@ -195,9 +197,6 @@ class Calibration:
 
     # ------------------------------------------------------------ persistence
     def save(self, path: Optional[str] = None) -> str:
-        import json
-        import os
-
         from autodist_tpu import const
 
         if path is None:
@@ -217,9 +216,6 @@ class Calibration:
 
     @classmethod
     def load(cls, path: Optional[str] = None) -> Optional["Calibration"]:
-        import json
-        import os
-
         from autodist_tpu import const
 
         if path is None:
