@@ -43,7 +43,7 @@ from autodist_tpu.kernel import DistributedTrainStep, GraphTransformer, Sharding
 from autodist_tpu.model_item import ModelItem, OptimizerSpec
 from autodist_tpu.resource_spec import ResourceSpec
 from autodist_tpu.strategy import PSLoadBalancing, Strategy, StrategyBuilder, StrategyCompiler
-from autodist_tpu.utils import logging
+from autodist_tpu.utils import is_broadcast_leaf, logging
 
 _default_autodist: Optional["AutoDist"] = None
 
@@ -513,7 +513,10 @@ class AutoDist:
         pc = jax.process_count()
         for leaf in jax.tree.leaves(example_batch):
             shape = tuple(np.shape(leaf))
-            if len(shape) >= 1 and shape[0] > 0 and shape[0] % pc != 0:
+            # Broadcast leaves (is_broadcast_leaf — masks, per-feature
+            # constants) replicate and are exempt from the per-process
+            # divisibility contract.
+            if not is_broadcast_leaf(shape) and shape[0] % pc != 0:
                 raise ValueError(
                     f"tune() on a {pc}-process fleet needs every batched "
                     f"leaf's leading dim divisible by {pc}; got {shape}"
@@ -533,14 +536,23 @@ class AutoDist:
         pi, pc = jax.process_index(), jax.process_count()
         AutoDist._check_fleet_batch(example_batch)
 
-        def to_local(x):
+        # The broadcast mask comes from the GLOBAL example shapes — after
+        # slicing, a genuinely batched leaf with global batch == pc also has
+        # local leading dim 1 and could not be told apart.
+        broadcast = jax.tree.map(
+            lambda x: is_broadcast_leaf(np.shape(x)), example_batch)
+
+        def to_local(x, is_bcast):
             arr = np.asarray(x)
-            if arr.ndim >= 1 and arr.shape[0] > 0:
+            # Broadcast leaves stay whole on every process; slicing them
+            # would hand k=0 rows to each host.
+            if not is_bcast:
                 k = arr.shape[0] // pc
                 return arr[pi * k:(pi + 1) * k]
             return arr
 
-        return plan.global_batch_from_local(jax.tree.map(to_local, example_batch))
+        return plan.global_batch_from_local(
+            jax.tree.map(to_local, example_batch, broadcast), broadcast)
 
     # ------------------------------------------------------------- accessors
     @property

@@ -309,6 +309,8 @@ class Saver:
             region = data[tuple(slice(a, b) for a, b in zip(start, stop))]
             return np.asarray(region)
         out: Optional[np.ndarray] = None
+        covered = 0
+        volume = int(np.prod(req_shape)) if req_shape else 1
         for sh in shards:
             s_start, s_stop = sh["start"], sh["stop"]
             lo = [max(a, sa) for a, sa in zip(start, s_start)]
@@ -324,10 +326,16 @@ class Saver:
                 out = np.empty(req_shape, dtype=np.dtype(entry["dtype"]))
             dst = tuple(slice(a - ra, b - ra) for a, b, ra in zip(lo, hi, start))
             out[dst] = data[src]
-        if out is None:
+            covered += int(np.prod([b - a for a, b in zip(lo, hi)]))
+        # Shard blocks tile the entry disjointly (one owner per block), so
+        # the overlap volumes must sum to exactly the requested region; a
+        # shortfall means a missing/mislisted shard and np.empty gaps would
+        # otherwise be returned as (silently corrupt) parameter data.
+        if out is None or covered != volume:
             raise ValueError(
-                f"checkpoint entry {name!r}: no shard overlaps region "
-                f"{start}:{stop} — corrupt block layout"
+                f"checkpoint entry {name!r}: shards cover {covered} of "
+                f"{volume} elements in region {start}:{stop} — corrupt or "
+                f"incomplete block layout"
             )
         return out
 

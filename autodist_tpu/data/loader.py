@@ -155,8 +155,14 @@ class DataLoader:
         """This process's batch is its local slice of the global batch —
         the plan dispatches: single-process device_put vs multi-host
         assembly (each host loads 1/P of the data, the reference's
-        per-worker feed-splitting contract in reverse)."""
-        return self.plan.global_batch_from_local(batch)
+        per-worker feed-splitting contract in reverse).
+
+        Every loader leaf is batched by construction (row-sliced from the
+        dataset), so the broadcast mask is explicitly all-False: a per-host
+        batch of 1 must concatenate across hosts, not be misread as a
+        replicated broadcast leaf by the dim-1 convention."""
+        return self.plan.global_batch_from_local(
+            batch, broadcast={name: False for name in batch})
 
     def _iter_python(self):
         total = None if self.epochs < 0 else self.epochs

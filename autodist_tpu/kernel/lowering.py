@@ -53,7 +53,7 @@ from autodist_tpu.strategy.ir import (
     PSSynchronizer,
     Strategy,
 )
-from autodist_tpu.utils import logging
+from autodist_tpu.utils import is_broadcast_leaf, logging
 
 
 class SyncKind(Enum):
@@ -493,11 +493,11 @@ class ShardingPlan:
 
         def leaf_sharding(leaf):
             shape = tuple(getattr(leaf, "shape", ()))
-            if len(shape) >= 1 and shape[0] % n == 0 and shape[0] > 0:
+            if not is_broadcast_leaf(shape) and shape[0] % n == 0:
                 return self._sharding(P(ax))
-            # Leading dim 1 is a deliberate broadcast leaf (attention
-            # masks, per-feature constants): replicate without complaint.
-            if len(shape) >= 1 and shape[0] > 1 and shape[0] % n != 0 and strict:
+            # Broadcast leaves (attention masks, per-feature constants —
+            # see is_broadcast_leaf) replicate without complaint.
+            if not is_broadcast_leaf(shape) and shape[0] % n != 0 and strict:
                 raise ValueError(
                     f"global batch dim {shape[0]} not divisible by data-parallel "
                     f"degree {n}"
@@ -506,11 +506,20 @@ class ShardingPlan:
 
         return jax.tree_util.tree_map(leaf_sharding, batch)
 
-    def global_batch_from_local(self, local_batch) -> Any:
+    def global_batch_from_local(self, local_batch, broadcast=None) -> Any:
         """Assemble per-process batch shards into global arrays (multi-host
         feed path — the remapper's feed-splitting contract in reverse,
         reference remapper.py:81-123: each host loads only its slice of the
         global batch, dim 0 concatenates across processes).
+
+        ``broadcast`` optionally disambiguates leaves whose LOCAL leading dim
+        is 1: a pytree of bools (same structure as ``local_batch``) marking
+        leaves every process holds whole (replicated) rather than as a slice.
+        Without it, local leading dim <= 1 is taken as broadcast — the
+        framework convention (``is_broadcast_leaf``) — which mis-classifies a
+        genuinely batched leaf whose per-process batch is exactly 1; callers
+        that know the global shapes (e.g. the fleet-tune feed) should pass
+        the mask.
 
         Single-process: equivalent to ``device_put`` with batch shardings.
         """
@@ -520,32 +529,40 @@ class ShardingPlan:
         import numpy as np
 
         n_proc = jax.process_count()
+        if broadcast is None:
+            broadcast = jax.tree_util.tree_map(
+                lambda x: is_broadcast_leaf(np.shape(x)), local_batch
+            )
 
-        def global_shape_of(x) -> Tuple[int, ...]:
+        def global_shape_of(x, is_bcast) -> Tuple[int, ...]:
             shape = tuple(np.shape(x))
-            if not shape:  # rank-0: replicated, same value on every process
+            # Broadcast (and rank-0) leaves are replicated: every process
+            # holds the same value, so the global shape is the local shape.
+            if not shape or is_bcast:
                 return shape
             return (shape[0] * n_proc,) + shape[1:]
 
-        def leaf_to_global(leaf, sharding):
+        def leaf_to_global(leaf, sharding, is_bcast):
             arr = np.asarray(leaf)
             if arr.ndim == 0:
                 # Replicated scalar: every process holds the same value;
                 # make_array_from_process_local_data has no dim to concat.
                 return jax.make_array_from_callback((), sharding, lambda _: arr)
             return jax.make_array_from_process_local_data(
-                sharding, arr, global_shape_of(arr))
+                sharding, arr, global_shape_of(arr, is_bcast))
 
         shardings = self.batch_shardings(
             jax.tree_util.tree_map(
-                lambda x: jax.ShapeDtypeStruct(
-                    global_shape_of(x), getattr(x, "dtype", None) or np.asarray(x).dtype
+                lambda x, b: jax.ShapeDtypeStruct(
+                    global_shape_of(x, b),
+                    getattr(x, "dtype", None) or np.asarray(x).dtype,
                 ),
-                local_batch,
+                local_batch, broadcast,
             ),
             strict=False,
         )
-        return jax.tree_util.tree_map(leaf_to_global, local_batch, shardings)
+        return jax.tree_util.tree_map(
+            leaf_to_global, local_batch, shardings, broadcast)
 
     def comp_shardings(self, comp_state) -> Any:
         """Compressor-state shardings: per-worker ("local") leaves carry a
@@ -859,12 +876,10 @@ class DistributedTrainStep:
 
         for leaf in jax.tree.leaves(batch):
             shape = getattr(leaf, "shape", ())
-            # Rank-0 and broadcast (leading-dim-1) leaves replicate — the
-            # same tolerance as batch_shardings; batched leaves must split
+            # Broadcast leaves replicate (is_broadcast_leaf — the same
+            # tolerance as batch_shardings); batched leaves must split
             # evenly.
-            if len(shape) >= 1 and shape[0] > 1 and (
-                shape[0] == 0 or shape[0] % k != 0
-            ):
+            if not is_broadcast_leaf(shape) and shape[0] % k != 0:
                 raise ValueError(
                     f"grad_accum_steps={k} requires every batched leaf's "
                     f"leading dim to be divisible by {k}; got shape {shape}")
@@ -876,7 +891,7 @@ class DistributedTrainStep:
             # whole activation set every micro-step). Rank-0 and broadcast
             # leaves ride along whole, one copy per micro-step.
             shape = tuple(getattr(x, "shape", ()))
-            if len(shape) < 1 or shape[0] <= 1:
+            if is_broadcast_leaf(shape):
                 m = jnp.broadcast_to(jnp.asarray(x)[None], (k,) + shape)
                 return lax.with_sharding_constraint(
                     m, NamedSharding(self.plan.mesh, P()))

@@ -57,16 +57,31 @@ def build_mesh(
         slice_ids = {getattr(d, "slice_index", None) for d in devices}
         slice_ids.discard(None)
         n_slices = max(len(slice_ids), 1)
-        if n_slices > 1 and dims[0] % n_slices == 0:
-            # Multi-slice pod: only the DATA (outermost) axis crosses
-            # DCN — its gradient all-reduce tolerates the slower hops
-            # via hierarchical reduce-scatter — while model/seq/expert
+        # The DCN-crossing axis is the DATA axis *by role*, not positionally:
+        # a mesh override may list axes in any order. Resolved only when
+        # multi-slice placement needs it — a role-only mesh (no batch-capable
+        # axis) must still build on a single slice.
+        data_ix = None
+        if n_slices > 1:
+            try:
+                data_ix = axis_names.index(_data_axis_name(axis_names, shape))
+            except ValueError:
+                logging.warning(
+                    "multi-slice runtime (%d slices) but the mesh has no "
+                    "data-capable axis — collectives may cross DCN", n_slices,
+                )
+        if data_ix is not None and dims[data_ix] % n_slices == 0:
+            # Multi-slice pod: only the DATA axis crosses DCN — its
+            # gradient all-reduce tolerates the slower hops via
+            # hierarchical reduce-scatter — while model/seq/expert
             # axes stay inside a slice so their per-layer collectives
             # ride ICI (the scaling-book layout; the reference's analog
             # was `network_bandwidth` steering PS placement).
             try:
-                dcn = [n_slices] + [1] * (len(dims) - 1)
-                ici = [dims[0] // n_slices] + list(dims[1:])
+                dcn = [1] * len(dims)
+                dcn[data_ix] = n_slices
+                ici = list(dims)
+                ici[data_ix] = dims[data_ix] // n_slices
                 mesh_devices = mesh_utils.create_hybrid_device_mesh(
                     ici, dcn, devices=devices
                 )
@@ -76,11 +91,11 @@ def build_mesh(
                     "create_hybrid_device_mesh failed (%s); falling back to "
                     "create_device_mesh", e,
                 )
-        elif n_slices > 1:
+        elif data_ix is not None:
             logging.warning(
                 "multi-slice runtime (%d slices) but data axis %d does "
                 "not divide by the slice count — model-axis collectives "
-                "may cross DCN", n_slices, dims[0],
+                "may cross DCN", n_slices, dims[data_ix],
             )
         try:
             mesh_devices = mesh_utils.create_device_mesh(dims, devices=devices)
@@ -90,6 +105,43 @@ def build_mesh(
     return Mesh(np.asarray(devices).reshape(dims), axis_names)
 
 
+def _data_axis_name(names: Sequence[str], sizes: Dict[str, int]) -> str:
+    """Resolve which axis carries the batch (shared by :func:`data_axis`
+    and :func:`build_mesh`'s DCN-placement logic).
+
+    ``data`` when present with degree > 1. When a mesh override uses a
+    custom axis name (e.g. ``{"x": 8}``), ``mesh_shape`` still setdefaults a
+    size-1 ``data`` axis — there, the batch axis is the custom-named axis
+    (degree > 1, not a known model/seq/expert/pipe role), not the vestigial
+    ``data``. Known non-data roles are never picked even when ``data`` has
+    degree 1: ``{"model": 8}`` means the user asked for pure model
+    parallelism with a replicated batch.
+    """
+    non_data_roles = set(const.ALL_MESH_AXES) - {const.MESH_AXIS_DATA}
+    if const.MESH_AXIS_DATA not in names:
+        for ax in names:
+            if ax not in non_data_roles:
+                return ax
+        # Every axis is a known non-data role (e.g. axes=("model",)):
+        # putting the batch on any of them would silently corrupt training
+        # (each model shard would see different examples). Pure model
+        # parallelism is spelled with a size-1 data axis — the default
+        # mesh_axes includes one automatically.
+        raise ValueError(
+            f"mesh axes {tuple(names)} contain no axis that can carry the "
+            f"batch; include '{const.MESH_AXIS_DATA}' (size 1 for pure "
+            f"model parallelism) in mesh_axes"
+        )
+    if sizes[const.MESH_AXIS_DATA] > 1:
+        return const.MESH_AXIS_DATA
+    for ax in names:
+        if ax not in non_data_roles and sizes[ax] > 1:
+            return ax
+    return const.MESH_AXIS_DATA
+
+
 def data_axis(mesh: Mesh) -> str:
-    """The batch axis name (first axis by convention)."""
-    return mesh.axis_names[0]
+    """The batch axis name (see :func:`_data_axis_name`)."""
+    return _data_axis_name(
+        mesh.axis_names, dict(zip(mesh.axis_names, mesh.devices.shape))
+    )

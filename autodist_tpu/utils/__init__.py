@@ -1,0 +1,24 @@
+"""Shared small utilities."""
+from __future__ import annotations
+
+from typing import Sequence
+
+
+def is_broadcast_leaf(shape: Sequence[int]) -> bool:
+    """The framework-wide broadcast convention for batch leaves, in ONE place.
+
+    A batch leaf whose (global) shape is rank-0 or has leading dim <= 1 is a
+    deliberate broadcast leaf — an attention mask, a per-feature constant —
+    and is replicated rather than sharded/split/sliced along the batch axis.
+    Every site that splits, shards, validates, or assembles a batch
+    (``batch_shardings``, ``global_batch_from_local``, microbatch splitting,
+    the fleet-tune feed contract) must use this predicate so the convention
+    cannot drift between call sites.
+
+    Note the contract is about GLOBAL shapes. A per-process *local* slice of
+    a genuinely batched leaf can also have leading dim 1 (global batch ==
+    process count); callers holding only local shapes must disambiguate
+    explicitly (see ``global_batch_from_local``'s ``broadcast`` parameter).
+    """
+    shape = tuple(shape)
+    return len(shape) == 0 or shape[0] <= 1

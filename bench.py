@@ -4,12 +4,13 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 The reference publishes no numeric tables (BASELINE.md), so ``vs_baseline``
 is measured MFU / 0.50, the BASELINE.json north-star target (>=50% MFU).
 
-Default workload is the flagship BERT-base MLM through the full AutoDist
-pipeline (AllReduce strategy) on whatever devices are visible — the real
-TPU chip under the driver, or CPU (tiny config) for local smoke runs.
-``python bench.py --model resnet`` measures the ResNet-50 image workload
-instead (BASELINE.json's second named target); docs/performance.md records
-the per-round sweep.
+By default BOTH named BASELINE.json workloads run — the flagship BERT-base
+MLM (AllReduce strategy, the headline ``metric: bert_base_mfu``) and the
+ResNet-50 image workload (``resnet50_mfu``/``resnet50_images_per_sec_per_chip``
+extras in the same line) — so the driver's single ``python bench.py`` call
+externally gates CNN perf too (VERDICT r2 #1/#3). ``--model bert|resnet``
+restricts to one workload for manual runs; docs/performance.md records the
+per-round sweep.
 """
 from __future__ import annotations
 
@@ -17,9 +18,6 @@ import argparse
 import json
 import sys
 import time
-
-import jax
-import jax.numpy as jnp
 
 
 # Peak bf16 FLOPs/s per chip by TPU generation (public figures). Matched
@@ -45,13 +43,11 @@ def _peak_flops(device) -> tuple:
     return DEFAULT_PEAK, False
 
 
-def _preflight(timeout_s: float = 180.0) -> bool:
-    """True if the accelerator answers a trivial op within ``timeout_s``.
+def _probe_once(timeout_s: float) -> bool:
+    """One fresh-subprocess probe: does a trivial matmul answer in time?
 
-    The axon tunnel can wedge persistently (e.g. after a transfer raced an
-    in-flight dispatch in some earlier process); a hung bench run reports
-    nothing at all, so probe in a subprocess and fail fast with an error
-    line instead.
+    The wedge is per-tunnel but each *hung* process stays hung — a fresh
+    subprocess per attempt is the only way a later attempt can succeed.
     """
     import subprocess
 
@@ -74,26 +70,65 @@ def _preflight(timeout_s: float = 180.0) -> bool:
     return True
 
 
-def main() -> None:
+def _preflight(timeouts=None, backoffs=None) -> bool:
+    """True if the accelerator answers a trivial op.
+
+    The axon tunnel can wedge for long stretches (a transfer racing an
+    in-flight dispatch in some earlier process); a hung bench run reports
+    nothing at all. Probe in fresh subprocesses with backoff between
+    attempts (~15 min worst case) so a wedge that clears mid-run still
+    yields a real TPU number instead of a CPU smoke fallback (VERDICT r2 #1).
+    ``BENCH_PREFLIGHT_TIMEOUTS``/``BENCH_PREFLIGHT_BACKOFFS`` (comma-separated
+    seconds) override the schedule, e.g. ``BENCH_PREFLIGHT_TIMEOUTS=10`` for a
+    single fast probe in local smoke runs.
+    """
+    import os
+
+    def _env(name, default, allow_empty=False):
+        raw = os.environ.get(name)
+        if raw is None:
+            return default
+        parsed = tuple(float(x) for x in raw.split(",") if x.strip())
+        # An empty TIMEOUTS schedule would mean "never probe" and report a
+        # healthy TPU as wedged; treat blank as unset. (Blank BACKOFFS is a
+        # legitimate "no waits" request.)
+        if not parsed and not allow_empty:
+            return default
+        return parsed
+
+    if timeouts is None:
+        timeouts = _env("BENCH_PREFLIGHT_TIMEOUTS", (120.0, 180.0, 180.0, 240.0))
+    if backoffs is None:
+        backoffs = _env("BENCH_PREFLIGHT_BACKOFFS", (60.0, 120.0, 240.0),
+                        allow_empty=True)
+    for i, t in enumerate(timeouts):
+        if _probe_once(t):
+            return True
+        if i + 1 < len(timeouts):
+            wait = backoffs[i] if i < len(backoffs) else 0.0
+            print(
+                f"bench: accelerator probe {i + 1}/{len(timeouts)} timed out "
+                f"({t:.0f}s); retrying in {wait:.0f}s",
+                file=sys.stderr,
+            )
+            time.sleep(wait)
+    return False
+
+
+def measure_workload(model_name: str, on_accel: bool) -> dict:
+    """Train-step throughput for one named workload on the visible devices.
+
+    Returns raw numbers; the caller formats the JSON line. Uses the full
+    AutoDist pipeline (AllReduce strategy) — the bench measures the
+    framework's production path, not a hand-written loop.
+    """
+    import jax
+
     from autodist_tpu.api import AutoDist
     from autodist_tpu.models import get_model
     import autodist_tpu.strategy as S
 
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--model", choices=("bert", "resnet"), default="bert")
-    args = ap.parse_args()
-
-    # Probe BEFORE touching the backend here: when the tunnel is wedged even
-    # jax.devices() blocks forever, so the parent must not initialize until
-    # a subprocess proves the platform answers. On probe failure fall back
-    # to the CPU smoke measurement rather than hanging or reporting nothing.
-    accel_ok = _preflight()
-    if not accel_ok:
-        jax.config.update("jax_platforms", "cpu")
-
-    dev = jax.devices()[0]
-    on_accel = dev.platform != "cpu"
-    if args.model == "resnet":
+    if model_name == "resnet":
         if on_accel:
             candidate_batches, steps = (128, 256), 20
             model_kw = dict()
@@ -101,7 +136,7 @@ def main() -> None:
             candidate_batches, steps = (8,), 3
             model_kw = dict(depth=18, image_size=32, num_classes=10)
         spec = get_model("resnet", **model_kw)
-        metric_name, unit_per = "resnet50_mfu", "images"
+        unit_per = "images"
     else:
         if on_accel:
             candidate_batches, steps = (64, 128), 20
@@ -113,7 +148,7 @@ def main() -> None:
                 d_ff=128, max_seq_len=32,
             )
         spec = get_model("bert_base", **model_kw)
-        metric_name, unit_per = "bert_base_mfu", "tokens"
+        unit_per = "tokens"
 
     params = spec.init(jax.random.PRNGKey(0))
 
@@ -155,40 +190,100 @@ def main() -> None:
         except Exception as e:
             # An OOM at a bigger candidate must not eat the result the
             # smaller one already produced.
-            print(f"bench: batch {bs} failed: {e}", file=sys.stderr)
+            print(f"bench[{model_name}]: batch {bs} failed: {e}", file=sys.stderr)
     if not results:
-        raise RuntimeError("every candidate batch size failed")
+        raise RuntimeError(f"{model_name}: every candidate batch size failed")
     batch_size = min(results, key=lambda bs: results[bs][0] / bs)
     dt, last_loss = results[batch_size]
 
-    seq = spec.config.max_seq_len if args.model == "bert" else 1
+    dev = jax.devices()[0]
+    seq = spec.config.max_seq_len if model_name == "bert" else 1
     examples_per_sec = batch_size * steps / dt
     units_per_sec = examples_per_sec * seq
     flops_per_step = spec.flops_per_example * batch_size
     achieved = flops_per_step * steps / dt
     n_chips = jax.device_count()
     peak_per_chip, peak_detected = _peak_flops(dev)
-    peak = peak_per_chip * n_chips if on_accel else float("nan")
-    mfu = achieved / peak if on_accel else float("nan")
-
-    result = {
-        "metric": metric_name if on_accel else f"{metric_name}_cpu_smoke",
-        "value": round(mfu, 4) if on_accel else round(units_per_sec, 1),
-        "unit": "mfu" if on_accel else f"{unit_per}/sec",
-        "vs_baseline": round(mfu / TARGET_MFU, 4) if on_accel else None,
-        f"{unit_per}_per_sec_per_chip": round(units_per_sec / n_chips, 1),
-        "achieved_tflops_per_chip": round(achieved / n_chips / 1e12, 2),
-        "device": getattr(dev, "device_kind", dev.platform),
-        "peak_tflops_assumed": None if peak_detected else round(DEFAULT_PEAK / 1e12),
+    mfu = achieved / (peak_per_chip * n_chips) if on_accel else float("nan")
+    return {
+        "unit_per": unit_per,
+        "mfu": mfu,
+        "units_per_sec": units_per_sec,
+        "achieved": achieved,
         "n_chips": n_chips,
         "batch_size": batch_size,
-        "loss": round(last_loss, 4),
+        "loss": last_loss,
+        "seq": seq,
+        "peak_detected": peak_detected,
+        "device": getattr(dev, "device_kind", dev.platform),
     }
-    if args.model == "bert":
-        result["seq_len"] = seq
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", choices=("bert", "resnet", "both"), default="both")
+    args = ap.parse_args()
+
+    # Probe BEFORE touching the backend here: when the tunnel is wedged even
+    # jax.devices() blocks forever, so the parent must not initialize until
+    # a subprocess proves the platform answers. On probe failure fall back
+    # to the CPU smoke measurement rather than hanging or reporting nothing.
+    accel_ok = _preflight()
+
+    import jax
+
+    if not accel_ok:
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    on_accel = dev.platform != "cpu"
+
+    workloads = ("bert", "resnet") if args.model == "both" else (args.model,)
+    measured, errors = {}, {}
+    for name in workloads:
+        try:
+            measured[name] = measure_workload(name, on_accel)
+        except Exception as e:  # noqa: BLE001 - one workload must not eat the other
+            errors[name] = str(e)[-500:]
+            print(f"bench[{name}] failed: {e}", file=sys.stderr)
+    if not measured:
+        raise RuntimeError(f"every workload failed: {errors}")
+
+    # The driver parses the LAST line; the headline stays bert_base_mfu
+    # whenever BERT measured, with ResNet riding along as extras.
+    head_name = "bert" if "bert" in measured else "resnet"
+    head = measured[head_name]
+    metric_base = "bert_base_mfu" if head_name == "bert" else "resnet50_mfu"
+    result = {
+        "metric": metric_base if on_accel else f"{metric_base}_cpu_smoke",
+        "value": round(head["mfu"], 4) if on_accel else round(head["units_per_sec"], 1),
+        "unit": "mfu" if on_accel else f"{head['unit_per']}/sec",
+        "vs_baseline": round(head["mfu"] / TARGET_MFU, 4) if on_accel else None,
+        f"{head['unit_per']}_per_sec_per_chip": round(
+            head["units_per_sec"] / head["n_chips"], 1),
+        "achieved_tflops_per_chip": round(
+            head["achieved"] / head["n_chips"] / 1e12, 2),
+        "device": head["device"],
+        "peak_tflops_assumed": None if head["peak_detected"]
+        else round(DEFAULT_PEAK / 1e12),
+        "n_chips": head["n_chips"],
+        "batch_size": head["batch_size"],
+        "loss": round(head["loss"], 4),
+    }
+    if head_name == "bert":
+        result["seq_len"] = head["seq"]
+    if "resnet" in measured and head_name == "bert":
+        rn = measured["resnet"]
+        if on_accel:
+            result["resnet50_mfu"] = round(rn["mfu"], 4)
+        result["resnet50_images_per_sec_per_chip"] = round(
+            rn["units_per_sec"] / rn["n_chips"], 1)
+        result["resnet50_batch_size"] = rn["batch_size"]
+    for name, err in errors.items():
+        result[f"{name}_error"] = err
     if not accel_ok:
         result["error"] = (
-            "accelerator unresponsive (tunnel wedged); CPU smoke fallback"
+            "accelerator unresponsive (tunnel wedged, retried preflight); "
+            "CPU smoke fallback"
         )
     print(json.dumps(result))
 

@@ -270,6 +270,22 @@ class TestTune:
         assert all(isinstance(n.synchronizer, AllReduceSynchronizer)
                    for n in a.strategy.node_config)
 
+    def test_fleet_batch_tolerates_broadcast_leaves(self, monkeypatch):
+        # Leading-dim-1 leaves are the framework-wide broadcast convention
+        # (batch_shardings replicates them); the fleet feed contract must
+        # match — not reject them (divisibility) nor slice them to empty
+        # (ADVICE r2 #1).
+        import numpy as np
+        import autodist_tpu.api as api_mod
+
+        monkeypatch.setattr(api_mod.jax, "process_count", lambda: 2)
+        batch = {"x": np.ones((4, 3)), "mask": np.ones((1, 3))}
+        ad.AutoDist._check_fleet_batch(batch)  # must not raise
+
+        # And an actually-indivisible batched leaf still fails loudly.
+        with pytest.raises(ValueError, match="divisible"):
+            ad.AutoDist._check_fleet_batch({"x": np.ones((5, 3))})
+
     def test_tune_all_candidates_fail_raises(self):
         from autodist_tpu.strategy import StrategyBuilder
 

@@ -365,6 +365,27 @@ class TestShardedLayout:
             rtol=1e-6,
         )
 
+    def test_partially_covered_region_raises(self, tmp_path):
+        # A shard missing from the metadata must fail the read loudly —
+        # assembling the remaining shards into np.empty would hand back
+        # uninitialized memory as parameter data (ADVICE r2 #2).
+        import json
+
+        step, params = build_step(PartitionedPS())
+        state = step.init(params)
+        saver = Saver(directory=str(tmp_path))
+        path = step.save(saver, state)
+        meta_path = os.path.join(path, "metadata.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        w = meta["entries"]["params/w"]
+        assert len(w["shards"]) > 1
+        w["shards"] = w["shards"][:-1]  # drop one block from the listing
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        with pytest.raises(ValueError, match="cover|overlap"):
+            saver.restore(path)
+
     def test_step_save_helper_uses_logical_shapes(self, tmp_path):
         # Pad-and-mask plan: step.save writes logical shapes; a raw
         # saver.save(state) writes padded storage, and restoring it then

@@ -118,6 +118,39 @@ def test_mesh_size_mismatch_rejected():
         build_mesh(rs_bad)
 
 
+def test_data_axis_resolution():
+    # The batch axis is resolved by ROLE, not position (ADVICE r2 #3):
+    # an override listing model first must not put the batch on it, a
+    # custom-named axis carries the batch when "data" is the vestigial
+    # size-1 setdefault, and an explicit all-model mesh replicates the batch.
+    from autodist_tpu.kernel.mesh import data_axis
+
+    def mesh_for(mesh_shape):
+        rs = ResourceSpec(resource_dict={
+            "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+            "mesh": mesh_shape,
+        })
+        return build_mesh(rs, axes=tuple(mesh_shape))
+
+    assert data_axis(mesh_for({"model": 2, "data": 4})) == "data"
+    # Custom-named batch axis; "data" setdefaults to 1 via mesh_shape().
+    rs = ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+        "mesh": {"x": 8},
+    })
+    assert data_axis(build_mesh(rs, axes=("data",))) == "x"
+    # Pure model parallelism: the batch replicates, never rides "model".
+    rs_mp = ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+        "mesh": {"model": 8},
+    })
+    assert data_axis(build_mesh(rs_mp, axes=("data",))) == "data"
+    # A mesh with ONLY role axes has no axis that can carry the batch:
+    # loud error, not a silent batch-on-model misassignment.
+    with pytest.raises(ValueError, match="carry the batch"):
+        data_axis(build_mesh(rs_mp, axes=("model",)))
+
+
 def test_batch_shardings_divisibility(model, rs):
     plan = make_plan(AllReduce(), model, rs)
     batch = {"x": jnp.zeros((16, 4)), "y": jnp.zeros((16,))}
