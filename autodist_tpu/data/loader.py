@@ -116,10 +116,7 @@ class DataLoader:
         return self.epochs * self.batches_per_epoch
 
     # ------------------------------------------------------------------- iter
-    def __iter__(self) -> Iterator[Dict[str, Any]]:
-        it = self._iter_native() if self.engine == "native" else self._iter_python()
-        if self.plan is None:
-            return it
+    def _check_multihost_remainder(self) -> None:
         import jax
 
         if (jax.process_count() > 1 and not self.drop_remainder
@@ -127,9 +124,29 @@ class DataLoader:
             raise ValueError(
                 "multi-host DataLoader requires drop_remainder=True: a "
                 "ragged final batch cannot assemble into a global array")
+
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        it = self._iter_native() if self.engine == "native" else self._iter_python()
+        if self.plan is None:
+            return it
+        self._check_multihost_remainder()
         if self.device_prefetch > 0:
             return self._iter_device_prefetch(it, self.device_prefetch)
         return (self._shard(b) for b in it)
+
+    def host_batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        """Raw per-process host batches, no device transfer.
+
+        The windowed-fit bridge (``DistributedTrainStep.fit(window=k)``)
+        stacks ``k`` of these and ships ONE transfer per window
+        (``ShardingPlan.window_from_local``) — stacking must happen before
+        the device put, so this bypasses the per-batch ``_shard`` path.
+        The multi-host ragged-tail contract is the same as ``__iter__``'s:
+        a final batch that can't assemble into a global array fails here,
+        loudly, not deep inside window assembly.
+        """
+        self._check_multihost_remainder()
+        return self._iter_native() if self.engine == "native" else self._iter_python()
 
     def _iter_device_prefetch(self, it, depth: int):
         """Keep ``depth`` sharded batches in flight ahead of the consumer.

@@ -61,6 +61,15 @@ class Compressor:
     ) -> Tuple[jnp.ndarray, State, State]:
         raise NotImplementedError
 
+    def wire_factor(self, shape: Tuple[int, ...]) -> float:
+        """Collective payload bytes under this compressor / dense fp32
+        payload bytes, for a gradient of ``shape``. The cost model's wire
+        term (strategy/cost_model.py) uses this, so the formula lives next
+        to the ``step`` whose collectives it prices;
+        ``tests/test_compressor.py`` pins it to the actual HLO payloads.
+        """
+        return 1.0
+
 
 class NoneCompressor(Compressor):
     """Identity: full-precision psum average (compressor.py:146-166)."""
@@ -82,6 +91,9 @@ class HorovodCompressor(Compressor):
         compressed = grad.astype(self.wire_dtype)
         summed = lax.psum(compressed, axis)
         return summed.astype(grad.dtype) / nshards, local, shared
+
+    def wire_factor(self, shape):
+        return jnp.dtype(self.wire_dtype).itemsize / jnp.dtype(jnp.float32).itemsize
 
 
 class HorovodCompressorEF(HorovodCompressor):
@@ -157,6 +169,19 @@ class PowerSGDCompressor(Compressor):
         approx = (p @ qn.T).reshape(grad.shape)
         residual = inp - approx
         return approx, {"residual": residual}, {"q": qn}
+
+    def wire_factor(self, shape):
+        """(m+k)·r over m·k: the two rank-r factor psums in :meth:`step`
+        (P is m×r, Qn is k×r) replace the dense m×k payload. Rank-0/1
+        gradients take the plain psum path — factor 1. Deliberately NOT
+        clamped at 1: for tiny matrices the factor payloads really do
+        exceed the dense gradient, and the cost model should see that
+        honestly rather than reward compressing tensors it shouldn't."""
+        if len(shape) < 2:
+            return 1.0
+        m_rows, k = self._matrix_shape(shape)
+        r = min(self.rank, k, m_rows)
+        return (m_rows + k) * r / (m_rows * k)
 
 
 _REGISTRY = {

@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import struct
 from jax import lax
@@ -526,8 +527,6 @@ class ShardingPlan:
         if jax.process_count() == 1:
             return jax.device_put(local_batch, self.batch_shardings(local_batch, strict=False))
 
-        import numpy as np
-
         n_proc = jax.process_count()
         if broadcast is None:
             broadcast = jax.tree_util.tree_map(
@@ -563,6 +562,59 @@ class ShardingPlan:
         )
         return jax.tree_util.tree_map(
             leaf_to_global, local_batch, shardings, broadcast)
+
+    def window_shardings(self, stacked_batch) -> Any:
+        """Shardings for a prefetched data window: every leaf carries a
+        leading (scan-step) axis that stays unsharded, and each per-step
+        slice shards exactly as :meth:`batch_shardings` would shard it."""
+        slice_struct = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                tuple(x.shape)[1:], getattr(x, "dtype", None) or np.asarray(x).dtype
+            ),
+            stacked_batch,
+        )
+        slice_sh = self.batch_shardings(slice_struct, strict=False)
+        return jax.tree_util.tree_map(
+            lambda s: self._sharding(P(None, *s.spec)), slice_sh)
+
+    def window_from_local(self, stacked_local) -> Any:
+        """Per-process stacked host window → device-resident global window.
+
+        ``stacked_local`` leaves are ``[num_steps, local_batch, ...]`` (this
+        process's slices of ``num_steps`` consecutive batches, stacked on a
+        new leading axis). One transfer ships the whole window — the bridge
+        between the DataLoader and ``run(stacked=True)``'s device-side scan,
+        instead of paying per-step dispatch+transfer latency
+        (docs/performance.md measures that pattern at ~11× slower here).
+
+        Window leaves are batched by construction, so no broadcast-leaf
+        ambiguity exists: dim 1 (after the step axis) always concatenates
+        across processes.
+        """
+        if jax.process_count() == 1:
+            return jax.device_put(
+                stacked_local, self.window_shardings(stacked_local))
+
+        n_proc = jax.process_count()
+
+        def global_shape_of(x) -> Tuple[int, ...]:
+            shape = tuple(np.shape(x))
+            return (shape[0], shape[1] * n_proc) + shape[2:]
+
+        shardings = self.window_shardings(
+            jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(
+                    global_shape_of(x),
+                    getattr(x, "dtype", None) or np.asarray(x).dtype,
+                ),
+                stacked_local,
+            )
+        )
+        return jax.tree_util.tree_map(
+            lambda leaf, sh: jax.make_array_from_process_local_data(
+                sh, np.asarray(leaf), global_shape_of(leaf)),
+            stacked_local, shardings,
+        )
 
     def comp_shardings(self, comp_state) -> Any:
         """Compressor-state shardings: per-worker ("local") leaves carry a
@@ -1158,13 +1210,7 @@ class DistributedTrainStep:
                 return st, jax.tree.map(lambda *xs: jnp.stack(xs), *ms)
 
             if stacked:
-                slice0 = jax.tree.map(lambda x: x[0], batch)
-                slice_sh = self.plan.batch_shardings(slice0)
-                # Prepend the (unsharded) scan axis to each leaf's spec.
-                batch_sh = jax.tree.map(
-                    lambda s: NamedSharding(self.plan.mesh, P(None, *s.spec)),
-                    slice_sh,
-                )
+                batch_sh = self.plan.window_shardings(batch)
 
                 def multi(st, bs):
                     if unroll:
@@ -1198,6 +1244,7 @@ class DistributedTrainStep:
         eval_batch=None,
         eval_every: int = 0,
         log_every: int = 0,
+        window: int = 0,
     ):
         """Keras-``model.fit``-shaped training loop over an iterable of
         batches (a :class:`~autodist_tpu.data.DataLoader` or any batch
@@ -1206,11 +1253,23 @@ class DistributedTrainStep:
 
         Returns ``(state, history)`` where ``history["loss"]`` is the
         per-step loss and ``history["eval_loss"]`` the periodic eval losses
-        (``eval_every`` > 0 with ``eval_batch``). For throughput-critical
-        loops prefer ``run()`` (device-side windows); ``fit`` dispatches one
-        step per batch, which is what a fresh-data training loop needs.
+        (``eval_every`` > 0 with ``eval_batch``).
+
+        ``window=k`` (k > 1) bridges fit to the windowed hot loop: ``k``
+        consecutive batches are stacked host-side and executed as ONE device
+        program (``run(stacked=True)`` — a ``lax.scan`` over fresh data),
+        paying one dispatch+transfer per window instead of per step — the
+        per-step dispatch pattern is ~11× slower on the remote-tunnel
+        platform (docs/performance.md). Windows are chopped so eval/steps
+        boundaries land exactly between windows; per-step history is
+        identical to ``window=0``.
         """
         import itertools
+
+        if window and window > 1:
+            return self._fit_windowed(
+                state, batches, steps, eval_batch, eval_every, log_every,
+                window)
 
         history = {"loss": []}
         if eval_every and eval_batch is not None:
@@ -1231,6 +1290,93 @@ class DistributedTrainStep:
                 history["eval_loss"].append(ev_loss)
                 if log_every:
                     logging.info("fit step %d: eval_loss=%.6f", i + 1, ev_loss)
+        return state, history
+
+    def _fit_windowed(self, state, batches, steps, eval_batch, eval_every,
+                      log_every, window):
+        """The ``fit(window=k)`` body: stack host batches, one dispatch per
+        window. See :meth:`fit` for the contract.
+
+        Batch source: a DataLoader exposes ``host_batches()`` (raw
+        per-process numpy batches — stacking must happen BEFORE the device
+        transfer); any other iterable is consumed as-is and stacked via
+        ``np.asarray``, which is single-process only (a generic iterator's
+        leaves can't be assembled into multi-host global windows).
+
+        A batch whose leaf shapes differ from the current window's (ragged
+        final batch with ``drop_remainder=False``) flushes the window and
+        runs alone; note that look-ahead batch is already consumed from a
+        shared iterator even if ``steps`` caps fit before it runs.
+        """
+        from_loader = hasattr(batches, "host_batches")
+        if from_loader:
+            it = iter(batches.host_batches())
+        else:
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "fit(window>1) on a multi-process fleet requires a "
+                    "DataLoader: generic iterator batches cannot be "
+                    "assembled into global windows")
+            it = iter(batches)
+
+        history = {"loss": []}
+        if eval_every and eval_batch is not None:
+            history["eval_loss"] = []
+
+        def sig(b):
+            return tuple(tuple(np.shape(leaf)) for leaf in jax.tree.leaves(b))
+
+        _end = object()
+        pending = None
+        step_i = 0
+        while True:
+            if steps is not None and step_i >= steps:
+                break
+            # Chop the window so steps/eval boundaries land between windows.
+            chunk = window
+            if steps is not None:
+                chunk = min(chunk, steps - step_i)
+            if eval_every:
+                chunk = min(chunk, eval_every - (step_i % eval_every))
+            buf = []
+            while len(buf) < chunk:
+                if pending is not None:
+                    b, pending = pending, None
+                else:
+                    b = next(it, _end)
+                    if b is _end:
+                        break
+                if buf and sig(b) != sig(buf[0]):
+                    pending = b  # ragged/shape-change batch: next window
+                    break
+                buf.append(b)
+            if not buf:
+                break
+            if len(buf) == 1:
+                batch = buf[0]
+                if from_loader:
+                    batch = self.plan.global_batch_from_local(
+                        batch, broadcast=jax.tree.map(lambda _: False, batch))
+                state, metrics = self(state, batch)
+                losses = [float(metrics["loss"])]
+            else:
+                stacked = jax.tree.map(
+                    lambda *xs: np.stack([np.asarray(x) for x in xs]), *buf)
+                wnd = (self.plan.window_from_local(stacked) if from_loader
+                       else stacked)
+                state, metrics = self.run(state, wnd, len(buf), stacked=True)
+                losses = [float(x) for x in np.asarray(metrics["loss"])]
+            for loss in losses:
+                step_i += 1
+                history["loss"].append(loss)
+                if log_every and step_i % log_every == 0:
+                    logging.info("fit step %d: loss=%.6f", step_i, loss)
+            if (eval_every and eval_batch is not None
+                    and step_i % eval_every == 0):
+                ev_loss = float(self.evaluate(state, eval_batch)["loss"])
+                history["eval_loss"].append(ev_loss)
+                if log_every:
+                    logging.info("fit step %d: eval_loss=%.6f", step_i, ev_loss)
         return state, history
 
     # ------------------------------------------------------------ evaluation

@@ -7,7 +7,11 @@ TPU-first: ring attention rotates K/V chunks around the ICI ring with
 and Ulysses-style all-to-all re-shards activations seq→heads so full-sequence
 flash attention runs locally (one ``lax.all_to_all`` each way).
 """
-from autodist_tpu.parallel.pipeline import pipeline_apply, pipeline_apply_local
+from autodist_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_apply_local,
+    pipeline_value_and_grad,
+)
 from autodist_tpu.parallel.ring_attention import (
     ring_attention,
     ring_attention_local,
@@ -18,6 +22,7 @@ from autodist_tpu.parallel.ring_attention import (
 __all__ = [
     "pipeline_apply",
     "pipeline_apply_local",
+    "pipeline_value_and_grad",
     "ring_attention",
     "ring_attention_local",
     "ulysses_attention",

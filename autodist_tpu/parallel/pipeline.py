@@ -96,6 +96,152 @@ def _pipeline_local(stage_params, x, *, stage_fn, n_micro, n_stages, axis_name):
     return outputs.reshape((b,) + x.shape[1:])
 
 
+def _pipeline_1f1b_local(stage_fn, n_micro, n_stages, axis_name):
+    """Local (per-device) pipeline with a 1F1B-style hand-written backward.
+
+    ``jax.grad`` through the GPipe scan saves every tick's residuals —
+    ppermute states plus stage interiors, O(n_micro + S) ticks live at
+    once. This variant wraps the forward in ``jax.custom_vjp``: the forward
+    additionally records ONLY each microbatch's stage-boundary input
+    ([n_micro, mb, ...] per device), and the backward replays the pipeline
+    in reverse — cotangents enter at the last stage and ``ppermute``
+    stage-to-stage in the reverse rotation while each stage recomputes its
+    vjp from the saved boundary input (remat). Tick residuals never
+    materialize together, which is the memory shape 1F1B schedules buy;
+    values and gradients are identical to the autodiff path.
+    """
+    S = n_stages
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_rev = [(i, (i - 1) % S) for i in range(S)]
+
+    def fwd_impl(params, x):
+        s_idx = lax.axis_index(axis_name)
+        b = x.shape[0]
+        if b % n_micro != 0:
+            raise ValueError(
+                f"batch {b} not divisible by n_microbatches {n_micro}")
+        mb = b // n_micro
+        micro = x.reshape((n_micro, mb) + x.shape[1:])
+        state = jnp.zeros_like(micro[0])
+        outputs = jnp.zeros_like(micro)
+        saved = jnp.zeros_like(micro)  # this stage's input per microbatch
+
+        def tick(carry, t):
+            state, outputs, saved = carry
+            m_f = t - s_idx
+            inj = lax.dynamic_index_in_dim(
+                micro, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False)
+            inp = jnp.where(s_idx == 0, inj, state)
+            valid_f = (m_f >= 0) & (m_f < n_micro)
+            saved = lax.cond(
+                valid_f,
+                lambda s: lax.dynamic_update_index_in_dim(
+                    s, inp, jnp.clip(m_f, 0, n_micro - 1), axis=0),
+                lambda s: s,
+                saved,
+            )
+            out = stage_fn(params, inp)
+            out_idx = t - (S - 1)
+            write = (s_idx == S - 1) & (out_idx >= 0)
+            outputs = lax.cond(
+                write,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, out, jnp.clip(out_idx, 0, n_micro - 1), axis=0),
+                lambda o: o,
+                outputs,
+            )
+            state = lax.ppermute(out, axis_name, perm_fwd)
+            return (state, outputs, saved), None
+
+        (state, outputs, saved), _ = lax.scan(
+            tick, (state, outputs, saved), jnp.arange(n_micro + S - 1))
+        outputs = lax.psum(
+            jnp.where(s_idx == S - 1, outputs, jnp.zeros_like(outputs)),
+            axis_name,
+        )
+        return outputs.reshape((b,) + x.shape[1:]), saved
+
+    @jax.custom_vjp
+    def f(params, x):
+        out, _ = fwd_impl(params, x)
+        return out
+
+    def f_fwd(params, x):
+        out, saved = fwd_impl(params, x)
+        return out, (params, saved)
+
+    def f_bwd(res, g):
+        params, saved = res
+        s_idx = lax.axis_index(axis_name)
+        # shard_map's unchecked-replication (check_vma=False) transpose
+        # convention, pinned by tests/test_moe_pipeline.py: a replicated
+        # (P()) OUTPUT's cotangent arrives divided by the axis size, and a
+        # replicated INPUT's cotangent is psummed across devices. Undo the
+        # division here; gx below relies on the psum.
+        g = g * n_stages
+        # The stage stack is shape-preserving, so g's shape IS x's shape.
+        x_shape = g.shape
+        mb = x_shape[0] // n_micro
+        g_micro = g.reshape((n_micro, mb) + x_shape[1:])
+        g_state = jnp.zeros_like(g_micro[0])
+        gx_micro = jnp.zeros_like(g_micro)
+        grad_acc = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+        def tick(carry, r):
+            g_state, gx_micro, grad_acc = carry
+            # Reverse pipeline: the LAST stage is reverse-position 0 and
+            # injects cotangent microbatch r; stage s handles microbatch
+            # m_b = r - (S-1-s), one ppermute hop behind its successor.
+            m_b = r - (S - 1 - s_idx)
+            inj = lax.dynamic_index_in_dim(
+                g_micro, jnp.clip(r, 0, n_micro - 1), axis=0, keepdims=False)
+            g_out = jnp.where(s_idx == S - 1, inj, g_state)
+            valid_b = (m_b >= 0) & (m_b < n_micro)
+            saved_inp = lax.dynamic_index_in_dim(
+                saved, jnp.clip(m_b, 0, n_micro - 1), axis=0, keepdims=False)
+            _, svjp = jax.vjp(stage_fn, params, saved_inp)
+            g_p, g_inp = svjp(g_out)
+            grad_acc = jax.tree_util.tree_map(
+                lambda a, gg: a + jnp.where(valid_b, gg, 0), grad_acc, g_p)
+            gx_micro = lax.cond(
+                valid_b & (s_idx == 0),
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, g_inp, jnp.clip(m_b, 0, n_micro - 1), axis=0),
+                lambda o: o,
+                gx_micro,
+            )
+            g_state = lax.ppermute(g_inp, axis_name, perm_rev)
+            return (g_state, gx_micro, grad_acc), None
+
+        (g_state, gx_micro, grad_acc), _ = lax.scan(
+            tick, (g_state, gx_micro, grad_acc), jnp.arange(n_micro + S - 1))
+        # x is replicated (P()): per-device cotangent returns are psummed by
+        # the transpose, so return only this device's true contribution —
+        # stage 0 holds it all, everyone else contributes zero.
+        gx = jnp.where(
+            s_idx == 0, gx_micro, jnp.zeros_like(gx_micro)
+        ).reshape(x_shape)
+        return grad_acc, gx
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def _resolve_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    if mesh is not None:
+        return mesh
+    from autodist_tpu.api import get_default_autodist
+
+    ad = get_default_autodist()
+    return ad.mesh if ad is not None else None
+
+
+def _pipe_axis_size(mesh: Optional[Mesh], axis_name: str) -> int:
+    if mesh is None:
+        return 1
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis_name, 1)
+
+
 def pipeline_apply(
     stage_fn: Callable,
     stacked_params,
@@ -104,6 +250,7 @@ def pipeline_apply(
     mesh: Optional[Mesh] = None,
     axis_name: str = const.MESH_AXIS_PIPE,
     remat_stages: bool = False,
+    schedule: str = "gpipe",
 ):
     """Apply a pipelined stage stack to global ``x``.
 
@@ -117,22 +264,26 @@ def pipeline_apply(
     memory cost vs 1F1B schedules); rematerializing the stage interior
     drops that to boundary activations only, at ~1/3 extra stage FLOPs —
     usually the right trade at large microbatch counts.
+
+    ``schedule``: ``"gpipe"`` (default) differentiates through the forward
+    scan; ``"1f1b"`` installs a hand-written reverse-pipeline backward that
+    saves only stage-boundary inputs and recomputes stage vjps tick by
+    tick (see :func:`_pipeline_1f1b_local`) — same values and gradients,
+    smaller peak memory. For the fully interleaved 1F1B loop whose live
+    activations stay O(S) independent of the microbatch count (possible
+    only when the loss is computed inside the pipelined region), use
+    :func:`pipeline_value_and_grad`.
     """
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if remat_stages:
         # prevent_cse=False: the checkpointed stage only ever runs inside
         # lax.scan bodies (the tick loop / the sequential fallback), where
         # the CSE-prevention barrier is unnecessary overhead.
         stage_fn = jax.checkpoint(stage_fn, prevent_cse=False)
-    if mesh is None:
-        from autodist_tpu.api import get_default_autodist
-
-        ad = get_default_autodist()
-        mesh = ad.mesh if ad is not None else None
+    mesh = _resolve_mesh(mesh)
     n_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
-    axis_size = (
-        dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis_name, 1)
-        if mesh is not None else 1
-    )
+    axis_size = _pipe_axis_size(mesh, axis_name)
     if axis_size <= 1:
         def body(h, sp):
             return stage_fn(sp, h), None
@@ -146,15 +297,19 @@ def pipeline_apply(
         )
 
     spec_params = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
-    local = functools.partial(
-        _pipeline_local,
-        stage_fn=lambda sp, h: stage_fn(
-            jax.tree_util.tree_map(lambda a: a[0], sp), h
-        ),
-        n_micro=n_microbatches,
-        n_stages=n_stages,
-        axis_name=axis_name,
-    )
+    local_stage = lambda sp, h: stage_fn(  # noqa: E731 - tiny adapter
+        jax.tree_util.tree_map(lambda a: a[0], sp), h)
+    if schedule == "1f1b":
+        local = _pipeline_1f1b_local(
+            local_stage, n_microbatches, n_stages, axis_name)
+    else:
+        local = functools.partial(
+            _pipeline_local,
+            stage_fn=local_stage,
+            n_micro=n_microbatches,
+            n_stages=n_stages,
+            axis_name=axis_name,
+        )
     sm = jax.shard_map(
         local,
         mesh=mesh,
@@ -164,3 +319,194 @@ def pipeline_apply(
         check_vma=False,
     )
     return sm(stacked_params, x)
+
+
+def _1f1b_interleaved_local(stage_fn, loss_head, n_micro, n_stages, axis_name):
+    """The fully interleaved 1F1B loop (per device, inside ``shard_map``).
+
+    One scan whose every tick does a (masked) forward AND a (masked)
+    backward: stage ``s`` forwards microbatch ``m`` at tick ``t = s + m``
+    and backwards it at ``t = 2(S-1) + m - s`` — the last stage turns a
+    microbatch around immediately (its loss cotangent is computed the same
+    tick its forward completes), cotangents then ride the reverse rotation.
+    A microbatch's boundary input therefore lives ``2(S-1-s)`` ticks, so a
+    ring buffer of ``R = 2S-1`` slots bounds live activations at O(S)
+    regardless of ``n_micro`` — the property GPipe-style split forward/
+    backward cannot have, and the reason the loss must be computed inside
+    the pipelined region. Stage interiors are rematerialized in the
+    backward (``jax.vjp`` re-runs the stage), the same trade
+    ``remat_stages`` makes.
+
+    Returns ``(loss, grads, gx)``: mean-over-microbatches loss, this
+    stage's parameter gradients, and the input-cotangent contribution
+    (nonzero only on stage 0; shard_map's transpose-style psum assembly is
+    done by the caller's ``out_specs``).
+    """
+    S = n_stages
+    R = 2 * S - 1
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_rev = [(i, (i - 1) % S) for i in range(S)]
+
+    def run(params, x, tgt):
+        s_idx = lax.axis_index(axis_name)
+        b = x.shape[0]
+        if b % n_micro != 0:
+            raise ValueError(
+                f"batch {b} not divisible by n_microbatches {n_micro}")
+        mb = b // n_micro
+        micro = x.reshape((n_micro, mb) + x.shape[1:])
+        tgt_micro = (
+            None if tgt is None else jax.tree_util.tree_map(
+                lambda a: a.reshape((n_micro, mb) + a.shape[1:]), tgt)
+        )
+        fwd_state = jnp.zeros_like(micro[0])
+        bwd_state = jnp.zeros_like(micro[0])
+        ring = jnp.zeros((R,) + micro[0].shape, micro.dtype)
+        gx_micro = jnp.zeros_like(micro)
+        grad_acc = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+        def tick(carry, t):
+            fwd_state, bwd_state, ring, gx_micro, grad_acc, loss_acc = carry
+            # ---- forward half-tick
+            m_f = t - s_idx
+            valid_f = (m_f >= 0) & (m_f < n_micro)
+            inj = lax.dynamic_index_in_dim(
+                micro, jnp.clip(m_f, 0, n_micro - 1), axis=0, keepdims=False)
+            inp = jnp.where(s_idx == 0, inj, fwd_state)
+            out = stage_fn(params, inp)
+            # Always-write is safe: slot t%R was last written R ticks ago
+            # and every saved input's lifetime is <= R-1 ticks.
+            ring = lax.dynamic_update_index_in_dim(
+                ring, inp, jnp.mod(t, R), axis=0)
+            # ---- last stage turns the microbatch around: loss + cotangent
+            last = s_idx == S - 1
+            if tgt_micro is None:
+                loss_m, lvjp = jax.vjp(loss_head, out)
+            else:
+                tgt_mb = jax.tree_util.tree_map(
+                    lambda a: lax.dynamic_index_in_dim(
+                        a, jnp.clip(m_f, 0, n_micro - 1), axis=0,
+                        keepdims=False),
+                    tgt_micro,
+                )
+                loss_m, lvjp = jax.vjp(lambda o: loss_head(o, tgt_mb), out)
+            (g_out_self,) = lvjp(jnp.ones_like(loss_m))
+            loss_acc = loss_acc + jnp.where(last & valid_f, loss_m, 0.0)
+            # ---- backward half-tick
+            m_b = t - 2 * (S - 1) + s_idx
+            valid_b = (m_b >= 0) & (m_b < n_micro)
+            g_out = jnp.where(last, g_out_self, bwd_state)
+            slot_b = jnp.mod(t - 2 * (S - 1) + 2 * s_idx, R)
+            saved_inp = lax.dynamic_index_in_dim(
+                ring, slot_b, axis=0, keepdims=False)
+            _, svjp = jax.vjp(stage_fn, params, saved_inp)
+            g_p, g_inp = svjp(g_out)
+            grad_acc = jax.tree_util.tree_map(
+                lambda a, gg: a + jnp.where(valid_b, gg, 0), grad_acc, g_p)
+            gx_micro = lax.cond(
+                valid_b & (s_idx == 0),
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, g_inp, jnp.clip(m_b, 0, n_micro - 1), axis=0),
+                lambda o: o,
+                gx_micro,
+            )
+            fwd_state = lax.ppermute(out, axis_name, perm_fwd)
+            bwd_state = lax.ppermute(g_inp, axis_name, perm_rev)
+            return (fwd_state, bwd_state, ring, gx_micro, grad_acc,
+                    loss_acc), None
+
+        carry = (fwd_state, bwd_state, ring, gx_micro, grad_acc,
+                 jnp.zeros((), x.dtype))
+        carry, _ = lax.scan(
+            tick, carry, jnp.arange(n_micro + 2 * (S - 1)))
+        _, _, _, gx_micro, grad_acc, loss_acc = carry
+        s_idx = lax.axis_index(axis_name)
+        loss = lax.psum(
+            jnp.where(s_idx == S - 1, loss_acc, 0.0), axis_name) / n_micro
+        grads = jax.tree_util.tree_map(lambda g: g / n_micro, grad_acc)
+        gx = lax.psum(
+            jnp.where(s_idx == 0, gx_micro, jnp.zeros_like(gx_micro)),
+            axis_name,
+        ).reshape(x.shape) / n_micro
+        return loss, grads, gx
+
+    return run
+
+
+def pipeline_value_and_grad(
+    stage_fn: Callable,
+    stacked_params,
+    x,
+    loss_head: Callable,
+    n_microbatches: int,
+    targets=None,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = const.MESH_AXIS_PIPE,
+):
+    """Loss + gradients of a pipelined stage stack in ONE interleaved 1F1B
+    loop — live activations O(S) per device, independent of ``n_micro``.
+
+    ``loss_head(out_microbatch[, target_microbatch]) -> scalar`` is the
+    per-microbatch MEAN loss computed at the last stage (targets, when
+    given, are batched like ``x`` on dim 0 and microbatched alongside it);
+    the returned loss/gradients are the mean over microbatches, identical
+    to ``loss_head`` over ``pipeline_apply``'s output when microbatches are
+    equal-sized. Returns ``(loss, stacked_grads, gx)`` with
+    ``stacked_grads`` shaped like ``stacked_params`` and ``gx`` the
+    cotangent of ``x`` (for layers below the pipelined region).
+
+    The loss must live inside the pipelined region for true 1F1B: with a
+    split forward/backward (``jax.grad`` over :func:`pipeline_apply`), all
+    microbatches' residuals necessarily coexist between the phases — see
+    ``schedule="1f1b"`` there for that (weaker) memory shape.
+    """
+    mesh = _resolve_mesh(mesh)
+    n_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    axis_size = _pipe_axis_size(mesh, axis_name)
+    if axis_size <= 1:
+        # Sequential fallback: same math via plain autodiff.
+        def total_loss(p, xx):
+            def body(h, sp):
+                return stage_fn(sp, h), None
+
+            out, _ = lax.scan(body, xx, p)
+            mb = out.shape[0] // n_microbatches
+            outs = out.reshape((n_microbatches, mb) + out.shape[1:])
+            if targets is None:
+                losses = jax.vmap(loss_head)(outs)
+            else:
+                tgts = jax.tree_util.tree_map(
+                    lambda a: a.reshape((n_microbatches, mb) + a.shape[1:]),
+                    targets,
+                )
+                losses = jax.vmap(loss_head)(outs, tgts)
+            return jnp.mean(losses)
+
+        (loss, (grads, gx)) = (
+            jax.value_and_grad(total_loss, argnums=(0, 1))(stacked_params, x)
+        )
+        return loss, grads, gx
+    if axis_size != n_stages:
+        raise ValueError(
+            f"stage dim ({n_stages}) must equal mesh axis {axis_name!r} "
+            f"size ({axis_size})"
+        )
+
+    spec_params = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    local_stage = lambda sp, h: stage_fn(  # noqa: E731 - tiny adapter
+        jax.tree_util.tree_map(lambda a: a[0], sp), h)
+    local = _1f1b_interleaved_local(
+        local_stage, loss_head, n_microbatches, n_stages, axis_name)
+    tgt_spec = (
+        None if targets is None
+        else jax.tree_util.tree_map(lambda _: P(), targets)
+    )
+    sm = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec_params, P(), tgt_spec),
+        out_specs=(P(), spec_params, P()),
+        axis_names={axis_name},
+        check_vma=False,
+    )
+    return sm(stacked_params, x, targets)

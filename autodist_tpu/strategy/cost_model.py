@@ -69,14 +69,20 @@ DEFAULT_SPARSE_TOUCH = 0.05
 # XLA scratch and infeed buffers.
 HBM_USABLE_FRACTION = 0.75
 
-# Wire-size multiplier per gradient compressor (kernel/compressor.py registry).
-# bf16 cast halves fp32 wire bytes; PowerSGD sends rank-k factors.
-COMPRESSOR_WIRE_FACTOR = {
-    "NoneCompressor": 1.0,
-    "HorovodCompressor": 0.5,
-    "HorovodCompressorEF": 0.5,
-    "PowerSGDCompressor": 0.1,
-}
+def compressor_wire_factor(name: Optional[str], shape) -> float:
+    """Wire-size multiplier for a gradient of ``shape`` under a compressor.
+
+    Delegates to ``Compressor.wire_factor`` (kernel/compressor.py) so the
+    priced payload is computed from the same rank/shape arithmetic as the
+    collectives the compressor actually emits — e.g. PowerSGD's
+    ``(m+k)·r / (m·k)`` instead of a flat guess (VERDICT r2 #9);
+    ``tests/test_compressor.py`` pins the factor to real HLO payloads.
+    """
+    if not name or name == "NoneCompressor":
+        return 1.0
+    from autodist_tpu.kernel.compressor import get_compressor
+
+    return float(get_compressor(name).wire_factor(tuple(shape)))
 
 # Optimizer-slot count per parameter byte (optax state residency). Unknown
 # optimizers — including "custom" (a raw optax transform whose state shape we
@@ -479,30 +485,42 @@ class CostModel:
                 return comm, update, 0.0, params, extra, 1, ps_loads
             shards = self._sharded(var, part_axis)
             res = self._residency_bytes(var, part_axis, shards)
-            wire = res * COMPRESSOR_WIRE_FACTOR.get(sync.compressor, 1.0)
             act = 0.0
             if shards <= 1:
-                # Plain DP: one gradient all-reduce over the data group.
-                comm = self.allreduce_s(wire)
+                # Plain DP: one gradient all-reduce over the data group,
+                # compressed at the full gradient shape.
+                comm = self.allreduce_s(
+                    res * compressor_wire_factor(sync.compressor, var.shape))
             elif self.n_model > 1:
                 # Model-axis tensor parallelism (lowering _shard_axis_name:
                 # any non-trivial model axis wins): each chip holds a
                 # 1/shards gradient slice, reduced over the data group; the
-                # split matmul pays an activation all-gather over the model
-                # group in forward and backward.
-                comm = self.allreduce_s(wire / shards)
+                # compressor runs ON THE SLICE, so its factor is computed
+                # from the slice shape (for PowerSGD that factor is worse
+                # than the full-shape one — the m+k term doesn't shrink
+                # with k/shards). The split matmul pays an activation
+                # all-gather over the model group in forward and backward.
+                slice_shape = list(var.shape)
+                if part_axis is not None and part_axis < len(slice_shape):
+                    slice_shape[part_axis] = max(
+                        1, -(-slice_shape[part_axis] // shards))
+                comm = self.allreduce_s(
+                    (res / shards)
+                    * compressor_wire_factor(sync.compressor, slice_shape))
                 act = 2.0 * (
                     self._group_latency(self.n_shard)
                     + self._oneway_s(self._act_bytes_for(var), self.n_shard)
                 )
             else:
                 # Data-axis parameter sharding (ZeRO rendering): params are
-                # all-gathered for compute at FULL size (compressors shrink
-                # only gradients), forward + backward, and grads
-                # reduce-scattered — ~1.5x the plain all-reduce wire, traded
-                # for 1/n residency. No activation term: compute is not
-                # split.
-                comm = self._oneway_s(wire) + 2.0 * self._oneway_s(res)
+                # all-gathered for compute at FULL size, forward + backward,
+                # and grads reduce-scattered. Compressors DO NOT apply here
+                # — lowering skips them for data-axis-sharded vars
+                # (_resolve_compressors warns and compresses nothing), so
+                # pricing a compressed wire would make tune prefer a
+                # compressed-ZeRO candidate whose real wire is the dense
+                # 1.5x all-reduce cost.
+                comm = self._oneway_s(res) + 2.0 * self._oneway_s(res)
             update = update_traffic_factor * res / shards / self.hbm_bw
             params = res / shards
             extra = self.slot_factor * res / shards + res  # slots + grad buffer

@@ -20,8 +20,8 @@ from autodist_tpu.strategy import (
     PartitionedAR,
 )
 from autodist_tpu.strategy.cost_model import (
-    COMPRESSOR_WIRE_FACTOR,
     HBM_USABLE_FRACTION,
+    compressor_wire_factor,
 )
 
 
@@ -79,7 +79,7 @@ class TestPrimitives:
         plain = AllReduce().build(item, spec)
         comp = AllReduce(compressor="HorovodCompressor").build(item, spec)
         cm = CostModel(item, spec)
-        assert COMPRESSOR_WIRE_FACTOR["HorovodCompressor"] == 0.5
+        assert compressor_wire_factor("HorovodCompressor", (1024, 1024)) == 0.5
         assert cm.strategy_cost(comp).comm_s == pytest.approx(
             cm.strategy_cost(plain).comm_s * 0.5
         )
@@ -201,7 +201,8 @@ class TestMeshOverride:
                 compressor="PowerSGDCompressor", group=n.synchronizer.group)
         plain = CostModel(item, spec).strategy_cost(s_plain)
         comp = CostModel(item, spec).strategy_cost(s_comp)
-        assert comp.comm_s > plain.comm_s * COMPRESSOR_WIRE_FACTOR["PowerSGDCompressor"]
+        assert comp.comm_s > plain.comm_s * compressor_wire_factor(
+            "PowerSGDCompressor", (25088, 4096))
         assert comp.comm_s > plain.comm_s * 2 / 3  # param gathers dominate
 
     def test_intra_node_model_group_rides_ici_on_multihost(self):

@@ -12,7 +12,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from autodist_tpu.api import AutoDist
 from autodist_tpu.models import get_model
-from autodist_tpu.parallel import pipeline_apply
+from autodist_tpu.parallel import pipeline_apply, pipeline_value_and_grad
 from autodist_tpu.resource_spec import ResourceSpec
 import autodist_tpu.strategy as S
 
@@ -186,6 +186,136 @@ class TestPipeline:
         x = jnp.zeros((8, 16))
         with pytest.raises(ValueError, match="must equal mesh axis"):
             pipeline_apply(self.stage_fn, params, x, 2, mesh=mesh)
+
+
+class Test1F1B:
+    """1F1B scheduling (VERDICT r2 #8): the custom-vjp reverse-pipeline
+    backward behind ``pipeline_apply(schedule='1f1b')``, and the fully
+    interleaved loop in ``pipeline_value_and_grad``."""
+
+    @staticmethod
+    def two_layer_stage(sp, h):
+        # A stage with an interior activation, so the gpipe-autodiff path
+        # has per-tick residuals to save and the memory contrast is real.
+        h = jnp.tanh(h @ sp["w1"])
+        return jnp.tanh(h @ sp["w2"] + sp["b"])
+
+    def stacked2(self, n_stages, d=16, dh=64, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        return {
+            "w1": jax.random.normal(ks[0], (n_stages, d, dh)) * 0.3,
+            "w2": jax.random.normal(ks[1], (n_stages, dh, d)) * 0.3,
+            "b": jax.random.normal(ks[2], (n_stages, d)) * 0.1,
+        }
+
+    def test_1f1b_matches_gpipe(self):
+        # Schedules change memory, never values: forward, param grads and
+        # the x cotangent must match the gpipe-autodiff path.
+        mesh = make_mesh((1, 8), ("data", "pipe"))
+        params = self.stacked2(8, d=8, dh=16)
+        x = jax.random.normal(jax.random.PRNGKey(4), (16, 8))
+
+        def loss(p, xx, sched):
+            return jnp.sum(pipeline_apply(
+                self.two_layer_stage, p, xx, 4, mesh=mesh,
+                schedule=sched) ** 2)
+
+        fwd_g = jax.jit(lambda p: pipeline_apply(
+            self.two_layer_stage, p, x, 4, mesh=mesh))(params)
+        fwd_1 = jax.jit(lambda p: pipeline_apply(
+            self.two_layer_stage, p, x, 4, mesh=mesh, schedule="1f1b"))(params)
+        np.testing.assert_allclose(
+            np.asarray(fwd_1), np.asarray(fwd_g), rtol=1e-6, atol=1e-7)
+        gg = jax.jit(jax.grad(
+            lambda p, xx: loss(p, xx, "gpipe"), argnums=(0, 1)))(params, x)
+        g1 = jax.jit(jax.grad(
+            lambda p, xx: loss(p, xx, "1f1b"), argnums=(0, 1)))(params, x)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            g1, gg)
+
+    def test_unknown_schedule_raises(self):
+        mesh = make_mesh((1, 8), ("data", "pipe"))
+        with pytest.raises(ValueError, match="schedule"):
+            pipeline_apply(self.two_layer_stage, self.stacked2(8), jnp.zeros((8, 16)),
+                           2, mesh=mesh, schedule="2f2b")
+
+    def test_interleaved_value_and_grad_matches_sequential(self):
+        # True 1F1B: loss inside the pipelined region, one interleaved
+        # fwd/bwd loop. Loss, stage grads and x cotangent must match plain
+        # autodiff of the sequential stack.
+        mesh = make_mesh((1, 8), ("data", "pipe"))
+        S, d, dh = 8, 8, 16
+        params = self.stacked2(S, d=d, dh=dh)
+        x = jax.random.normal(jax.random.PRNGKey(5), (16, d))
+        tgt = jax.random.normal(jax.random.PRNGKey(6), (16, d))
+
+        def loss_head(o, t):
+            return jnp.mean((o - t) ** 2)
+
+        loss, grads, gx = jax.jit(
+            lambda p, xx, tt: pipeline_value_and_grad(
+                self.two_layer_stage, p, xx, loss_head, 4, targets=tt,
+                mesh=mesh)
+        )(params, x, tgt)
+
+        def seq_loss(p, xx):
+            out = xx
+            for s in range(S):
+                out = self.two_layer_stage(
+                    jax.tree.map(lambda a: a[s], p), out)
+            return jnp.mean((out - tgt) ** 2)
+
+        want_l, (want_g, want_gx) = jax.value_and_grad(
+            seq_loss, argnums=(0, 1))(params, x)
+        np.testing.assert_allclose(float(loss), float(want_l), rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            (grads, gx), (want_g, want_gx))
+
+    def test_memory_shapes_of_the_three_schedules(self):
+        # Compiled HLO buffer stats (VERDICT r2 #8 done-criterion):
+        #   (a) gpipe-autodiff temp memory grows with n_micro (per-tick
+        #       residuals) — the control showing the contrast is real;
+        #   (b) the 1f1b backward saves only stage-boundary inputs — far
+        #       smaller temp at large n_micro;
+        #   (c) the interleaved loop's temp stays FLAT in n_micro: live
+        #       activations are the O(S) ring buffer, the 1F1B property.
+        mesh = make_mesh((1, 8), ("data", "pipe"))
+        params = self.stacked2(8)
+
+        def temp_bytes(f, *args):
+            c = jax.jit(f).lower(*args).compile()
+            return c.memory_analysis().temp_size_in_bytes
+
+        def measure(n_micro):
+            x = jax.random.normal(jax.random.PRNGKey(7), (n_micro * 4, 16))
+
+            def lg(p, xx):
+                return jnp.sum(pipeline_apply(
+                    self.two_layer_stage, p, xx, n_micro, mesh=mesh) ** 2)
+
+            def l1(p, xx):
+                return jnp.sum(pipeline_apply(
+                    self.two_layer_stage, p, xx, n_micro, mesh=mesh,
+                    schedule="1f1b") ** 2)
+
+            tg = temp_bytes(jax.grad(lg), params, x)
+            t1 = temp_bytes(jax.grad(l1), params, x)
+            ti = temp_bytes(
+                lambda p, xx: pipeline_value_and_grad(
+                    self.two_layer_stage, p, xx,
+                    lambda o: jnp.mean(o ** 2), n_micro, mesh=mesh),
+                params, x)
+            return tg, t1, ti
+
+        tg8, t18, ti8 = measure(8)
+        tg32, t132, ti32 = measure(32)
+        assert tg32 > 2 * tg8          # (a) control: gpipe grows ~linearly
+        assert t132 < tg32 / 2         # (b) 1f1b backward is much leaner
+        assert ti32 < 1.1 * ti8        # (c) interleaved: O(S), flat in n_micro
 
 
 class TestPipelineRemat:
