@@ -108,6 +108,25 @@ class Saver:
         self.max_to_keep = max_to_keep
         self._pending = None        # in-flight async write thread
         self._pending_error = None  # its failure, re-raised from wait()
+        self._save_seq = 0          # barrier-name uniqueness across saves
+
+    @staticmethod
+    def _coordination_client():
+        """The jax.distributed coordination-service client, or None.
+
+        Its ``wait_at_barrier`` is a pure-RPC barrier — no device
+        collectives — which makes it the ONLY barrier safe to run on a
+        background writer thread: a ``sync_global_devices`` there would
+        enqueue device collectives racing the training step's, and XLA
+        matches collectives by launch order per device (mismatched orders
+        across processes deadlock the fleet).
+        """
+        try:
+            from jax._src import distributed
+
+            return distributed.global_state.client
+        except Exception:  # noqa: BLE001 - internal layout may move
+            return None
 
     def _list_checkpoints(self):
         """``ckpt-<step>`` entries under ``directory``, step-ascending."""
@@ -188,8 +207,13 @@ class Saver:
         donates its state buffers, so the device values must be captured
         before the next step runs), then written by a background thread.
         Call :meth:`wait` (or any restore/latest query, which waits
-        implicitly) before relying on the files. Async applies only
-        single-process: multi-host saves keep the write→barrier ordering.
+        implicitly) before relying on the files. On a multi-process fleet
+        the writer thread's stage→metadata→swap barriers run on the
+        coordination service (pure RPC — device collectives on a
+        background thread would race the training step's and deadlock);
+        every process must call ``save`` in the same order. Without a
+        coordination client (no ``jax.distributed`` runtime), multi-host
+        async degrades to blocking with a warning.
         """
         self.wait()  # one write at a time, ordered — async OR blocking
         if path is None:
@@ -197,8 +221,17 @@ class Saver:
             # them; a bare "ckpt" dir would be invisible to both.
             path = os.path.join(self.directory, f"ckpt-{step or 0}")
         entries, local_files = self._collect(tree)
+        self._save_seq += 1
 
-        if not block and jax.process_count() == 1:
+        multi = jax.process_count() > 1
+        if not block and multi and self._coordination_client() is None:
+            logging.warning(
+                "async save: no coordination-service client on a "
+                "%d-process fleet; falling back to a blocking save",
+                jax.process_count(),
+            )
+            block = True
+        if not block:
             import threading
 
             # Async must materialize every leaf NOW (donation safety: the
@@ -210,7 +243,8 @@ class Saver:
             # Non-daemon: a normal interpreter exit waits for the write
             # instead of killing it mid-file.
             self._pending = threading.Thread(
-                target=self._write_guarded, args=(path, step, entries, local_files)
+                target=self._write_guarded,
+                args=(path, step, entries, local_files, self._save_seq),
             )
             self._pending.start()
             return path
@@ -219,12 +253,16 @@ class Saver:
         return path
 
     def _write(self, path: str, step: Optional[int], entries: Dict[str, dict],
-               local_files: Sequence[Tuple[str, np.ndarray]]) -> None:
+               local_files: Sequence[Tuple[str, np.ndarray]],
+               async_seq: Optional[int] = None) -> None:
         """Write atomically: stage into a tmp dir and rename, so a killed
         writer never leaves a metadata-less ckpt dir that
         ``restore_latest`` would trip over. Multi-host: all processes stage
         into the SAME tmp dir (deterministic name), with barriers around
-        the stage → metadata → swap sequence."""
+        the stage → metadata → swap sequence. ``async_seq`` (background
+        writer) switches those barriers onto the coordination service —
+        see :meth:`_coordination_client` for why device collectives are
+        forbidden on the writer thread."""
         import glob
         import shutil
 
@@ -235,10 +273,23 @@ class Saver:
         tmp = path + (".tmp" if multi else f".tmp-{os.getpid()}")
 
         def barrier(tag: str) -> None:
-            if multi:
-                from jax.experimental import multihost_utils
+            if not multi:
+                return
+            if async_seq is not None:
+                # Barrier ids must be unique per use and identical across
+                # processes: tag + per-saver save ordinal. A stable hash of
+                # the path keeps ids short (the service caps key length).
+                import hashlib
 
-                multihost_utils.sync_global_devices(f"autodist_tpu:save:{tag}:{path}")
+                digest = hashlib.sha1(path.encode()).hexdigest()[:12]
+                self._coordination_client().wait_at_barrier(
+                    f"adtpu_save_{digest}_{async_seq}_{tag}",
+                    const.ASYNC_SAVE_BARRIER_TIMEOUT_MS,
+                )
+                return
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"autodist_tpu:save:{tag}:{path}")
 
         if is_chief:
             # Sweep leftovers of earlier killed writers (full-checkpoint-
@@ -272,9 +323,10 @@ class Saver:
         barrier("swapped")  # no process may see `path` before the swap
         logging.info("saved checkpoint with %d arrays -> %s", len(entries), path)
 
-    def _write_guarded(self, path, step, entries, local_files) -> None:
+    def _write_guarded(self, path, step, entries, local_files,
+                       async_seq) -> None:
         try:
-            self._write(path, step, entries, local_files)
+            self._write(path, step, entries, local_files, async_seq=async_seq)
         except BaseException as e:  # re-raised from wait()
             self._pending_error = e
 

@@ -23,6 +23,10 @@ DEFAULT_CHECKPOINT_DIR = os.path.join(DEFAULT_WORKING_DIR, "checkpoints")
 DEFAULT_PORT_RANGE = range(15000, 16000)
 DEFAULT_COORDINATOR_PORT = 15000
 
+# Async-save writer threads block at coordination-service barriers; a slow
+# or dead peer must fail the save (surfaced by Saver.wait), not hang it.
+ASYNC_SAVE_BARRIER_TIMEOUT_MS = 10 * 60 * 1000
+
 # Default logical mesh axis names. "data" is the batch axis (reference's
 # replica set), "model" carries tensor/variable partitioning (the reference's
 # partitioner axis), "seq" is new TPU-native sequence/context parallelism.

@@ -563,17 +563,20 @@ class ShardingPlan:
         return jax.tree_util.tree_map(
             leaf_to_global, local_batch, shardings, broadcast)
 
-    def window_shardings(self, stacked_batch) -> Any:
+    def window_shardings(self, stacked_batch, strict: bool = True) -> Any:
         """Shardings for a prefetched data window: every leaf carries a
         leading (scan-step) axis that stays unsharded, and each per-step
-        slice shards exactly as :meth:`batch_shardings` would shard it."""
+        slice shards exactly as :meth:`batch_shardings` would shard it —
+        including the strict default: a window is always TRAINING data, so
+        a non-divisible slice dim should fail loudly, not silently
+        replicate 8x redundant work per device."""
         slice_struct = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(
                 tuple(x.shape)[1:], getattr(x, "dtype", None) or np.asarray(x).dtype
             ),
             stacked_batch,
         )
-        slice_sh = self.batch_shardings(slice_struct, strict=False)
+        slice_sh = self.batch_shardings(slice_struct, strict=strict)
         return jax.tree_util.tree_map(
             lambda s: self._sharding(P(None, *s.spec)), slice_sh)
 
@@ -1336,7 +1339,7 @@ class DistributedTrainStep:
             chunk = window
             if steps is not None:
                 chunk = min(chunk, steps - step_i)
-            if eval_every:
+            if eval_every and eval_batch is not None:
                 chunk = min(chunk, eval_every - (step_i % eval_every))
             buf = []
             while len(buf) < chunk:

@@ -43,6 +43,7 @@ import numpy as np
 
 from autodist_tpu.model_item import ModelItem, VarItem
 from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.utils import logging
 from autodist_tpu.strategy.ir import (
     AllReduceSynchronizer,
     NodeConfig,
@@ -82,7 +83,15 @@ def compressor_wire_factor(name: Optional[str], shape) -> float:
         return 1.0
     from autodist_tpu.kernel.compressor import get_compressor
 
-    return float(get_compressor(name).wire_factor(tuple(shape)))
+    try:
+        comp = get_compressor(name)
+    except ValueError:
+        # A hand-built/deserialized IR may name a compressor this build
+        # doesn't know; rank it conservatively as dense rather than
+        # crashing the whole tune()/explain() candidate pass.
+        logging.warning("unknown compressor %r: pricing wire as dense", name)
+        return 1.0
+    return float(comp.wire_factor(tuple(shape)))
 
 # Optimizer-slot count per parameter byte (optax state residency). Unknown
 # optimizers — including "custom" (a raw optax transform whose state shape we
@@ -520,7 +529,7 @@ class CostModel:
                 # pricing a compressed wire would make tune prefer a
                 # compressed-ZeRO candidate whose real wire is the dense
                 # 1.5x all-reduce cost.
-                comm = self._oneway_s(res) + 2.0 * self._oneway_s(res)
+                comm = 3.0 * self._oneway_s(res)
             update = update_traffic_factor * res / shards / self.hbm_bw
             params = res / shards
             extra = self.slot_factor * res / shards + res  # slots + grad buffer
@@ -552,7 +561,7 @@ class CostModel:
             else:
                 # ZeRO-3 / partitioned: sharded param; reduce-scatter grads
                 # + all-gather params on use (forward + backward).
-                comm = self._oneway_s(res) + 2.0 * self._oneway_s(res)
+                comm = 3.0 * self._oneway_s(res)
                 params = res / upd_shards
             update = update_traffic_factor * res / upd_shards / self.hbm_bw
             extra = self.slot_factor * res / upd_shards + res

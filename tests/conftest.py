@@ -26,6 +26,10 @@ import pytest  # noqa: E402
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "integration: slow multi-process tests")
+    # Fast lane: `pytest tests/ -m "not slow"` targets a sub-minute smoke
+    # tier for pre-commit runs; the plain (slow-inclusive) suite stays the
+    # gate. Mark tests/parametrizations that cost multiple seconds.
+    config.addinivalue_line("markers", "slow: expensive tests, excluded from the fast lane")
 
 
 def pytest_addoption(parser):
@@ -38,7 +42,56 @@ def pytest_addoption(parser):
     )
 
 
+# Tests costing multiple seconds each (measured via --durations; dominated
+# by big-model builds and oracle comparisons). Centralized here so the fast
+# lane stays curated in one place; matched as nodeid substrings. A renamed
+# test silently drops OUT of this list into the fast lane — re-check with
+# `pytest tests/ -m "not slow" --durations=20` when the lane exceeds ~60s.
+_SLOW_NODEID_PARTS = (
+    "test_models.py::test_model_loss_and_grads",
+    "test_models.py::test_end_to_end_build",
+    "test_models.py::test_batchnorm_high_mean_low_variance_no_nan",
+    "test_graft_entry.py::test_dryrun_runs_on_preprovisioned_mesh",
+    "test_tensor_parallel.py::test_tp_training_matches_unsharded",
+    "test_examples.py::test_long_context_example",
+    "test_examples.py::test_benchmark_runner",
+    "test_moe_pipeline.py::TestMoE",
+    "test_moe_pipeline.py::Test1F1B",
+    "test_moe_pipeline.py::TestPipeline",  # also matches TestPipelineRemat, intended
+    "test_parallel.py::test_transformer_ring_impl_end_to_end",
+    "test_parallel.py::test_seq_parallel_matches_reference",
+    "test_parallel.py::test_ring_with_sharded_inputs",
+    "test_api.py::test_remat_matches_baseline",
+    "test_ops.py::test_transformer_with_flash_impl",
+    "test_ops.py::test_gradients_match_reference",
+    "test_ops.py::test_nonaligned_seq_falls_back",
+    "test_ops.py::test_forward_matches_reference",
+    "test_runtime.py::TestCoordinator::test_chief_fail_fast_on_worker_death",
+    "test_compressor.py::test_powersgd",
+    "test_compressor.py::test_compressed_path_with_sparse_embedding",
+    "test_lowering.py::TestMultiStepRun::test_run_matches_sequential_compressed",
+    "test_lowering.py::TestMultiStepRun::test_run_matches_sequential_staleness",
+    "test_e2e_numeric.py::test_embedding_sparse_step_matches_single_device",
+    "test_lowering.py::TestGradAccumulation",
+    "test_checkpoint.py::test_partitioned_save_restores_into_unpartitioned",
+    "test_compressor.py::test_compression_on_data_model_mesh",
+    "test_api.py::TestTune::test_tune_picks_a_candidate_and_trains_correctly",
+    "test_api.py::test_remat_preserves_sparse_detection",
+    "test_models.py::test_sparse_detection",
+    "test_models.py::test_space_to_depth_stem_exactly_equivalent",
+    "test_examples.py::test_launcher_cli_runs_trivial_command",
+    "test_runtime.py::TestCoordinator::test_local_worker_launch_and_join",
+    "test_runtime.py::TestStaleCleanup",
+    "test_integrations.py::test_flax_module_trains",
+    "test_parallel.py::test_trivial_seq_axis_falls_back",
+)
+
+
 def pytest_collection_modifyitems(config, items):
+    slow = pytest.mark.slow
+    for item in items:
+        if any(part in item.nodeid for part in _SLOW_NODEID_PARTS):
+            item.add_marker(slow)
     if config.getoption("--run-integration"):
         return
     skip = pytest.mark.skip(reason="needs --run-integration option to run")

@@ -419,3 +419,44 @@ class TestShardedLayout:
         logical = jax.eval_shape(pstep.plan.unpad_state, jax.eval_shape(lambda: state))
         with pytest.raises(ValueError, match="step.save"):
             bad_saver.restore(bad_path, target=logical)
+
+
+@pytest.mark.slow
+def test_sharded_write_throughput_vs_global_assembly(tmp_path):
+    """The v2 layout's write path must not be slower than the r1-style
+    'assemble globally on one process, then dump' it replaced — on one
+    host both write the same bytes, so block-parallel files should land
+    within a small factor of one monolithic np.save (the v2 win proper —
+    per-host parallel writers, no assembly memory — needs a fleet; the
+    2-process integration tests cover the correctness side). ~512MB
+    synthetic sharded state (VERDICT r2 #7 write-throughput test)."""
+    import time
+
+    from autodist_tpu.kernel.mesh import build_mesh
+    from autodist_tpu.resource_spec import ResourceSpec
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+    mesh = build_mesh(spec, axes=("data",))
+    n_rows, n_cols = 8 * 2048, 8192  # 8 row blocks x 64MB = 512MB fp32
+    x = jax.device_put(
+        jnp.ones((n_rows, n_cols), jnp.float32),
+        NamedSharding(mesh, P("data", None)))
+    jax.block_until_ready(x)
+
+    t0 = time.perf_counter()
+    saver = Saver(directory=str(tmp_path / "v2"))
+    path = saver.save({"w": x}, step=1)
+    t_v2 = time.perf_counter() - t0
+    assert len(Saver.read_metadata(path)["entries"]["w"]["shards"]) == 8
+
+    t0 = time.perf_counter()
+    host = np.asarray(x)  # the r1-style global assembly
+    np.save(str(tmp_path / "assembled.npy"), host)
+    t_naive = time.perf_counter() - t0
+
+    # Generous bound: both are disk-bandwidth-bound on one host; v2 pays
+    # only block-file overheads (8 opens + metadata + atomic swap).
+    assert t_v2 < 3.0 * t_naive + 1.0, (
+        f"v2 sharded write {t_v2:.2f}s vs naive assembly {t_naive:.2f}s")

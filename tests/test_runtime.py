@@ -476,6 +476,66 @@ def test_two_process_sharded_checkpoint(tmp_path):
 
 
 @pytest.mark.integration
+def test_two_process_async_checkpoint(tmp_path):
+    """Async (block=False) save on a real 2-process fleet (VERDICT r2 #7):
+    the background writer's barriers ride the coordination service, so
+    device collectives issued by the main thread WHILE the write is in
+    flight don't deadlock against them; wait() then finalizes and the
+    checkpoint restores."""
+    script = tmp_path / "async_ckpt.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        from autodist_tpu.runtime.launcher import initialize_from_env
+        initialize_from_env()
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        assert jax.process_count() == 2
+
+        mesh = Mesh(np.array(jax.devices()).reshape(4), ("data",))
+        sharding = NamedSharding(mesh, P("data", None))
+        local = np.arange(32, dtype=np.float32).reshape(2, 16) + 10 * jax.process_index()
+        x = jax.make_array_from_process_local_data(sharding, local, (4, 16))
+
+        from autodist_tpu.checkpoint import Saver
+        saver = Saver(directory=os.environ["AUTODIST_TEST_CKPT_DIR"])
+        path = saver.save({"w": x}, step=1, block=False)
+        # Training-style device collectives while the writer is in flight:
+        # these enqueue in launch order on the main thread; the writer's
+        # coordination-service barriers must not interleave with them.
+        y = jax.device_put(np.ones((4, 16), np.float32), sharding)
+        for _ in range(5):
+            y = jax.jit(
+                lambda a: jax.lax.with_sharding_constraint(a * 2.0, sharding)
+            )(y)
+        total = float(jnp.sum(y))
+        saver.wait()
+        meta = Saver.read_metadata(path)
+        assert len(meta["entries"]["w"]["shards"]) == 4, meta
+        restored = saver.restore(path)
+        got = np.asarray(restored["w"])
+        want = np.concatenate([
+            np.arange(32, dtype=np.float32).reshape(2, 16),
+            np.arange(32, dtype=np.float32).reshape(2, 16) + 10,
+        ])
+        np.testing.assert_array_equal(got, want)
+        assert total == 32 * 4 * 16
+        print("OK", jax.process_index(), flush=True)
+    """))
+    from autodist_tpu.runtime.launcher import _launch_local_fleet
+
+    env = _scrubbed_cpu_env()
+    env["AUTODIST_TEST_CKPT_DIR"] = str(tmp_path / "ckpt")
+    code = _launch_local_fleet(
+        [sys.executable, str(script)], 2, coordinator_port=_free_port(), base_env=env
+    )
+    assert code == 0
+
+
+@pytest.mark.integration
 def test_two_process_measured_tune_elects_same_winner(tmp_path):
     """Fleet tune(): both processes time the candidates in lockstep, the
     chief's measurements decide, and every process rebuilds the same
