@@ -6,6 +6,7 @@ matmuls on the MXU (feature dims padded by the caller, not here).
 """
 from __future__ import annotations
 
+import functools
 import math
 from typing import Tuple
 
@@ -163,6 +164,58 @@ def batchnorm_init(dim: int):
     return {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))}
 
 
+def _batchnorm_autodiff(p, x, eps: float = 1e-5):
+    """The r2 HBM-lean forward, differentiated by autodiff — kept as the
+    A/B reference for the custom-vjp default below (resnet_bounds.py
+    variant ``autodiffbn``). See :func:`batchnorm` for the semantics."""
+    x32 = x.astype(jnp.float32)
+    axes = tuple(range(x.ndim - 1))
+    mean = x32.mean(axes)
+    # Clamp: E[x²]−E[x]² cancels catastrophically for high-mean/low-variance
+    # channels and can come out slightly negative, which rsqrt turns to NaN.
+    var = jnp.maximum((x32 * x32).mean(axes) - mean * mean, 0.0)
+    inv = lax.rsqrt(var + eps)
+    return (((x32 - mean) * (p["scale"] * inv)) + p["bias"]).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _batchnorm_core(scale, bias, x, eps):
+    return _batchnorm_autodiff({"scale": scale, "bias": bias}, x, eps)
+
+
+def _batchnorm_core_fwd(scale, bias, x, eps):
+    x32 = x.astype(jnp.float32)
+    axes = tuple(range(x.ndim - 1))
+    mean = x32.mean(axes)
+    var = jnp.maximum((x32 * x32).mean(axes) - mean * mean, 0.0)
+    inv = lax.rsqrt(var + eps)
+    y = (((x32 - mean) * (scale * inv)) + bias).astype(x.dtype)
+    # Residuals beyond x itself are per-channel vectors — the backward
+    # re-derives x_hat from (x, mean, inv) instead of saving an
+    # activation-sized x_hat the way autodiff-through-the-moments would.
+    return y, (x, mean, inv, scale)
+
+
+def _batchnorm_core_bwd(eps, res, dy):
+    x, mean, inv, scale = res
+    axes = tuple(range(x.ndim - 1))
+    n = float(np.prod([x.shape[a] for a in axes]))
+    dy32 = dy.astype(jnp.float32)
+    x_hat = (x.astype(jnp.float32) - mean) * inv
+    # One fused reduction pass over (dy, dy·x_hat), then one fused
+    # elementwise pass — the classic analytic BN backward:
+    #   dx = (γ·inv)·(dy − E[dy] − x̂·E[dy·x̂])
+    sum_dy = dy32.sum(axes)
+    sum_dy_xhat = (dy32 * x_hat).sum(axes)
+    dbias = sum_dy
+    dscale = sum_dy_xhat
+    dx = (scale * inv) * (dy32 - sum_dy / n - x_hat * (sum_dy_xhat / n))
+    return dscale, dbias, dx.astype(x.dtype)
+
+
+_batchnorm_core.defvjp(_batchnorm_core_fwd, _batchnorm_core_bwd)
+
+
 def batchnorm(p, x, eps: float = 1e-5):
     """Training-mode batch norm over N,H,W (batch statistics only).
 
@@ -180,15 +233,15 @@ def batchnorm(p, x, eps: float = 1e-5):
     kernel (XLA reads bf16, writes bf16; the fp32 intermediate never
     reaches HBM), so high-mean/low-variance channels cancel exactly — a
     folded ``x*scale+bias`` in bf16 would lose the cancellation to
-    rounding."""
-    x32 = x.astype(jnp.float32)
-    axes = tuple(range(x.ndim - 1))
-    mean = x32.mean(axes)
-    # Clamp: E[x²]−E[x]² cancels catastrophically for high-mean/low-variance
-    # channels and can come out slightly negative, which rsqrt turns to NaN.
-    var = jnp.maximum((x32 * x32).mean(axes) - mean * mean, 0.0)
-    inv = lax.rsqrt(var + eps)
-    return (((x32 - mean) * (p["scale"] * inv)) + p["bias"]).astype(x.dtype)
+    rounding.
+
+    The backward is hand-written (r3): autodiff through the moments saves
+    activation-sized intermediates and re-reads x on several paths; the
+    custom vjp saves only (x, per-channel mean/inv) and lowers to exactly
+    one reduction pass + one elementwise pass
+    (``tests/test_models.py::test_batchnorm_custom_vjp_matches_autodiff``
+    pins it to the autodiff gradients bit-for-bit-tight)."""
+    return _batchnorm_core(p["scale"], p["bias"], x, eps)
 
 
 # ----------------------------------------------------------------------- losses

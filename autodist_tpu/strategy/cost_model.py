@@ -88,10 +88,16 @@ def compressor_wire_factor(name: Optional[str], shape) -> float:
     except ValueError:
         # A hand-built/deserialized IR may name a compressor this build
         # doesn't know; rank it conservatively as dense rather than
-        # crashing the whole tune()/explain() candidate pass.
-        logging.warning("unknown compressor %r: pricing wire as dense", name)
+        # crashing the whole tune()/explain() candidate pass. Warn once
+        # per name — tune sweeps call this per var x candidate.
+        if name not in _warned_compressors:
+            _warned_compressors.add(name)
+            logging.warning("unknown compressor %r: pricing wire as dense", name)
         return 1.0
     return float(comp.wire_factor(tuple(shape)))
+
+
+_warned_compressors: set = set()
 
 # Optimizer-slot count per parameter byte (optax state residency). Unknown
 # optimizers — including "custom" (a raw optax transform whose state shape we

