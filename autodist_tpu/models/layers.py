@@ -187,17 +187,20 @@ def _batchnorm_core_fwd(scale, bias, x, eps):
     x32 = x.astype(jnp.float32)
     axes = tuple(range(x.ndim - 1))
     mean = x32.mean(axes)
-    var = jnp.maximum((x32 * x32).mean(axes) - mean * mean, 0.0)
+    var_raw = (x32 * x32).mean(axes) - mean * mean
+    var = jnp.maximum(var_raw, 0.0)
     inv = lax.rsqrt(var + eps)
     y = (((x32 - mean) * (scale * inv)) + bias).astype(x.dtype)
     # Residuals beyond x itself are per-channel vectors — the backward
     # re-derives x_hat from (x, mean, inv) instead of saving an
     # activation-sized x_hat the way autodiff-through-the-moments would.
-    return y, (x, mean, inv, scale)
+    # The clamp mask rides along so the backward can zero the variance
+    # path exactly where the clamp froze it (matching autodiff).
+    return y, (x, mean, inv, scale, var_raw > 0.0)
 
 
 def _batchnorm_core_bwd(eps, res, dy):
-    x, mean, inv, scale = res
+    x, mean, inv, scale, var_live = res
     axes = tuple(range(x.ndim - 1))
     n = float(np.prod([x.shape[a] for a in axes]))
     dy32 = dy.astype(jnp.float32)
@@ -205,11 +208,16 @@ def _batchnorm_core_bwd(eps, res, dy):
     # One fused reduction pass over (dy, dy·x_hat), then one fused
     # elementwise pass — the classic analytic BN backward:
     #   dx = (γ·inv)·(dy − E[dy] − x̂·E[dy·x̂])
+    # In the clamped-variance regime (catastrophic cancellation pushed the
+    # one-pass variance negative; forward froze it at 0) the variance term
+    # is dropped per channel: d var/dx is identically 0 there, which is
+    # also what autodiff-through-the-clamp produces.
     sum_dy = dy32.sum(axes)
     sum_dy_xhat = (dy32 * x_hat).sum(axes)
     dbias = sum_dy
     dscale = sum_dy_xhat
-    dx = (scale * inv) * (dy32 - sum_dy / n - x_hat * (sum_dy_xhat / n))
+    var_term = jnp.where(var_live, sum_dy_xhat / n, 0.0)
+    dx = (scale * inv) * (dy32 - sum_dy / n - x_hat * var_term)
     return dscale, dbias, dx.astype(x.dtype)
 
 

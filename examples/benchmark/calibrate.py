@@ -24,8 +24,13 @@ import contextlib
 import io
 import json
 import os
+import sys
 
 import jax
+
+# Allow `python examples/benchmark/calibrate.py` straight from a repo
+# checkout (script dir, not the repo root, lands on sys.path).
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
 
 MODELS = {
     # Bench-shaped BERT (same family as bench.py) and the zoo ResNet-50.

@@ -1,0 +1,165 @@
+"""Device op-time attribution for a windowed train step (bench chip).
+
+Captures a ``jax.profiler`` trace of one windowed ``DistributedTrainStep.run``
+and aggregates the TPU plane's leaf "XLA Ops" line into a per-kernel-category
+table — the op-by-op evidence behind the conv-net ceiling discussion in
+docs/performance.md (VERDICT r2 #2 asked the remaining non-MXU time to be
+attributed; this is the attribution tool).
+
+The xplane.pb is parsed directly with the tensorflow-bundled proto (the
+tensorboard_plugin_profile converters in this image are version-skewed
+against TF), counting only the leaf op line: container events (the while
+loop, the jit region) and the async-copy line double-count wall time and
+are skipped. Categories follow the fusion names XLA emits on TPU —
+convolutions fuse into ``*_fusion`` kernels with their epilogues, so a
+"conv" category would be misleading; kernels are grouped by what their
+name says they compute.
+
+Usage::
+
+    python examples/benchmark/profile_ops.py --model resnet --batch 128 --window 20
+    python examples/benchmark/profile_ops.py --parse /tmp/trace_dir   # parse only
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import re
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+
+def capture(model: str, batch: int, window: int, trace_dir: str) -> None:
+    """Same production build path as bench.py/flash_crossover.py — a
+    hand-rolled pipeline here would silently drift from what users run."""
+    import jax
+
+    from autodist_tpu.api import AutoDist
+    from autodist_tpu.models import get_model
+    import autodist_tpu.strategy as S
+
+    spec = get_model(model)
+    params = spec.init(jax.random.PRNGKey(0))
+    batch_data = spec.example_batch(batch)
+    AutoDist.reset_default()
+    ad = AutoDist(strategy_builder=S.AllReduce())
+    step = ad.build(spec.loss_fn, params, batch_data)
+    state = step.init(params)
+    batch_data = jax.device_put(batch_data, step.plan.batch_shardings(batch_data))
+    jax.block_until_ready(batch_data)
+    state, m = step.run(state, batch_data, window)   # warmup + compile
+    float(m["loss"][-1])
+    with jax.profiler.trace(trace_dir):
+        state, m = step.run(state, batch_data, window)
+        float(m["loss"][-1])
+    # Sidecar so --parse later normalizes by the window this trace actually
+    # used instead of whatever --window defaults to in that invocation.
+    with open(os.path.join(trace_dir, "capture_meta.json"), "w") as fh:
+        json.dump({"model": model, "batch": batch, "window": window}, fh)
+
+
+_CATEGORIES = (
+    # (regex on the HLO op name, category label)
+    (r"%convert_reduce_fusion|%reduce_fusion", "stats/grad reductions (+fused producer conv)"),
+    (r"%multiply_add_fusion", "wgrad conv + optimizer update"),
+    (r"%select_and_scatter", "maxpool backward (SelectAndScatter)"),
+    (r"%reduce_window", "pooling forward"),
+    (r"%copy", "layout/loop-boundary copies"),
+    (r"%slice-start|%slice-done|%dynamic-slice", "async activation slices"),
+    (r"%fusion", "conv/elementwise fusions"),
+    (r"%while|^jit_|^0$", None),      # containers: skip, they double-count
+)
+
+
+def parse(trace_dir: str, window: int):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(os.path.join(trace_dir, "plugins/profile/*/*.xplane.pb"))
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
+    xs = xplane_pb2.XSpace()
+    with open(sorted(paths)[-1], "rb") as fh:
+        xs.ParseFromString(fh.read())
+    planes = [p for p in xs.planes if p.name.startswith("/device:TPU")]
+    if not planes:
+        raise RuntimeError(f"no TPU plane in trace ({[p.name for p in xs.planes]})")
+    plane = planes[0]
+    ev_md = plane.event_metadata
+    lines = [l for l in plane.lines if l.name == "XLA Ops"]
+    if not lines:
+        raise RuntimeError(f"no 'XLA Ops' line ({[l.name for l in plane.lines]})")
+
+    agg = collections.Counter()
+    cnt = collections.Counter()
+    for ev in lines[0].events:
+        name = ev_md[ev.metadata_id].name
+        for pat, label in _CATEGORIES:
+            if re.match(pat, name) or re.search(pat, name[:40]):
+                break
+        else:
+            label = "other"
+        if label is None:
+            continue
+        agg[label] += ev.duration_ps
+        cnt[label] += 1
+    total = sum(agg.values())
+    rows = []
+    print(f"device-op total {total / 1e9:.1f} ms "
+          f"-> {total / 1e9 / window:.2f} ms/step (window {window})")
+    for label, ps in agg.most_common():
+        rows.append({
+            "category": label,
+            "ms_per_step": round(ps / 1e9 / window, 3),
+            "pct": round(100 * ps / max(total, 1), 1),
+            "kernels": cnt[label],
+        })
+        print(f"  {ps / 1e9 / window:7.2f} ms/step {100 * ps / max(total, 1):5.1f}% "
+              f" n={cnt[label]:6d}  {label}")
+    return {"total_ms_per_step": round(total / 1e9 / window, 2), "rows": rows}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="resnet")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--window", type=int, default=None,
+                    help="steps per scan window (capture default: 20; parse "
+                         "default: the capture_meta.json sidecar next to the "
+                         "trace)")
+    ap.add_argument("--parse", default="", help="parse an existing trace dir only")
+    ap.add_argument("--out", default="", help="write the table as JSON here")
+    args = ap.parse_args()
+
+    if args.parse:
+        trace_dir = args.parse
+        window = args.window
+        meta_path = os.path.join(trace_dir, "capture_meta.json")
+        if window is None:
+            if not os.path.exists(meta_path):
+                ap.error(
+                    f"--parse with no --window and no {meta_path}: the window "
+                    "the trace was captured with is needed to report ms/step")
+            with open(meta_path) as fh:
+                window = json.load(fh)["window"]
+    else:
+        window = args.window if args.window is not None else 20
+        trace_dir = tempfile.mkdtemp(prefix=f"{args.model}_trace_")
+        capture(args.model, args.batch, window, trace_dir)
+        print(f"trace -> {trace_dir}")
+    table = parse(trace_dir, window)
+    if args.out:
+        table["model"] = args.model
+        table["batch"] = args.batch
+        table["window"] = window
+        with open(args.out, "w") as fh:
+            json.dump(table, fh, indent=2)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

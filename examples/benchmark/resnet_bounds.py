@@ -23,8 +23,13 @@ Variants (current repo BN = one-pass forward + hand-written vjp backward):
                   SelectAndScatter (maxpool backward) cost
   bf16feed      — batch pinned in HBM as bf16 (halves image read traffic)
 """
+import os
 import sys
 import time
+
+# Allow `python examples/benchmark/resnet_bounds.py` straight from a repo
+# checkout (script dir, not the repo root, lands on sys.path).
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
 
 import jax
 import jax.numpy as jnp

@@ -63,6 +63,11 @@ def parse_args():
     p.add_argument("--accum", type=int, default=1,
                    help="gradient accumulation microbatches per step")
     p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--pin", action="store_true",
+                   help="pin ONE batch in HBM and reuse it every window: "
+                        "measures the steady-state device rate (the 'compute' "
+                        "methodology in docs/performance.md) instead of "
+                        "paying a host upload per window ('fed')")
     p.add_argument("--trace", action="store_true", help="profile one step to TensorBoard")
     p.add_argument("--model-kwargs", default="",
                    help='JSON overrides for the model factory, e.g. \'{"num_layers": 2}\'')
@@ -93,7 +98,13 @@ def main():
 
     # Synthetic epoch streamed through the native loader (batch dict only —
     # tuple-structured batches fall back to repeating the example batch).
-    if isinstance(example, dict):
+    # --pin skips the loader entirely: one batch lives in HBM and the host
+    # stays idle during the timed windows.
+    if args.pin:
+        pinned = jax.device_put(example, step.plan.batch_shardings(example))
+        jax.block_until_ready(pinned)
+        next_batch = lambda: pinned  # noqa: E731
+    elif isinstance(example, dict):
         data = {
             k: np.tile(np.asarray(v), (4,) + (1,) * (np.asarray(v).ndim - 1))
             for k, v in example.items()
@@ -146,7 +157,8 @@ def main():
 
     s = timer.summary()
     result = {
-        "metric": f"{args.model}_{item_kind}_per_sec",
+        "metric": f"{args.model}_{item_kind}_per_sec"
+                  + ("_pinned" if args.pin else ""),
         "value": round(s.get("items_per_sec", 0.0), 2),
         "unit": f"{item_kind}/s",
         "strategy": args.strategy,

@@ -160,3 +160,45 @@ def test_batchnorm_custom_vjp_matches_autodiff():
         for k in ("scale", "bias"):
             np.testing.assert_allclose(
                 np.asarray(dp_c[k]), np.asarray(dp_a[k]), atol=tol, rtol=tol)
+
+
+def test_batchnorm_custom_vjp_matches_autodiff_in_clamp_regime():
+    # High-mean / near-zero-variance channels make the one-pass variance
+    # E[x²]−E[x]² go negative; the forward clamps it at 0 and autodiff's
+    # variance path freezes. The hand-written backward must drop the same
+    # term there — the well-conditioned test above never engages the clamp.
+    import numpy as np
+    from autodist_tpu.models import layers as L
+
+    # Constant channel value 100.0: true var = 0, one-pass fp32 var < 0.
+    x = jnp.full((8, 4, 4, 6), 100.0, jnp.float32)
+    x = x + jax.random.normal(jax.random.PRNGKey(0), x.shape) * 1e-4
+    raw_var = np.asarray((x.astype(jnp.float32) ** 2).mean((0, 1, 2))
+                         - x.astype(jnp.float32).mean((0, 1, 2)) ** 2)
+    assert (raw_var < 0).any(), "test setup: clamp regime not reached"
+    p = {"scale": jnp.ones((6,)), "bias": jnp.zeros((6,))}
+    dy = jax.random.normal(jax.random.PRNGKey(1), x.shape)
+
+    def run(fn):
+        y, vjp = jax.vjp(lambda pp, xx: fn(pp, xx), p, x)
+        return y, vjp(dy)
+
+    y_c, (dp_c, dx_c) = run(L.batchnorm)
+    y_a, (dp_a, dx_a) = run(L._batchnorm_autodiff)
+    np.testing.assert_allclose(np.asarray(y_c), np.asarray(y_a), atol=1e-4)
+    # Compare dx only on CLAMPED channels: there both formulations reduce
+    # to scale·inv·(dy − E[dy]) exactly. Channels whose raw variance landed
+    # at a tiny *positive* value keep the variance path, whose coefficient
+    # (var+eps)^{-3/2} ≈ 3e7 amplifies fp association noise differently in
+    # the two (algebraically equal) formulations — no meaningful contract
+    # exists there.
+    clamped = raw_var < 0
+    got = np.asarray(dx_c)[..., clamped]
+    want = np.asarray(dx_a)[..., clamped]
+    scale_mag = np.abs(want).max()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4 * scale_mag)
+    np.testing.assert_allclose(
+        np.asarray(dp_c["bias"]), np.asarray(dp_a["bias"]), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(dp_c["scale"])[clamped], np.asarray(dp_a["scale"])[clamped],
+        rtol=1e-3, atol=1e-3 * max(np.abs(np.asarray(dp_a["scale"])).max(), 1.0))
