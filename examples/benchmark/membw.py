@@ -1,0 +1,114 @@
+"""Achieved-HBM-bandwidth microbenchmark for the bench chip.
+
+The conv-net ceiling analysis in docs/performance.md prices kernels
+against the v5e *spec* HBM bandwidth (819 GB/s). This measures what a
+simple streaming kernel actually achieves through this runtime, at several
+tensor sizes, for three access patterns:
+
+  copy    y = x + 1            (read N, write N)
+  add3    y = a + b + c        (read 3N, write N)
+  reduce  s = sum(x, axis=0)   (read N, write ~0 — the BN-stats shape)
+
+Each pattern runs inside a scanned window (one dispatch, K repeats) with
+inputs pinned on device, mirroring the train-step methodology. If the
+measured ceiling is materially below spec, kernels "6x off the spec
+roofline" may in fact be at the *platform* roofline — that changes the
+conclusion of the bound analysis, which is why this exists.
+
+Usage::
+
+    python examples/benchmark/membw.py            # sweep
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+SIZES_MB = (16, 64, 256)
+REPEATS = 50
+DTYPE = jnp.bfloat16
+
+
+def _window(body, carry_init, n):
+    def step(c, _):
+        return body(c), None
+
+    return lax.scan(step, carry_init, None, length=n)[0]
+
+
+def bench_pattern(name, make_args, body, moved_bytes, repeats=REPEATS):
+    args = jax.device_put(make_args())
+    jax.block_until_ready(args)
+    fn = jax.jit(lambda a: _window(body, a, repeats))
+    out = fn(args)                      # compile + warmup
+    jax.block_until_ready(out)
+    trials = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fn(args)
+        jax.block_until_ready(jax.tree.leaves(out)[0])
+        trials.append(time.perf_counter() - t0)
+    dt = sorted(trials)[1] / repeats
+    gbs = moved_bytes / dt / 1e9
+    return {"pattern": name, "moved_mb": round(moved_bytes / 1e6, 1),
+            "us_per_iter": round(dt * 1e6, 1), "achieved_gb_s": round(gbs, 1)}
+
+
+def main() -> None:
+    dev = jax.devices()[0]
+    rows = []
+    bpe = jnp.dtype(DTYPE).itemsize
+    for mb in SIZES_MB:
+        n = mb * 1_000_000 // bpe
+        # 2D shape with a 128-lane minor dim, like real activations.
+        shape = (n // 128, 128)
+
+        def mk(shape=shape):
+            return jnp.ones(shape, DTYPE)
+
+        rows.append(bench_pattern(
+            f"copy_{mb}mb", mk, lambda x: x + jnp.asarray(1, x.dtype),
+            moved_bytes=2 * n * bpe))
+        rows.append(bench_pattern(
+            f"reduce_{mb}mb", mk,
+            # Carry shape must match the input: keep x as carry and mix a
+            # *tiny but nonzero* multiple of the fp32 row-reduction back in
+            # (a zero multiple would let XLA fold the whole body away).
+            lambda x: x + (x.astype(jnp.float32).sum(0, keepdims=True)
+                           * 1e-30).astype(x.dtype),
+            moved_bytes=2 * n * bpe))
+
+        def mk3(shape=shape):
+            return (jnp.ones(shape, DTYPE), jnp.ones(shape, DTYPE),
+                    jnp.ones(shape, DTYPE))
+
+        rows.append(bench_pattern(
+            f"add3_{mb}mb", mk3,
+            lambda abc: (abc[0] + abc[1] + abc[2], abc[1], abc[2]),
+            moved_bytes=4 * n * bpe))
+
+    for r in rows:
+        print(f"{r['pattern']:>14s}: {r['achieved_gb_s']:8.1f} GB/s "
+              f"({r['us_per_iter']:.0f} us/iter, {r['moved_mb']:.0f} MB moved)")
+    best = max(r["achieved_gb_s"] for r in rows)
+    print(f"\nbest achieved: {best:.0f} GB/s "
+          f"(v5e HBM spec 819 GB/s -> {best / 819:.0%} of spec)")
+    out = os.path.join(os.path.dirname(__file__), "..", "..", "docs",
+                       "measured", "membw.json")
+    with open(os.path.abspath(out), "w") as fh:
+        json.dump({"device": getattr(dev, "device_kind", dev.platform),
+                   "dtype": "bfloat16", "repeats": REPEATS, "rows": rows,
+                   "best_gb_s": best}, fh, indent=2)
+    print(f"wrote {os.path.abspath(out)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -76,7 +76,7 @@ _CATEGORIES = (
 )
 
 
-def parse(trace_dir: str, window: int):
+def parse(trace_dir: str, window: int, top: int = 0):
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
     paths = glob.glob(os.path.join(trace_dir, "plugins/profile/*/*.xplane.pb"))
@@ -96,6 +96,7 @@ def parse(trace_dir: str, window: int):
 
     agg = collections.Counter()
     cnt = collections.Counter()
+    per_op = collections.Counter()
     for ev in lines[0].events:
         name = ev_md[ev.metadata_id].name
         for pat, label in _CATEGORIES:
@@ -107,6 +108,7 @@ def parse(trace_dir: str, window: int):
             continue
         agg[label] += ev.duration_ps
         cnt[label] += 1
+        per_op[name] += ev.duration_ps
     total = sum(agg.values())
     rows = []
     print(f"device-op total {total / 1e9:.1f} ms "
@@ -120,6 +122,10 @@ def parse(trace_dir: str, window: int):
         })
         print(f"  {ps / 1e9 / window:7.2f} ms/step {100 * ps / max(total, 1):5.1f}% "
               f" n={cnt[label]:6d}  {label}")
+    if top:
+        print(f"\ntop {top} individual kernels (name truncated, shapes included):")
+        for name, ps in per_op.most_common(top):
+            print(f"  {ps / 1e9 / window:7.3f} ms/step  {name[:140]}")
     return {"total_ms_per_step": round(total / 1e9 / window, 2), "rows": rows}
 
 
@@ -133,6 +139,8 @@ def main() -> None:
                          "trace)")
     ap.add_argument("--parse", default="", help="parse an existing trace dir only")
     ap.add_argument("--out", default="", help="write the table as JSON here")
+    ap.add_argument("--top", type=int, default=0,
+                    help="also print the N largest individual kernels")
     args = ap.parse_args()
 
     if args.parse:
@@ -151,7 +159,7 @@ def main() -> None:
         trace_dir = tempfile.mkdtemp(prefix=f"{args.model}_trace_")
         capture(args.model, args.batch, window, trace_dir)
         print(f"trace -> {trace_dir}")
-    table = parse(trace_dir, window)
+    table = parse(trace_dir, window, args.top)
     if args.out:
         table["model"] = args.model
         table["batch"] = args.batch

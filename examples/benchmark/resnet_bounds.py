@@ -22,6 +22,17 @@ Variants (current repo BN = one-pass forward + hand-written vjp backward):
   avgstem       — stem max_pool replaced by avg_pool: bounds the
                   SelectAndScatter (maxpool backward) cost
   bf16feed      — batch pinned in HBM as bf16 (halves image read traffic)
+  nchw          — convs declared NCHW instead of NHWC: layout-assignment
+                  A/B (XLA re-lays-out either way; the declared order can
+                  steer which fusion layouts it picks)
+  dotstats      — BN statistics (fwd moments AND bwd sums) expressed as
+                  [1,M]@[M,C] matmul reductions instead of cross-NHW
+                  reduces. Hypothesis from the r3 op profile: the reduces
+                  make layout assignment put BATCH on the 128-lane minor
+                  dim of conv inputs ({0,3,2,1}) while conv outputs are
+                  channel-minor — mismatched layouts inside every conv
+                  kernel. A dot-shaped reduction prefers channel-minor,
+                  which may let convs run layout-matched.
 """
 import os
 import sys
@@ -62,6 +73,66 @@ elif VARIANT == "autodiffbn":
 elif VARIANT == "avgstem":
     orig_max_pool = L.max_pool
     L.max_pool = lambda x, w, s, padding="SAME": L.avg_pool(x, w, s, padding)
+elif VARIANT == "dotstats":
+    import functools
+
+    import numpy as np
+
+    def _colsum(m2d):
+        """Per-column sum via a dot against a runtime ones vector (iota-
+        derived so the algebraic simplifier cannot rewrite it back into the
+        cross-lane reduce this variant exists to avoid)."""
+        n = m2d.shape[0]
+        ones = (jax.lax.iota(jnp.float32, n) * 0.0 + 1.0)[None, :]
+        return jax.lax.dot_general(
+            ones, m2d, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)[0]
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+    def _bn_dot(scale, bias, x, eps):
+        return L._batchnorm_autodiff({"scale": scale, "bias": bias}, x, eps)
+
+    def _bn_dot_fwd(scale, bias, x, eps):
+        c = x.shape[-1]
+        x2d = x.astype(jnp.float32).reshape(-1, c)
+        n = x2d.shape[0]
+        mean = _colsum(x2d) / n
+        var_raw = _colsum(x2d * x2d) / n - mean * mean
+        var = jnp.maximum(var_raw, 0.0)
+        inv = jax.lax.rsqrt(var + eps)
+        y = (((x.astype(jnp.float32) - mean) * (scale * inv)) + bias).astype(x.dtype)
+        return y, (x, mean, inv, scale, var_raw > 0.0)
+
+    def _bn_dot_bwd(eps, res, dy):
+        x, mean, inv, scale, var_live = res
+        c = x.shape[-1]
+        n = float(np.prod(x.shape[:-1]))
+        dy32 = dy.astype(jnp.float32)
+        x_hat = (x.astype(jnp.float32) - mean) * inv
+        sum_dy = _colsum(dy32.reshape(-1, c))
+        sum_dy_xhat = _colsum((dy32 * x_hat).reshape(-1, c))
+        var_term = jnp.where(var_live, sum_dy_xhat / n, 0.0)
+        dx = (scale * inv) * (dy32 - sum_dy / n - x_hat * var_term)
+        return sum_dy_xhat, sum_dy, dx.astype(x.dtype)
+
+    _bn_dot.defvjp(_bn_dot_fwd, _bn_dot_bwd)
+    L.batchnorm = lambda p, x, eps=1e-5: _bn_dot(p["scale"], p["bias"], x, eps)
+elif VARIANT == "nchw":
+    _orig_conv = L.conv
+
+    def _conv_nchw(p, x, stride=1, padding="SAME", *, compute_dtype=None):
+        k = p["kernel"]
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+            k = k.astype(compute_dtype)
+        y = lax.conv_general_dilated(
+            x.transpose(0, 3, 1, 2), k,
+            window_strides=(stride, stride), padding=padding,
+            dimension_numbers=("NCHW", "HWIO", "NCHW"),
+        )
+        return y.transpose(0, 2, 3, 1)
+
+    L.conv = _conv_nchw
 
 spec = get_model("resnet")
 params = spec.init(jax.random.PRNGKey(0))

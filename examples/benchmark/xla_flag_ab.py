@@ -1,0 +1,85 @@
+"""A/B the ResNet step time across XLA/libtpu compiler-flag settings.
+
+Compiler flags must exist in the environment before backend init, so each
+configuration runs ``resnet_bounds.py base`` in a FRESH subprocess with
+``XLA_FLAGS`` / ``LIBTPU_INIT_ARGS`` composed from the table below. The
+base config is measured first and last (drift guard: if the two base runs
+disagree by >5% the session is unstable and the A/B is void).
+
+These are throughput experiments, not shipped defaults: anything that wins
+must be re-validated for numerics before being promoted into the
+framework (and flags are runtime-version-specific by nature).
+
+Usage::
+
+    python examples/benchmark/xla_flag_ab.py [batch] [window]
+"""
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+BATCH = sys.argv[1] if len(sys.argv) > 1 else "128"
+WINDOW = sys.argv[2] if len(sys.argv) > 2 else "20"
+
+# name -> (XLA_FLAGS additions, LIBTPU_INIT_ARGS additions)
+CONFIGS = {
+    "base": ("", ""),
+    # Bigger scoped VMEM budget: deeper async prefetch of weights and
+    # activation slices into the alternate memory the profile shows heavy
+    # copy-start traffic through.
+    "vmem128m": ("", "--xla_tpu_scoped_vmem_limit_kib=131072"),
+    # Latency-hiding scheduler off: A/B whether its overlap choices help
+    # this while-loop-of-fusions shape at all.
+    "no_lhs": ("", "--xla_tpu_enable_latency_hiding_scheduler=false"),
+    # Flip all-reduce/all-gather async continuation packing.
+    "no_async_cf": ("", "--xla_tpu_enable_async_collective_fusion=false"),
+    "base_again": ("", ""),
+}
+
+LINE = re.compile(r"VARIANT \S+ b\d+ w\d+: ([0-9.]+) ms/step")
+
+
+def run_one(name, xla, libtpu):
+    env = dict(os.environ)
+    if xla:
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + xla).strip()
+    if libtpu:
+        env["LIBTPU_INIT_ARGS"] = (
+            env.get("LIBTPU_INIT_ARGS", "") + " " + libtpu).strip()
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "resnet_bounds.py")
+    r = subprocess.run(
+        [sys.executable, script, "base", BATCH, WINDOW],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    m = LINE.search(r.stdout or "")
+    if r.returncode != 0 or not m:
+        print(f"{name}: FAILED\n{(r.stderr or '')[-800:]}", file=sys.stderr)
+        return None
+    return float(m.group(1))
+
+
+def main() -> None:
+    results = {}
+    for name, (xla, libtpu) in CONFIGS.items():
+        ms = run_one(name, xla, libtpu)
+        results[name] = ms
+        print(f"{name:>14s}: {'FAILED' if ms is None else f'{ms:.2f} ms/step'}",
+              flush=True)
+    b0, b1 = results.get("base"), results.get("base_again")
+    if b0 and b1 and abs(b0 - b1) / b0 > 0.05:
+        print(f"\nUNSTABLE SESSION: base {b0:.2f} vs {b1:.2f} ms/step "
+              "(>5% drift) — A/B void")
+        return
+    if b0:
+        print("\nvs base:")
+        for name, ms in results.items():
+            if ms and name not in ("base", "base_again"):
+                print(f"  {name:>14s}: {b0 / ms:5.2f}x")
+
+
+if __name__ == "__main__":
+    main()
