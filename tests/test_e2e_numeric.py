@@ -74,6 +74,65 @@ def embed_batch():
     return (ids, jax.random.normal(k2, (BATCH,)))
 
 
+DH, SEQ = 8, 5
+
+
+def scan_params():
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(21), 3)
+    return {
+        "cell_wx": jax.random.normal(k1, (DIN, DH)) * 0.4,
+        "cell_wh": jax.random.normal(k2, (DH, DH)) * 0.4,
+        "out_w": jax.random.normal(k3, (DH, 1)),
+    }
+
+
+def scan_loss(params, batch):
+    """Recurrent model with the loss fed from a ``lax.scan`` carry — the
+    reference's while-loop / dynamic-LSTM cases (c4/c6,
+    ``tests/integration/test_all.py:20-30``): strategies must lower models
+    whose jaxpr nests the parameter uses inside a scan body."""
+    x_seq, y = batch
+    def cell(h, xt):
+        return jnp.tanh(xt @ params["cell_wx"] + h @ params["cell_wh"]), None
+
+    h0 = jnp.zeros((x_seq.shape[0], DH))
+    h_t, _ = jax.lax.scan(cell, h0, x_seq.transpose(1, 0, 2))
+    pred = (h_t @ params["out_w"]).squeeze(-1)
+    return jnp.mean((pred - y) ** 2)
+
+
+def scan_batch():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(22))
+    return (jax.random.normal(k1, (BATCH, SEQ, DIN)),
+            jax.random.normal(k2, (BATCH,)))
+
+
+def cond_loss(params, batch):
+    """Parameters used inside ``lax.cond`` branches: the jaxpr walker and
+    every lowering must see through cond sub-jaxprs. The predicate depends
+    only on params, so it is identical on every shard."""
+    x, y = batch
+    y0 = y[:, 0]   # dense_batch targets are [B, DOUT]; this head predicts one
+    pred = (x @ params["w"] + params["b"]) @ params["w2"]
+
+    def big(p):
+        return jnp.mean((pred.squeeze(-1) - y0) ** 2) + 1e-3 * jnp.sum(p["w2"] ** 2)
+
+    def small(p):
+        return jnp.mean(jnp.abs(pred.squeeze(-1) - y0)) + jnp.sum(p["b"] ** 2)
+
+    return jax.lax.cond(jnp.sum(params["b"]) > 0.0, big, small, params)
+
+
+def cond_params():
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(31), 3)
+    return {
+        "w": jax.random.normal(k1, (DIN, DOUT)),
+        "b": jnp.abs(jax.random.normal(k2, (DOUT,))),   # sum > 0: big branch
+        "w2": jax.random.normal(k3, (DOUT, 1)),
+    }
+
+
 ALL_BUILDERS = [
     PS(),
     PS(local_proxy_variable=True),
@@ -145,6 +204,35 @@ def test_embedding_sparse_step_matches_single_device(builder):
     opt = OptimizerSpec("sgd", {"learning_rate": 0.1})
     expected = reference_step(embed_loss, params, batch, opt.make())
     step, new_state, _ = run_distributed(builder, embed_loss, params, batch, opt, sparse=True)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6),
+        jax.device_get(step.logical_params(new_state)),
+        jax.device_get(expected),
+    )
+
+
+@pytest.mark.parametrize("builder", ALL_BUILDERS, ids=IDS)
+def test_scan_model_matches_single_device(builder):
+    params, batch = scan_params(), scan_batch()
+    opt = OptimizerSpec("sgd", {"learning_rate": 0.05})
+    expected = reference_step(scan_loss, params, batch, opt.make())
+    step, new_state, metrics = run_distributed(builder, scan_loss, params, batch, opt)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6),
+        jax.device_get(step.logical_params(new_state)),
+        jax.device_get(expected),
+    )
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(scan_loss(params, batch)), rtol=1e-5
+    )
+
+
+@pytest.mark.parametrize("builder", ALL_BUILDERS, ids=IDS)
+def test_cond_model_matches_single_device(builder):
+    params, batch = cond_params(), dense_batch()
+    opt = OptimizerSpec("sgd", {"learning_rate": 0.05})
+    expected = reference_step(cond_loss, params, batch, opt.make())
+    step, new_state, _ = run_distributed(builder, cond_loss, params, batch, opt)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6),
         jax.device_get(step.logical_params(new_state)),
