@@ -15,9 +15,19 @@ per-round sweep.
 from __future__ import annotations
 
 import argparse
+import datetime
 import json
+import os
 import sys
 import time
+
+# Successful accelerator runs cache their JSON line here; the CPU-smoke
+# fallback embeds it (clearly labeled with its timestamp) so a tunnel wedge
+# at report time doesn't erase the round's verified TPU evidence.
+LAST_ACCEL_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "docs", "measured", "bench_last_accel.json",
+)
 
 
 # Peak bf16 FLOPs/s per chip by TPU generation (public figures). Matched
@@ -275,16 +285,33 @@ def main() -> None:
         rn = measured["resnet"]
         if on_accel:
             result["resnet50_mfu"] = round(rn["mfu"], 4)
+            result["resnet50_vs_baseline"] = round(rn["mfu"] / TARGET_MFU, 4)
         result["resnet50_images_per_sec_per_chip"] = round(
             rn["units_per_sec"] / rn["n_chips"], 1)
         result["resnet50_batch_size"] = rn["batch_size"]
     for name, err in errors.items():
         result[f"{name}_error"] = err
-    if not accel_ok:
+    if on_accel:
+        try:
+            with open(LAST_ACCEL_PATH, "w") as fh:
+                json.dump({
+                    "at": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+                    "result": result,
+                }, fh, indent=2)
+        except OSError as e:
+            print(f"bench: could not cache accel result: {e}", file=sys.stderr)
+    else:
         result["error"] = (
             "accelerator unresponsive (tunnel wedged, retried preflight); "
             "CPU smoke fallback"
         )
+        try:
+            with open(LAST_ACCEL_PATH) as fh:
+                cached = json.load(fh)
+            result["last_verified_accel_at"] = cached["at"]
+            result["last_verified_accel_result"] = cached["result"]
+        except (OSError, ValueError, KeyError):
+            pass
     print(json.dumps(result))
 
 
