@@ -141,7 +141,21 @@ def main(argv=None) -> int:
         help='path to a tune()-written calibration.json, or "auto" for the '
              'default location; adds a calibrated step-time column',
     )
+    p.add_argument(
+        "--platform", default="cpu",
+        help="jax platform for the planning traces (default cpu: ranking is "
+             "analytical and must not hang on an absent/wedged accelerator; "
+             "pass e.g. 'tpu' to derive the default ResourceSpec from the "
+             "real local devices instead of a --resource-spec file)",
+    )
     args = p.parse_args(argv)
+
+    import jax
+
+    if args.platform:
+        # Before any backend use: shape-only planning runs anywhere, and the
+        # default accelerator may be absent or wedged (axon tunnel).
+        jax.config.update("jax_platforms", args.platform)
 
     from autodist_tpu.models import get_model
 
@@ -151,7 +165,6 @@ def main(argv=None) -> int:
             k, v = pair.split("=", 1)
             kwargs[k.strip()] = _coerce(v.strip())
     spec = get_model(args.model, **kwargs)
-    import jax
 
     params = spec.init(jax.random.PRNGKey(0))
     batch = spec.example_batch(args.batch_size)
@@ -161,11 +174,18 @@ def main(argv=None) -> int:
         params, loss_fn=spec.loss_fn, example_batch=batch,
         sparse_names=spec.sparse_names, expert_names=spec.expert_names,
     )
-    rs = (
-        ResourceSpec(args.resource_spec)
-        if args.resource_spec
-        else ResourceSpec.from_local_devices()
-    )
+    if args.resource_spec:
+        rs = ResourceSpec(args.resource_spec)
+    else:
+        rs = ResourceSpec.from_local_devices()
+        if args.platform == "cpu":
+            print(
+                "note: cluster derived from the cpu planning platform "
+                f"({rs.num_chips} device); pass --resource-spec <yml> for "
+                "a real multi-chip topology, or --platform tpu to derive "
+                "from the local accelerator",
+                file=sys.stderr,
+            )
     measured = None
     if args.measured_file:
         import json
