@@ -92,8 +92,6 @@ def _preflight(timeouts=None, backoffs=None) -> bool:
     seconds) override the schedule, e.g. ``BENCH_PREFLIGHT_TIMEOUTS=10`` for a
     single fast probe in local smoke runs.
     """
-    import os
-
     def _env(name, default, allow_empty=False):
         raw = os.environ.get(name)
         if raw is None:
