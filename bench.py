@@ -123,6 +123,31 @@ def _preflight(timeouts=None, backoffs=None) -> bool:
     return False
 
 
+def _store_last_accel(result: dict) -> None:
+    """Cache a successful accelerator result for later wedge fallbacks."""
+    try:
+        with open(LAST_ACCEL_PATH, "w") as fh:
+            json.dump({
+                "at": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+                "result": result,
+            }, fh, indent=2)
+    except OSError as e:
+        print(f"bench: could not cache accel result: {e}", file=sys.stderr)
+
+
+def _embed_last_accel(result: dict) -> dict:
+    """Attach the cached accelerator result (if any) to a fallback line,
+    clearly labeled with its capture time."""
+    try:
+        with open(LAST_ACCEL_PATH) as fh:
+            cached = json.load(fh)
+        result["last_verified_accel_at"] = cached["at"]
+        result["last_verified_accel_result"] = cached["result"]
+    except (OSError, ValueError, KeyError):
+        pass
+    return result
+
+
 def measure_workload(model_name: str, on_accel: bool) -> dict:
     """Train-step throughput for one named workload on the visible devices.
 
@@ -290,26 +315,13 @@ def main() -> None:
     for name, err in errors.items():
         result[f"{name}_error"] = err
     if on_accel:
-        try:
-            with open(LAST_ACCEL_PATH, "w") as fh:
-                json.dump({
-                    "at": datetime.datetime.now(datetime.timezone.utc).isoformat(),
-                    "result": result,
-                }, fh, indent=2)
-        except OSError as e:
-            print(f"bench: could not cache accel result: {e}", file=sys.stderr)
+        _store_last_accel(result)
     else:
         result["error"] = (
             "accelerator unresponsive (tunnel wedged, retried preflight); "
             "CPU smoke fallback"
         )
-        try:
-            with open(LAST_ACCEL_PATH) as fh:
-                cached = json.load(fh)
-            result["last_verified_accel_at"] = cached["at"]
-            result["last_verified_accel_result"] = cached["result"]
-        except (OSError, ValueError, KeyError):
-            pass
+        result = _embed_last_accel(result)
     print(json.dumps(result))
 
 

@@ -1,0 +1,97 @@
+"""Unit tests for bench.py's pure helpers.
+
+bench.py is the driver-facing perf surface: a silent regression in its
+preflight schedule parsing or peak-FLOPs detection converts a healthy
+round into a CPU-smoke report (exactly the r2 failure mode), so the pure
+pieces are pinned here. The measurement path itself runs on hardware and
+is exercised by the driver.
+"""
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bench():
+    path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    spec = importlib.util.spec_from_file_location("bench_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _Dev:
+    def __init__(self, kind):
+        self.device_kind = kind
+
+
+def test_peak_flops_detects_known_kinds(bench):
+    for kind, want in (("TPU v5 lite", 197e12), ("TPU v5e", 197e12),
+                       ("TPU v5p", 459e12), ("TPU v4", 275e12),
+                       ("TPU v6e", 918e12)):
+        peak, detected = bench._peak_flops(_Dev(kind))
+        assert detected, kind
+        assert peak == want, kind
+
+
+def test_peak_flops_unknown_kind_flags_guess(bench):
+    peak, detected = bench._peak_flops(_Dev("TPU v9 hypothetical"))
+    assert not detected
+    assert peak == bench.DEFAULT_PEAK
+
+
+def test_preflight_env_schedule_overrides(bench, monkeypatch):
+    calls = []
+    monkeypatch.setattr(bench, "_probe_once", lambda t: (calls.append(t), False)[1])
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setenv("BENCH_PREFLIGHT_TIMEOUTS", "5,7")
+    monkeypatch.setenv("BENCH_PREFLIGHT_BACKOFFS", "1")
+    assert bench._preflight() is False
+    assert calls == [5.0, 7.0]
+
+
+def test_preflight_blank_timeouts_means_default_not_never(bench, monkeypatch):
+    # An empty TIMEOUTS schedule would mean "never probe" and report a
+    # healthy TPU as wedged; blank must fall back to the default schedule.
+    calls = []
+    monkeypatch.setattr(bench, "_probe_once", lambda t: (calls.append(t), True)[1])
+    monkeypatch.setenv("BENCH_PREFLIGHT_TIMEOUTS", "")
+    assert bench._preflight() is True
+    assert calls == [120.0]
+
+
+def test_preflight_stops_at_first_success(bench, monkeypatch):
+    calls = []
+
+    def probe(t):
+        calls.append(t)
+        return len(calls) == 2
+
+    monkeypatch.setattr(bench, "_probe_once", probe)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setenv("BENCH_PREFLIGHT_TIMEOUTS", "1,2,3,4")
+    monkeypatch.setenv("BENCH_PREFLIGHT_BACKOFFS", "0,0,0")
+    assert bench._preflight() is True
+    assert calls == [1.0, 2.0]
+
+
+def test_last_accel_cache_round_trips(bench, tmp_path, monkeypatch):
+    # A successful run's cache must come back attached to a later fallback
+    # line, clearly labeled with its capture time.
+    monkeypatch.setattr(bench, "LAST_ACCEL_PATH",
+                        str(tmp_path / "bench_last_accel.json"))
+    accel_line = {"metric": "bert_base_mfu", "value": 0.69}
+    bench._store_last_accel(accel_line)
+
+    fallback = bench._embed_last_accel({"metric": "bert_base_mfu_cpu_smoke"})
+    assert fallback["last_verified_accel_result"] == accel_line
+    assert fallback["last_verified_accel_at"]  # ISO timestamp present
+
+
+def test_embed_last_accel_tolerates_missing_cache(bench, tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "LAST_ACCEL_PATH", str(tmp_path / "absent.json"))
+    line = {"metric": "bert_base_mfu_cpu_smoke"}
+    assert bench._embed_last_accel(dict(line)) == line
