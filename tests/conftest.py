@@ -72,6 +72,19 @@ _SLOW_NODEID_PARTS = (
     "test_lowering.py::TestMultiStepRun::test_run_matches_sequential_compressed",
     "test_lowering.py::TestMultiStepRun::test_run_matches_sequential_staleness",
     "test_e2e_numeric.py::test_embedding_sparse_step_matches_single_device",
+    # Control-flow matrix cases: keep one representative ([AllReduce]) in
+    # the fast lane, the other 8 builders run in the full gate.
+    "test_e2e_numeric.py::test_scan_model_matches_single_device[PS",
+    "test_e2e_numeric.py::test_scan_model_matches_single_device[Partitioned",
+    "test_e2e_numeric.py::test_scan_model_matches_single_device[UnevenPartitionedPS",
+    "test_e2e_numeric.py::test_scan_model_matches_single_device[RandomAxisPartitionAR",
+    "test_e2e_numeric.py::test_scan_model_matches_single_device[Parallax",
+    "test_e2e_numeric.py::test_cond_model_matches_single_device[PS",
+    "test_e2e_numeric.py::test_cond_model_matches_single_device[Partitioned",
+    "test_e2e_numeric.py::test_cond_model_matches_single_device[UnevenPartitionedPS",
+    "test_e2e_numeric.py::test_cond_model_matches_single_device[RandomAxisPartitionAR",
+    "test_e2e_numeric.py::test_cond_model_matches_single_device[Parallax",
+    "test_models.py::test_batchnorm_custom_vjp_matches_autodiff",
     "test_lowering.py::TestGradAccumulation",
     "test_checkpoint.py::test_partitioned_save_restores_into_unpartitioned",
     "test_compressor.py::test_compression_on_data_model_mesh",

@@ -162,7 +162,7 @@ def test_batchnorm_custom_vjp_matches_autodiff():
                 np.asarray(dp_c[k]), np.asarray(dp_a[k]), atol=tol, rtol=tol)
 
 
-def test_batchnorm_custom_vjp_matches_autodiff_in_clamp_regime():
+def test_batchnorm_clamp_regime_vjp_matches_autodiff():
     # High-mean / near-zero-variance channels make the one-pass variance
     # E[x²]−E[x]² go negative; the forward clamps it at 0 and autodiff's
     # variance path freezes. The hand-written backward must drop the same
