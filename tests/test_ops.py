@@ -67,3 +67,25 @@ def test_transformer_with_flash_impl():
     l1 = spec_dot.loss_fn(params, batch)
     l2 = spec_flash.loss_fn(params, batch)
     np.testing.assert_allclose(l1, l2, atol=1e-4, rtol=1e-4)
+
+
+def test_fused_matmul_stats_matches_xla():
+    """The experimental pallas matmul+BN-stats kernel
+    (examples/benchmark/fused_conv_stats.py — the isolated rendering of
+    ResNet's dominant fused-kernel shape) must agree with the XLA
+    formulation in interpret mode: same product, same fp32 moments."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "examples", "benchmark"))
+    from fused_conv_stats import fused_matmul_stats, xla_matmul_stats
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2048, 64)).astype(jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), (64, 128)).astype(jnp.bfloat16)
+    y_p, s1_p, s2_p = fused_matmul_stats(x, w, block_m=512, interpret=True)
+    y_x, s1_x, s2_x = xla_matmul_stats(x, w)
+    np.testing.assert_allclose(np.asarray(y_p, np.float32),
+                               np.asarray(y_x, np.float32), atol=1e-2)
+    np.testing.assert_allclose(np.asarray(s1_p), np.asarray(s1_x), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(s2_p), np.asarray(s2_x), rtol=1e-4)
