@@ -114,15 +114,22 @@ def xla_matmul_stats(x, w):
     return y, y32.sum(0), (y32 * y32).sum(0)
 
 
+def _sync(out):
+    """Device->host scalar fetch: the only trustworthy barrier through the
+    axon tunnel (block_until_ready can return early there); execution is
+    in-order per device, so one element of the LAST result syncs them all."""
+    return float(jax.tree.leaves(out)[-1].ravel()[0])
+
+
 def _time(fn, *args, repeats=30):
     out = fn(*args)
-    jax.block_until_ready(out)
+    _sync(out)
     trials = []
     for _ in range(3):
         t0 = time.perf_counter()
         for _ in range(repeats):
             out = fn(*args)
-        jax.block_until_ready(out)
+        _sync(out)
         trials.append((time.perf_counter() - t0) / repeats)
     return sorted(trials)[1]
 
