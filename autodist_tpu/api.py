@@ -256,7 +256,7 @@ class AutoDist:
         sparse_names: Sequence[str] = (),
         expert_names: Sequence[str] = (),
         donate_state: bool = True,
-        host_offload: bool = False,
+        host_offload: Union[bool, str] = False,
         grad_accum_steps: int = 1,
         remat: Union[bool, str] = False,
     ) -> DistributedTrainStep:
@@ -266,7 +266,10 @@ class AutoDist:
         builders see the optimizer) or a raw optax transform.
         ``host_offload=True`` parks PS-synchronized parameters + optimizer
         slots in pinned host memory, streaming through HBM per step (the
-        reference's params-on-CPU placement, ps_strategy.py:38-55).
+        reference's params-on-CPU placement, ps_strategy.py:38-55);
+        ``host_offload="from_strategy"`` follows the strategy's own
+        placement instead — only variables whose ``reduction_destination``
+        (node- or shard-level) names a host CPU device are offloaded.
         ``grad_accum_steps=k`` microbatches each step k-ways (activation
         memory ÷ k, same update for batch-mean losses).
         ``remat`` rematerializes the forward pass during backward

@@ -80,6 +80,15 @@ class VarPlan:
     # streaming through HBM inside the step — the TPU rendering of the
     # reference parking PS variables on host CPUs (ps_strategy.py:38-55).
     offload: bool = False
+    # Per-shard PS destination table (reference strategy.proto:46-50, as
+    # emitted by the PartitionedPS load balancer): shard i of the variable
+    # reduces at shard_destinations[i]. Under SPMD the *identity* of each
+    # destination collapses onto mesh coordinates (shard i lives at position
+    # i of the shard axis — uniform by construction), but the table is part
+    # of the plan: explain prints it, the cost model prices it, and
+    # ``host_offload="from_strategy"`` reads the destinations' device type
+    # to pick the memory kind.
+    shard_destinations: Tuple[str, ...] = ()
     # Pad-and-mask sharding (SURVEY §7.4 item 5): when a requested shard
     # axis divides no axis evenly (e.g. GPT-2's prime vocab 50257), the
     # parameter is STORED zero-padded to this shape so XLA's equal-shard
@@ -114,6 +123,22 @@ def _spec_with_axis(rank: int, dim: int, mesh_axis: str) -> P:
     return P(*entries)
 
 
+def _is_cpu_device(dest: str) -> bool:
+    """True when a DeviceSpec string (``host:TYPE:index``) names a host CPU.
+
+    Delegates the parse to :class:`resource_spec.DeviceSpec` so there is one
+    implementation of the device-string grammar; unparseable destinations
+    read as non-CPU (stay in HBM) rather than raising — a strategy artifact
+    with a malformed destination should still lower.
+    """
+    from autodist_tpu.resource_spec import DeviceSpec, DeviceType
+
+    try:
+        return DeviceSpec.from_string(dest).device_type is DeviceType.CPU
+    except (ValueError, KeyError):
+        return False
+
+
 def _memory_kinds_supported(mesh: Mesh) -> bool:
     """True when the runtime can stream pinned-host leaves inside jit.
 
@@ -144,17 +169,30 @@ class GraphTransformer:
     passes here are sharding-assignment rules instead of graph rewrites.
     """
 
+    #: host_offload modes: False (never), True (every PS variable), or
+    #: "from_strategy" (PS variables whose reduction destination — node- or
+    #: shard-level — names a host CPU device, the reference's literal
+    #: placement; ps_strategy.py:38-55).
+    OFFLOAD_MODES = (False, True, "from_strategy")
+
     def __init__(
         self,
         strategy: Strategy,
         model_item: ModelItem,
         mesh: Mesh,
-        host_offload: bool = False,
+        host_offload: "bool | str" = False,
     ):
+        if host_offload not in self.OFFLOAD_MODES:
+            raise ValueError(
+                f"host_offload={host_offload!r}: expected one of "
+                f"{self.OFFLOAD_MODES}"
+            )
         self.strategy = strategy
         self.model_item = model_item
         self.mesh = mesh
-        self.host_offload = host_offload and _memory_kinds_supported(mesh)
+        if host_offload and not _memory_kinds_supported(mesh):
+            host_offload = False
+        self.host_offload = host_offload
 
     def transform(self) -> "ShardingPlan":
         plans: Dict[str, VarPlan] = {}
@@ -179,14 +217,82 @@ class GraphTransformer:
             return model_ax
         return data_axis(self.mesh)
 
+    @staticmethod
+    def _fold_part_config(node: NodeConfig) -> dict:
+        """Fold per-shard sync configs (strategy.proto:46-50) into the plan.
+
+        The reference rendered each shard of a partitioned variable as an
+        independent variable with its own synchronizer, so shards could
+        legitimately differ (partitioned_ps_strategy.py:104-121 gives each a
+        different reduction destination). Under SPMD one variable lowers to
+        ONE NamedSharding and one gradient wire, so the per-shard degrees of
+        freedom fold: settings that must be uniform across a single wire
+        (synchronizer kind, sync/staleness, compressor, local_replication)
+        are validated uniform — heterogeneous values have no SPMD rendering
+        and raise — and the uniform value *overrides* the node-level one
+        (shard configs are the more specific contract). Exception: ``sync``
+        is validated, never overridden — async PS is rejected loudly whether
+        it appears at node or shard level (a shard-level ``sync=True`` does
+        not resurrect an async node config).
+        Per-shard destinations survive as the plan's ``shard_destinations``
+        table. Per-shard ``group`` ids are advisory (see
+        AllReduceSynchronizer.group) and are not required to agree.
+        """
+        parts = node.part_config
+        folded: dict = {}
+        if not parts:
+            return folded
+        if len(parts) != node.num_shards:
+            # StrategyCompiler checks this too, but GraphTransformer also
+            # lowers hand-built / deserialized strategies directly — a
+            # mismatched table must not silently skew shard_destinations.
+            raise ValueError(
+                f"{node.var_name!r}: {len(parts)} part configs but "
+                f"partitioner {node.partitioner!r} implies {node.num_shards}"
+            )
+        kinds = {type(p.synchronizer) for p in parts} | {type(node.synchronizer)}
+        if len(kinds) > 1:
+            raise ValueError(
+                f"{node.var_name!r}: per-shard synchronizers mix "
+                f"{sorted(k.__name__ for k in kinds)} — shards of one "
+                f"variable share a single gradient wire under SPMD, so "
+                f"heterogeneous synchronizer kinds have no rendering"
+            )
+
+        def uniform(field_name: str):
+            vals = {getattr(p.synchronizer, field_name) for p in parts}
+            if len(vals) > 1:
+                raise ValueError(
+                    f"{node.var_name!r}: per-shard {field_name} differs "
+                    f"across shards ({sorted(map(str, vals))}) — one "
+                    f"variable has one gradient wire under SPMD, so "
+                    f"per-shard {field_name} must be uniform"
+                )
+            return vals.pop()
+
+        if isinstance(node.synchronizer, PSSynchronizer):
+            if not uniform("sync"):
+                from autodist_tpu.strategy.base import check_sync_supported
+
+                check_sync_supported(False)
+            folded["staleness"] = uniform("staleness")
+            folded["proxy"] = uniform("local_replication")
+            folded["shard_destinations"] = tuple(
+                p.synchronizer.reduction_destination for p in parts
+            )
+        else:
+            folded["compressor"] = uniform("compressor")
+        return folded
+
     def _lower_node(self, node: NodeConfig, var: VarItem) -> VarPlan:
         sync = node.synchronizer
         shard_ax = self._shard_axis_name()
         rank = len(var.shape)
+        folded = self._fold_part_config(node)
 
         if isinstance(sync, AllReduceSynchronizer):
             kind = SyncKind.ALL_REDUCE
-            compressor, group = sync.compressor, sync.group
+            compressor, group = folded.get("compressor", sync.compressor), sync.group
             staleness, dest, proxy = 0, "", False
         else:
             assert isinstance(sync, PSSynchronizer)
@@ -199,8 +305,9 @@ class GraphTransformer:
                 check_sync_supported(False)
             kind = SyncKind.PS
             compressor, group = "NoneCompressor", 0
-            staleness = sync.staleness
-            dest, proxy = sync.reduction_destination, sync.local_replication
+            staleness = folded.get("staleness", sync.staleness)
+            dest = sync.reduction_destination
+            proxy = folded.get("proxy", sync.local_replication)
 
         mesh_shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
         n_shard = mesh_shape[shard_ax]
@@ -305,6 +412,25 @@ class GraphTransformer:
             pspec = P()
             update_pspec = P()
 
+        shard_dests = folded.get("shard_destinations", ())
+        # Reference parity: PS destinations are host CPUs; offload is opt-in
+        # (True = every PS var) because HBM residency is usually faster on
+        # TPU, or destination-driven ("from_strategy" = follow the strategy's
+        # placement: offload exactly the vars whose reduction destination
+        # names a CPU device).
+        if kind is SyncKind.PS and self.host_offload:
+            if self.host_offload == "from_strategy":
+                # Shard destinations are the more specific contract: when
+                # the table exists it decides placement (the node-level
+                # destination may be stale relative to it, and the cost
+                # model prices the shard table too); empty shard entries
+                # fall back to the node-level destination.
+                dests = [d or dest for d in shard_dests] if shard_dests else [dest]
+                offload = any(_is_cpu_device(d) for d in dests if d)
+            else:
+                offload = True
+        else:
+            offload = False
         return VarPlan(
             var=var,
             kind=kind,
@@ -316,9 +442,8 @@ class GraphTransformer:
             reduction_destination=dest,
             local_replication=proxy,
             num_shards=node.num_shards,
-            # Reference parity: PS destinations are host CPUs; offload is
-            # opt-in because HBM residency is usually faster on TPU.
-            offload=self.host_offload and kind is SyncKind.PS,
+            offload=offload,
+            shard_destinations=shard_dests,
             storage_shape=storage_shape,
         )
 
@@ -655,6 +780,9 @@ class ShardingPlan:
             lines.append(
                 f"  {name}: {p.kind.value} param={p.pspec} update={p.update_pspec}"
                 + (f" dest={p.reduction_destination}" if p.reduction_destination else "")
+                + (f" shard_dests={list(p.shard_destinations)}"
+                   if p.shard_destinations else "")
+                + (" offload=pinned_host" if p.offload else "")
             )
         return "\n".join(lines)
 

@@ -573,11 +573,23 @@ class CostModel:
             extra = self.slot_factor * res / upd_shards + res
         # Multi-node PS: the destination host's NIC serializes this var's
         # cross-host traffic (reference: all workers push to one PS CPU).
+        # A partitioned var's shards may reduce at different hosts
+        # (PartitionedPS bin-packing, strategy.proto:46-50): each shard
+        # destination carries its 1/num_shards slice of the wire, so a
+        # well-spread shard table genuinely relieves the per-host NIC term.
         if self.m > 1:
-            dest = sync.reduction_destination or "chief"
-            host = dest.split(":", 1)[0]
             wire_dcn = (B * self.sparse_touch) if var.sparse_update else B
-            ps_loads[host] = 2.0 * (self.m - 1) * wire_dcn / self.bw_dcn
+            load = 2.0 * (self.m - 1) * wire_dcn / self.bw_dcn
+            node_dest = sync.reduction_destination or "chief"
+            shard_dests = [
+                p.synchronizer.reduction_destination or node_dest
+                for p in node.part_config
+                if isinstance(p.synchronizer, PSSynchronizer)
+            ]
+            dests = shard_dests or [node_dest]
+            for d in dests:
+                host = d.split(":", 1)[0]
+                ps_loads[host] = ps_loads.get(host, 0.0) + load / len(dests)
         act = 0.0
         n_coll = 2  # push + pull round
         return comm, update, act, params, extra, n_coll, ps_loads

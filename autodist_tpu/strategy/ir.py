@@ -39,7 +39,19 @@ from autodist_tpu.utils import logging
 # --------------------------------------------------------------------------- #
 @dataclass(frozen=True)
 class PSSynchronizer:
-    """Centralized-reduction sync config (synchronizers.proto:25-30)."""
+    """Centralized-reduction sync config (synchronizers.proto:25-30).
+
+    ``reduction_destination`` semantics on TPU: the destination's *identity*
+    (which host) collapses at lowering — PS updates shard uniformly over the
+    mesh (ZeRO-style), which load-balances strictly better than any per-host
+    bin-packing, so PS / PSLoadBalancing / per-destination packing produce
+    the same shardings (documented in docs/parity.md). The destination still
+    has two real consumers: the cost model prices reduction traffic per
+    destination (cost_model.py), and its *device type* drives placement
+    under ``host_offload="from_strategy"`` — a CPU destination parks that
+    variable in pinned host memory, the reference's literal placement
+    (ps_strategy.py:38-55).
+    """
 
     reduction_destination: str = ""  # DeviceSpec string, e.g. "10.0.0.1:CPU:0"
     local_replication: bool = False  # proxy-variable analog: keep a device-local cached copy
@@ -108,7 +120,12 @@ class NodeConfig:
 
     ``partitioner`` of ``"1,4,1"`` means: shard axis 1 four ways. When set,
     ``part_config`` may carry one NodeConfig per shard (the reference's
-    per-part sync choice, strategy.proto:46-50).
+    per-part sync choice, strategy.proto:46-50). Lowering folds the shard
+    configs into the single-wire SPMD plan (GraphTransformer._fold_part_config):
+    uniform per-shard settings override the node-level ones, heterogeneous
+    synchronizer kinds / compressors / staleness across shards raise (no
+    SPMD rendering), and per-shard PS destinations become the plan's
+    ``shard_destinations`` table.
     """
 
     var_name: str

@@ -44,16 +44,25 @@ def _window(body, carry_init, n):
     return lax.scan(step, carry_init, None, length=n)[0]
 
 
-def bench_pattern(name, make_args, body, moved_bytes, repeats=REPEATS):
-    args = jax.device_put(make_args())
-    jax.block_until_ready(args)
-    fn = jax.jit(lambda a: _window(body, a, repeats))
-    out = fn(args)                      # compile + warmup
+def bench_pattern(name, make_const, make_carry, body, moved_bytes,
+                  repeats=REPEATS):
+    """Time ``repeats`` iterations of ``body(const, carry) -> carry``.
+
+    ``const`` is a scan-invariant operand (may be ``()``): it lets a pattern
+    read a large tensor each iteration while writing only a tiny carry back.
+    The body must still *depend* on the carry, else XLA hoists the read out
+    of the loop.
+    """
+    const = jax.device_put(make_const())
+    args = jax.device_put(make_carry())
+    jax.block_until_ready((const, args))
+    fn = jax.jit(lambda c, a: _window(lambda s: body(c, s), a, repeats))
+    out = fn(const, args)               # compile + warmup
     jax.block_until_ready(out)
     trials = []
     for _ in range(3):
         t0 = time.perf_counter()
-        out = fn(args)
+        out = fn(const, args)
         jax.block_until_ready(jax.tree.leaves(out)[0])
         trials.append(time.perf_counter() - t0)
     dt = sorted(trials)[1] / repeats
@@ -75,24 +84,34 @@ def main() -> None:
             return jnp.ones(shape, DTYPE)
 
         rows.append(bench_pattern(
-            f"copy_{mb}mb", mk, lambda x: x + jnp.asarray(1, x.dtype),
+            f"copy_{mb}mb", tuple, mk,
+            lambda _, x: x + jnp.asarray(1, x.dtype),
             moved_bytes=2 * n * bpe))
+        # Read N, write ~0 (the BN-stats access pattern): x is scan-invariant,
+        # the carry is the [1,128] fp32 stats row. Mixing the carry into the
+        # summand (tiny but nonzero scale) forces a fresh full read each
+        # iteration. Runs in f32 end-to-end: a bf16 input needs an f32
+        # convert for the accumulation, and XLA hoists that loop-invariant
+        # convert OUT of the scan (confirmed in HLO), silently streaming a
+        # materialized f32 copy while the row prices bf16 bytes — same-dtype
+        # f32 leaves nothing to hoist, so moved_bytes is exact. The pattern
+        # (not the element width) is what's being isolated; copy/add3 cover
+        # the bf16 streaming rate.
+        n32 = mb * 1_000_000 // 4
+        shape32 = (n32 // 128, 128)
         rows.append(bench_pattern(
-            f"reduce_{mb}mb", mk,
-            # Carry shape must match the input: keep x as carry and mix a
-            # *tiny but nonzero* multiple of the fp32 row-reduction back in
-            # (a zero multiple would let XLA fold the whole body away).
-            lambda x: x + (x.astype(jnp.float32).sum(0, keepdims=True)
-                           * 1e-30).astype(x.dtype),
-            moved_bytes=2 * n * bpe))
+            f"reduce_{mb}mb", lambda s=shape32: jnp.ones(s, jnp.float32),
+            lambda: jnp.zeros((1, 128), jnp.float32),
+            lambda x, s: (x + s * 1e-30).sum(0, keepdims=True),
+            moved_bytes=n32 * 4))
 
         def mk3(shape=shape):
             return (jnp.ones(shape, DTYPE), jnp.ones(shape, DTYPE),
                     jnp.ones(shape, DTYPE))
 
         rows.append(bench_pattern(
-            f"add3_{mb}mb", mk3,
-            lambda abc: (abc[0] + abc[1] + abc[2], abc[1], abc[2]),
+            f"add3_{mb}mb", tuple, mk3,
+            lambda _, abc: (abc[0] + abc[1] + abc[2], abc[1], abc[2]),
             moved_bytes=4 * n * bpe))
 
     for r in rows:

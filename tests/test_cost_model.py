@@ -523,3 +523,44 @@ def test_slate_includes_tensor_parallel_and_it_ranks_on_model_mesh():
     assert tp.feasible
     # TP's residency is sharded: well below the replicated AllReduce row.
     assert tp.per_chip_bytes < by_name["AllReduce"].per_chip_bytes
+
+
+def test_shard_destinations_spread_ps_nic_load():
+    """Per-shard destinations (strategy.proto:46-50) split a partitioned
+    var's PS wire across their hosts; a single node-level destination
+    carries it all (the reference's per-host NIC serialization model)."""
+    from autodist_tpu.strategy.ir import NodeConfig, PSSynchronizer
+
+    item = _item({"w": (256, 64)})
+    spec = ResourceSpec(resource_dict={
+        "nodes": [{"address": "10.0.0.1", "chips": 4, "chief": True},
+                  {"address": "10.0.0.2", "chips": 4}],
+    })
+    cm = CostModel(item, spec)
+    var = item.var("w")
+
+    def node(shard_dests):
+        n = NodeConfig(
+            "w", PSSynchronizer(reduction_destination="10.0.0.1:CPU:0"),
+            partitioner="2,1")
+        if shard_dests:
+            n.part_config = [
+                NodeConfig(f"w/part_{i}",
+                           PSSynchronizer(reduction_destination=d))
+                for i, d in enumerate(shard_dests)
+            ]
+        return n
+
+    *_, loads_single = cm._node_cost(node([]), var)
+    *_, loads_spread = cm._node_cost(
+        node(["10.0.0.1:CPU:0", "10.0.0.2:CPU:0"]), var)
+    *_, loads_packed = cm._node_cost(
+        node(["10.0.0.1:CPU:0", "10.0.0.1:CPU:0"]), var)
+
+    total = loads_single["10.0.0.1"]
+    assert total > 0
+    # Spread shards: each host carries half the wire.
+    assert loads_spread["10.0.0.1"] == pytest.approx(total / 2)
+    assert loads_spread["10.0.0.2"] == pytest.approx(total / 2)
+    # Both shards on one host re-accumulate to the full load there.
+    assert loads_packed["10.0.0.1"] == pytest.approx(total)

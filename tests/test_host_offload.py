@@ -103,3 +103,72 @@ def test_offloaded_matches_resident_on_tpu():
     w_d = np.asarray(jax.device_get(state_d.params["w"]))
     np.testing.assert_allclose(w_h, w_d, rtol=1e-6, atol=1e-7)
     np.testing.assert_allclose(float(m_h["loss"]), float(m_d["loss"]), rtol=1e-6)
+
+
+# --------------------------------------------------------------------------- #
+# Destination-driven offload (host_offload="from_strategy"; VERDICT r3 #4b)
+# --------------------------------------------------------------------------- #
+def test_from_strategy_offload_follows_cpu_destinations(monkeypatch):
+    """PSLoadBalancing emits host-CPU reduction destinations (reference
+    parity), so "from_strategy" offloads exactly those vars."""
+    monkeypatch.setattr(lowering, "_memory_kinds_supported", lambda mesh: True)
+    plan, params, batch = make_plan(S.PSLoadBalancing(),
+                                    host_offload="from_strategy")
+    assert plan.has_offload
+    assert all(p.offload for p in plan.var_plans.values())
+
+
+def test_from_strategy_keeps_non_cpu_destinations_in_hbm(monkeypatch):
+    from autodist_tpu.strategy.ir import NodeConfig, PSSynchronizer, Strategy
+
+    monkeypatch.setattr(lowering, "_memory_kinds_supported", lambda mesh: True)
+    params, batch = problem()
+    item = ModelItem.from_params(params)
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    nodes = [
+        NodeConfig("w", PSSynchronizer(reduction_destination="localhost:TPU:0")),
+        NodeConfig("b", PSSynchronizer(reduction_destination="localhost:CPU:0")),
+    ]
+    plan = GraphTransformer(
+        Strategy(node_config=nodes), item, mesh, host_offload="from_strategy"
+    ).transform()
+    assert not plan.plan_for("w").offload   # TPU destination: stays in HBM
+    assert plan.plan_for("b").offload       # CPU destination: pinned host
+
+
+def test_invalid_offload_mode_rejected():
+    params, _ = problem()
+    item = ModelItem.from_params(params)
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    from autodist_tpu.strategy.ir import Strategy
+    with pytest.raises(ValueError, match="host_offload"):
+        GraphTransformer(Strategy(), item, mesh, host_offload="always")
+
+
+def test_from_strategy_shard_table_overrides_node_destination(monkeypatch):
+    """Shard destinations are the more specific contract: a stale node-level
+    CPU destination must not offload a var whose shards all reduce on TPU."""
+    from autodist_tpu.strategy.ir import NodeConfig, PSSynchronizer, Strategy
+
+    monkeypatch.setattr(lowering, "_memory_kinds_supported", lambda mesh: True)
+    params, _ = problem()
+    item = ModelItem.from_params(params)
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    nodes = [
+        NodeConfig(
+            "w",
+            PSSynchronizer(reduction_destination="h:CPU:0"),
+            partitioner="2,1",
+            part_config=[
+                NodeConfig(f"w/part_{i}",
+                           PSSynchronizer(reduction_destination="h:TPU:0"))
+                for i in range(2)
+            ],
+        ),
+        NodeConfig("b", PSSynchronizer(reduction_destination="h:CPU:0")),
+    ]
+    plan = GraphTransformer(
+        Strategy(node_config=nodes), item, mesh, host_offload="from_strategy"
+    ).transform()
+    assert not plan.plan_for("w").offload  # shard table (TPU) wins
+    assert plan.plan_for("b").offload      # node-level CPU dest still honored

@@ -1044,3 +1044,144 @@ def test_plain_accum_tolerates_broadcast_leaves():
     np.testing.assert_allclose(
         np.asarray(jax.device_get(new_state.params["w"])),
         np.asarray(expected["w"]), rtol=2e-5, atol=2e-6)
+
+
+# --------------------------------------------------------------------------- #
+# part_config folding (reference strategy.proto:46-50; VERDICT r3 missing #2)
+# --------------------------------------------------------------------------- #
+from autodist_tpu.strategy.base import part_name
+from autodist_tpu.strategy.ir import (
+    AllReduceSynchronizer,
+    NodeConfig,
+    PSSynchronizer,
+    Strategy,
+)
+
+
+def _one_var_model():
+    return ModelItem(
+        [VarItem("w", (16, 8), "float32")],
+        optimizer_spec=OptimizerSpec("sgd", {"learning_rate": 0.1}),
+    )
+
+
+def _lower_node(node, rs):
+    mesh = build_mesh(rs)
+    return GraphTransformer(Strategy(node_config=[node]), _one_var_model(), mesh)
+
+
+def test_part_config_uniform_compressor_overrides_node(rs):
+    # Shard configs are the more specific contract: a uniform per-shard
+    # compressor wins over the node-level default.
+    node = NodeConfig(
+        "w",
+        AllReduceSynchronizer(),
+        partitioner="2,1",
+        part_config=[
+            NodeConfig(part_name("w", i),
+                       AllReduceSynchronizer(compressor="HorovodCompressor"))
+            for i in range(2)
+        ],
+    )
+    plan = _lower_node(node, rs).transform()
+    assert plan.plan_for("w").compressor == "HorovodCompressor"
+
+
+def test_part_config_mixed_compressors_raise(rs):
+    node = NodeConfig(
+        "w",
+        AllReduceSynchronizer(),
+        partitioner="2,1",
+        part_config=[
+            NodeConfig(part_name("w", 0),
+                       AllReduceSynchronizer(compressor="HorovodCompressor")),
+            NodeConfig(part_name("w", 1),
+                       AllReduceSynchronizer(compressor="NoneCompressor")),
+        ],
+    )
+    with pytest.raises(ValueError, match="compressor"):
+        _lower_node(node, rs).transform()
+
+
+def test_part_config_mixed_synchronizer_kinds_raise(rs):
+    node = NodeConfig(
+        "w",
+        PSSynchronizer(reduction_destination="localhost:CPU:0"),
+        partitioner="2,1",
+        part_config=[
+            NodeConfig(part_name("w", 0), PSSynchronizer()),
+            NodeConfig(part_name("w", 1), AllReduceSynchronizer()),
+        ],
+    )
+    with pytest.raises(ValueError, match="synchronizer"):
+        _lower_node(node, rs).transform()
+
+
+def test_part_config_async_shard_rejected(rs):
+    node = NodeConfig(
+        "w",
+        PSSynchronizer(),
+        partitioner="2,1",
+        part_config=[
+            NodeConfig(part_name("w", i), PSSynchronizer(sync=False))
+            for i in range(2)
+        ],
+    )
+    with pytest.raises(NotImplementedError, match="sync=False"):
+        _lower_node(node, rs).transform()
+
+
+def test_part_config_staleness_and_destinations_fold_into_plan(rs):
+    node = NodeConfig(
+        "w",
+        PSSynchronizer(reduction_destination="host0:CPU:0"),
+        partitioner="2,1",
+        part_config=[
+            NodeConfig(part_name("w", i),
+                       PSSynchronizer(reduction_destination=f"host{i}:CPU:0",
+                                      staleness=2))
+            for i in range(2)
+        ],
+    )
+    plan = _lower_node(node, rs).transform()
+    p = plan.plan_for("w")
+    assert p.staleness == 2  # uniform shard staleness overrides node-level 0
+    assert p.shard_destinations == ("host0:CPU:0", "host1:CPU:0")
+
+
+def test_part_config_mixed_staleness_raises(rs):
+    node = NodeConfig(
+        "w",
+        PSSynchronizer(),
+        partitioner="2,1",
+        part_config=[
+            NodeConfig(part_name("w", 0), PSSynchronizer(staleness=1)),
+            NodeConfig(part_name("w", 1), PSSynchronizer(staleness=3)),
+        ],
+    )
+    with pytest.raises(ValueError, match="staleness"):
+        _lower_node(node, rs).transform()
+
+
+def test_partitioned_ps_builder_destinations_reach_the_plan(model, rs):
+    # The real PartitionedPS load balancer emits per-shard destinations
+    # (partitioned_ps_strategy.py); the lowered plan must record them.
+    plan = make_plan(PartitionedPS(), model, rs)
+    kernel = plan.plan_for("dense/kernel")
+    assert kernel.num_shards == 2  # min divisor of 16
+    assert len(kernel.shard_destinations) == 2
+    assert all(":CPU:" in d for d in kernel.shard_destinations)
+
+
+def test_part_config_count_mismatch_raises_at_lowering(rs):
+    # GraphTransformer also lowers hand-built strategies that never passed
+    # through StrategyCompiler; a mismatched table must fail loudly.
+    node = NodeConfig(
+        "w",
+        PSSynchronizer(),
+        partitioner="2,1",
+        part_config=[NodeConfig(part_name("w", i), PSSynchronizer())
+                     for i in range(3)],
+    )
+    with pytest.raises(ValueError, match="part configs"):
+        _lower_node(node, rs).transform()
