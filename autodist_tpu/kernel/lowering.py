@@ -281,7 +281,15 @@ class GraphTransformer:
                 p.synchronizer.reduction_destination for p in parts
             )
         else:
-            folded["compressor"] = uniform("compressor")
+            # The schema has no "unset" sentinel for compressor, so a shard
+            # table left at the default is indistinguishable from one that
+            # explicitly chose NoneCompressor; treat default-valued parts as
+            # deferring to the node-level choice (overriding would silently
+            # strip an explicitly configured node-level compressor). A
+            # non-default uniform part compressor wins as usual.
+            part_comp = uniform("compressor")
+            if part_comp != "NoneCompressor":
+                folded["compressor"] = part_comp
         return folded
 
     def _lower_node(self, node: NodeConfig, var: VarItem) -> VarPlan:

@@ -28,6 +28,7 @@ def build_mesh(
     resource_spec: Optional[ResourceSpec] = None,
     axes: Sequence[str] = DEFAULT_AXES,
     devices=None,
+    slice_of=None,
 ) -> Mesh:
     """Build the logical mesh the strategy lowers onto.
 
@@ -35,9 +36,17 @@ def build_mesh(
     all-chips-on-data default); the concrete devices come from the local JAX
     runtime. The spec's chip count must match the visible device count —
     the analog of the reference's cluster_spec/worker agreement.
+
+    ``slice_of`` maps a device to its slice/ICI-domain id (None = single
+    domain). Defaults to the runtime's ``slice_index`` attribute; tests and
+    the driver dryrun inject a fake assignment to exercise the multi-slice
+    hybrid layout on the host-platform mesh.
     """
     if devices is None:
         devices = jax.devices()
+    injected_slices = slice_of is not None
+    if slice_of is None:
+        slice_of = lambda d: getattr(d, "slice_index", None)  # noqa: E731
     if resource_spec is None:
         shape: Dict[str, int] = {ax: 1 for ax in axes}
         shape[list(axes)[0]] = len(devices)
@@ -51,25 +60,23 @@ def build_mesh(
         )
     axis_names = tuple(shape.keys())
     dims = [shape[ax] for ax in axis_names]
-    if devices and devices[0].platform == "tpu":
-        from jax.experimental import mesh_utils
 
-        slice_ids = {getattr(d, "slice_index", None) for d in devices}
-        slice_ids.discard(None)
-        n_slices = max(len(slice_ids), 1)
+    slice_ids = {slice_of(d) for d in devices}
+    slice_ids.discard(None)
+    n_slices = max(len(slice_ids), 1)
+    if n_slices > 1:
         # The DCN-crossing axis is the DATA axis *by role*, not positionally:
         # a mesh override may list axes in any order. Resolved only when
         # multi-slice placement needs it — a role-only mesh (no batch-capable
         # axis) must still build on a single slice.
         data_ix = None
-        if n_slices > 1:
-            try:
-                data_ix = axis_names.index(_data_axis_name(axis_names, shape))
-            except ValueError:
-                logging.warning(
-                    "multi-slice runtime (%d slices) but the mesh has no "
-                    "data-capable axis — collectives may cross DCN", n_slices,
-                )
+        try:
+            data_ix = axis_names.index(_data_axis_name(axis_names, shape))
+        except ValueError:
+            logging.warning(
+                "multi-slice runtime (%d slices) but the mesh has no "
+                "data-capable axis — collectives may cross DCN", n_slices,
+            )
         if data_ix is not None and dims[data_ix] % n_slices == 0:
             # Multi-slice pod: only the DATA axis crosses DCN — its
             # gradient all-reduce tolerates the slower hops via
@@ -78,17 +85,16 @@ def build_mesh(
             # ride ICI (the scaling-book layout; the reference's analog
             # was `network_bandwidth` steering PS placement).
             try:
-                dcn = [1] * len(dims)
-                dcn[data_ix] = n_slices
-                ici = list(dims)
-                ici[data_ix] = dims[data_ix] // n_slices
-                mesh_devices = mesh_utils.create_hybrid_device_mesh(
-                    ici, dcn, devices=devices
+                return Mesh(
+                    _hybrid_arrangement(
+                        devices, dims, data_ix, n_slices, slice_of,
+                        honor_slice_of=injected_slices,
+                    ),
+                    axis_names,
                 )
-                return Mesh(mesh_devices, axis_names)
             except Exception as e:  # noqa: BLE001 - ICI-aware path still next
                 logging.warning(
-                    "create_hybrid_device_mesh failed (%s); falling back to "
+                    "hybrid mesh arrangement failed (%s); falling back to "
                     "create_device_mesh", e,
                 )
         elif data_ix is not None:
@@ -97,12 +103,55 @@ def build_mesh(
                 "not divide by the slice count — model-axis collectives "
                 "may cross DCN", n_slices, dims[data_ix],
             )
+    if devices and devices[0].platform == "tpu":
+        from jax.experimental import mesh_utils
+
         try:
             mesh_devices = mesh_utils.create_device_mesh(dims, devices=devices)
             return Mesh(mesh_devices, axis_names)
         except Exception as e:  # noqa: BLE001 - fall back to naive order
             logging.warning("create_device_mesh failed (%s); using naive order", e)
     return Mesh(np.asarray(devices).reshape(dims), axis_names)
+
+
+def _hybrid_arrangement(devices, dims, data_ix: int, n_slices: int, slice_of,
+                        honor_slice_of: bool = False):
+    """Device array for a multi-slice mesh: DCN-major along the data axis.
+
+    The data axis splits into ``n_slices`` contiguous DCN blocks, each filled
+    by exactly one slice's devices, so fixing a data coordinate pins a slice
+    (model/seq/expert fibers never leave their ICI domain) and the gradient
+    all-reduce decomposes into in-slice reduce-scatter + cross-slice
+    exchange + in-slice all-gather (XLA does this given the layout). On TPU
+    with the runtime's own slice notion the arrangement delegates to
+    ``mesh_utils.create_hybrid_device_mesh`` (physical-topology-aware within
+    each slice); with a caller-injected ``slice_of`` (``honor_slice_of``) or
+    off-TPU, each slice block is ordered by a plain reshape — the injected
+    assignment is the contract, so it must not be silently re-derived from
+    hardware attributes that may disagree.
+    """
+    groups: Dict[object, list] = {}
+    for d in devices:
+        groups.setdefault(slice_of(d), []).append(d)
+    sizes = {len(g) for g in groups.values()}
+    if len(sizes) != 1:
+        raise ValueError(
+            f"uneven slices: {sorted((k, len(v)) for k, v in groups.items())}"
+        )
+    if devices[0].platform == "tpu" and not honor_slice_of:
+        from jax.experimental import mesh_utils
+
+        dcn = [1] * len(dims)
+        dcn[data_ix] = n_slices
+        ici = list(dims)
+        ici[data_ix] = dims[data_ix] // n_slices
+        return mesh_utils.create_hybrid_device_mesh(ici, dcn, devices=devices)
+    per_slice = list(dims)
+    per_slice[data_ix] //= n_slices
+    blocks = [
+        np.asarray(groups[sid]).reshape(per_slice) for sid in sorted(groups)
+    ]
+    return np.concatenate(blocks, axis=data_ix)
 
 
 def _data_axis_name(names: Sequence[str], sizes: Dict[str, int]) -> str:

@@ -1185,3 +1185,17 @@ def test_part_config_count_mismatch_raises_at_lowering(rs):
     )
     with pytest.raises(ValueError, match="part configs"):
         _lower_node(node, rs).transform()
+
+
+def test_part_config_default_compressor_defers_to_node(rs):
+    # No "unset" sentinel exists in the schema: a shard table left at the
+    # default must not strip an explicitly configured node-level compressor.
+    node = NodeConfig(
+        "w",
+        AllReduceSynchronizer(compressor="PowerSGDCompressor"),
+        partitioner="2,1",
+        part_config=[NodeConfig(part_name("w", i), AllReduceSynchronizer())
+                     for i in range(2)],
+    )
+    plan = _lower_node(node, rs).transform()
+    assert plan.plan_for("w").compressor == "PowerSGDCompressor"

@@ -1,0 +1,94 @@
+"""Multi-slice hybrid mesh layout (reference capability: per-node
+``network_bandwidth`` steering, resource_spec.py:209-215; here the
+scaling-book layout: only the data axis crosses DCN).
+
+Real multi-slice hardware is not available in CI, so the slice assignment is
+injected via ``build_mesh(slice_of=...)`` — the same hook the driver dryrun
+uses — and the layout contract is asserted structurally.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu.kernel import DistributedTrainStep, GraphTransformer, build_mesh
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import AllReduce, StrategyCompiler
+
+
+def _two_node_spec(mesh=None):
+    d = {"nodes": [{"address": "10.0.0.1", "chips": 4, "chief": True},
+                   {"address": "10.0.0.2", "chips": 4}]}
+    if mesh:
+        d["mesh"] = mesh
+    return ResourceSpec(resource_dict=d)
+
+
+def _slice_by_id(n_per_slice):
+    return lambda d: d.id // n_per_slice
+
+
+def _slice_lookup(n_per_slice):
+    ids = {d.id: d.id // n_per_slice for d in jax.devices()}
+    return lambda d: ids[d.id]
+
+
+def test_hybrid_layout_data_axis_is_dcn_major():
+    # 2 fake slices of 4 over the 8-device host mesh, {"data": 4, "model": 2}:
+    # fixing a data coordinate must pin a slice (model fibers stay on ICI),
+    # and the data axis must walk slice blocks contiguously (DCN-major).
+    rs = _two_node_spec(mesh={"data": 4, "model": 2})
+    mesh = build_mesh(rs, slice_of=_slice_by_id(4))
+    assert mesh.devices.shape == (4, 2)
+    for d in range(4):
+        slices = {dev.id // 4 for dev in mesh.devices[d, :]}
+        assert len(slices) == 1, f"model fiber at data={d} crosses slices"
+        assert slices.pop() == d // 2  # contiguous DCN blocks along data
+    # Each slice contributes exactly its own devices.
+    assert {dev.id for dev in mesh.devices[:2, :].flat} == set(range(4))
+    assert {dev.id for dev in mesh.devices[2:, :].flat} == set(range(4, 8))
+
+
+def test_hybrid_layout_finds_data_axis_by_role_not_position():
+    # Axis order reversed: the DCN split must still land on "data".
+    rs = _two_node_spec(mesh={"model": 2, "data": 4})
+    mesh = build_mesh(rs, axes=("model", "data"), slice_of=_slice_by_id(4))
+    assert mesh.devices.shape == (2, 4)
+    for d in range(4):
+        slices = {dev.id // 4 for dev in mesh.devices[:, d]}
+        assert len(slices) == 1
+        assert slices.pop() == d // 2
+
+
+def test_uneven_slices_fall_back_to_flat_mesh():
+    # 3 "slices" of 3/3/2 devices: the hybrid arrangement must refuse
+    # (uneven ICI domains) and the mesh still builds flat.
+    rs = _two_node_spec(mesh={"data": 8, "model": 1})
+    mesh = build_mesh(rs, slice_of=lambda d: d.id // 3)
+    assert mesh.devices.shape == (8, 1)
+
+
+def test_training_step_runs_on_hybrid_mesh():
+    # End-to-end: lower an AllReduce strategy over the hybrid 2-slice mesh
+    # and take a real step — the layout must be a valid Mesh for pjit.
+    rng = np.random.default_rng(0)
+    params = {"w": rng.standard_normal((8, 4)).astype(np.float32)}
+    batch = {"x": rng.standard_normal((16, 8)).astype(np.float32),
+             "y": rng.standard_normal((16, 4)).astype(np.float32)}
+
+    def loss_fn(params, batch):
+        return ((batch["x"] @ params["w"] - batch["y"]) ** 2).mean()
+
+    rs = _two_node_spec(mesh={"data": 4, "model": 2})
+    mesh = build_mesh(rs, slice_of=_slice_lookup(4))
+    item = ModelItem.from_params(params)
+    strategy = StrategyCompiler(item).compile(AllReduce().build(item, rs))
+    plan = GraphTransformer(strategy, item, mesh).transform()
+    step = DistributedTrainStep(plan, loss_fn, optax.sgd(0.1))
+    state = step.init(params)
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    state, m2 = step(state, batch)
+    assert float(m2["loss"]) < float(metrics["loss"])
