@@ -1,4 +1,5 @@
-"""Data pipeline: native prefetching loader + sharded feed helpers."""
+"""Data pipeline: native prefetching loader + sharded on-disk datasets."""
 from autodist_tpu.data.loader import DataLoader
+from autodist_tpu.data.files import DatasetWriter, load_dataset, write_dataset
 
-__all__ = ["DataLoader"]
+__all__ = ["DataLoader", "DatasetWriter", "load_dataset", "write_dataset"]

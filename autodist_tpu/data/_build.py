@@ -72,6 +72,11 @@ def load_library() -> Optional[ctypes.CDLL]:
     # c_void_p, NOT c_char_p: char_p elements auto-convert to NUL-terminated
     # bytes and would corrupt binary rows.
     lib.ad_loader_set_source.argtypes = [ptr, i32, ctypes.c_void_p, u64]
+    lib.ad_loader_set_source_shards.restype = i32
+    lib.ad_loader_set_source_shards.argtypes = [
+        ptr, i32, ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(u64),
+        i32, u64,
+    ]
     lib.ad_loader_start.restype = i32
     lib.ad_loader_start.argtypes = [ptr]
     lib.ad_loader_next.restype = i64

@@ -1,4 +1,4 @@
-"""DataLoader: prefetched, shuffled batches from in-memory feature arrays.
+"""DataLoader: prefetched, shuffled batches from in-memory or on-disk rows.
 
 Facade over two engines with identical semantics:
 
@@ -10,8 +10,15 @@ Facade over two engines with identical semantics:
   shuffle is splitmix64-based in both), used as fallback and as the test
   oracle for the native engine.
 
+Each feature may be a single array or a list of row-shard arrays; sharded
+``np.memmap`` features (``DataLoader.from_files`` / ``files.load_dataset``)
+stream larger-than-RAM datasets straight from the page cache — the native
+engine gathers rows from the mapped shards with no Python on the hot path
+(the reference's C++ TFRecord input pipelines,
+``examples/benchmark/utils/input_pipeline.py``, played this role).
+
 Batch order is deterministic given (seed, batch_size, drop_remainder)
-regardless of engine or thread count.
+regardless of engine, thread count, or shard layout.
 
 Optionally binds a :class:`~autodist_tpu.kernel.lowering.ShardingPlan` so
 every yielded batch is already ``device_put`` along the mesh data axis (the
@@ -20,7 +27,7 @@ remapper's feed-splitting contract, reference remapper.py:81-123).
 from __future__ import annotations
 
 import ctypes
-from typing import Any, Dict, Iterator, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 
@@ -52,14 +59,19 @@ def _epoch_perm(n_rows: int, epoch: int, seed: int, shuffle: bool) -> np.ndarray
 class DataLoader:
     """Iterate dict-of-arrays data as prefetched batches.
 
-    ``data``: mapping name -> np.ndarray, all with equal leading dim.
-    ``epochs``: -1 repeats forever. ``plan``: optional ShardingPlan; when
-    given, batches come back as jax Arrays sharded along the data axis.
+    ``data``: mapping name -> np.ndarray (or list of row-shard arrays, e.g.
+    the mmap'd shards from ``files.load_dataset``), all with equal total
+    rows. ``epochs``: -1 repeats forever. ``plan``: optional ShardingPlan;
+    when given, batches come back as jax Arrays sharded along the data axis.
+    ``transform``: optional host-side ``f(batch, step) -> batch`` hook
+    applied to every gathered batch before device transfer — the
+    decode/augment stage (see ``data/imagenet.py``); must be deterministic
+    in ``(batch, step)`` for multi-host consistency.
     """
 
     def __init__(
         self,
-        data: Dict[str, np.ndarray],
+        data: Dict[str, Any],
         batch_size: int,
         shuffle: bool = True,
         seed: int = 0,
@@ -70,15 +82,54 @@ class DataLoader:
         engine: str = "auto",      # auto | native | python
         plan: Any = None,
         device_prefetch: int = 0,
+        transform: Optional[Callable[[Dict[str, np.ndarray], int], Dict[str, np.ndarray]]] = None,
     ):
         if not data:
             raise ValueError("data must have at least one feature array")
         self.names = sorted(data)
-        self.arrays = [np.ascontiguousarray(data[k]) for k in self.names]
-        n_rows = {a.shape[0] for a in self.arrays}
+        # Normalize every feature to a list of row shards. ascontiguousarray
+        # is a no-op view for already-contiguous inputs — crucially including
+        # np.memmap shards, which must NOT be copied into RAM here.
+        self.sources: List[List[np.ndarray]] = []
+        for k in self.names:
+            v = data[k]
+            # A list/tuple is a shard list ONLY when every element is
+            # already an ndarray — a nested python list like [[0, 1], [2, 3]]
+            # is one array-like (and must not be silently re-read as two
+            # scalar-row shards).
+            if (isinstance(v, (list, tuple)) and v
+                    and all(isinstance(s, np.ndarray) for s in v)):
+                shards = list(v)
+            else:
+                shards = [np.asarray(v)]
+            if not all(s.ndim >= 1 for s in shards):
+                raise ValueError(f"feature {k!r} shards must have a row dim")
+            # Preserve already-contiguous arrays as-is (ascontiguousarray
+            # would rewrap np.memmap shards as plain ndarray views; same
+            # mapped data, but keeping the memmap type makes "not copied"
+            # checkable).
+            shards = [
+                s if (isinstance(s, np.ndarray) and s.flags.c_contiguous)
+                else np.ascontiguousarray(s)
+                for s in shards
+            ]
+            tails = {(s.dtype, s.shape[1:]) for s in shards}
+            if len(tails) != 1:
+                raise ValueError(
+                    f"feature {k!r} shards disagree on dtype/row shape: {tails}")
+            self.sources.append(shards)
+        self.transform = transform
+        n_rows = {sum(s.shape[0] for s in shards) for shards in self.sources}
         if len(n_rows) != 1:
-            raise ValueError(f"feature arrays disagree on leading dim: {n_rows}")
+            raise ValueError(
+                f"feature arrays disagree on total rows (leading dims): {n_rows}")
         self.n_rows = n_rows.pop()
+        # Per-feature prefix-sum shard offsets (python-engine gather + native
+        # shard tables share this).
+        self._offsets = [
+            np.cumsum([0] + [s.shape[0] for s in shards])[:-1]
+            for shards in self.sources
+        ]
         if batch_size <= 0 or batch_size > self.n_rows:
             raise ValueError(
                 f"batch_size {batch_size} invalid for {self.n_rows} rows"
@@ -125,8 +176,17 @@ class DataLoader:
                 "multi-host DataLoader requires drop_remainder=True: a "
                 "ragged final batch cannot assemble into a global array")
 
+    def _with_transform(self, it) -> Iterator[Dict[str, np.ndarray]]:
+        if self.transform is None:
+            yield from it
+            return
+        for step, batch in enumerate(it):
+            yield self.transform(batch, step)
+
     def __iter__(self) -> Iterator[Dict[str, Any]]:
-        it = self._iter_native() if self.engine == "native" else self._iter_python()
+        it = self._with_transform(
+            self._iter_native() if self.engine == "native" else self._iter_python()
+        )
         if self.plan is None:
             return it
         self._check_multihost_remainder()
@@ -146,7 +206,21 @@ class DataLoader:
         loudly, not deep inside window assembly.
         """
         self._check_multihost_remainder()
-        return self._iter_native() if self.engine == "native" else self._iter_python()
+        return self._with_transform(
+            self._iter_native() if self.engine == "native" else self._iter_python()
+        )
+
+    @classmethod
+    def from_files(cls, data_dir: str, batch_size: int, **kwargs) -> "DataLoader":
+        """Open a ``files.write_dataset`` directory as a streaming loader.
+
+        Every shard arrives as an ``np.memmap`` view; rows are gathered
+        (by the native engine when available) straight from the page cache,
+        so the dataset may be far larger than RAM.
+        """
+        from autodist_tpu.data.files import load_dataset
+
+        return cls(load_dataset(data_dir), batch_size, **kwargs)
 
     def _iter_device_prefetch(self, it, depth: int):
         """Keep ``depth`` sharded batches in flight ahead of the consumer.
@@ -181,6 +255,19 @@ class DataLoader:
         return self.plan.global_batch_from_local(
             batch, broadcast={name: False for name in batch})
 
+    def _gather(self, i: int, idx: np.ndarray) -> np.ndarray:
+        """Gather global rows ``idx`` of feature ``i`` across its shards."""
+        shards = self.sources[i]
+        if len(shards) == 1:
+            return shards[0][idx]
+        offsets = self._offsets[i]
+        which = np.searchsorted(offsets, idx, side="right") - 1
+        out = np.empty((len(idx),) + shards[0].shape[1:], shards[0].dtype)
+        for s in np.unique(which):
+            m = which == s
+            out[m] = shards[s][idx[m] - offsets[s]]
+        return out
+
     def _iter_python(self):
         total = None if self.epochs < 0 else self.epochs
         epoch = 0
@@ -188,16 +275,17 @@ class DataLoader:
             perm = _epoch_perm(self.n_rows, epoch, self.seed, self.shuffle)
             for b in range(self.batches_per_epoch):
                 idx = perm[b * self.batch_size:(b + 1) * self.batch_size]
+                idx = idx.astype(np.int64)
                 yield {
-                    name: arr[idx.astype(np.int64)]
-                    for name, arr in zip(self.names, self.arrays)
+                    name: self._gather(i, idx)
+                    for i, name in enumerate(self.names)
                 }
             epoch += 1
 
     def _iter_native(self):
         lib = self._lib
         h = lib.ad_loader_create(
-            len(self.arrays), self.n_rows, self.batch_size, self.capacity,
+            len(self.sources), self.n_rows, self.batch_size, self.capacity,
             self.num_threads, int(self.shuffle), self.seed,
             int(self.drop_remainder), self.epochs,
         )
@@ -206,14 +294,32 @@ class DataLoader:
             yield from self._iter_python()
             return
         try:
-            for i, arr in enumerate(self.arrays):
-                row_bytes = arr.dtype.itemsize * int(np.prod(arr.shape[1:], dtype=np.int64))
-                lib.ad_loader_set_source(
-                    h, i, arr.ctypes.data_as(ctypes.c_void_p), row_bytes
-                )
+            for i, shards in enumerate(self.sources):
+                head = shards[0]
+                row_bytes = head.dtype.itemsize * int(
+                    np.prod(head.shape[1:], dtype=np.int64))
+                if len(shards) == 1:
+                    lib.ad_loader_set_source(
+                        h, i, head.ctypes.data_as(ctypes.c_void_p), row_bytes
+                    )
+                else:
+                    bases = (ctypes.c_void_p * len(shards))(
+                        *[s.ctypes.data_as(ctypes.c_void_p).value for s in shards]
+                    )
+                    srows = (ctypes.c_uint64 * len(shards))(
+                        *[s.shape[0] for s in shards]
+                    )
+                    rc = lib.ad_loader_set_source_shards(
+                        h, i, bases, srows, len(shards), row_bytes
+                    )
+                    if rc != 0:
+                        raise RuntimeError(
+                            f"native loader rejected shard table for "
+                            f"{self.names[i]!r}"
+                        )
             if lib.ad_loader_start(h) != 0:
                 raise RuntimeError("native loader failed to start")
-            ptrs = (ctypes.c_void_p * len(self.arrays))()
+            ptrs = (ctypes.c_void_p * len(self.sources))()
             rows = ctypes.c_uint64()
             while True:
                 slot = lib.ad_loader_next(h, ptrs, ctypes.byref(rows))
@@ -221,15 +327,16 @@ class DataLoader:
                     break
                 n = int(rows.value)
                 batch = {}
-                for i, (name, arr) in enumerate(zip(self.names, self.arrays)):
-                    shape = (n,) + arr.shape[1:]
-                    nbytes = arr.dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+                for i, name in enumerate(self.names):
+                    head = self.sources[i][0]
+                    shape = (n,) + head.shape[1:]
+                    nbytes = head.dtype.itemsize * int(np.prod(shape, dtype=np.int64))
                     # bytearray copy: (a) frees the slot for immediate refill,
                     # (b) yields a WRITEABLE array like the python engine's
                     # fancy-indexed copies (np.frombuffer over bytes would be
                     # read-only and break in-place batch mutation).
                     buf = bytearray(ctypes.string_at(ptrs[i], nbytes))
-                    batch[name] = np.frombuffer(buf, dtype=arr.dtype).reshape(shape)
+                    batch[name] = np.frombuffer(buf, dtype=head.dtype).reshape(shape)
                 lib.ad_loader_release(h, int(slot))
                 yield batch
         finally:

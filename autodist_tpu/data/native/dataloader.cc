@@ -18,6 +18,7 @@
 //
 // C ABI only (ctypes-friendly): create/set_source/start/next/release/destroy.
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -39,9 +40,23 @@ static inline uint64_t splitmix64(uint64_t& x) {
   return z ^ (z >> 31);
 }
 
+// A feature's storage: one or more row-major shards (mmap'd dataset files or
+// in-memory arrays — the gather path is agnostic). Global row r resolves to
+// (shard, local row) through the prefix-sum offset table; the single-shard
+// case short-circuits to plain pointer arithmetic.
 struct Source {
-  const uint8_t* data = nullptr;
+  std::vector<const uint8_t*> bases;
+  std::vector<uint64_t> offsets;  // offsets[k] = first global row of shard k
+  uint64_t total_rows = 0;
   uint64_t row_bytes = 0;
+
+  inline const uint8_t* row(uint64_t r) const {
+    if (bases.size() == 1) return bases[0] + r * row_bytes;
+    size_t k = static_cast<size_t>(
+        std::upper_bound(offsets.begin(), offsets.end(), r) -
+        offsets.begin() - 1);
+    return bases[k] + (r - offsets[k]) * row_bytes;
+  }
 };
 
 struct Slot {
@@ -73,12 +88,30 @@ class Loader {
   ~Loader() { Stop(); }
 
   void SetSource(int i, const uint8_t* data, uint64_t row_bytes) {
-    sources_[i].data = data;
-    sources_[i].row_bytes = row_bytes;
+    const uint8_t* bases[1] = {data};
+    uint64_t rows[1] = {n_rows_};
+    SetSourceShards(i, bases, rows, 1, row_bytes);
+  }
+
+  bool SetSourceShards(int i, const uint8_t** bases, const uint64_t* rows,
+                       int n_shards, uint64_t row_bytes) {
+    if (n_shards <= 0) return false;
+    Source& src = sources_[i];
+    src.bases.assign(bases, bases + n_shards);
+    src.offsets.resize(n_shards);
+    src.total_rows = 0;
+    for (int k = 0; k < n_shards; ++k) {
+      src.offsets[k] = src.total_rows;
+      src.total_rows += rows[k];
+    }
+    src.row_bytes = row_bytes;
+    return src.total_rows == n_rows_;
   }
 
   bool Start() {
     if (started_ || batches_per_epoch_ == 0) return batches_per_epoch_ != 0;
+    for (const Source& s : sources_)
+      if (s.total_rows != n_rows_ || s.bases.empty()) return false;
     slots_.resize(capacity_);
     for (int s = 0; s < capacity_; ++s) {
       slots_[s].bufs.resize(sources_.size());
@@ -210,11 +243,9 @@ class Loader {
     for (size_t i = 0; i < sources_.size(); ++i) {
       const Source& src = sources_[i];
       uint8_t* dst = slots_[slot].bufs[i].data();
-      for (uint64_t r = 0; r < rows; ++r) {
-        uint64_t row = perm[start + r];
-        std::memcpy(dst + r * src.row_bytes,
-                    src.data + row * src.row_bytes, src.row_bytes);
-      }
+      for (uint64_t r = 0; r < rows; ++r)
+        std::memcpy(dst + r * src.row_bytes, src.row(perm[start + r]),
+                    src.row_bytes);
     }
   }
 
@@ -255,6 +286,18 @@ void* ad_loader_create(int n_sources, uint64_t n_rows, uint64_t batch,
 void ad_loader_set_source(void* h, int i, const uint8_t* data,
                           uint64_t row_bytes) {
   static_cast<Loader*>(h)->SetSource(i, data, row_bytes);
+}
+
+// Sharded source (mmap'd dataset files): `bases[k]` holds `shard_rows[k]`
+// row-major rows; shards concatenate to the loader's n_rows. Returns 0 on
+// success, -1 when the shard rows don't sum to n_rows.
+int ad_loader_set_source_shards(void* h, int i, const uint8_t** bases,
+                                const uint64_t* shard_rows, int n_shards,
+                                uint64_t row_bytes) {
+  return static_cast<Loader*>(h)->SetSourceShards(i, bases, shard_rows,
+                                                  n_shards, row_bytes)
+             ? 0
+             : -1;
 }
 
 int ad_loader_start(void* h) { return static_cast<Loader*>(h)->Start() ? 0 : -1; }

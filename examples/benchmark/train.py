@@ -63,6 +63,11 @@ def parse_args():
     p.add_argument("--accum", type=int, default=1,
                    help="gradient accumulation microbatches per step")
     p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--data-dir", default="",
+                   help="stream batches from a sharded on-disk dataset "
+                        "(autodist_tpu.data.write_dataset layout, feature "
+                        "names matching the model's batch dict) instead of "
+                        "synthetic in-memory data")
     p.add_argument("--pin", action="store_true",
                    help="pin ONE batch in HBM and reuse it every window: "
                         "measures the steady-state device rate (the 'compute' "
@@ -104,6 +109,13 @@ def main():
         pinned = jax.device_put(example, step.plan.batch_shardings(example))
         jax.block_until_ready(pinned)
         next_batch = lambda: pinned  # noqa: E731
+    elif args.data_dir:
+        # Larger-than-RAM path: mmap'd shards gathered by the native engine.
+        loader = iter(DataLoader.from_files(
+            args.data_dir, batch_size=batch_size, epochs=-1, plan=step.plan,
+            shuffle=False,
+        ))
+        next_batch = lambda: next(loader)  # noqa: E731
     elif isinstance(example, dict):
         data = {
             k: np.tile(np.asarray(v), (4,) + (1,) * (np.asarray(v).ndim - 1))

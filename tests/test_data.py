@@ -125,3 +125,158 @@ def test_validation_errors():
         DataLoader({"a": np.zeros((4, 2)), "b": np.zeros((5,))}, batch_size=2)
     with pytest.raises(ValueError, match="at least one"):
         DataLoader({}, batch_size=1)
+
+
+# --------------------------------------------------------------------------- #
+# File-backed datasets (VERDICT r3 missing #1): sharded npy + mmap streaming
+# --------------------------------------------------------------------------- #
+from autodist_tpu.data import DatasetWriter, load_dataset, write_dataset
+from autodist_tpu.data import imagenet
+
+
+def test_write_load_roundtrip(tmp_path):
+    data = dataset(n=100)
+    write_dataset(str(tmp_path / "ds"), data, shard_rows=32)  # 32,32,32,4
+    loaded = load_dataset(str(tmp_path / "ds"))
+    assert sorted(loaded) == ["x", "y"]
+    assert [s.shape[0] for s in loaded["x"]] == [32, 32, 32, 4]
+    np.testing.assert_array_equal(np.concatenate(loaded["x"]), data["x"])
+    np.testing.assert_array_equal(np.concatenate(loaded["y"]), data["y"])
+    # Shards arrive memory-mapped: nothing was read into RAM.
+    assert all(isinstance(s, np.memmap) for s in loaded["x"])
+
+
+def test_streaming_writer_equals_whole_write(tmp_path):
+    data = dataset(n=100)
+    write_dataset(str(tmp_path / "whole"), data, shard_rows=30)
+    with DatasetWriter(str(tmp_path / "streamed"), shard_rows=30) as w:
+        for lo in range(0, 100, 7):  # ragged appends crossing shard cuts
+            w.append({k: v[lo:lo + 7] for k, v in data.items()})
+    a, b = load_dataset(str(tmp_path / "whole")), load_dataset(str(tmp_path / "streamed"))
+    for k in a:
+        np.testing.assert_array_equal(np.concatenate(a[k]), np.concatenate(b[k]))
+        assert [s.shape[0] for s in a[k]] == [s.shape[0] for s in b[k]]
+
+
+@pytest.mark.parametrize("engine", ["python"] + (["native"] if native_available else []))
+def test_file_backed_loader_matches_in_memory(tmp_path, engine):
+    """Gathering across mmap'd shard boundaries must reproduce the
+    in-memory batch stream exactly, under shuffle, both engines."""
+    data = dataset(n=101)
+    write_dataset(str(tmp_path / "ds"), data, shard_rows=17)
+    mem = DataLoader(data, batch_size=16, seed=3, epochs=2, engine=engine)
+    disk = DataLoader.from_files(
+        str(tmp_path / "ds"), batch_size=16, seed=3, epochs=2, engine=engine)
+    got_mem, got_disk = collect(mem), collect(disk)
+    assert len(got_mem) == len(got_disk) == 2 * (101 // 16)
+    for bm, bd in zip(got_mem, got_disk):
+        for k in bm:
+            np.testing.assert_array_equal(bm[k], bd[k])
+
+
+def test_loader_does_not_copy_mmap_shards(tmp_path):
+    data = dataset(n=64)
+    write_dataset(str(tmp_path / "ds"), data, shard_rows=16)
+    loader = DataLoader.from_files(str(tmp_path / "ds"), batch_size=8)
+    for shards in loader.sources:
+        for s in shards:
+            assert isinstance(s, np.memmap), "shard was copied into RAM"
+
+
+def test_transform_hook_applied_and_step_indexed():
+    data = dataset(n=32)
+    seen = []
+
+    def transform(batch, step):
+        seen.append(step)
+        return {k: (v + 1 if k == "x" else v) for k, v in batch.items()}
+
+    plain = collect(DataLoader(data, batch_size=8, seed=1, engine="python"))
+    transformed = collect(DataLoader(
+        data, batch_size=8, seed=1, engine="python", transform=transform))
+    assert seen == [0, 1, 2, 3]
+    for p, t in zip(plain, transformed):
+        np.testing.assert_array_equal(p["x"] + 1, t["x"])
+        np.testing.assert_array_equal(p["y"], t["y"])
+
+
+def test_imagenet_augment_deterministic_and_shaped():
+    rng = np.random.default_rng(0)
+    batch = {"image": rng.integers(0, 256, size=(4, 16, 16, 3)).astype(np.uint8),
+             "label": np.arange(4, dtype=np.int32)}
+    t = imagenet.augment(seed=7)
+    a, b = t(dict(batch), step=5), t(dict(batch), step=5)
+    np.testing.assert_array_equal(a["image"], b["image"])  # (seed, step) det.
+    c = t(dict(batch), step=6)
+    assert not np.array_equal(a["image"], c["image"])  # step varies the aug
+    assert a["image"].shape == (4, 16, 16, 3) and a["image"].dtype == np.float32
+    np.testing.assert_array_equal(a["label"], batch["label"])
+    # Eval: center crop, no randomness.
+    e = imagenet.eval_transform(crop=12)
+    ev = e(dict(batch), step=0)
+    assert ev["image"].shape == (4, 12, 12, 3)
+    np.testing.assert_array_equal(ev["image"], e(dict(batch), step=9)["image"])
+
+
+def test_shard_list_input_without_files():
+    # Sharded in-memory input (the files loader's shape) works directly.
+    data = dataset(n=50)
+    sharded = {k: [v[:20], v[20:45], v[45:]] for k, v in data.items()}
+    a = collect(DataLoader(data, batch_size=10, seed=2, engine="python"))
+    b = collect(DataLoader(sharded, batch_size=10, seed=2, engine="python"))
+    for ba, bb in zip(a, b):
+        for k in ba:
+            np.testing.assert_array_equal(ba[k], bb[k])
+
+
+def test_shard_validation_errors(tmp_path):
+    with pytest.raises(ValueError, match="dtype/row shape"):
+        DataLoader({"x": [np.zeros((4, 2)), np.zeros((4, 3))]}, batch_size=2)
+    with pytest.raises(ValueError, match="total rows"):
+        DataLoader({"x": np.zeros((8, 2)), "y": np.zeros((7,))}, batch_size=2)
+    # Corrupt manifest: row count mismatch must fail loudly at load.
+    data = dataset(n=40)
+    p = str(tmp_path / "ds")
+    write_dataset(p, data, shard_rows=20)
+    import json, os
+    meta = json.load(open(os.path.join(p, "meta.json")))
+    meta["shard_rows"][0] = 19
+    json.dump(meta, open(os.path.join(p, "meta.json"), "w"))
+    with pytest.raises(ValueError, match="manifest"):
+        load_dataset(p)
+
+
+def test_writer_copies_caller_buffer(tmp_path):
+    # Fill-one-buffer-in-a-loop must not corrupt rows pending a shard flush.
+    p = str(tmp_path / "ds")
+    buf = np.empty((6, 2), np.float32)
+    with DatasetWriter(p, shard_rows=100) as w:
+        buf[:] = 1.0
+        w.append({"x": buf})
+        buf[:] = 2.0
+        w.append({"x": buf})
+    x = np.concatenate(load_dataset(p)["x"])
+    np.testing.assert_array_equal(x[:6], 1.0)
+    np.testing.assert_array_equal(x[6:], 2.0)
+
+
+def test_writer_rejects_dtype_drift(tmp_path):
+    w = DatasetWriter(str(tmp_path / "ds"), shard_rows=100)
+    w.append({"x": np.zeros((4, 2), np.float32)})
+    with pytest.raises(ValueError, match="differs from earlier"):
+        w.append({"x": np.zeros((4, 2), np.float64)})
+
+
+def test_writer_zero_rows_raises_cleanly(tmp_path):
+    w = DatasetWriter(str(tmp_path / "ds"), shard_rows=8)
+    w.append({"x": np.zeros((0, 3), np.float32)})
+    with pytest.raises(ValueError, match="no rows"):
+        w.close()
+
+
+def test_nested_list_feature_is_one_array_not_shards():
+    # [[0,1],[2,3]] is a single (2,2) array-like, NOT two scalar-row shards.
+    loader = DataLoader({"x": [[0.0, 1.0], [2.0, 3.0]]}, batch_size=2,
+                        shuffle=False, engine="python")
+    (batch,) = collect(loader)
+    assert batch["x"].shape == (2, 2)
