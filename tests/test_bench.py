@@ -95,3 +95,50 @@ def test_embed_last_accel_tolerates_missing_cache(bench, tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "LAST_ACCEL_PATH", str(tmp_path / "absent.json"))
     line = {"metric": "bert_base_mfu_cpu_smoke"}
     assert bench._embed_last_accel(dict(line)) == line
+
+
+def _head(unit_per="tokens", mfu=0.5, on_accel=True):
+    return {"unit_per": unit_per, "mfu": mfu, "units_per_sec": 1000.0,
+            "achieved": 1e12, "n_chips": 1, "batch_size": 64, "loss": 2.0,
+            "seq": 128, "peak_detected": True, "device": "TPU v5e",
+            "on_accel": on_accel}
+
+
+def test_format_result_headline_bert_with_resnet_extras(bench):
+    measured = {"bert": _head(), "resnet": _head(unit_per="images", mfu=0.2)}
+    r, on_accel = bench._format_result(measured, {})
+    assert on_accel
+    assert r["metric"] == "bert_base_mfu" and r["value"] == 0.5
+    assert r["resnet50_mfu"] == 0.2
+    assert r["vs_baseline"] == pytest.approx(1.0)
+
+
+def test_format_result_resnet_only_and_errors(bench):
+    measured = {"resnet": _head(unit_per="images", mfu=0.2)}
+    r, on_accel = bench._format_result(measured, {"bert": "timed out"})
+    assert on_accel
+    assert r["metric"] == "resnet50_mfu"
+    assert r["bert_error"] == "timed out"
+
+
+def test_format_result_cpu_smoke_naming(bench):
+    r, on_accel = bench._format_result(
+        {"bert": _head(mfu=float("nan"), on_accel=False)}, {})
+    assert not on_accel
+    assert r["metric"] == "bert_base_mfu_cpu_smoke"
+    assert r["unit"] == "tokens/sec"
+    assert r["vs_baseline"] is None
+
+
+def test_format_result_mixed_accel_omits_cpu_mfu(bench):
+    # bert on TPU, resnet silently fell back to CPU (mfu=NaN): the NaN must
+    # not leak into the JSON line; a note records the downgrade.
+    import json as _json
+    measured = {"bert": _head(),
+                "resnet": _head(unit_per="images", mfu=float("nan"),
+                                on_accel=False)}
+    r, on_accel = bench._format_result(measured, {})
+    assert on_accel
+    assert "resnet50_mfu" not in r
+    assert "mid-bench" in r["resnet50_note"]
+    _json.loads(_json.dumps(r))  # strictly serializable, no NaN tokens
