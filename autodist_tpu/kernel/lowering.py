@@ -1444,8 +1444,12 @@ class DistributedTrainStep:
 
         A batch whose leaf shapes differ from the current window's (ragged
         final batch with ``drop_remainder=False``) flushes the window and
-        runs alone; note that look-ahead batch is already consumed from a
-        shared iterator even if ``steps`` caps fit before it runs.
+        runs alone. Look-ahead never over-consumes a shared iterator: a
+        shape-mismatched pull is carried as ``pending`` into the next
+        window, and since a window that defers a pull always ran fewer
+        than ``steps - step_i`` batches, the loop always comes back around
+        to run it — consumed == ran, pinned by
+        ``tests/test_lowering.py::test_fit_windowed_consumes_exactly_ran``.
         """
         from_loader = hasattr(batches, "host_batches")
         if from_loader:
