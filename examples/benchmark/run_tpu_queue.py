@@ -1,0 +1,180 @@
+"""Serial TPU experiment queue with wedge-aware scheduling.
+
+The axon tunnel wedges for long stretches; healthy windows are precious
+and must never be wasted or double-booked (two concurrent TPU processes
+deadlock it). This driver owns the tunnel: it probes in fresh
+subprocesses, and on the first healthy probe runs the round's queued
+experiments strictly serially, each in its own watchdogged subprocess.
+A job that hangs (re-wedge) is killed, the driver goes back to probing,
+and completed jobs are never re-run (state in ``docs/measured/queue/``).
+
+Usage::
+
+    python examples/benchmark/run_tpu_queue.py            # run queue
+    python examples/benchmark/run_tpu_queue.py --status   # show state
+    python examples/benchmark/run_tpu_queue.py --max-hours 8
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+QDIR = os.path.join(ROOT, "docs", "measured", "queue")
+STATE = os.path.join(QDIR, "state.json")
+
+# (name, argv-after-python, timeout_s). Priority order: the membw roofline
+# decides the ResNet-ceiling question (VERDICT r3 #1), layout/kernel A/Bs
+# next, then the BERT profile (#5), coverage/calibration (#7), and a fresh
+# full bench capture last so docs/measured/bench_last_accel.json ends the
+# round healthy (#2).
+JOBS = [
+    ("membw", ["examples/benchmark/membw.py"], 1500),
+    ("resnet_base", ["examples/benchmark/resnet_bounds.py", "base", "128", "20"], 900),
+    ("resnet_dotstats", ["examples/benchmark/resnet_bounds.py", "dotstats", "128", "20"], 900),
+    ("resnet_nchw", ["examples/benchmark/resnet_bounds.py", "nchw", "128", "20"], 900),
+    ("fused_conv_stats", ["examples/benchmark/fused_conv_stats.py"], 1500),
+    ("xla_flag_ab", ["examples/benchmark/xla_flag_ab.py"], 3600),
+    ("bert_profile", ["examples/benchmark/profile_ops.py", "--model", "bert_base",
+                      "--batch", "64", "--top", "15", "--out",
+                      "docs/measured/bert_op_profile.json"], 1800),
+    ("bert_seq512_flash", ["examples/benchmark/train.py", "--model", "bert_base",
+                           "--batch-size", "32", "--steps", "40", "--window", "20",
+                           "--pin", "--model-kwargs",
+                           '{"max_seq_len": 512, "attention_impl": "flash"}'], 1500),
+    ("bert_seq512_dot", ["examples/benchmark/train.py", "--model", "bert_base",
+                         "--batch-size", "32", "--steps", "40", "--window", "20",
+                         "--pin", "--model-kwargs",
+                         '{"max_seq_len": 512, "attention_impl": "dot"}'], 1500),
+    ("strategy_coverage", ["examples/benchmark/strategy_coverage.py"], 3600),
+    ("calibrate", ["examples/benchmark/calibrate.py", "--out", "docs/measured"], 2700),
+    ("bench_full", ["bench.py"], 5400),
+]
+MAX_ATTEMPTS = 2
+
+
+def _load_state() -> dict:
+    try:
+        with open(STATE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {"jobs": {}}
+
+
+def _save_state(st: dict) -> None:
+    os.makedirs(QDIR, exist_ok=True)
+    tmp = STATE + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(st, f, indent=2, sort_keys=True)
+    os.replace(tmp, STATE)
+
+
+def _log(msg: str) -> None:
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+    line = f"[{stamp}] {msg}"
+    print(line, flush=True)
+    os.makedirs(QDIR, exist_ok=True)
+    with open(os.path.join(QDIR, "queue.log"), "a") as f:
+        f.write(line + "\n")
+
+
+def probe(timeout_s: float = 150.0) -> bool:
+    """Fresh-subprocess matmul probe (the only wedge-safe health check)."""
+    code = ("import jax, jax.numpy as jnp; "
+            "x = jnp.ones((256, 256), jnp.bfloat16); "
+            "print(float((x @ x).sum()))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return False
+    return r.returncode == 0
+
+
+def run_job(name: str, argv: list, timeout_s: float) -> str:
+    """Run one experiment; returns done|wedged|failed. Output is teed to
+    ``docs/measured/queue/<name>.log`` for post-hoc inspection."""
+    log_path = os.path.join(QDIR, f"{name}.log")
+    _log(f"job {name}: starting (timeout {timeout_s:.0f}s)")
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable] + argv[:1] + argv[1:], cwd=ROOT,
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired as e:
+        with open(log_path, "w") as f:
+            f.write((e.stdout or "") if isinstance(e.stdout, str) else "")
+            f.write("\n--- TIMEOUT ---\n")
+        _log(f"job {name}: TIMED OUT after {timeout_s:.0f}s (tunnel wedge?)")
+        return "wedged"
+    with open(log_path, "w") as f:
+        f.write(r.stdout)
+        if r.stderr:
+            f.write("\n--- stderr ---\n" + r.stderr[-8000:])
+    dt = time.time() - t0
+    if r.returncode != 0:
+        _log(f"job {name}: FAILED rc={r.returncode} in {dt:.0f}s "
+             f"(see {os.path.relpath(log_path, ROOT)})")
+        return "failed"
+    tail = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+    _log(f"job {name}: done in {dt:.0f}s — {tail[:160]}")
+    return "done"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-hours", type=float, default=10.0)
+    ap.add_argument("--probe-interval", type=float, default=480.0,
+                    help="seconds between probes while wedged")
+    ap.add_argument("--status", action="store_true")
+    args = ap.parse_args()
+
+    st = _load_state()
+    if args.status:
+        for name, _, _ in JOBS:
+            j = st["jobs"].get(name, {})
+            print(f"{name:>20s}: {j.get('status', 'pending')} "
+                  f"(attempts {j.get('attempts', 0)})")
+        return
+
+    deadline = time.time() + args.max_hours * 3600
+    while time.time() < deadline:
+        todo = [
+            (n, a, t) for n, a, t in JOBS
+            if st["jobs"].get(n, {}).get("status") != "done"
+            and st["jobs"].get(n, {}).get("attempts", 0) < MAX_ATTEMPTS
+        ]
+        if not todo:
+            _log("queue complete")
+            return
+        if not probe():
+            _log(f"tunnel wedged; {len(todo)} jobs pending; sleeping "
+                 f"{args.probe_interval:.0f}s")
+            time.sleep(args.probe_interval)
+            continue
+        _log(f"tunnel HEALTHY; running {len(todo)} pending jobs")
+        for name, argv, timeout_s in todo:
+            if time.time() > deadline:
+                break
+            j = st["jobs"].setdefault(name, {"attempts": 0})
+            j["attempts"] += 1
+            j["status"] = "running"
+            _save_state(st)
+            status = run_job(name, argv, timeout_s)
+            j["status"] = status
+            j["at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+            _save_state(st)
+            if status == "wedged":
+                # Tunnel died mid-queue: back to the probe loop; completed
+                # jobs stay done, this one retries on the next window.
+                break
+    _log("queue driver: deadline reached")
+
+
+if __name__ == "__main__":
+    main()
