@@ -54,7 +54,8 @@ JOBS = [
     ("calibrate", ["examples/benchmark/calibrate.py", "--out", "docs/measured"], 2700),
     ("bench_full", ["bench.py"], 5400),
 ]
-MAX_ATTEMPTS = 2
+MAX_FAILED_ATTEMPTS = 2   # genuine non-zero exits: the job itself is broken
+MAX_WEDGED_ATTEMPTS = 6   # environmental kills (tunnel wedge) retry more
 
 
 def _load_state() -> dict:
@@ -103,16 +104,24 @@ def run_job(name: str, argv: list, timeout_s: float) -> str:
     t0 = time.time()
     try:
         r = subprocess.run(
-            [sys.executable] + argv[:1] + argv[1:], cwd=ROOT,
+            [sys.executable] + argv, cwd=ROOT,
             timeout=timeout_s, capture_output=True, text=True,
         )
     except subprocess.TimeoutExpired as e:
-        with open(log_path, "w") as f:
-            f.write((e.stdout or "") if isinstance(e.stdout, str) else "")
+        def _txt(x):
+            if isinstance(x, bytes):
+                return x.decode(errors="replace")
+            return x or ""
+        with open(log_path, "a") as f:
+            f.write(f"\n===== attempt @ {time.strftime('%H:%M:%S')} =====\n")
+            f.write(_txt(e.stdout))
+            if e.stderr:
+                f.write("\n--- stderr ---\n" + _txt(e.stderr)[-8000:])
             f.write("\n--- TIMEOUT ---\n")
         _log(f"job {name}: TIMED OUT after {timeout_s:.0f}s (tunnel wedge?)")
         return "wedged"
-    with open(log_path, "w") as f:
+    with open(log_path, "a") as f:
+        f.write(f"\n===== attempt @ {time.strftime('%H:%M:%S')} =====\n")
         f.write(r.stdout)
         if r.stderr:
             f.write("\n--- stderr ---\n" + r.stderr[-8000:])
@@ -139,41 +148,80 @@ def main() -> None:
         for name, _, _ in JOBS:
             j = st["jobs"].get(name, {})
             print(f"{name:>20s}: {j.get('status', 'pending')} "
-                  f"(attempts {j.get('attempts', 0)})")
+                  f"(failed {j.get('failed', 0)}, wedged {j.get('wedged', 0)})")
         return
 
-    deadline = time.time() + args.max_hours * 3600
-    while time.time() < deadline:
-        todo = [
-            (n, a, t) for n, a, t in JOBS
-            if st["jobs"].get(n, {}).get("status") != "done"
-            and st["jobs"].get(n, {}).get("attempts", 0) < MAX_ATTEMPTS
-        ]
-        if not todo:
-            _log("queue complete")
+    # Single-instance lock: two drivers passing probe() together would
+    # double-book the tunnel — the exact deadlock this script exists to
+    # prevent. Stale locks (dead pid) are reclaimed.
+    os.makedirs(QDIR, exist_ok=True)
+    lock = os.path.join(QDIR, "driver.pid")
+    if os.path.exists(lock):
+        try:
+            old = int(open(lock).read().strip())
+            os.kill(old, 0)
+            print(f"another queue driver (pid {old}) is running; exiting")
             return
-        if not probe():
-            _log(f"tunnel wedged; {len(todo)} jobs pending; sleeping "
-                 f"{args.probe_interval:.0f}s")
-            time.sleep(args.probe_interval)
-            continue
-        _log(f"tunnel HEALTHY; running {len(todo)} pending jobs")
-        for name, argv, timeout_s in todo:
-            if time.time() > deadline:
+        except (ValueError, ProcessLookupError, PermissionError):
+            pass  # stale
+    with open(lock, "w") as f:
+        f.write(str(os.getpid()))
+
+    def _eligible(j):
+        return (j.get("status") != "done"
+                and j.get("failed", 0) < MAX_FAILED_ATTEMPTS
+                and j.get("wedged", 0) < MAX_WEDGED_ATTEMPTS)
+
+    try:
+        deadline = time.time() + args.max_hours * 3600
+        while time.time() < deadline:
+            todo = [(n, a, t) for n, a, t in JOBS
+                    if _eligible(st["jobs"].get(n, {}))]
+            if not todo:
                 break
-            j = st["jobs"].setdefault(name, {"attempts": 0})
-            j["attempts"] += 1
-            j["status"] = "running"
-            _save_state(st)
-            status = run_job(name, argv, timeout_s)
-            j["status"] = status
-            j["at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-            _save_state(st)
-            if status == "wedged":
-                # Tunnel died mid-queue: back to the probe loop; completed
-                # jobs stay done, this one retries on the next window.
-                break
-    _log("queue driver: deadline reached")
+            if not probe():
+                _log(f"tunnel wedged; {len(todo)} jobs pending; sleeping "
+                     f"{args.probe_interval:.0f}s")
+                time.sleep(args.probe_interval)
+                continue
+            _log(f"tunnel HEALTHY; running {len(todo)} pending jobs")
+            for name, argv, timeout_s in todo:
+                if time.time() > deadline:
+                    break
+                j = st["jobs"].setdefault(name, {})
+                j["status"] = "running"
+                _save_state(st)
+                status = run_job(name, argv, timeout_s)
+                if status == "failed" and not probe():
+                    # The "failure" was the tunnel dying mid-batch as a
+                    # fast error, not the job: reclassify so it retries and
+                    # the rest of the batch isn't burned on a dead tunnel.
+                    _log(f"job {name}: reclassified failed -> wedged "
+                         f"(post-job probe unhealthy)")
+                    status = "wedged"
+                j["status"] = status
+                if status in ("failed", "wedged"):
+                    j[status] = j.get(status, 0) + 1
+                j["at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+                _save_state(st)
+                if status == "wedged":
+                    # Tunnel died mid-queue: back to the probe loop;
+                    # completed jobs stay done, this one retries on the
+                    # next window (wedges don't count as real failures).
+                    break
+        done = [n for n, _, _ in JOBS
+                if st["jobs"].get(n, {}).get("status") == "done"]
+        rest = [n for n, _, _ in JOBS if n not in done]
+        if rest:
+            _log(f"queue finished INCOMPLETE: {len(done)}/{len(JOBS)} done; "
+                 f"unfinished: {', '.join(rest)}")
+            sys.exit(1)
+        _log(f"queue complete: all {len(JOBS)} jobs done")
+    finally:
+        try:
+            os.remove(lock)
+        except OSError:
+            pass
 
 
 if __name__ == "__main__":
