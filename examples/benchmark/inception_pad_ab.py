@@ -1,0 +1,168 @@
+"""Inception small-channel-tower padding A/B (VERDICT r3 weak #3).
+
+Inception-V3 runs at ~4.5% MFU on the bench chip; the r3 attribution blames
+the heterogeneous small-channel towers (48/96-channel convs pad poorly onto
+128-lane MXU tiles), but no layout experiment backed it. This isolates the
+hypothesis at block level: the Inception-A tower set
+(``autodist_tpu/models/inception.py:66-81``) rebuilt with parametrized
+channel widths, raced in two variants on the same input:
+
+  v3     exact V3 channels   (1x1:64 | 48->5x5:64 | 64->3x3:96->3x3:96 | pool:64)
+  pad64  widths rounded up to multiples of 64 (48->64, 96->128)
+
+pad64 does MORE model FLOPs; if its *wall time* is close to (or below) v3's,
+the padding-waste hypothesis is confirmed — the MXU was already burning
+those lanes as padding — and channel-rounding is a real whole-model lever.
+If pad64 is proportionally slower, the towers are not tile-bound and the
+attribution is wrong.
+
+Methodology matches the bench: inputs pinned on device, fwd+bwd inside a
+scanned window, one dispatch per window, scalar-fetch sync.
+
+Usage::
+
+    python examples/benchmark/inception_pad_ab.py              # bench shapes
+    python examples/benchmark/inception_pad_ab.py --smoke      # CPU correctness
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from autodist_tpu.models import layers as L
+from autodist_tpu.models.inception import _conv_bn, _conv_bn_init
+
+# Inception-A tower widths (inception.py:66-74): (out, kh, kw) chains keyed
+# by branch. ``round_to`` pads every width up to the lane multiple.
+# Deliberately a parallel copy of _inception_a_init's spec rather than a
+# call through its ``w`` hook: ``w`` scales *both* ends of every conv, so
+# rounding through it would also widen the block's input (288 -> 320) and
+# the A/B would no longer hold the input tensor fixed. Here only OUTPUT
+# widths round; the input stays the model's real mixed_a2 shape.
+BRANCHES = {
+    "b1x1": [(64, 1, 1)],
+    "b5x5": [(48, 1, 1), (64, 5, 5)],
+    "b3x3dbl": [(64, 1, 1), (96, 3, 3), (96, 3, 3)],
+    "bpool": [(64, 1, 1)],
+}
+
+
+def _round(c: int, m: int) -> int:
+    return c if m <= 1 else -(-c // m) * m
+
+
+def block_init(rng, cin: int, round_to: int):
+    keys = iter(jax.random.split(rng, 16))
+    params = {}
+    for name, chain in BRANCHES.items():
+        c = cin
+        for i, (out, kh, kw) in enumerate(chain):
+            out = _round(out, round_to)
+            params[f"{name}_{i}"] = _conv_bn_init(next(keys), kh, kw, c, out)
+            c = out
+    return params
+
+
+def block_fwd(params, x, dtype=jnp.bfloat16):
+    outs = []
+    for name, chain in BRANCHES.items():
+        y = L.avg_pool(x, 3, 1) if name == "bpool" else x
+        for i in range(len(chain)):
+            y = _conv_bn(params[f"{name}_{i}"], y, dtype=dtype)
+        outs.append(y)
+    return jnp.concatenate(outs, axis=-1)
+
+
+def block_flops(cin: int, hw: int, round_to: int) -> float:
+    total = 0.0
+    for chain in BRANCHES.values():
+        c = cin
+        for out, kh, kw in chain:
+            out = _round(out, round_to)
+            total += 2.0 * hw * hw * kh * kw * c * out
+            c = out
+    return 3.0 * total  # fwd + ~2x bwd
+
+
+def measure(variant: str, round_to: int, batch: int, hw: int, cin: int,
+            window: int) -> dict:
+    rng = jax.random.PRNGKey(0)
+    params = block_init(rng, cin, round_to)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, hw, hw, cin),
+                          jnp.bfloat16)
+
+    def loss(p, x):
+        return (block_fwd(p, x).astype(jnp.float32) ** 2).mean()
+
+    grad = jax.grad(loss)
+
+    @jax.jit
+    def win(p, x):
+        def body(c, _):
+            g = grad(c, x)
+            return jax.tree.map(lambda a, b: a - 1e-6 * b, c, g), None
+        return lax.scan(body, p, None, length=window)[0]
+
+    params = jax.device_put(params)
+    out = win(params, x)                           # compile + warmup
+    float(jax.tree.leaves(out)[0].reshape(-1)[0])  # scalar-fetch sync
+    trials = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = win(params, x)
+        float(jax.tree.leaves(out)[0].reshape(-1)[0])
+        trials.append(time.perf_counter() - t0)
+    dt = sorted(trials)[1] / window
+    flops = block_flops(cin, hw, round_to) * batch
+    return {"variant": variant, "round_to": round_to,
+            "ms_per_step": round(dt * 1e3, 3),
+            "model_tflops_per_s": round(flops / dt / 1e12, 2),
+            "flops_per_step_g": round(flops / 1e9, 2)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU shapes, correctness only")
+    ap.add_argument("--batch", type=int, default=128)
+    args = ap.parse_args()
+    if args.smoke:
+        jax.config.update("jax_platforms", "cpu")
+        batch, hw, cin, window = 4, 8, 32, 2
+    else:
+        batch, hw, cin, window = args.batch, 35, 288, 20  # mixed_a2 shapes
+
+    rows = [measure("v3", 1, batch, hw, cin, window),
+            measure("pad64", 64, batch, hw, cin, window)]
+    for r in rows:
+        print(f"{r['variant']:>6s}: {r['ms_per_step']:8.3f} ms/step  "
+              f"{r['model_tflops_per_s']:6.2f} TFLOP/s  "
+              f"({r['flops_per_step_g']:.1f} GF/step)")
+    v3, pad = rows
+    wall = pad["ms_per_step"] / v3["ms_per_step"]
+    fl = pad["flops_per_step_g"] / v3["flops_per_step_g"]
+    print(f"\npad64/v3: wall {wall:.2f}x for {fl:.2f}x FLOPs -> "
+          f"{'padding-waste CONFIRMED' if wall < (1 + (fl - 1) / 2) else 'towers not tile-bound'}")
+    if not args.smoke:
+        out = os.path.abspath(os.path.join(
+            os.path.dirname(__file__), "..", "..", "docs", "measured",
+            "inception_pad_ab.json"))
+        with open(out, "w") as fh:
+            json.dump({"batch": batch, "hw": hw, "cin": cin,
+                       "window": window, "rows": rows,
+                       "wall_ratio": round(wall, 3),
+                       "flops_ratio": round(fl, 3)}, fh, indent=2)
+        print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

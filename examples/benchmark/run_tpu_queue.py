@@ -50,6 +50,7 @@ JOBS = [
                          "--batch-size", "32", "--steps", "40", "--window", "20",
                          "--pin", "--model-kwargs",
                          '{"max_seq_len": 512, "attention_impl": "dot"}'], 1500),
+    ("inception_pad_ab", ["examples/benchmark/inception_pad_ab.py"], 1200),
     ("strategy_coverage", ["examples/benchmark/strategy_coverage.py"], 3600),
     ("calibrate", ["examples/benchmark/calibrate.py", "--out", "docs/measured"], 2700),
     ("bench_full", ["bench.py"], 5400),
