@@ -143,6 +143,38 @@ def write_dataset(path: str, data: Dict[str, np.ndarray],
     return path
 
 
+def slice_rows(dataset: Dict[str, List[np.ndarray]], lo: int, hi: int
+               ) -> Dict[str, List[np.ndarray]]:
+    """Restrict every feature's shard list to global rows ``[lo, hi)``.
+
+    Shards are sliced as views (an ``np.memmap`` slice stays mapped), so
+    this is how a multi-host fleet reads a shared on-disk dataset: every
+    process opens the same directory, then keeps only its contiguous row
+    range — the per-worker feed-splitting contract
+    (reference remapper.py:81-123) applied at the storage layer.
+    """
+    if lo < 0 or hi <= lo:
+        raise ValueError(f"invalid row range [{lo}, {hi})")
+    out: Dict[str, List[np.ndarray]] = {}
+    for name, shards in dataset.items():
+        pieces, off = [], 0
+        for s in shards:
+            n = s.shape[0]
+            a, b = max(lo - off, 0), min(hi - off, n)
+            if a < b:
+                pieces.append(s[a:b])
+            off += n
+        if hi > off:
+            # Truncating silently would hand one fleet process fewer rows
+            # than its peers — a collective deadlock later instead of an
+            # error here.
+            raise ValueError(
+                f"row range [{lo}, {hi}) exceeds feature {name!r} "
+                f"({off} rows)")
+        out[name] = pieces
+    return out
+
+
 def load_dataset(path: str) -> Dict[str, List[np.ndarray]]:
     """Open a dataset directory as per-feature lists of mmap'd shards.
 

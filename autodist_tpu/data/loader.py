@@ -211,16 +211,36 @@ class DataLoader:
         )
 
     @classmethod
-    def from_files(cls, data_dir: str, batch_size: int, **kwargs) -> "DataLoader":
+    def from_files(cls, data_dir: str, batch_size: int,
+                   process_slice: bool = False, **kwargs) -> "DataLoader":
         """Open a ``files.write_dataset`` directory as a streaming loader.
 
         Every shard arrives as an ``np.memmap`` view; rows are gathered
         (by the native engine when available) straight from the page cache,
         so the dataset may be far larger than RAM.
-        """
-        from autodist_tpu.data.files import load_dataset
 
-        return cls(load_dataset(data_dir), batch_size, **kwargs)
+        ``process_slice=True`` is the multi-host recipe: every process
+        opens the same (shared-filesystem) directory but keeps only its
+        contiguous ``n_rows / process_count`` row range, so the loader's
+        local batches assemble into disjoint global batches via ``plan``
+        exactly like per-host in-memory data. Requires the process count
+        to divide the row count evenly.
+        """
+        from autodist_tpu.data.files import load_dataset, slice_rows
+
+        data = load_dataset(data_dir)
+        if process_slice:
+            import jax
+
+            P, p = jax.process_count(), jax.process_index()
+            n = sum(s.shape[0] for s in next(iter(data.values())))
+            if n % P:
+                raise ValueError(
+                    f"process_slice needs rows % processes == 0; "
+                    f"{n} rows over {P} processes")
+            rpp = n // P
+            data = slice_rows(data, p * rpp, (p + 1) * rpp)
+        return cls(data, batch_size, **kwargs)
 
     def _iter_device_prefetch(self, it, depth: int):
         """Keep ``depth`` sharded batches in flight ahead of the consumer.

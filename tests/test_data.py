@@ -280,3 +280,32 @@ def test_nested_list_feature_is_one_array_not_shards():
                         shuffle=False, engine="python")
     (batch,) = collect(loader)
     assert batch["x"].shape == (2, 2)
+
+
+def test_slice_rows_across_shard_boundaries(tmp_path):
+    from autodist_tpu.data.files import slice_rows
+
+    data = dataset(n=50)
+    write_dataset(str(tmp_path / "ds"), data, shard_rows=15)  # 15,15,15,5
+    ds = load_dataset(str(tmp_path / "ds"))
+    sl = slice_rows(ds, 10, 40)  # spans shards 0..2
+    np.testing.assert_array_equal(np.concatenate(sl["x"]), data["x"][10:40])
+    # Views stay mapped (no copy).
+    assert all(s.base is not None for s in sl["x"])
+    with pytest.raises(ValueError, match="exceeds"):
+        slice_rows(ds, 40, 60)  # silent truncation would desync a fleet
+    with pytest.raises(ValueError, match="invalid row range"):
+        slice_rows(ds, 10, 10)
+
+
+def test_from_files_process_slice_single_process(tmp_path):
+    # process_count()==1: the slice is the whole dataset; divisibility holds.
+    data = dataset(n=48)
+    write_dataset(str(tmp_path / "ds"), data, shard_rows=20)
+    a = collect(DataLoader.from_files(str(tmp_path / "ds"), batch_size=8,
+                                      seed=1, engine="python"))
+    b = collect(DataLoader.from_files(str(tmp_path / "ds"), batch_size=8,
+                                      seed=1, engine="python",
+                                      process_slice=True))
+    for ba, bb in zip(a, b):
+        np.testing.assert_array_equal(ba["x"], bb["x"])
