@@ -214,3 +214,18 @@ def bert_base(**overrides) -> ModelSpec:
     spec = transformer_lm(**kw)
     spec.name = "bert_base"
     return spec
+
+
+@register_model("bert_large")
+def bert_large(**overrides) -> ModelSpec:
+    """BERT-large uncased — the exact model the reference's published
+    benchmark pretrains (docs/usage/performance.md:7, bert_config.json in
+    examples/benchmark/utils: L=24, H=1024, A=16)."""
+    kw = dict(
+        vocab_size=30522, num_layers=24, d_model=1024, num_heads=16,
+        d_ff=4096, max_seq_len=128, causal=False,
+    )
+    kw.update(overrides)
+    spec = transformer_lm(**kw)
+    spec.name = "bert_large"
+    return spec

@@ -43,6 +43,7 @@ MODELS = {
     "densenet121": ("densenet", {"depth": 121, "image_size": 224}, "images"),
     "inceptionv3": ("inception", {"image_size": 299}, "images"),
     "bert_base": ("bert_base", {}, "tokens"),
+    "bert_large": ("bert_large", {}, "tokens"),
     "transformer": ("transformer", {}, "tokens"),
     "lm1b": ("lstm_lm", {}, "tokens"),
     "ncf": ("ncf", {}, "examples"),
@@ -105,15 +106,25 @@ def main():
     # tuple-structured batches fall back to repeating the example batch).
     # --pin skips the loader entirely: one batch lives in HBM and the host
     # stays idle during the timed windows.
+    # Loaders take the LOCAL batch: each process feeds its
+    # global/process_count slice and the plan assembles the global batch
+    # (the remapper feed contract). Single-process: local == global.
+    n_proc = jax.process_count()
+    if batch_size % n_proc:
+        raise SystemExit(
+            f"--batch-size {batch_size} must divide the {n_proc}-process fleet")
+    local_bs = batch_size // n_proc
     if args.pin:
         pinned = jax.device_put(example, step.plan.batch_shardings(example))
         jax.block_until_ready(pinned)
         next_batch = lambda: pinned  # noqa: E731
     elif args.data_dir:
-        # Larger-than-RAM path: mmap'd shards gathered by the native engine.
+        # Larger-than-RAM path: mmap'd shards gathered by the native
+        # engine; process_slice gives each host a disjoint row range of
+        # the shared dataset.
         loader = iter(DataLoader.from_files(
-            args.data_dir, batch_size=batch_size, epochs=-1, plan=step.plan,
-            shuffle=False,
+            args.data_dir, batch_size=local_bs, epochs=-1, plan=step.plan,
+            shuffle=False, process_slice=True,
         ))
         next_batch = lambda: next(loader)  # noqa: E731
     elif isinstance(example, dict):
@@ -122,7 +133,7 @@ def main():
             for k, v in example.items()
         }
         loader = iter(DataLoader(
-            data, batch_size=batch_size, epochs=-1, plan=step.plan, shuffle=False
+            data, batch_size=local_bs, epochs=-1, plan=step.plan, shuffle=False
         ))
         next_batch = lambda: next(loader)  # noqa: E731
     else:

@@ -17,6 +17,8 @@ SMALL = {
                         d_ff=64, max_seq_len=16),
     "bert_base": dict(vocab_size=128, num_layers=2, d_model=32, num_heads=4,
                       d_ff=64, max_seq_len=16),
+    "bert_large": dict(vocab_size=128, num_layers=2, d_model=32, num_heads=4,
+                       d_ff=64, max_seq_len=16),
     "resnet": dict(depth=18, num_classes=10, image_size=32),
     "densenet": dict(num_classes=10, image_size=32, blocks=[2, 2], growth=8),
     "inception": dict(num_classes=10, image_size=64, width=0.25),
