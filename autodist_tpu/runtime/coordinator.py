@@ -52,13 +52,19 @@ class Coordinator:
         cluster: Cluster,
         strategy: Optional[Strategy] = None,
         argv: Optional[Sequence[str]] = None,
+        extra_env: Optional[Dict[str, str]] = None,
     ):
         self.cluster = cluster
         self.strategy = strategy
         self.argv = list(argv) if argv is not None else [sys.executable] + sys.argv
+        # Forwarded into every worker's env (local subprocess and SSH shell
+        # alike) — the supervisor's AUTODIST_RESTART travels here so remote
+        # workers see the same attempt counter as the chief.
+        self.extra_env = dict(extra_env or {})
         self.procs: List[subprocess.Popen] = []
         self.threads: List[threading.Thread] = []
         self._failed = threading.Event()
+        self._failure_action = None
 
     # ------------------------------------------------------------------ launch
     def launch_clients(self) -> None:
@@ -68,7 +74,8 @@ class Coordinator:
             if n.address != self.cluster.resource_spec.chief_address
         ]
         for node in workers:
-            env = self.cluster.env_for_worker(node.address, strategy_id)
+            env = {**self.extra_env,
+                   **self.cluster.env_for_worker(node.address, strategy_id)}
             if _is_local(node.address):
                 proc = self._launch_local(env)
             else:
@@ -152,6 +159,18 @@ class Coordinator:
         )
 
     # ----------------------------------------------------------------- monitor
+    def set_failure_action(self, action) -> None:
+        """Replace the fail-fast ``os._exit(1)`` with ``action()``.
+
+        The default (reference parity, coordinator.py:98-110) kills the
+        whole launcher process — correct for an unsupervised run, fatal
+        for a restart supervisor living in the same process. A supervised
+        launch installs ``chief.terminate`` instead: the chief subprocess
+        dies, ``launch()`` returns its non-zero code, and the supervisor
+        decides whether to relaunch.
+        """
+        self._failure_action = action
+
     def _monitor(self, address: str, proc: subprocess.Popen) -> None:
         code = proc.wait()
         if code != 0 and not self._failed.is_set():
@@ -161,7 +180,10 @@ class Coordinator:
                 "(fail-fast, reference coordinator.py:98-110)", address, code,
             )
             self.cluster.terminate()
-            os._exit(1)
+            if self._failure_action is not None:
+                self._failure_action()
+            else:
+                os._exit(1)
 
     def join(self) -> None:
         """Block until every worker exits (clean launcher shutdown)."""

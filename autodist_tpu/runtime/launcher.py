@@ -62,6 +62,8 @@ def launch(
     argv: Sequence[str],
     num_local_processes: int = 0,
     coordinator_port: Optional[int] = None,
+    extra_env: Optional[dict] = None,
+    supervised: bool = False,
 ) -> int:
     """Launch ``argv`` across the cluster; returns the chief's exit code.
 
@@ -69,28 +71,96 @@ def launch(
     N processes, process 0 (chief) runs in the foreground, the rest are
     subprocesses with worker role env — the moral equivalent of the
     reference's docker-on-one-box distributed CI (``Jenkinsfile:93-131``).
+
+    ``extra_env`` is merged into every process's environment (chief and
+    workers, local or SSH). ``supervised=True`` redirects the coordinator's
+    worker-death fail-fast from ``os._exit(1)`` to terminating the chief
+    subprocess, so this function *returns* non-zero instead of killing the
+    calling process — required by :func:`launch_supervised`'s restart loop.
     """
     clean_stale_processes()
     argv = list(argv)
+    extra_env = dict(extra_env or {})
 
     if num_local_processes > 1:
-        return _launch_local_fleet(argv, num_local_processes, coordinator_port)
+        base = {**_scrub_role_vars(dict(os.environ)), **extra_env}
+        return _launch_local_fleet(
+            argv, num_local_processes, coordinator_port, base_env=base)
 
     cluster = Cluster(resource_spec, coordinator_port=coordinator_port)
-    coordinator = Coordinator(cluster, argv=argv)
+    coordinator = Coordinator(cluster, argv=argv, extra_env=extra_env)
+    if supervised:
+        # Placeholder until the chief exists: a worker dying in this window
+        # leaves the cluster torn down, the chief then fails its runtime
+        # join and launch() returns non-zero — still restartable.
+        coordinator.set_failure_action(lambda: None)
     coordinator.launch_clients()
 
     env = {
+        **extra_env,
         ENV.AUTODIST_COORDINATOR.name: cluster.coordinator_address,
         ENV.AUTODIST_NUM_PROCESSES.name: str(cluster.num_processes),
         ENV.AUTODIST_PROCESS_ID.name: "0",
     }
     chief = subprocess.Popen(argv, env={**_scrub_role_vars(dict(os.environ)), **env})
+    if supervised:
+        coordinator.set_failure_action(chief.terminate)
     code = chief.wait()
     if code == 0:
         coordinator.join()
     cluster.terminate()
     return code
+
+
+def launch_supervised(
+    resource_spec: ResourceSpec,
+    argv: Sequence[str],
+    max_restarts: int = 0,
+    num_local_processes: int = 0,
+    coordinator_port: Optional[int] = None,
+    restart_backoff_s: float = 5.0,
+) -> int:
+    """:func:`launch` under a restart supervisor (checkpoint-resume loop).
+
+    The reference's fault story ended at fail-fast (worker death kills the
+    chief, ``coordinator.py:98-110``) + manual restart; this closes the
+    loop: a fleet that exits non-zero is relaunched — same command, fresh
+    role env, stale pidfiles swept by the inner :func:`launch` — up to
+    ``max_restarts`` times. Worker death is survivable too: ``supervised``
+    launches redirect the coordinator's fail-fast from ``os._exit(1)`` to
+    terminating the chief, so it surfaces as a non-zero return here
+    instead of killing this process. Training scripts resume by
+    construction when they open their state with
+    ``DistributedTrainStep.init_or_restore`` (fresh init when the
+    checkpoint dir is empty, latest checkpoint otherwise), so the
+    supervisor needs no protocol with the script. Each attempt carries
+    ``AUTODIST_RESTART`` (0 on the first run) in every process's env —
+    chief, local workers, and SSH-launched remote workers alike.
+    """
+    import time
+
+    attempt = 0
+    while True:
+        code = launch(
+            resource_spec, argv,
+            num_local_processes=num_local_processes,
+            coordinator_port=coordinator_port,
+            extra_env={"AUTODIST_RESTART": str(attempt)},
+            supervised=True,
+        )
+        if code == 0 or attempt >= max_restarts:
+            if code != 0:
+                logging.error(
+                    "fleet failed rc=%d after %d restart(s); giving up",
+                    code, attempt,
+                )
+            return code
+        attempt += 1
+        logging.warning(
+            "fleet exited rc=%d; restarting (%d/%d) in %.0fs",
+            code, attempt, max_restarts, restart_backoff_s,
+        )
+        time.sleep(restart_backoff_s)
 
 
 def _launch_local_fleet(
@@ -175,6 +245,12 @@ def main(args: Optional[Sequence[str]] = None) -> int:
         help="emulate N hosts on this machine (testing)",
     )
     parser.add_argument("--coordinator-port", type=int, default=0)
+    parser.add_argument(
+        "--max-restarts", type=int, default=0,
+        help="relaunch a non-zero-exiting fleet up to N times; scripts "
+             "using init_or_restore resume from their latest checkpoint",
+    )
+    parser.add_argument("--restart-backoff", type=float, default=5.0)
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="-- python train.py ...")
     ns = parser.parse_args(args)
@@ -184,10 +260,12 @@ def main(args: Optional[Sequence[str]] = None) -> int:
     spec = (
         ResourceSpec(ns.resource_spec) if ns.resource_spec else ResourceSpec.from_local_devices()
     )
-    return launch(
+    return launch_supervised(
         spec, command,
+        max_restarts=ns.max_restarts,
         num_local_processes=ns.num_local_processes,
         coordinator_port=ns.coordinator_port or None,
+        restart_backoff_s=ns.restart_backoff,
     )
 
 

@@ -681,3 +681,155 @@ def test_two_process_file_backed_feed(tmp_path):
         base_env=env,
     )
     assert code == 0
+
+
+class TestRestartSupervisor:
+    """launch_supervised: the checkpoint-resume loop over launch()."""
+
+    def _sup(self, monkeypatch, codes, max_restarts):
+        import autodist_tpu.runtime.launcher as L
+
+        calls = []
+
+        def fake_launch(spec, argv, num_local_processes=0,
+                        coordinator_port=None, extra_env=None,
+                        supervised=False):
+            assert supervised  # the loop must take the non-exiting path
+            calls.append((extra_env or {}).get("AUTODIST_RESTART"))
+            return codes[len(calls) - 1]
+
+        monkeypatch.setattr(L, "launch", fake_launch)
+        monkeypatch.setattr("time.sleep", lambda s: None)
+        rc = L.launch_supervised(
+            None, ["true"], max_restarts=max_restarts, restart_backoff_s=0)
+        return rc, calls
+
+    def test_restarts_until_success(self, monkeypatch):
+        rc, calls = self._sup(monkeypatch, [1, 1, 0], max_restarts=3)
+        assert rc == 0
+        assert calls == ["0", "1", "2"]  # AUTODIST_RESTART exported per attempt
+
+    def test_gives_up_after_budget(self, monkeypatch):
+        rc, calls = self._sup(monkeypatch, [7, 7], max_restarts=1)
+        assert rc == 7
+        assert len(calls) == 2
+
+    def test_zero_restarts_is_plain_launch(self, monkeypatch):
+        rc, calls = self._sup(monkeypatch, [3], max_restarts=0)
+        assert rc == 3
+        assert len(calls) == 1
+
+
+def test_supervised_failure_action_replaces_os_exit(tmp_path):
+    """Coordinator.set_failure_action: worker death under supervision
+    terminates the chief via the action instead of os._exit(1)ing the
+    launcher process (which would kill the restart loop itself)."""
+    import threading
+    import time as _time
+
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.runtime.cluster import Cluster
+    from autodist_tpu.runtime.coordinator import Coordinator
+
+    spec = ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+    c = Cluster(spec)
+    coord = Coordinator(c, argv=[sys.executable, "-c", "raise SystemExit(3)"])
+    fired = threading.Event()
+    coord.set_failure_action(fired.set)
+    proc = coord._launch_local(c.env_for_worker("localhost"))
+    coord.procs.append(proc)
+    t = threading.Thread(target=coord._monitor, args=("localhost", proc),
+                         daemon=True)
+    t.start()
+    assert fired.wait(timeout=30)   # action ran...
+    _time.sleep(0.2)                # ...and we are demonstrably still alive
+    assert coord.any_failed
+
+
+def test_coordinator_extra_env_reaches_local_workers():
+    """extra_env (the supervisor's AUTODIST_RESTART) must reach worker
+    processes, and role env must still win over it."""
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.runtime.cluster import Cluster
+    from autodist_tpu.runtime.coordinator import Coordinator
+
+    spec = ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+    c = Cluster(spec)
+    coord = Coordinator(
+        c, argv=["true"],
+        extra_env={"AUTODIST_RESTART": "2",
+                   ENV.AUTODIST_WORKER.name: "must-not-win"})
+    env = {**coord.extra_env, **c.env_for_worker("localhost")}
+    assert env["AUTODIST_RESTART"] == "2"
+    assert env[ENV.AUTODIST_WORKER.name] != "must-not-win"
+
+
+@pytest.mark.integration
+def test_supervised_crash_resume(tmp_path, monkeypatch):
+    """End-to-end fault tolerance: a 2-process fleet whose chief crashes
+    mid-training on the first attempt; the supervisor relaunches, the
+    script's init_or_restore resumes from the latest checkpoint, and the
+    final checkpoint reflects the full step count with no repeated work."""
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        from autodist_tpu.runtime.launcher import initialize_from_env
+        initialize_from_env()
+        import jax
+        import numpy as np
+        from autodist_tpu.api import AutoDist
+        from autodist_tpu.checkpoint import Saver
+        from autodist_tpu.model_item import OptimizerSpec
+        import autodist_tpu.strategy as S
+
+        ad = AutoDist(strategy_builder=S.AllReduce())
+
+        def loss_fn(params, batch):
+            return ((batch["x"] @ params["w"]) ** 2).mean()
+
+        params = {"w": np.ones((4, 2), np.float32)}
+        batch = {"x": np.ones((8, 4), np.float32) / 4.0}
+        step = ad.build(loss_fn, params, batch,
+                        optimizer=OptimizerSpec("sgd", {"learning_rate": 0.05}))
+        saver = Saver(directory=os.environ["AUTODIST_TEST_CKPT_DIR"])
+        state = step.init_or_restore(params, saver)
+        start = int(state.step)
+        restart = int(os.environ.get("AUTODIST_RESTART", "0"))
+        # Attempt 0 must start fresh; attempt 1 must resume past the crash.
+        assert (start == 0) == (restart == 0), (start, restart)
+        batch = step.plan.global_batch_from_local(
+            {"x": batch["x"][jax.process_index() * 4:(jax.process_index() + 1) * 4]})
+        while int(state.step) < 4:
+            state, _ = step(state, batch)
+            step.save(saver, state)
+            if restart == 0 and int(state.step) == 2:
+                os._exit(1)   # simulated mid-training crash on every process
+        print("OK", jax.process_index(), int(state.step), flush=True)
+    """))
+    import autodist_tpu.runtime.launcher as L
+
+    env = _scrubbed_cpu_env()
+    env["AUTODIST_TEST_CKPT_DIR"] = str(tmp_path / "ckpt")
+    port = _free_port()
+
+    def launch_with_scrubbed_env(spec, argv, num_local_processes=0,
+                                 coordinator_port=None, extra_env=None,
+                                 supervised=False):
+        base = {**env, **(extra_env or {})}
+        return L._launch_local_fleet(argv, 2, coordinator_port=port,
+                                     base_env=base)
+
+    monkeypatch.setattr(L, "launch", launch_with_scrubbed_env)
+    rc = L.launch_supervised(None, [sys.executable, str(script)],
+                             max_restarts=2, restart_backoff_s=0.1)
+    assert rc == 0
+    import numpy as np
+
+    from autodist_tpu.checkpoint import Saver
+
+    final = Saver(directory=str(tmp_path / "ckpt")).restore_latest()
+    assert int(np.asarray(final["step"])) == 4
