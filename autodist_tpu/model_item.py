@@ -96,12 +96,83 @@ class VarItem:
         return self.size * np.dtype(self.dtype).itemsize
 
 
+def make_schedule(spec: Dict[str, Any]):
+    """Materialize a serializable schedule spec into an optax schedule.
+
+    ``spec`` is a plain-JSON dict ``{"schedule": <name>, ...params}`` so
+    schedules survive the ModelItem/Strategy round trip like every other
+    hyperparameter. Covers the reference benchmarks' training recipes:
+    BERT pretraining's linear-warmup + polynomial decay
+    (``/root/reference/examples/benchmark/utils/bert_utils.py`` optimizer
+    setup) and the ResNet piecewise step schedule
+    (``imagenet_preprocessing``-era recipes), plus the TPU-era staples.
+    """
+    import optax
+
+    d = dict(spec)
+    name = d.pop("schedule")
+    if name == "constant":
+        return optax.constant_schedule(d["value"])
+    if name == "cosine":
+        return optax.cosine_decay_schedule(
+            init_value=d["init_value"], decay_steps=d["decay_steps"],
+            alpha=d.get("alpha", 0.0))
+    if name == "exponential":
+        return optax.exponential_decay(
+            init_value=d["init_value"],
+            transition_steps=d["transition_steps"],
+            decay_rate=d["decay_rate"],
+            staircase=d.get("staircase", False))
+    if name == "warmup_cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=d.get("init_value", 0.0), peak_value=d["peak_value"],
+            warmup_steps=d["warmup_steps"], decay_steps=d["decay_steps"],
+            end_value=d.get("end_value", 0.0))
+    if name == "warmup_polynomial":
+        # BERT's recipe: linear warmup to peak, then polynomial decay to
+        # end_value over the remaining steps. decay_steps is the TOTAL
+        # schedule length (warmup included), so it must exceed warmup —
+        # optax would otherwise silently render a constant-at-peak LR.
+        if d["decay_steps"] <= d["warmup_steps"]:
+            raise ValueError(
+                f"warmup_polynomial: decay_steps ({d['decay_steps']}) is the "
+                f"total schedule length and must exceed warmup_steps "
+                f"({d['warmup_steps']})")
+        warmup = optax.linear_schedule(
+            init_value=d.get("init_value", 0.0), end_value=d["peak_value"],
+            transition_steps=d["warmup_steps"])
+        decay = optax.polynomial_schedule(
+            init_value=d["peak_value"], end_value=d.get("end_value", 0.0),
+            power=d.get("power", 1.0),
+            transition_steps=d["decay_steps"] - d["warmup_steps"])
+        return optax.join_schedules([warmup, decay], [d["warmup_steps"]])
+    if name == "piecewise":
+        # JSON object keys are strings; optax wants int boundaries.
+        scales = {int(k): float(v)
+                  for k, v in d["boundaries_and_scales"].items()}
+        return optax.piecewise_constant_schedule(
+            init_value=d["init_value"], boundaries_and_scales=scales)
+    if name == "linear":
+        return optax.linear_schedule(
+            init_value=d["init_value"], end_value=d["end_value"],
+            transition_steps=d["transition_steps"])
+    raise ValueError(
+        f"unknown schedule {name!r}; known: constant, cosine, exponential, "
+        f"warmup_cosine, warmup_polynomial, piecewise, linear")
+
+
 @dataclass
 class OptimizerSpec:
     """Explicit optimizer capture (replaces reference optimizer patching).
 
     ``name`` indexes into :data:`OPTIMIZER_REGISTRY`; ``kwargs`` are its
-    hyperparameters. ``make()`` materializes the optax transform.
+    hyperparameters. ``make()`` materializes the optax transform. Any
+    kwarg whose value is ``{"schedule": ...}`` materializes through
+    :func:`make_schedule`, so learning-rate schedules stay serializable::
+
+        OptimizerSpec("adamw", {"learning_rate": {
+            "schedule": "warmup_polynomial", "peak_value": 1e-4,
+            "warmup_steps": 1000, "decay_steps": 100_000}})
     """
 
     name: str = "sgd"
@@ -125,7 +196,12 @@ class OptimizerSpec:
         }
         if self.name not in registry:
             raise ValueError(f"unknown optimizer {self.name!r}; known: {sorted(registry)}")
-        return registry[self.name](**self.kwargs)
+        kwargs = {
+            k: make_schedule(v) if isinstance(v, dict) and "schedule" in v
+            else v
+            for k, v in self.kwargs.items()
+        }
+        return registry[self.name](**kwargs)
 
 
 class ModelItem:

@@ -108,6 +108,14 @@ def launch(
     code = chief.wait()
     if code == 0:
         coordinator.join()
+        if coordinator.any_failed:
+            # A worker died after the chief already exited cleanly (e.g.
+            # crash during teardown/final save): under supervision the
+            # failure action (chief.terminate) was a no-op by then, so the
+            # failure must surface in the return code — a clean-looking 0
+            # here would make the supervisor (and CI) report success.
+            logging.error("chief exited 0 but a worker failed; reporting failure")
+            code = 1
     cluster.terminate()
     return code
 
@@ -146,7 +154,10 @@ def launch_supervised(
             num_local_processes=num_local_processes,
             coordinator_port=coordinator_port,
             extra_env={"AUTODIST_RESTART": str(attempt)},
-            supervised=True,
+            # max_restarts=0 keeps exact unsupervised fail-fast semantics
+            # (immediate os._exit on worker death) — there is no restart
+            # loop to protect, so the reference behavior wins.
+            supervised=max_restarts > 0,
         )
         if code == 0 or attempt >= max_restarts:
             if code != 0:

@@ -694,7 +694,7 @@ class TestRestartSupervisor:
         def fake_launch(spec, argv, num_local_processes=0,
                         coordinator_port=None, extra_env=None,
                         supervised=False):
-            assert supervised  # the loop must take the non-exiting path
+            self.last_supervised = supervised
             calls.append((extra_env or {}).get("AUTODIST_RESTART"))
             return codes[len(calls) - 1]
 
@@ -715,9 +715,16 @@ class TestRestartSupervisor:
         assert len(calls) == 2
 
     def test_zero_restarts_is_plain_launch(self, monkeypatch):
+        # max_restarts=0: no loop to protect, keep exact unsupervised
+        # fail-fast semantics (supervised=False through to launch()).
         rc, calls = self._sup(monkeypatch, [3], max_restarts=0)
         assert rc == 3
         assert len(calls) == 1
+        assert self.last_supervised is False
+
+    def test_restart_budget_runs_supervised(self, monkeypatch):
+        self._sup(monkeypatch, [0], max_restarts=2)
+        assert self.last_supervised is True
 
 
 def test_supervised_failure_action_replaces_os_exit(tmp_path):
