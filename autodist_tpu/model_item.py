@@ -177,6 +177,10 @@ class OptimizerSpec:
 
     name: str = "sgd"
     kwargs: Dict[str, Any] = field(default_factory=dict)
+    # Global-norm gradient clipping applied BEFORE the optimizer update
+    # (optax.clip_by_global_norm chained in front) — the reference BERT
+    # recipe's clip-at-1.0 (bert_utils.py optimizer setup). None = off.
+    clip_norm: Optional[float] = None
 
     def make(self):
         import optax
@@ -201,7 +205,10 @@ class OptimizerSpec:
             else v
             for k, v in self.kwargs.items()
         }
-        return registry[self.name](**kwargs)
+        tx = registry[self.name](**kwargs)
+        if self.clip_norm is not None:
+            tx = optax.chain(optax.clip_by_global_norm(self.clip_norm), tx)
+        return tx
 
 
 class ModelItem:
@@ -445,7 +452,12 @@ class ModelItem:
                 }
                 for v in self._variables
             ],
-            "optimizer": {"name": self.optimizer_spec.name, "kwargs": self.optimizer_spec.kwargs},
+            "optimizer": {
+                "name": self.optimizer_spec.name,
+                "kwargs": self.optimizer_spec.kwargs,
+                **({"clip_norm": self.optimizer_spec.clip_norm}
+                   if self.optimizer_spec.clip_norm is not None else {}),
+            },
             **({"batch_size": self.batch_size} if self.batch_size is not None else {}),
         }
 

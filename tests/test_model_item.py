@@ -247,3 +247,25 @@ class TestSchedules:
         with pytest.raises(ValueError, match="exceed warmup_steps"):
             make_schedule({"schedule": "warmup_polynomial", "peak_value": 1e-4,
                            "warmup_steps": 10000, "decay_steps": 10000})
+
+    def test_clip_norm_chains_and_round_trips(self):
+        from autodist_tpu.model_item import ModelItem, OptimizerSpec
+
+        spec = OptimizerSpec("sgd", {"learning_rate": 1.0}, clip_norm=1.0)
+        item = ModelItem.from_params({"w": np.ones((2,), np.float32)},
+                                     optimizer_spec=spec)
+        rt = ModelItem.from_json(item.to_json())
+        assert rt.optimizer_spec.clip_norm == 1.0
+
+        tx = rt.optimizer_spec.make()
+        params = {"w": jnp.ones((2,), jnp.float32)}
+        state = tx.init(params)
+        big = {"w": jnp.full((2,), 30.0, jnp.float32)}  # ||g|| ~ 42.4
+        upd, _ = tx.update(big, state, params)
+        # Clipped to global norm 1.0, then sgd(lr=1) negates.
+        assert float(jnp.linalg.norm(upd["w"])) == pytest.approx(1.0, rel=1e-5)
+        # Default: no clipping.
+        tx2 = OptimizerSpec("sgd", {"learning_rate": 1.0}).make()
+        upd2, _ = tx2.update(big, tx2.init(params), params)
+        assert float(jnp.linalg.norm(upd2["w"])) == pytest.approx(
+            float(jnp.linalg.norm(big["w"])), rel=1e-5)
