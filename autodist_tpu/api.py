@@ -70,6 +70,23 @@ def _remat_policy(remat: Union[bool, str]):
         f"unknown remat policy {remat!r}; use True or one of {_REMAT_POLICIES}")
 
 
+def _resolve_optimizer(optimizer):
+    """(OptimizerSpec, optax transform) from the user's optimizer argument.
+
+    One resolution path for :meth:`AutoDist.build` and
+    :meth:`AutoDist.build_pipeline`: an :class:`OptimizerSpec` is
+    materialized; ``None`` gets the default spec; a raw optax transform is
+    wrapped as the opaque ``"custom"`` spec (planners then assume the
+    conservative worst-case slot count).
+    """
+    if isinstance(optimizer, OptimizerSpec):
+        return optimizer, optimizer.make()
+    if optimizer is None:
+        spec = OptimizerSpec("sgd", {"learning_rate": 0.01})
+        return spec, spec.make()
+    return OptimizerSpec("custom"), optimizer
+
+
 def get_default_autodist() -> Optional["AutoDist"]:
     return _default_autodist
 
@@ -278,13 +295,7 @@ class AutoDist:
         ``"dots_saveable"``) to keep MXU outputs and recompute the rest —
         the HBM-vs-FLOPs trade the TPU guide recommends.
         """
-        if isinstance(optimizer, OptimizerSpec):
-            opt_spec, tx = optimizer, optimizer.make()
-        elif optimizer is None:
-            opt_spec, tx = OptimizerSpec("sgd", {"learning_rate": 0.01}), None
-            tx = opt_spec.make()
-        else:
-            opt_spec, tx = OptimizerSpec("custom"), optimizer
+        opt_spec, tx = _resolve_optimizer(optimizer)
 
         model_item = ModelItem.from_params(
             params,
@@ -313,6 +324,34 @@ class AutoDist:
         )
         self._built, self._strategy, self._model_item = step, compiled, model_item
         return step
+
+    # ------------------------------------------------------------- pipeline
+    def build_pipeline(
+        self,
+        stage_fn: Callable,
+        loss_head: Callable,
+        n_microbatches: int,
+        optimizer: Union[OptimizerSpec, optax.GradientTransformation, None] = None,
+        donate_state: bool = True,
+    ):
+        """Pipeline-parallel train step over this AutoDist's mesh.
+
+        The pipelined counterpart of :meth:`build` for stage-stack models
+        (``stage_fn(stage_params, h) -> h`` shape-preserving, params given
+        stacked ``[S, ...]`` to ``init``): returns a
+        :class:`~autodist_tpu.parallel.PipelineTrainStep` with the same
+        ``init / __call__ / run / evaluate`` contract, running the
+        interleaved-1F1B schedule over the mesh ``pipe`` axis while the
+        batch shards over ``data`` (beyond-reference capability;
+        SURVEY.md §2.2 lists pipeline parallelism as absent upstream).
+        """
+        from autodist_tpu.parallel import PipelineTrainStep
+
+        _, tx = _resolve_optimizer(optimizer)
+        return PipelineTrainStep(
+            stage_fn, loss_head, tx, n_microbatches,
+            mesh=self.mesh, donate_state=donate_state,
+        )
 
     # ----------------------------------------------------------------- tune
     def tune(

@@ -8,6 +8,7 @@ and Ulysses-style all-to-all re-shards activations seq→heads so full-sequence
 flash attention runs locally (one ``lax.all_to_all`` each way).
 """
 from autodist_tpu.parallel.pipeline import (
+    PipelineTrainStep,
     pipeline_apply,
     pipeline_apply_local,
     pipeline_value_and_grad,
@@ -20,6 +21,7 @@ from autodist_tpu.parallel.ring_attention import (
 )
 
 __all__ = [
+    "PipelineTrainStep",
     "pipeline_apply",
     "pipeline_apply_local",
     "pipeline_value_and_grad",

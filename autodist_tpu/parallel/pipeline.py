@@ -510,3 +510,144 @@ def pipeline_value_and_grad(
         check_vma=False,
     )
     return sm(stacked_params, x, targets)
+
+
+# ----------------------------------------------------------------- train step
+class PipelineTrainStep:
+    """``DistributedTrainStep``-shaped surface over a pipelined stage stack.
+
+    Makes pipeline parallelism first-class in the user API
+    (``AutoDist.build_pipeline``) instead of a raw library call: the same
+    ``init / __call__ / run / evaluate`` contract the strategy-compiled
+    step exposes, backed by :func:`pipeline_value_and_grad` (interleaved
+    1F1B, O(S) live activations) with the stage stack sharded over the
+    ``pipe`` axis and the batch over the data axis (GSPMD composes the two
+    — the pipelined region is partial-manual over ``pipe`` only).
+
+    ``batch`` is ``(x, targets)`` (``targets=None`` for self-supervised
+    ``loss_head``\\s). Embedding/head layers stay outside the pipelined
+    region by design (module docstring); fold them into ``stage_fn`` s=0 /
+    s=S-1 branches or keep them replicated in ``stacked_params``-adjacent
+    state of your own.
+    """
+
+    def __init__(
+        self,
+        stage_fn: Callable,
+        loss_head: Callable,
+        tx,
+        n_microbatches: int,
+        mesh: Optional[Mesh] = None,
+        axis_name: str = const.MESH_AXIS_PIPE,
+        donate_state: bool = True,
+    ):
+        self.stage_fn = stage_fn
+        self.loss_head = loss_head
+        self.tx = tx
+        self.n_microbatches = n_microbatches
+        self.mesh = _resolve_mesh(mesh)
+        self.axis_name = axis_name
+        self._donate = donate_state
+        self._compiled = {}
+
+    # ----------------------------------------------------------- shardings
+    def _stage_spec(self, leaf) -> P:
+        rank = getattr(leaf, "ndim", 0)
+        if rank == 0:
+            return P()
+        return P(self.axis_name, *([None] * (rank - 1)))
+
+    def _state_shardings(self, state):
+        from jax.sharding import NamedSharding
+
+        n_stages = jax.tree_util.tree_leaves(state.params)[0].shape[0]
+
+        def spec(leaf):
+            # Optimizer slots mirror param shapes (leading [S] stage dim);
+            # scalar counters and unstacked leaves stay replicated.
+            if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == n_stages:
+                return NamedSharding(self.mesh, self._stage_spec(leaf))
+            return NamedSharding(self.mesh, P())
+
+        return jax.tree.map(spec, state)
+
+    # ----------------------------------------------------------------- api
+    def init(self, stacked_params):
+        from autodist_tpu.kernel.lowering import TrainState
+
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=stacked_params,
+            opt_state=self.tx.init(stacked_params),
+        )
+        return jax.device_put(state, self._state_shardings(state))
+
+    def _update(self, state, batch):
+        x, targets = batch
+        loss, grads, _ = pipeline_value_and_grad(
+            self.stage_fn, state.params, x, self.loss_head,
+            n_microbatches=self.n_microbatches, targets=targets,
+            mesh=self.mesh, axis_name=self.axis_name,
+        )
+        updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
+        import optax
+
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(
+            step=state.step + 1, params=params, opt_state=opt_state
+        ), {"loss": loss}
+
+    def _get(self, key, build):
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._compiled[key] = build()
+        return fn
+
+    def __call__(self, state, batch):
+        fn = self._get(("step",), lambda: jax.jit(
+            self._update,
+            donate_argnums=(0,) if self._donate else (),
+        ))
+        return fn(state, batch)
+
+    def run(self, state, batch, n_steps: int):
+        """``n_steps`` on ONE batch in a single device program (scan window
+        — same hot-loop contract as ``DistributedTrainStep.run``)."""
+
+        def build():
+            def multi(st, b):
+                def body(c, _):
+                    c, m = self._update(c, b)
+                    return c, m
+
+                return lax.scan(body, st, None, length=n_steps)
+
+            return jax.jit(
+                multi, donate_argnums=(0,) if self._donate else ())
+
+        return self._get(("run", int(n_steps)), build)(state, batch)
+
+    def evaluate(self, state, batch):
+        """Mean microbatched loss, no gradients or state mutation."""
+
+        def build():
+            def ev(params, b):
+                x, targets = b
+                out = pipeline_apply(
+                    self.stage_fn, params, x, self.n_microbatches,
+                    mesh=self.mesh, axis_name=self.axis_name,
+                )
+                mb = out.shape[0] // self.n_microbatches
+                outs = out.reshape((self.n_microbatches, mb) + out.shape[1:])
+                if targets is None:
+                    losses = jax.vmap(self.loss_head)(outs)
+                else:
+                    tgts = jax.tree.map(
+                        lambda t: t.reshape((self.n_microbatches, mb) + t.shape[1:]),
+                        targets)
+                    losses = jax.vmap(self.loss_head)(outs, tgts)
+                return {"loss": jnp.mean(losses)}
+
+            return jax.jit(ev)
+
+        return self._get(("eval",), build)(state.params, batch)
