@@ -183,7 +183,12 @@ def measure_workload(model_name: str, on_accel: bool) -> dict:
         unit_per = "images"
     else:
         if on_accel:
-            candidate_batches, steps = (64, 128), 20
+            # 256 rides the sweep's per-candidate OOM guard: its MLM logits
+            # ([256*128, 30522] bf16 ~ 2 GB + grads) may or may not fit
+            # beside the activations on a given chip generation; when it
+            # fits it can beat 128 on MXU utilization, and when it OOMs the
+            # smaller candidates' results are unaffected.
+            candidate_batches, steps = (64, 128, 256), 20
             model_kw = dict(max_seq_len=128)
         else:  # CPU smoke: shrink so the line still prints quickly
             candidate_batches, steps = (8,), 3
@@ -227,7 +232,33 @@ def measure_workload(model_name: str, on_accel: bool) -> dict:
         dt = sorted(trials)[len(trials) // 2]  # median trial
         return dt, float(metrics["loss"][-1])
 
+    def result_from(results: dict) -> dict:
+        batch_size = min(results, key=lambda bs: results[bs][0] / bs)
+        dt, last_loss = results[batch_size]
+        dev = jax.devices()[0]
+        seq = spec.config.max_seq_len if model_name == "bert" else 1
+        examples_per_sec = batch_size * steps / dt
+        units_per_sec = examples_per_sec * seq
+        flops_per_step = spec.flops_per_example * batch_size
+        achieved = flops_per_step * steps / dt
+        n_chips = jax.device_count()
+        peak_per_chip, peak_detected = _peak_flops(dev)
+        mfu = achieved / (peak_per_chip * n_chips) if on_accel else float("nan")
+        return {
+            "unit_per": unit_per,
+            "mfu": mfu,
+            "units_per_sec": units_per_sec,
+            "achieved": achieved,
+            "n_chips": n_chips,
+            "batch_size": batch_size,
+            "loss": last_loss,
+            "seq": seq,
+            "peak_detected": peak_detected,
+            "device": getattr(dev, "device_kind", dev.platform),
+        }
+
     results = {}
+    best = None
     for bs in candidate_batches:
         try:
             results[bs] = measure(bs)
@@ -235,32 +266,18 @@ def measure_workload(model_name: str, on_accel: bool) -> dict:
             # An OOM at a bigger candidate must not eat the result the
             # smaller one already produced.
             print(f"bench[{model_name}]: batch {bs} failed: {e}", file=sys.stderr)
+            continue
+        # Provisional emit after EVERY candidate: on the axon tunnel a
+        # bigger candidate can HANG (not raise), and a watchdog kill would
+        # otherwise discard the measurements that already succeeded — the
+        # parent recovers the last complete line from the dead child's
+        # stdout (_measure_in_subprocess).
+        best = result_from(results)
+        print(json.dumps({**best, "on_accel": on_accel,
+                          "provisional_after": bs}), flush=True)
     if not results:
         raise RuntimeError(f"{model_name}: every candidate batch size failed")
-    batch_size = min(results, key=lambda bs: results[bs][0] / bs)
-    dt, last_loss = results[batch_size]
-
-    dev = jax.devices()[0]
-    seq = spec.config.max_seq_len if model_name == "bert" else 1
-    examples_per_sec = batch_size * steps / dt
-    units_per_sec = examples_per_sec * seq
-    flops_per_step = spec.flops_per_example * batch_size
-    achieved = flops_per_step * steps / dt
-    n_chips = jax.device_count()
-    peak_per_chip, peak_detected = _peak_flops(dev)
-    mfu = achieved / (peak_per_chip * n_chips) if on_accel else float("nan")
-    return {
-        "unit_per": unit_per,
-        "mfu": mfu,
-        "units_per_sec": units_per_sec,
-        "achieved": achieved,
-        "n_chips": n_chips,
-        "batch_size": batch_size,
-        "loss": last_loss,
-        "seq": seq,
-        "peak_detected": peak_detected,
-        "device": getattr(dev, "device_kind", dev.platform),
-    }
+    return best
 
 
 def _format_result(measured: dict, errors: dict) -> tuple:
@@ -311,6 +328,20 @@ def _format_result(measured: dict, errors: dict) -> tuple:
     return result, on_accel
 
 
+def _last_json_line(out):
+    """Parse the last ``{``-prefixed line of (possibly bytes, possibly
+    truncated) child stdout; None when nothing parses."""
+    if isinstance(out, bytes):
+        out = out.decode(errors="replace")
+    for line in reversed((out or "").strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue  # killed mid-write: fall back to the previous line
+    return None
+
+
 def _measure_in_subprocess(name: str, cpu_smoke: bool, timeout_s: float):
     """Run one workload isolated in a child process.
 
@@ -329,16 +360,22 @@ def _measure_in_subprocess(name: str, cpu_smoke: bool, timeout_s: float):
         r = subprocess.run(
             cmd, timeout=timeout_s, capture_output=True, text=True,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # The child emits a provisional JSON line after every successful
+        # candidate batch exactly so a hang at a bigger candidate doesn't
+        # discard measurements that already landed: recover the last one.
+        partial = _last_json_line(e.stdout)
+        if partial is not None:
+            partial["note"] = (
+                f"watchdog killed the sweep after {timeout_s:.0f}s; "
+                f"result is the last completed candidate")
+            return partial, None
         return None, f"workload timed out after {timeout_s:.0f}s (tunnel wedge?)"
     if r.stderr:
         sys.stderr.write(r.stderr[-2000:])
-    for line in reversed(r.stdout.strip().splitlines()):
-        if line.startswith("{"):
-            try:
-                return json.loads(line), None
-            except ValueError:
-                break
+    parsed = _last_json_line(r.stdout)
+    if parsed is not None:
+        return parsed, None
     return None, f"workload exited rc={r.returncode} with no JSON line"
 
 

@@ -142,3 +142,15 @@ def test_format_result_mixed_accel_omits_cpu_mfu(bench):
     assert "resnet50_mfu" not in r
     assert "mid-bench" in r["resnet50_note"]
     _json.loads(_json.dumps(r))  # strictly serializable, no NaN tokens
+
+
+def test_last_json_line_recovers_partial_stdout(bench):
+    # Watchdog-killed child: recover the last provisional line from
+    # truncated/bytes stdout; garbage after it must not break recovery.
+    out = b'log noise\n{"a": 1}\n{"a": 2, "provisional_after": 128}\npartial trunc{'
+    assert bench._last_json_line(out) == {"a": 2, "provisional_after": 128}
+    assert bench._last_json_line(b"no json here") is None
+    assert bench._last_json_line(None) is None
+    # A final line killed mid-write falls back to the previous complete
+    # provisional line — losing it would defeat the recovery.
+    assert bench._last_json_line('{"a": 1}\n{"trunca') == {"a": 1}
