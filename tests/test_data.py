@@ -309,3 +309,29 @@ def test_from_files_process_slice_single_process(tmp_path):
                                       process_slice=True))
     for ba, bb in zip(a, b):
         np.testing.assert_array_equal(ba["x"], bb["x"])
+
+
+@pytest.mark.skipif(not native_available, reason="needs native engine")
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_native_shard_gather_randomized_splits(seed):
+    """Property check on the C++ shard table: ANY shard partition of the
+    rows must produce the identical batch stream (binary-searched gather
+    == single-buffer gather), shuffle on."""
+    rng = np.random.default_rng(seed)
+    data = dataset(n=int(rng.integers(60, 120)))
+    n = data["x"].shape[0]
+    n_cuts = int(rng.integers(1, 6))
+    cuts = sorted(rng.choice(np.arange(1, n), size=n_cuts, replace=False))
+    bounds = [0, *cuts, n]
+    sharded = {
+        k: [v[a:b] for a, b in zip(bounds[:-1], bounds[1:])]
+        for k, v in data.items()
+    }
+    whole = collect(DataLoader(data, batch_size=16, seed=seed, epochs=2,
+                               engine="native"))
+    split = collect(DataLoader(sharded, batch_size=16, seed=seed, epochs=2,
+                               engine="native", num_threads=3))
+    assert len(whole) == len(split)
+    for bw, bs in zip(whole, split):
+        for k in bw:
+            np.testing.assert_array_equal(bw[k], bs[k])
