@@ -11,8 +11,11 @@ prefetch threads' output. Randomness is derived from ``(seed, step)`` so
 every host applies identical augmentation to its slice (the multi-host
 determinism contract of ``DataLoader.transform``).
 
-Normalization constants match the reference
-(``imagenet_preprocessing.py`` ``CHANNEL_MEANS``); outputs are float32 NHWC,
+Default normalization matches the reference exactly: mean subtraction only
+(``imagenet_preprocessing.py`` ``_mean_image_subtraction`` with
+``CHANNEL_MEANS``; the reference never divides by a std). Pass
+``stds=CHANNEL_STDS`` to opt into the torchvision-style mean/std recipe —
+a deliberate extension, not reference parity. Outputs are float32 NHWC,
 ready for the model's own bf16 cast on device.
 """
 from __future__ import annotations
@@ -22,7 +25,8 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 # Reference CHANNEL_MEANS (imagenet_preprocessing.py: R=123.68, G=116.78,
-# B=103.94), kept in 0-255 scale.
+# B=103.94), kept in 0-255 scale. CHANNEL_STDS are the common ImageNet
+# stds (opt-in; the reference subtracts means only).
 CHANNEL_MEANS = (123.68, 116.78, 103.94)
 CHANNEL_STDS = (58.393, 57.12, 57.375)
 
@@ -38,7 +42,7 @@ def augment(
     flip: bool = True,
     normalize: bool = True,
     means: Sequence[float] = CHANNEL_MEANS,
-    stds: Sequence[float] = CHANNEL_STDS,
+    stds: Optional[Sequence[float]] = None,
     seed: int = 0,
 ):
     """Build a training transform: pad-random-crop + horizontal flip +
@@ -73,7 +77,8 @@ def augment(
         out = cropped.astype(np.float32)
         if normalize:
             out -= np.asarray(means, np.float32)
-            out /= np.asarray(stds, np.float32)
+            if stds is not None:
+                out /= np.asarray(stds, np.float32)
         new = dict(batch)
         new[image_key] = out
         return new
@@ -86,7 +91,7 @@ def eval_transform(
     crop: Optional[int] = None,
     normalize: bool = True,
     means: Sequence[float] = CHANNEL_MEANS,
-    stds: Sequence[float] = CHANNEL_STDS,
+    stds: Optional[Sequence[float]] = None,
 ):
     """Deterministic eval transform: center crop + normalize (the
     reference's eval path: resize + central_crop + mean subtraction)."""
@@ -101,7 +106,8 @@ def eval_transform(
         out = img.astype(np.float32)
         if normalize:
             out -= np.asarray(means, np.float32)
-            out /= np.asarray(stds, np.float32)
+            if stds is not None:
+                out /= np.asarray(stds, np.float32)
         new = dict(batch)
         new[image_key] = out
         return new

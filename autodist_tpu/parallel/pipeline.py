@@ -560,16 +560,53 @@ class PipelineTrainStep:
     def _state_shardings(self, state):
         from jax.sharding import NamedSharding
 
-        n_stages = jax.tree_util.tree_leaves(state.params)[0].shape[0]
+        # Params are stacked [S, ...] and always stage-sharded. Optimizer
+        # slots are stage-sharded when their FULL shape mirrors some param
+        # leaf's (Adam m/v etc.) or a single-axis reduction of one
+        # (factored second-moment row/col stats, adafactor-style: param
+        # [S, d1, d2] -> stats [S, d1] / [S, d2]); anything else — scalar
+        # counters, schedule states, custom hyperparameter vectors even of
+        # coincidental length S — stays replicated. (Matching on shape[0]
+        # alone would silently pipe-shard such a vector; reduced matches
+        # stay rank>=2 so a [S] vector never matches a factored stat.)
+        param_shapes = {
+            tuple(leaf.shape) for leaf in jax.tree_util.tree_leaves(state.params)
+        }
+        slot_shapes = set(param_shapes)
+        for shape in param_shapes:
+            dims = shape[1:]
+            for i in range(len(dims)):
+                reduced = shape[:1] + dims[:i] + dims[i + 1:]
+                if len(reduced) >= 2:
+                    slot_shapes.add(reduced)
 
-        def spec(leaf):
-            # Optimizer slots mirror param shapes (leading [S] stage dim);
-            # scalar counters and unstacked leaves stay replicated.
-            if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == n_stages:
+        def param_spec(leaf):
+            return NamedSharding(self.mesh, self._stage_spec(leaf))
+
+        def slot_spec(leaf):
+            if getattr(leaf, "ndim", 0) >= 1 and tuple(leaf.shape) in slot_shapes:
                 return NamedSharding(self.mesh, self._stage_spec(leaf))
             return NamedSharding(self.mesh, P())
 
-        return jax.tree.map(spec, state)
+        def replicated(leaf):
+            return NamedSharding(self.mesh, P())
+
+        # Every OTHER TrainState field (step, comp_state, stale_state, and
+        # anything future) maps to replicated — leaving a field holding raw
+        # values inside the shardings pytree would crash device_put the
+        # moment that field carries leaves.
+        import dataclasses
+
+        others = {
+            f.name: jax.tree.map(replicated, getattr(state, f.name))
+            for f in dataclasses.fields(state)
+            if f.name not in ("params", "opt_state")
+        }
+        return state.replace(
+            params=jax.tree.map(param_spec, state.params),
+            opt_state=jax.tree.map(slot_spec, state.opt_state),
+            **others,
+        )
 
     # ----------------------------------------------------------------- api
     def init(self, stacked_params):

@@ -70,6 +70,24 @@ DEFAULT_SPARSE_TOUCH = 0.05
 # XLA scratch and infeed buffers.
 HBM_USABLE_FRACTION = 0.75
 
+def _shard_weights(var: VarItem, node, n_dests: int) -> List[float]:
+    """Fraction of ``var``'s wire each shard destination carries.
+
+    Mirrors the floor/ceil row split the partitioner applies along the
+    active axis: dim rows over k shards gives ``dim % k`` shards one extra
+    row. Falls back to an even split when the axis is unknown (e.g. a
+    hand-built table on an unpartitioned node).
+    """
+    axis = node.active_partition_axis
+    if axis is None or axis >= len(var.shape) or n_dests <= 0:
+        return [1.0 / max(n_dests, 1)] * max(n_dests, 1)
+    dim = int(var.shape[axis])
+    base, rem = divmod(dim, n_dests)
+    rows = [base + 1 if i < rem else base for i in range(n_dests)]
+    total = float(sum(rows)) or 1.0
+    return [r / total for r in rows]
+
+
 def compressor_wire_factor(name: Optional[str], shape) -> float:
     """Wire-size multiplier for a gradient of ``shape`` under a compressor.
 
@@ -581,15 +599,32 @@ class CostModel:
             wire_dcn = (B * self.sparse_touch) if var.sparse_update else B
             load = 2.0 * (self.m - 1) * wire_dcn / self.bw_dcn
             node_dest = sync.reduction_destination or "chief"
+            if node.part_config and len(node.part_config) != node.num_shards:
+                # Same contract the lowering enforces (_fold_part_config):
+                # a mismatched shard table must not silently skew per-host
+                # load estimates for a strategy that could never lower.
+                raise ValueError(
+                    f"{node.var_name!r}: {len(node.part_config)} part "
+                    f"configs but partitioner {node.partitioner!r} implies "
+                    f"{node.num_shards}"
+                )
             shard_dests = [
                 p.synchronizer.reduction_destination or node_dest
                 for p in node.part_config
                 if isinstance(p.synchronizer, PSSynchronizer)
             ]
-            dests = shard_dests or [node_dest]
-            for d in dests:
-                host = d.split(":", 1)[0]
-                ps_loads[host] = ps_loads.get(host, 0.0) + load / len(dests)
+            if shard_dests:
+                # Each destination's NIC carries its shard's actual slice
+                # of the wire. Shards can be uneven (UnevenPartitionedPS
+                # splits a non-divisible axis floor/ceil), so weight by the
+                # shard's row count rather than splitting evenly.
+                weights = _shard_weights(var, node, len(shard_dests))
+                for d, w in zip(shard_dests, weights):
+                    host = d.split(":", 1)[0]
+                    ps_loads[host] = ps_loads.get(host, 0.0) + load * w
+            else:
+                host = node_dest.split(":", 1)[0]
+                ps_loads[host] = ps_loads.get(host, 0.0) + load
         act = 0.0
         n_coll = 2  # push + pull round
         return comm, update, act, params, extra, n_coll, ps_loads

@@ -53,8 +53,15 @@ JOBS = [
     ("inception_pad_ab", ["examples/benchmark/inception_pad_ab.py"], 1200),
     ("strategy_coverage", ["examples/benchmark/strategy_coverage.py"], 3600),
     ("calibrate", ["examples/benchmark/calibrate.py", "--out", "docs/measured"], 2700),
+    ("host_offload_ab", ["examples/benchmark/host_offload_ab.py"], 1200),
     ("bench_full", ["bench.py"], 5400),
 ]
+# Per-job env overrides (merged over os.environ). bench_full gets the full
+# budget its 5400s job timeout affords; bench's own default (3300s) is
+# conservative for unknown drivers.
+JOB_ENV = {
+    "bench_full": {"BENCH_BUDGET_S": "5100"},
+}
 MAX_FAILED_ATTEMPTS = 2   # genuine non-zero exits: the job itself is broken
 MAX_WEDGED_ATTEMPTS = 6   # environmental kills (tunnel wedge) retry more
 
@@ -107,6 +114,7 @@ def run_job(name: str, argv: list, timeout_s: float) -> str:
         r = subprocess.run(
             [sys.executable] + argv, cwd=ROOT,
             timeout=timeout_s, capture_output=True, text=True,
+            env={**os.environ, **JOB_ENV.get(name, {})},
         )
     except subprocess.TimeoutExpired as e:
         def _txt(x):
@@ -157,16 +165,88 @@ def main() -> None:
     # prevent. Stale locks (dead pid) are reclaimed.
     os.makedirs(QDIR, exist_ok=True)
     lock = os.path.join(QDIR, "driver.pid")
-    if os.path.exists(lock):
+    # Atomic acquisition via hard-link: the pid is written to a private temp
+    # file FIRST, then link() publishes it — so the lock path either doesn't
+    # exist or already carries a complete pid (a reader can never observe an
+    # empty lock from a live acquirer, which check-then-write or even
+    # O_EXCL-then-write would allow). On EEXIST, reclaim only if the holder
+    # is provably not a queue driver anymore: a recycled pid would pass
+    # os.kill(pid, 0), so confirm via /proc cmdline when possible.
+    def _acquire() -> bool:
+        tmp = f"{lock}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            f.write(str(os.getpid()))
+        try:
+            os.link(tmp, lock)
+            return True
+        except FileExistsError:
+            return False
+        finally:
+            os.unlink(tmp)
+
+    def _holder_alive() -> "int | None":
         try:
             old = int(open(lock).read().strip())
+        except OSError:
+            return None
+        except ValueError:
+            # Unparseable content cannot come from _acquire (link publishes
+            # a complete pid); treat a fresh foreign file as live to stay
+            # safe, a decayed one as stale.
+            try:
+                age = time.time() - os.stat(lock).st_mtime
+            except OSError:
+                return None
+            return -1 if age < 60.0 else None
+        try:
             os.kill(old, 0)
+        except OSError:
+            return None
+        try:
+            with open(f"/proc/{old}/cmdline", "rb") as f:
+                if b"run_tpu_queue" not in f.read():
+                    return None  # pid recycled by an unrelated process
+        except OSError:
+            pass  # no /proc: trust the kill(0) signal
+        return old
+
+    if not _acquire():
+        old = _holder_alive()
+        if old is not None:
             print(f"another queue driver (pid {old}) is running; exiting")
             return
-        except (ValueError, ProcessLookupError, PermissionError):
-            pass  # stale
-    with open(lock, "w") as f:
-        f.write(str(os.getpid()))
+        # Stale-lock reclaim happens under its OWN exclusive mutex: two
+        # starters that both judged the lock stale must not both remove it —
+        # the second remove would unlink the winner's freshly published live
+        # lock and admit a second driver. The loser of the reclaim mutex
+        # simply exits. A reclaim mutex abandoned by a crash (the reclaim
+        # section is a few syscalls long) decays after 120s.
+        reclaim = lock + ".reclaim"
+        try:
+            if time.time() - os.stat(reclaim).st_mtime > 120.0:
+                os.remove(reclaim)
+        except OSError:
+            pass
+        try:
+            fd = os.open(reclaim, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+            os.close(fd)
+        except FileExistsError:
+            print("another starting driver is reclaiming the stale lock; exiting")
+            return
+        try:
+            if _holder_alive() is None:  # re-check under the mutex
+                try:
+                    os.remove(lock)
+                except OSError:
+                    pass
+            if not _acquire():
+                print("queue-driver lock held after reclaim; exiting")
+                return
+        finally:
+            try:
+                os.remove(reclaim)
+            except OSError:
+                pass
 
     def _eligible(j):
         return (j.get("status") != "done"
@@ -220,7 +300,11 @@ def main() -> None:
         _log(f"queue complete: all {len(JOBS)} jobs done")
     finally:
         try:
-            os.remove(lock)
+            # Remove only OUR lock: if another driver legitimately reclaimed
+            # it (e.g. after this process was SIGKILLed and restarted with
+            # the same script), deleting theirs would admit a third driver.
+            if open(lock).read().strip() == str(os.getpid()):
+                os.remove(lock)
         except OSError:
             pass
 

@@ -154,3 +154,62 @@ def test_last_json_line_recovers_partial_stdout(bench):
     # A final line killed mid-write falls back to the previous complete
     # provisional line — losing it would defeat the recovery.
     assert bench._last_json_line('{"a": 1}\n{"trunca') == {"a": 1}
+
+
+def test_budget_clamps_probe_and_workload_windows(bench, monkeypatch):
+    # With the budget nearly spent, probes and child watchdogs must shrink
+    # to the remaining window instead of overshooting the driver deadline.
+    monkeypatch.setattr(bench.BUDGET, "total", 60.0)
+    monkeypatch.setattr(bench.BUDGET, "t0", bench.time.monotonic() - 50.0)
+    assert bench.BUDGET.clamp(300.0) <= 10.0 + 46.0  # remaining - reserve slack
+    out, err = bench._measure_in_subprocess("bert", cpu_smoke=True,
+                                            timeout_s=300.0)
+    # 10s left minus the 45s reserve -> refuses to even start the child.
+    assert out is None and "budget expired" in err
+
+
+def test_emergency_line_promotes_cached_accel(bench, tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "LAST_ACCEL_PATH",
+                        str(tmp_path / "bench_last_accel.json"))
+    bench._store_last_accel({"metric": "bert_base_mfu", "value": 0.69,
+                             "unit": "mfu", "vs_baseline": 1.38})
+    line = bench._emergency_line({"bert": "timed out"}, "budget expired")
+    assert line["metric"] == "bert_base_mfu_stale_cached"
+    assert line["value"] == 0.69 and line["vs_baseline"] == 1.38
+    assert line["bert_error"] == "timed out"
+    assert line["last_verified_accel_result"]["value"] == 0.69
+
+
+def test_emergency_line_without_cache_still_parseable(bench, tmp_path,
+                                                      monkeypatch):
+    import json as _json
+    monkeypatch.setattr(bench, "LAST_ACCEL_PATH", str(tmp_path / "absent.json"))
+    line = bench._emergency_line({}, "no workload completed")
+    parsed = _json.loads(_json.dumps(line))
+    assert parsed["metric"] == "bench_unavailable"
+    assert parsed["value"] == 0.0
+
+
+@pytest.mark.slow
+def test_wedged_bench_emits_line_within_budget(tmp_path):
+    # End-to-end wedge simulation (VERDICT r4 weak #1): probe children hang,
+    # the budget is tiny, and bench must still print ONE parseable JSON line
+    # and exit promptly instead of outliving the driver.
+    import subprocess
+    import time as _time
+
+    path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    env = {**os.environ,
+           "BENCH_BUDGET_S": "20",
+           "BENCH_PROBE_CODE": "import time; time.sleep(999)"}
+    t0 = _time.monotonic()
+    r = subprocess.run([sys.executable, path], env=env, timeout=90,
+                       capture_output=True, text=True)
+    elapsed = _time.monotonic() - t0
+    assert elapsed < 60, f"bench outlived its 20s budget by too much: {elapsed:.0f}s"
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON line emitted; stderr: {r.stderr[-500:]}"
+    parsed = json.loads(lines[-1])
+    assert "metric" in parsed and "value" in parsed
+    assert "budget" in parsed.get("error", "") or parsed["metric"].endswith(
+        "_stale_cached") or parsed["metric"] == "bench_unavailable"
