@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import os
 from contextlib import contextmanager
-from typing import Any, Callable, Optional, Sequence, Union
+from typing import Any, Callable, Optional, Sequence, TYPE_CHECKING, Union
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +44,9 @@ from autodist_tpu.model_item import ModelItem, OptimizerSpec
 from autodist_tpu.resource_spec import ResourceSpec
 from autodist_tpu.strategy import PSLoadBalancing, Strategy, StrategyBuilder, StrategyCompiler
 from autodist_tpu.utils import is_broadcast_leaf, logging
+
+if TYPE_CHECKING:  # circular at runtime: async_ps imports nothing from api
+    from autodist_tpu.runtime.async_ps import AsyncPSTrainer
 
 _default_autodist: Optional["AutoDist"] = None
 
@@ -276,8 +279,15 @@ class AutoDist:
         host_offload: Union[bool, str] = False,
         grad_accum_steps: int = 1,
         remat: Union[bool, str] = False,
-    ) -> DistributedTrainStep:
+    ) -> "Union[DistributedTrainStep, AsyncPSTrainer]":
         """Capture → strategy → compile → lower (autodist.py:139-150).
+
+        Returns a :class:`DistributedTrainStep` (SPMD path), or — when the
+        strategy carries ``sync=False`` PS nodes — a host-driven
+        :class:`autodist_tpu.runtime.async_ps.AsyncPSTrainer`, whose
+        ``run(state, next_batch_callable, n_pushes)`` signature differs
+        from the SPMD step's ``run(state, batch, n_steps)`` (asynchronous
+        pulls need a batch *source*, not one batch). See docs/async_ps.md.
 
         ``optimizer`` may be an :class:`OptimizerSpec` (serializable, lets
         builders see the optimizer) or a raw optax transform.
@@ -309,6 +319,12 @@ class AutoDist:
         )
         strategy = self._build_or_load_strategy(model_item)
         compiled = StrategyCompiler(model_item).compile(strategy)
+        async_trainer = self._maybe_build_async(
+            compiled, model_item, loss_fn, tx, has_aux=has_aux,
+            host_offload=host_offload, grad_accum_steps=grad_accum_steps,
+            remat=remat)
+        if async_trainer is not None:
+            return async_trainer
         plan = GraphTransformer(
             compiled, model_item, self.mesh, host_offload=host_offload
         ).transform()
@@ -324,6 +340,71 @@ class AutoDist:
         )
         self._built, self._strategy, self._model_item = step, compiled, model_item
         return step
+
+    # -------------------------------------------------------------- async
+    def _maybe_build_async(self, compiled, model_item, loss_fn, tx, *,
+                           has_aux, host_offload, grad_accum_steps, remat):
+        """Route ``sync=False`` strategies to the host-driven async PS.
+
+        The reference's asynchronous training mode (synchronizers.proto:28,
+        ps_synchronizer.py:553-630) has no SPMD rendering — lockstep jitted
+        programs cannot express "a worker that doesn't wait" — so the
+        asynchrony lives in the host dispatch schedule instead
+        (runtime/async_ps.py, docs/async_ps.md). Returns None for fully
+        synchronous strategies.
+        """
+        from autodist_tpu.strategy.ir import PSSynchronizer
+
+        def _syncs(node):
+            yield node.synchronizer
+            for p in node.part_config:
+                yield p.synchronizer
+
+        async_nodes = [
+            n for n in compiled.node_config
+            if any(isinstance(s, PSSynchronizer) and not s.sync
+                   for s in _syncs(n))
+        ]
+        if not async_nodes:
+            return None
+        if len(async_nodes) != len(compiled.node_config):
+            raise NotImplementedError(
+                "strategies mixing sync and async synchronizers have no "
+                "rendering: under the host-driven async loop every "
+                "variable's update applies per push. Make the strategy "
+                "uniformly sync or uniformly async (sync=False)."
+            )
+        unsupported = []
+        if host_offload:
+            unsupported.append("host_offload")
+        if grad_accum_steps != 1:
+            unsupported.append("grad_accum_steps")
+        if remat:
+            unsupported.append("remat")
+        if unsupported:
+            raise NotImplementedError(
+                f"async PS (sync=False) does not compose with "
+                f"{', '.join(unsupported)}; these knobs belong to the SPMD "
+                f"lowering path."
+            )
+        from autodist_tpu.runtime.async_ps import AsyncPSTrainer
+
+        staleness = max(
+            (s.staleness for n in async_nodes for s in _syncs(n)
+             if isinstance(s, PSSynchronizer)),
+            default=0,
+        )
+        n_workers = max(1, len(compiled.graph_config.replicas))
+        trainer = AsyncPSTrainer(
+            loss_fn, tx, n_workers=n_workers, staleness=staleness,
+            has_aux=has_aux,
+        )
+        self._built, self._strategy, self._model_item = (
+            trainer, compiled, model_item)
+        logging.info(
+            "sync=False strategy: routed to host-driven AsyncPSTrainer "
+            "(%d workers, staleness=%d)", n_workers, staleness)
+        return trainer
 
     # ------------------------------------------------------------- pipeline
     def build_pipeline(
@@ -603,7 +684,9 @@ class AutoDist:
 
     @property
     def plan(self) -> Optional[ShardingPlan]:
-        return self._built.plan if self._built else None
+        # AsyncPSTrainer has no sharding plan (host-driven engine): None,
+        # same as "not built yet", so function()'s guidance path still fires.
+        return getattr(self._built, "plan", None)
 
     @property
     def model_item(self) -> Optional[ModelItem]:

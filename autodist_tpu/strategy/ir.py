@@ -56,8 +56,9 @@ class PSSynchronizer:
     reduction_destination: str = ""  # DeviceSpec string, e.g. "10.0.0.1:CPU:0"
     local_replication: bool = False  # proxy-variable analog: keep a device-local cached copy
     # Serialization parity with the reference proto (synchronizers.proto:28);
-    # sync=False (async PS) has no SPMD rendering and is REJECTED at build
-    # and lowering time (strategy/base.check_sync_supported) — use
+    # sync=False (async PS) has no SPMD rendering — AutoDist.build routes it
+    # to the host-driven AsyncPSTrainer (runtime/async_ps.py), and direct
+    # lowering rejects it (strategy/base.check_sync_supported) — or use
     # staleness=K for bounded-staleness semantics.
     sync: bool = True
     staleness: int = 0               # bounded staleness in steps (0 = fully sync)

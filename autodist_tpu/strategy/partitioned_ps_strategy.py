@@ -9,7 +9,6 @@ from autodist_tpu.resource_spec import ResourceSpec
 from autodist_tpu.strategy.base import (
     StrategyBuilder,
     byte_size_load_fn,
-    check_sync_supported,
     min_divisor_shards,
     part_name,
     reduction_devices,
@@ -27,7 +26,6 @@ class PartitionedPS(StrategyBuilder):
     """
 
     def __init__(self, local_proxy_variable: bool = False, sync: bool = True, staleness: int = 0):
-        check_sync_supported(sync)
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness

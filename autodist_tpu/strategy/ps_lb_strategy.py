@@ -7,7 +7,6 @@ from autodist_tpu.resource_spec import ResourceSpec
 from autodist_tpu.strategy.base import (
     StrategyBuilder,
     byte_size_load_fn,
-    check_sync_supported,
     reduction_devices,
 )
 from autodist_tpu.strategy.ir import NodeConfig, PSSynchronizer, Strategy
@@ -17,7 +16,6 @@ class PSLoadBalancing(StrategyBuilder):
     """Greedy bin-packing of variables onto reduction destinations by bytes."""
 
     def __init__(self, local_proxy_variable: bool = False, sync: bool = True, staleness: int = 0):
-        check_sync_supported(sync)
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness

@@ -2,7 +2,7 @@
 (reference: strategy/ps_strategy.py:38-76)."""
 from autodist_tpu.model_item import ModelItem
 from autodist_tpu.resource_spec import ResourceSpec
-from autodist_tpu.strategy.base import StrategyBuilder, check_sync_supported, reduction_devices
+from autodist_tpu.strategy.base import StrategyBuilder, reduction_devices
 from autodist_tpu.strategy.ir import NodeConfig, PSSynchronizer, Strategy
 
 
@@ -14,7 +14,6 @@ class PS(StrategyBuilder):
     """
 
     def __init__(self, local_proxy_variable: bool = False, sync: bool = True, staleness: int = 0):
-        check_sync_supported(sync)
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness

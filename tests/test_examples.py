@@ -84,3 +84,19 @@ def test_long_context_example(monkeypatch, capsys):
     runpy.run_path("/root/repo/examples/long_context.py", run_name="__main__")
     out = capsys.readouterr().out
     assert "impl=ring" in out and "->" in out
+
+
+@pytest.mark.slow
+def test_async_ps_example(monkeypatch, capsys):
+    import runpy
+
+    import autodist_tpu as ad
+
+    ad.AutoDist.reset_default()
+    monkeypatch.setattr(sys, "argv", ["async_ps.py"])
+    runpy.run_path("/root/repo/examples/async_ps.py", run_name="__main__")
+    out = capsys.readouterr().out
+    assert "async :" in out and "sync  :" in out
+    line = json.loads([l for l in out.splitlines() if l.startswith("{")][-1])
+    assert line["max_lag"] <= line["ssp_bound"]
+    ad.AutoDist.reset_default()
