@@ -260,6 +260,13 @@ def main() -> None:
                     if _eligible(st["jobs"].get(n, {}))]
             if not todo:
                 break
+            if all(time.time() + t > deadline for _, _, t in todo):
+                # Nothing left can finish before the deadline (the round
+                # driver's own bench run follows it): stop rather than
+                # spinning probes until the clock runs out.
+                _log(f"{len(todo)} jobs pending but none fit the remaining "
+                     f"window; stopping early")
+                break
             if not probe():
                 _log(f"tunnel wedged; {len(todo)} jobs pending; sleeping "
                      f"{args.probe_interval:.0f}s")
@@ -267,8 +274,13 @@ def main() -> None:
                 continue
             _log(f"tunnel HEALTHY; running {len(todo)} pending jobs")
             for name, argv, timeout_s in todo:
-                if time.time() > deadline:
-                    break
+                if time.time() + timeout_s > deadline:
+                    # Never START a job that could outlive the deadline:
+                    # the round driver runs its own bench right after, and
+                    # a straggler job would double-book the tunnel with it.
+                    _log(f"job {name}: skipped (timeout {timeout_s:.0f}s "
+                         f"would overrun the driver deadline)")
+                    continue
                 j = st["jobs"].setdefault(name, {})
                 j["status"] = "running"
                 _save_state(st)
