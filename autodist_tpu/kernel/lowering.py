@@ -865,7 +865,10 @@ class DistributedTrainStep:
         manual over the data axis only, with other mesh axes left to GSPMD
         (partial-manual shard_map).
         """
-        from autodist_tpu.kernel.compressor import get_compressor
+        from autodist_tpu.kernel.compressor import (
+            canonical_compressor_name,
+            get_compressor,
+        )
 
         ax = data_axis(plan.mesh)
         sizes = dict(zip(plan.mesh.axis_names, plan.mesh.devices.shape))
@@ -873,7 +876,7 @@ class DistributedTrainStep:
         platform = plan.mesh.devices.flat[0].platform
         out = {}
         for name, p in plan.var_plans.items():
-            if p.compressor in ("", "NoneCompressor"):
+            if canonical_compressor_name(p.compressor or "") in ("", "NoneCompressor"):
                 continue
             if any(e == ax or (isinstance(e, tuple) and ax in e) for e in p.pspec):
                 logging.warning(

@@ -88,16 +88,22 @@ def _shard_weights(var: VarItem, node, n_dests: int) -> List[float]:
     return [r / total for r in rows]
 
 
-def compressor_wire_factor(name: Optional[str], shape) -> float:
-    """Wire-size multiplier for a gradient of ``shape`` under a compressor.
+def compressor_wire_factor(name: Optional[str], shape, nshards: int = 1,
+                           traced_shape=None) -> float:
+    """Wire-size multiplier for a gradient of ``shape`` under a compressor
+    synced over ``nshards`` data shards.
 
     Delegates to ``Compressor.wire_factor`` (kernel/compressor.py) so the
     priced payload is computed from the same rank/shape arithmetic as the
     collectives the compressor actually emits — e.g. PowerSGD's
     ``(m+k)·r / (m·k)`` instead of a flat guess (VERDICT r2 #9);
     ``tests/test_compressor.py`` pins the factor to real HLO payloads.
+    ``nshards`` matters only for gather-shaped compressors (TopK), whose
+    payload grows with the group size.
     """
-    if not name or name == "NoneCompressor":
+    from autodist_tpu.kernel.compressor import canonical_compressor_name
+
+    if not name or canonical_compressor_name(name) == "NoneCompressor":
         return 1.0
     from autodist_tpu.kernel.compressor import get_compressor
 
@@ -112,7 +118,14 @@ def compressor_wire_factor(name: Optional[str], shape) -> float:
             _warned_compressors.add(name)
             logging.warning("unknown compressor %r: pricing wire as dense", name)
         return 1.0
-    return float(comp.wire_factor(tuple(shape)))
+    try:
+        return float(comp.wire_factor(
+            tuple(shape), max(nshards, 1),
+            traced_shape=tuple(traced_shape) if traced_shape else None))
+    except TypeError:
+        # Third-party Compressor subclasses predating the traced_shape
+        # parameter.
+        return float(comp.wire_factor(tuple(shape), max(nshards, 1)))
 
 
 _warned_compressors: set = set()
@@ -488,8 +501,13 @@ class CostModel:
         if isinstance(sync, AllReduceSynchronizer):
             part_axis = node.active_partition_axis
             if var.sparse_update and part_axis is None:
+                from autodist_tpu.kernel.compressor import (
+                    canonical_compressor_name,
+                )
+
                 compressed = (
-                    sync.compressor not in ("", "NoneCompressor")
+                    canonical_compressor_name(sync.compressor or "")
+                    not in ("", "NoneCompressor")
                     and self.n_model == 1
                 )
                 if compressed:
@@ -523,7 +541,8 @@ class CostModel:
                 # Plain DP: one gradient all-reduce over the data group,
                 # compressed at the full gradient shape.
                 comm = self.allreduce_s(
-                    res * compressor_wire_factor(sync.compressor, var.shape))
+                    res * compressor_wire_factor(
+                        sync.compressor, var.shape, self.n_data))
             elif self.n_model > 1:
                 # Model-axis tensor parallelism (lowering _shard_axis_name:
                 # any non-trivial model axis wins): each chip holds a
@@ -539,7 +558,9 @@ class CostModel:
                         1, -(-slice_shape[part_axis] // shards))
                 comm = self.allreduce_s(
                     (res / shards)
-                    * compressor_wire_factor(sync.compressor, slice_shape))
+                    * compressor_wire_factor(
+                        sync.compressor, slice_shape, self.n_data,
+                        traced_shape=var.shape))
                 act = 2.0 * (
                     self._group_latency(self.n_shard)
                     + self._oneway_s(self._act_bytes_for(var), self.n_shard)

@@ -538,3 +538,207 @@ def test_compression_accum_tolerates_replicated_batch_leaves():
     state = step.init(params)
     new_state, metrics = step(state, batch)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_topk_full_ratio_matches_dense_psum():
+    """ratio=1.0 selects everything: TopK must reproduce the dense psum
+    mean exactly (the sparsifier's correctness anchor)."""
+    from autodist_tpu.kernel.compressor import TopKCompressor
+    from autodist_tpu.model_item import VarItem
+
+    comp = TopKCompressor(ratio=1.0, min_size=1)
+    n_shards, n_elems = 4, 32
+    var = VarItem(name="g", shape=(n_elems,), dtype="float32")
+    grads = jax.random.normal(jax.random.PRNGKey(7), (n_shards, n_elems))
+    local = jax.tree.map(
+        lambda x: jnp.tile(x[None], (n_shards, 1)), comp.init_local(var))
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:n_shards]), ("data",))
+    P = jax.sharding.PartitionSpec
+
+    def shardwise(g, l):
+        out, l2, _ = comp.step(
+            g[0], jax.tree.map(lambda x: x[0], l), {}, axis="data",
+            nshards=n_shards)
+        return out[None], jax.tree.map(lambda x: x[None], l2)
+
+    f = jax.shard_map(
+        shardwise, mesh=mesh,
+        in_specs=(P("data"), P("data")),
+        out_specs=(P("data"), P("data")),
+        axis_names={"data"}, check_vma=False,
+    )
+    out, local2 = f(grads, local)
+    expected = jnp.mean(grads, axis=0)
+    for s in range(n_shards):
+        np.testing.assert_allclose(np.asarray(out[s]), np.asarray(expected),
+                                   rtol=1e-6)
+    # Full selection leaves no residual.
+    np.testing.assert_allclose(np.asarray(local2["residual"]), 0.0, atol=1e-7)
+
+
+def test_topk_disjoint_supports_union():
+    """Two workers picking disjoint entries must land both contributions,
+    each averaged over the worker count (dense-psum semantics restricted
+    to the union support); everything unselected goes to the residual."""
+    from autodist_tpu.kernel.compressor import TopKCompressor
+    from autodist_tpu.model_item import VarItem
+
+    comp = TopKCompressor(ratio=0.25, min_size=1)  # k = 2 of 8
+    var = VarItem(name="g", shape=(8,), dtype="float32")
+    g0 = jnp.array([10.0, -9.0, 0.1, 0.2, 0.0, 0.0, 0.3, 0.1])
+    g1 = jnp.array([0.1, 0.2, -8.0, 7.0, 0.0, 0.1, 0.0, 0.2])
+    grads = jnp.stack([g0, g1])
+    local = jax.tree.map(
+        lambda x: jnp.tile(x[None], (2, 1)), comp.init_local(var))
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("data",))
+    P = jax.sharding.PartitionSpec
+
+    def shardwise(g, l):
+        out, l2, _ = comp.step(
+            g[0], jax.tree.map(lambda x: x[0], l), {}, axis="data", nshards=2)
+        return out[None], jax.tree.map(lambda x: x[None], l2)
+
+    f = jax.shard_map(
+        shardwise, mesh=mesh,
+        in_specs=(P("data"), P("data")), out_specs=(P("data"), P("data")),
+        axis_names={"data"}, check_vma=False,
+    )
+    out, local2 = f(grads, local)
+    expected = jnp.array([10.0, -9.0, -8.0, 7.0, 0, 0, 0, 0]) / 2.0
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(expected),
+                               rtol=1e-6)
+    # Residuals carry exactly the unselected mass, per worker.
+    np.testing.assert_allclose(np.asarray(local2["residual"][0]),
+                               np.asarray(g0).copy() * (np.abs(g0) < 9.0),
+                               rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_topk_ef_end_to_end_trains():
+    """Full pipeline: AllReduce(compressor=TopK) on an 8192-element weight
+    (above min_size, so real sparsification) still trains the quadratic."""
+    m, k = 128, 64
+    spec = ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+    mesh = build_mesh(spec, axes=("data",))
+    kp = jax.random.PRNGKey(3)
+    params = {"w": jax.random.normal(kp, (m, k)) * 0.1}
+
+    def mat_loss(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    batch = (jax.random.normal(kp, (BATCH, m)), jax.random.normal(kp, (BATCH, k)))
+    mi = ModelItem.from_params(
+        params, optimizer_spec=OptimizerSpec("sgd", {"learning_rate": 0.05}))
+    strategy = AllReduce(compressor="TopKCompressor").build(mi, spec)
+    plan = GraphTransformer(
+        StrategyCompiler(mi).compile(strategy), mi, mesh).transform()
+    step = DistributedTrainStep(plan, mat_loss, optax.sgd(0.05))
+    state = step.init(params)
+    losses = []
+    for _ in range(12):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    # 1% density updates ~82 of 8192 coords per step (plus EF ramp-up):
+    # expect steady but modest decrease, not the dense rate.
+    assert losses[-1] < losses[0] * 0.95, losses
+    assert losses[-1] < losses[len(losses) // 2], losses  # still descending
+
+
+def test_topk_wire_factor_and_aliases():
+    from autodist_tpu.kernel.compressor import TopKCompressor
+    from autodist_tpu.strategy.cost_model import compressor_wire_factor
+
+    tk = TopKCompressor(ratio=0.01, min_size=4096)
+    n_elems = 128 * 64
+    k = max(1, int(n_elems * 0.01))
+    # Gather payload grows with the group: factor = k*n/N.
+    assert tk.wire_factor((128, 64), nshards=8) == pytest.approx(k * 8 / n_elems)
+    assert tk.wire_factor((128, 64)) == pytest.approx(k / n_elems)
+    # Below min_size the dense psum path runs.
+    assert tk.wire_factor((16, 16), nshards=8) == 1.0
+    # Enough workers price the gathered pairs above dense — not clamped.
+    assert TopKCompressor(ratio=0.5, min_size=1).wire_factor(
+        (64,), nshards=4) == pytest.approx(2.0)
+    # Cost-model routing passes the group size through.
+    assert compressor_wire_factor("TopKCompressor", (128, 64), 8) == (
+        pytest.approx(k * 8 / n_elems))
+    # Friendly aliases resolve.
+    from autodist_tpu.kernel.compressor import (
+        HorovodCompressor, HorovodCompressorEF, PowerSGDCompressor)
+    assert isinstance(get_compressor("bf16"), HorovodCompressor)
+    assert isinstance(get_compressor("ef"), HorovodCompressorEF)
+    assert isinstance(get_compressor("powersgd"), PowerSGDCompressor)
+    assert isinstance(get_compressor("topk"), TopKCompressor)
+
+
+def test_topk_collective_payloads_match_wire_factor():
+    """The compiled HLO must carry k-element gather payloads, never the
+    dense 8192-element gradient (same methodology as the PowerSGD payload
+    test)."""
+    from test_sparse_wire import _collective_sizes
+
+    m, k = 128, 64
+    spec = ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+    mesh = build_mesh(spec, axes=("data",))
+    kp = jax.random.PRNGKey(0)
+    params = {"w": jax.random.normal(kp, (m, k))}
+
+    def mat_loss(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    batch = (jax.random.normal(kp, (BATCH, m)), jax.random.normal(kp, (BATCH, k)))
+    mi = ModelItem.from_params(
+        params, optimizer_spec=OptimizerSpec("sgd", {"learning_rate": 0.1}))
+    strategy = AllReduce(compressor="TopKCompressor").build(mi, spec)
+    plan = GraphTransformer(
+        StrategyCompiler(mi).compile(strategy), mi, mesh).transform()
+    step = DistributedTrainStep(plan, mat_loss, optax.sgd(0.1))
+    state = step.init(params)
+    hlo = step._compile(state, batch).lower(state, batch).compile().as_text()
+    sizes = _collective_sizes(hlo)
+    assert sizes, "expected collectives in the compressed step"
+    dense = m * k
+    topk_elems = max(1, int(dense * 0.01))
+    gather_cap = 8 * topk_elems  # all-gather output: n_shards x k
+    assert max(sizes) <= gather_cap, (
+        f"TopK collective carries {max(sizes)} elems "
+        f"(> gather cap {gather_cap}; dense={dense})")
+
+
+def test_none_alias_is_a_true_noop():
+    """compressor='none' must behave exactly like 'NoneCompressor': no
+    compressed shard_map region, identical HLO, identical cost ranking —
+    an active-but-identity region would make data-axis-sharded vars pay
+    full-size wire (the lowering warning's hazard)."""
+    from test_sparse_wire import _collective_sizes
+    from autodist_tpu.strategy.cost_model import CostModel
+
+    spec = ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+    mesh = build_mesh(spec, axes=("data",))
+    params = params0()
+
+    def program(compressor):
+        mi = ModelItem.from_params(
+            params, optimizer_spec=OptimizerSpec("sgd", {"learning_rate": 0.1}))
+        strategy = AllReduce(compressor=compressor).build(mi, spec)
+        plan = GraphTransformer(
+            StrategyCompiler(mi).compile(strategy), mi, mesh).transform()
+        step = DistributedTrainStep(plan, loss_fn, optax.sgd(0.1))
+        state = step.init(params)
+        batch = batch0()
+        hlo = step._compile(state, batch).lower(state, batch).compile().as_text()
+        cost = CostModel(mi, spec).strategy_cost(strategy)
+        return _collective_sizes(hlo), cost.total_s
+
+    sizes_canonical, cost_canonical = program("NoneCompressor")
+    sizes_alias, cost_alias = program("none")
+    assert sizes_alias == sizes_canonical
+    assert cost_alias == pytest.approx(cost_canonical)
