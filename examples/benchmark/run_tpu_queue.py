@@ -54,6 +54,7 @@ JOBS = [
     ("strategy_coverage", ["examples/benchmark/strategy_coverage.py"], 3600),
     ("calibrate", ["examples/benchmark/calibrate.py", "--out", "docs/measured"], 2700),
     ("host_offload_ab", ["examples/benchmark/host_offload_ab.py"], 1200),
+    ("async_ps", ["examples/async_ps.py"], 900),
     ("bench_full", ["bench.py"], 5400),
 ]
 # Per-job env overrides (merged over os.environ). bench_full gets the full
