@@ -540,19 +540,14 @@ def test_compression_accum_tolerates_replicated_batch_leaves():
     assert np.isfinite(float(metrics["loss"]))
 
 
-def test_topk_full_ratio_matches_dense_psum():
-    """ratio=1.0 selects everything: TopK must reproduce the dense psum
-    mean exactly (the sparsifier's correctness anchor)."""
-    from autodist_tpu.kernel.compressor import TopKCompressor
+def _run_topk_shardwise(comp, grads, n_shards):
+    """Shared harness: run comp.step per data shard over a [n_shards, N]
+    gradient stack; returns (synced [n_shards, N], local_state)."""
     from autodist_tpu.model_item import VarItem
 
-    comp = TopKCompressor(ratio=1.0, min_size=1)
-    n_shards, n_elems = 4, 32
-    var = VarItem(name="g", shape=(n_elems,), dtype="float32")
-    grads = jax.random.normal(jax.random.PRNGKey(7), (n_shards, n_elems))
+    var = VarItem(name="g", shape=grads.shape[1:], dtype="float32")
     local = jax.tree.map(
         lambda x: jnp.tile(x[None], (n_shards, 1)), comp.init_local(var))
-
     mesh = jax.sharding.Mesh(np.array(jax.devices()[:n_shards]), ("data",))
     P = jax.sharding.PartitionSpec
 
@@ -564,11 +559,22 @@ def test_topk_full_ratio_matches_dense_psum():
 
     f = jax.shard_map(
         shardwise, mesh=mesh,
-        in_specs=(P("data"), P("data")),
-        out_specs=(P("data"), P("data")),
+        in_specs=(P("data"), P("data")), out_specs=(P("data"), P("data")),
         axis_names={"data"}, check_vma=False,
     )
-    out, local2 = f(grads, local)
+    return f(grads, local)
+
+
+def test_topk_full_ratio_matches_dense_psum():
+
+    """ratio=1.0 selects everything: TopK must reproduce the dense psum
+    mean exactly (the sparsifier's correctness anchor)."""
+    from autodist_tpu.kernel.compressor import TopKCompressor
+
+    comp = TopKCompressor(ratio=1.0, min_size=1)
+    n_shards, n_elems = 4, 32
+    grads = jax.random.normal(jax.random.PRNGKey(7), (n_shards, n_elems))
+    out, local2 = _run_topk_shardwise(comp, grads, n_shards)
     expected = jnp.mean(grads, axis=0)
     for s in range(n_shards):
         np.testing.assert_allclose(np.asarray(out[s]), np.asarray(expected),
@@ -582,30 +588,12 @@ def test_topk_disjoint_supports_union():
     each averaged over the worker count (dense-psum semantics restricted
     to the union support); everything unselected goes to the residual."""
     from autodist_tpu.kernel.compressor import TopKCompressor
-    from autodist_tpu.model_item import VarItem
 
     comp = TopKCompressor(ratio=0.25, min_size=1)  # k = 2 of 8
-    var = VarItem(name="g", shape=(8,), dtype="float32")
     g0 = jnp.array([10.0, -9.0, 0.1, 0.2, 0.0, 0.0, 0.3, 0.1])
     g1 = jnp.array([0.1, 0.2, -8.0, 7.0, 0.0, 0.1, 0.0, 0.2])
     grads = jnp.stack([g0, g1])
-    local = jax.tree.map(
-        lambda x: jnp.tile(x[None], (2, 1)), comp.init_local(var))
-
-    mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("data",))
-    P = jax.sharding.PartitionSpec
-
-    def shardwise(g, l):
-        out, l2, _ = comp.step(
-            g[0], jax.tree.map(lambda x: x[0], l), {}, axis="data", nshards=2)
-        return out[None], jax.tree.map(lambda x: x[None], l2)
-
-    f = jax.shard_map(
-        shardwise, mesh=mesh,
-        in_specs=(P("data"), P("data")), out_specs=(P("data"), P("data")),
-        axis_names={"data"}, check_vma=False,
-    )
-    out, local2 = f(grads, local)
+    out, local2 = _run_topk_shardwise(comp, grads, 2)
     expected = jnp.array([10.0, -9.0, -8.0, 7.0, 0, 0, 0, 0]) / 2.0
     np.testing.assert_allclose(np.asarray(out[0]), np.asarray(expected),
                                rtol=1e-6)
@@ -742,3 +730,31 @@ def test_none_alias_is_a_true_noop():
     sizes_alias, cost_alias = program("none")
     assert sizes_alias == sizes_canonical
     assert cost_alias == pytest.approx(cost_canonical)
+
+
+def test_topk_decomposition_property_randomized():
+    """Property over random inputs/shard counts: per worker,
+    selected + residual == input exactly, and the synced output equals
+    the scatter-add mean of all selections (TopK's conservation law)."""
+    from autodist_tpu.kernel.compressor import TopKCompressor
+
+    rng = np.random.default_rng(0)
+    for trial in range(5):
+        n_shards = int(rng.choice([2, 4, 8]))
+        n_elems = int(rng.choice([16, 64, 256]))
+        ratio = float(rng.choice([0.1, 0.25, 0.5]))
+        comp = TopKCompressor(ratio=ratio, min_size=1)
+        grads = jnp.asarray(rng.normal(size=(n_shards, n_elems)), jnp.float32)
+        out, local2 = _run_topk_shardwise(comp, grads, n_shards)
+        selected = np.asarray(grads) - np.asarray(local2["residual"])
+        # Conservation: what was synced is exactly the mean of selections.
+        np.testing.assert_allclose(
+            np.asarray(out[0]), selected.sum(axis=0) / n_shards,
+            rtol=1e-5, atol=1e-6,
+            err_msg=f"trial {trial}: n={n_shards} N={n_elems} r={ratio}")
+        # Every shard sees the identical synced tensor.
+        for sh in range(1, n_shards):
+            np.testing.assert_array_equal(np.asarray(out[sh]), np.asarray(out[0]))
+        # Selection size: each worker contributed exactly k entries.
+        k = max(1, int(n_elems * ratio))
+        assert (np.count_nonzero(selected, axis=1) <= k).all()
