@@ -302,6 +302,15 @@ def canonical_compressor_name(name: str) -> str:
     return _ALIASES.get(name, name)
 
 
+def is_active_compressor(name: str) -> bool:
+    """True when ``name`` (IR string, alias or canonical) denotes a real
+    wire transformation — i.e. not empty and not the identity
+    NoneCompressor. The single predicate behind lowering's no-op skip,
+    the cost model's compressed-path pricing, and explain's lossy
+    classification; string-comparing anywhere else invites drift."""
+    return canonical_compressor_name(name or "") not in ("", "NoneCompressor")
+
+
 def get_compressor(name: str) -> Compressor:
     """Instantiate by strategy-IR name (AllReduceSynchronizer.compressor);
     lowercase aliases accepted (``bf16``/``ef``/``powersgd``/``topk``)."""

@@ -866,8 +866,8 @@ class DistributedTrainStep:
         (partial-manual shard_map).
         """
         from autodist_tpu.kernel.compressor import (
-            canonical_compressor_name,
             get_compressor,
+            is_active_compressor,
         )
 
         ax = data_axis(plan.mesh)
@@ -876,7 +876,7 @@ class DistributedTrainStep:
         platform = plan.mesh.devices.flat[0].platform
         out = {}
         for name, p in plan.var_plans.items():
-            if canonical_compressor_name(p.compressor or "") in ("", "NoneCompressor"):
+            if not is_active_compressor(p.compressor):
                 continue
             if any(e == ax or (isinstance(e, tuple) and ax in e) for e in p.pspec):
                 logging.warning(

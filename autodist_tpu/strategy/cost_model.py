@@ -187,6 +187,13 @@ def candidate_slate(
             ("RandomAxisPartitionAR", RandomAxisPartitionAR(chunk_size=chunk_size)),
             ("PartitionedPS", PartitionedPS()),
             ("UnevenPartitionedPS", UnevenPartitionedPS()),
+            # Compressed wires appear only in the exhaustive explain table:
+            # they change numerics (lossy), so Auto/tune must never pick
+            # one silently — the user opts in by naming the compressor.
+            ("AllReduce+bf16", AllReduce(chunk_size=chunk_size,
+                                         compressor="bf16")),
+            ("AllReduce+topk", AllReduce(chunk_size=chunk_size,
+                                         compressor="topk")),
         ])
     return slate
 
@@ -501,14 +508,10 @@ class CostModel:
         if isinstance(sync, AllReduceSynchronizer):
             part_axis = node.active_partition_axis
             if var.sparse_update and part_axis is None:
-                from autodist_tpu.kernel.compressor import (
-                    canonical_compressor_name,
-                )
+                from autodist_tpu.kernel.compressor import is_active_compressor
 
                 compressed = (
-                    canonical_compressor_name(sync.compressor or "")
-                    not in ("", "NoneCompressor")
-                    and self.n_model == 1
+                    is_active_compressor(sync.compressor) and self.n_model == 1
                 )
                 if compressed:
                     # Lowering parity for the compressed path: an active

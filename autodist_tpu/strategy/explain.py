@@ -118,6 +118,44 @@ def explain(
             file=out,
         )
     best = ranked[0][0] if ranked else "(none)"
+    # Lossy-wire candidates (active gradient compressors) may top the
+    # table but are never *recommended*: compression changes numerics, so
+    # the user opts in by naming the compressor, not by following a
+    # default recommendation.
+    from autodist_tpu.kernel.compressor import is_active_compressor
+
+    def _lossy(strategy) -> bool:
+        # Per-shard (part_config) compressors override node-level ones
+        # (ir.py fold contract), so both levels classify.
+        def syncs(node):
+            yield node.synchronizer
+            for p in node.part_config:
+                yield p.synchronizer
+
+        return any(
+            is_active_compressor(getattr(s, "compressor", "") or "")
+            for n in strategy.node_config
+            for s in syncs(n)
+        )
+
+    lossy_names = {name for name, s in built if _lossy(s)}
+    if best in lossy_names:
+        lossless = next((n for n, _ in ranked if n not in lossy_names), None)
+        if lossless is not None:
+            print(
+                f"\nrecommended: {lossless} (fastest priced: {best}, but "
+                f"its compressed wire changes numerics — opt in explicitly "
+                f"via its compressor knob)",
+                file=out,
+            )
+        else:
+            print(
+                f"\nrecommended: {best} — NOTE: every ranked candidate "
+                f"carries a compressed (lossy) wire; there is no lossless "
+                f"default here, so treat this as an explicit opt-in",
+                file=out,
+            )
+        return ranked
     print(f"\nrecommended: {best}", file=out)
     return ranked
 
