@@ -64,6 +64,29 @@ _REMAT_POLICIES = (
 )
 
 
+def _cast_compute(loss_fn: Callable, compute_dtype: str) -> Callable:
+    """Mixed-precision wrapper: params enter the loss in ``compute_dtype``
+    while the train state stays fp32 (master weights). Autodiff through
+    ``astype`` upcasts gradients back to the parameter dtype, so the
+    optimizer update runs full precision — the standard TPU policy (MXU
+    eats bf16, accumulation and weight updates stay fp32). Non-floating
+    leaves (embedding id tables etc.) pass through untouched.
+    """
+    dtype = jnp.dtype(compute_dtype)
+    if not jnp.issubdtype(dtype, jnp.floating):
+        raise ValueError(f"compute_dtype must be floating, got {compute_dtype!r}")
+
+    def cast(leaf):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf.astype(dtype)
+        return leaf
+
+    def wrapped(params, batch):
+        return loss_fn(jax.tree.map(cast, params), batch)
+
+    return wrapped
+
+
 def _remat_policy(remat: Union[bool, str]):
     if remat is True:
         return None
@@ -279,6 +302,7 @@ class AutoDist:
         host_offload: Union[bool, str] = False,
         grad_accum_steps: int = 1,
         remat: Union[bool, str] = False,
+        compute_dtype: Union[str, None] = None,
     ) -> "Union[DistributedTrainStep, AsyncPSTrainer]":
         """Capture → strategy → compile → lower (autodist.py:139-150).
 
@@ -304,6 +328,15 @@ class AutoDist:
         ~+1/3 FLOPs), or pass a ``jax.checkpoint_policies`` name (e.g.
         ``"dots_saveable"``) to keep MXU outputs and recompute the rest —
         the HBM-vs-FLOPs trade the TPU guide recommends.
+        ``compute_dtype="bfloat16"`` is the mixed-precision master-weight
+        policy: floating-point parameters are cast to the compute dtype on
+        entry to the loss (XLA fuses the casts into the consuming
+        matmuls, so the MXU sees bf16 operands and param HBM reads
+        halve), while the stored parameters, gradients, and optimizer
+        update stay full fp32 — autodiff through the cast upcasts the
+        gradient automatically. Zoo models already cast activations
+        internally; this knob brings user-supplied fp32 models onto the
+        same MXU contract without touching their code.
         """
         opt_spec, tx = _resolve_optimizer(optimizer)
 
@@ -319,6 +352,14 @@ class AutoDist:
         )
         strategy = self._build_or_load_strategy(model_item)
         compiled = StrategyCompiler(model_item).compile(strategy)
+        if compute_dtype is not None:
+            # Wrap AFTER ModelItem capture (like remat below): sparse
+            # detection must run on the bare loss_fn. Only floating leaves
+            # cast — integer tables/embedding ids pass through. BEFORE the
+            # async route, so mixed precision composes with sync=False
+            # (workers compute in bf16, the server's master weights stay
+            # fp32) and an invalid dtype fails fast on every path.
+            loss_fn = _cast_compute(loss_fn, compute_dtype)
         async_trainer = self._maybe_build_async(
             compiled, model_item, loss_fn, tx, has_aux=has_aux,
             host_offload=host_offload, grad_accum_steps=grad_accum_steps,
@@ -354,11 +395,7 @@ class AutoDist:
         synchronous strategies.
         """
         from autodist_tpu.strategy.ir import PSSynchronizer
-
-        def _syncs(node):
-            yield node.synchronizer
-            for p in node.part_config:
-                yield p.synchronizer
+        from autodist_tpu.strategy.ir import iter_synchronizers as _syncs
 
         async_nodes = [
             n for n in compiled.node_config

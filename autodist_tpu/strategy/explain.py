@@ -54,8 +54,15 @@ def explain(
     one (VERDICT r1 next #10: the model's predictions carry a measured
     anchor).
 
-    Returns the ranked ``[(name, StrategyCost), ...]`` (best first) so
-    callers can act on it programmatically.
+    Returns the ranked ``[(name, StrategyCost), ...]`` — the RAW cost
+    ranking, best-priced first. This may place a lossy compressed-wire
+    candidate (e.g. ``AllReduce+topk`` from the full slate) at index 0;
+    the printed ``recommended:`` headline applies the lossless-first
+    policy on top, and programmatic callers wanting the same safe default
+    must do likewise (classify with
+    ``kernel.compressor.is_active_compressor`` over
+    ``strategy.ir.iter_synchronizers``) rather than blindly adopting
+    ``ranked[0]``.
     """
     from autodist_tpu.strategy.cost_model import Calibration
 
@@ -123,19 +130,15 @@ def explain(
     # the user opts in by naming the compressor, not by following a
     # default recommendation.
     from autodist_tpu.kernel.compressor import is_active_compressor
+    from autodist_tpu.strategy.ir import iter_synchronizers
 
     def _lossy(strategy) -> bool:
         # Per-shard (part_config) compressors override node-level ones
-        # (ir.py fold contract), so both levels classify.
-        def syncs(node):
-            yield node.synchronizer
-            for p in node.part_config:
-                yield p.synchronizer
-
+        # (ir.py fold contract) — iter_synchronizers walks both levels.
         return any(
             is_active_compressor(getattr(s, "compressor", "") or "")
             for n in strategy.node_config
-            for s in syncs(n)
+            for s in iter_synchronizers(n)
         )
 
     lossy_names = {name for name, s in built if _lossy(s)}

@@ -180,6 +180,21 @@ class GraphConfig:
 # --------------------------------------------------------------------------- #
 # Strategy wrapper (reference: strategy/base.py:34-99)
 # --------------------------------------------------------------------------- #
+def iter_synchronizers(node: "NodeConfig"):
+    """Yield the node-level synchronizer then every per-shard one.
+
+    THE way to walk a node's synchronizers: per-shard (part_config)
+    settings override node-level ones under the fold contract (see
+    NodeConfig docstring), so any classification that reads only
+    ``node.synchronizer`` silently misses shard-level choices. Consumers:
+    async routing (api._maybe_build_async), explain's lossy-wire
+    classification.
+    """
+    yield node.synchronizer
+    for p in node.part_config:
+        yield p.synchronizer
+
+
 def _sync_to_json(s: Synchronizer) -> dict:
     return {"type": type(s).__name__, **dataclasses.asdict(s)}
 

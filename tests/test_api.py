@@ -298,3 +298,56 @@ class TestTune:
         with pytest.raises(RuntimeError, match="every candidate"):
             a.tune(loss_fn, params, batch, window=2,
                    candidates=[("boom", Exploding())])
+
+
+class TestComputeDtype:
+    """build(compute_dtype=...): mixed-precision master-weight policy."""
+
+    def _build(self, compute_dtype=None):
+        ad.AutoDist.reset_default()
+        import autodist_tpu.strategy as S
+
+        autodist = ad.AutoDist(strategy_builder=S.AllReduce())
+        k = jax.random.PRNGKey(0)
+        params = {"w": jax.random.normal(k, (32, 16)), "b": jnp.zeros((16,))}
+
+        def loss_fn(p, batch):
+            x, y = batch
+            return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+        batch = (jax.random.normal(k, (8, 32)), jax.random.normal(k, (8, 16)))
+        step = autodist.build(loss_fn, params, batch,
+                              compute_dtype=compute_dtype)
+        return step, params, batch
+
+    def test_master_weights_stay_f32_and_mxu_sees_bf16(self):
+        step, params, batch = self._build("bfloat16")
+        state = step.init(params)
+        # Stored parameters and optimizer state remain full precision.
+        assert state.params["w"].dtype == jnp.float32
+        hlo = step._compile(state, batch).lower(state, batch).as_text()
+        assert "bf16" in hlo, "no bf16 operand reached the lowered program"
+        state, metrics = step(state, batch)
+        assert state.params["w"].dtype == jnp.float32  # update ran in f32
+        assert np.isfinite(float(metrics["loss"]))
+        ad.AutoDist.reset_default()
+
+    def test_bf16_compute_tracks_f32_within_cast_tolerance(self):
+        step32, params, batch = self._build(None)
+        s32 = step32.init(params)
+        for _ in range(3):
+            s32, m32 = step32(s32, batch)
+        step16, params, batch = self._build("bfloat16")
+        s16 = step16.init(params)
+        for _ in range(3):
+            s16, m16 = step16(s16, batch)
+        np.testing.assert_allclose(float(m16["loss"]), float(m32["loss"]),
+                                   rtol=0.05)
+        np.testing.assert_allclose(np.asarray(s16.params["w"]),
+                                   np.asarray(s32.params["w"]), atol=0.05)
+        ad.AutoDist.reset_default()
+
+    def test_non_floating_compute_dtype_rejected(self):
+        with pytest.raises(ValueError, match="floating"):
+            self._build("int8")
+        ad.AutoDist.reset_default()

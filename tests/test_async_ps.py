@@ -245,3 +245,25 @@ def test_slow_worker_does_not_stall_the_fleet():
         f"fleet appears serialized behind the straggler: {wall:.1f}s "
         f">= 4.0s stall sum")
     assert np.isfinite(metrics["loss"]).all()
+
+
+def test_async_composes_with_compute_dtype():
+    # Mixed precision on the async path: workers compute in bf16, the
+    # server's master weights stay fp32 (the cast wrap runs before the
+    # async route, so the knob is honored, not silently dropped).
+    ad.AutoDist.reset_default()
+    autodist = ad.AutoDist(resource_spec=_rs(),
+                           strategy_builder=PS(sync=False))
+    params = init_params()
+    batch = make_batches(1)[0]
+    step = autodist.build(quad_loss, params, batch,
+                          compute_dtype="bfloat16")
+    assert isinstance(step, AsyncPSTrainer)
+    state = step.init(params)
+    state, metrics = step.run(state, lambda tick: batch, 4)
+    assert state.params["w"].dtype == jnp.float32  # master weights
+    assert np.isfinite(metrics["loss"]).all()
+    # And the invalid dtype fails fast on the async path too.
+    with pytest.raises(ValueError, match="floating"):
+        autodist.build(quad_loss, params, batch, compute_dtype="int8")
+    ad.AutoDist.reset_default()
