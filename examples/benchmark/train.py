@@ -63,6 +63,14 @@ def parse_args():
                    help="steps per device-side scan window (1 = per-step dispatch)")
     p.add_argument("--accum", type=int, default=1,
                    help="gradient accumulation microbatches per step")
+    p.add_argument("--compute-dtype", default="",
+                   help="mixed-precision policy, e.g. bfloat16 (bf16 "
+                        "compute, fp32 master weights); empty = model "
+                        "default")
+    p.add_argument("--remat", default="", type=str.lower,
+                   help="rematerialization: 'true' (save nothing), "
+                        "'false'/'off'/'' (disabled), or a "
+                        "jax.checkpoint_policies name like dots_saveable")
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--data-dir", default="",
                    help="stream batches from a sharded on-disk dataset "
@@ -99,6 +107,12 @@ def main():
     step = autodist.build(
         model.loss_fn, params, example, sparse_names=model.sparse_names,
         grad_accum_steps=args.accum,
+        compute_dtype=args.compute_dtype or None,
+        # 'true' -> True, false-likes -> off, anything else is a policy
+        # name that build() validates against jax.checkpoint_policies.
+        remat=(True if args.remat == "true"
+               else False if args.remat in ("", "false", "off")
+               else args.remat),
     )
     state = step.init(params)
 
@@ -192,6 +206,14 @@ def main():
         "steps_executed": steps_executed,
         "first_loss_to_last": [round(first_loss, 4), round(last_loss, 4)],
     }
+    # Record non-default build knobs so A/B runs are distinguishable in
+    # the emitted line (the --pin suffix already marks the feed mode).
+    if args.compute_dtype:
+        result["compute_dtype"] = args.compute_dtype
+    if args.remat not in ("", "false", "off"):
+        result["remat"] = args.remat
+    if args.accum > 1:
+        result["grad_accum_steps"] = args.accum
     if model.flops_per_example:
         result["model_tflops_per_sec"] = round(
             model.flops_per_example * s.get("items_per_sec", 0.0) / 1e12, 2
