@@ -452,6 +452,60 @@ def _run_one(name: str, cpu_smoke: bool) -> None:
     print(json.dumps(out))
 
 
+QUEUE_DRIVER_PIDFILE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "docs", "measured", "queue", "driver.pid",
+)
+
+
+def _queue_driver_alive(lock: str = None) -> bool:
+    """True when the pid in the queue driver's lock file is a live
+    run_tpu_queue process. EPERM from kill(0) means the process EXISTS
+    (owned by another uid) — that counts as alive, not dead."""
+    lock = lock or QUEUE_DRIVER_PIDFILE
+    try:
+        pid = int(open(lock).read().strip())
+    except (OSError, ValueError):
+        return False
+    try:
+        os.kill(pid, 0)
+    except PermissionError:
+        pass  # exists, different owner: alive
+    except OSError:
+        return False
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return b"run_tpu_queue" in f.read()
+    except OSError:
+        return True  # no /proc: trust the existence signal
+
+
+def _wait_for_queue_driver() -> None:
+    """If the TPU experiment-queue driver (run_tpu_queue.py) is mid-run,
+    wait for it — two processes through the axon tunnel deadlock it, and
+    the driver serializes all its own TPU work, so bench must not race a
+    queue job (or even its probe) with its own. Bounded: at most a third
+    of the bench budget, then proceed regardless (the emergency-line
+    guarantee still holds)."""
+    if os.environ.get("BENCH_QUEUE_CHILD"):
+        return  # spawned BY the driver: already serialized under it
+    wait_budget = BUDGET.total / 3.0
+    waited = 0.0
+    while (_queue_driver_alive() and waited < wait_budget
+           and BUDGET.remaining() > 60):
+        if waited == 0.0:
+            print("bench: TPU queue driver is running; waiting for it to "
+                  "finish (tunnel is single-occupancy)", file=sys.stderr)
+        time.sleep(20.0)
+        waited += 20.0
+    if waited and not _queue_driver_alive():
+        print(f"bench: queue driver exited after {waited:.0f}s; proceeding",
+              file=sys.stderr)
+    elif waited >= wait_budget:
+        print("bench: queue driver still running after the wait budget; "
+              "proceeding anyway", file=sys.stderr)
+
+
 def _emergency_line(errors: dict, reason: str) -> dict:
     """The line of last resort: nothing measured, but the driver-parseable
     contract ('bench always emits ONE JSON line') still holds. Carries the
@@ -504,6 +558,7 @@ def main() -> None:
     accel_ok = False
     wedged_mid_bench = False
     try:
+        _wait_for_queue_driver()
         # Probe BEFORE touching any backend: when the tunnel is wedged even
         # jax.devices() blocks forever. On probe failure fall back to the CPU
         # smoke measurement rather than hanging or reporting nothing. The

@@ -63,6 +63,10 @@ JOBS = [
 JOB_ENV = {
     "bench_full": {"BENCH_BUDGET_S": "5100"},
 }
+# Every child the driver spawns is already serialized under the driver's
+# lock — bench.py (and anything that shells out to it) must skip its
+# wait-for-queue-driver guard or it would stall on its own parent.
+BASE_JOB_ENV = {"BENCH_QUEUE_CHILD": "1"}
 MAX_FAILED_ATTEMPTS = 2   # genuine non-zero exits: the job itself is broken
 MAX_WEDGED_ATTEMPTS = 6   # environmental kills (tunnel wedge) retry more
 
@@ -115,7 +119,7 @@ def run_job(name: str, argv: list, timeout_s: float) -> str:
         r = subprocess.run(
             [sys.executable] + argv, cwd=ROOT,
             timeout=timeout_s, capture_output=True, text=True,
-            env={**os.environ, **JOB_ENV.get(name, {})},
+            env={**os.environ, **BASE_JOB_ENV, **JOB_ENV.get(name, {})},
         )
     except subprocess.TimeoutExpired as e:
         def _txt(x):

@@ -213,3 +213,43 @@ def test_wedged_bench_emits_line_within_budget(tmp_path):
     assert "metric" in parsed and "value" in parsed
     assert "budget" in parsed.get("error", "") or parsed["metric"].endswith(
         "_stale_cached") or parsed["metric"] == "bench_unavailable"
+
+
+def test_wait_for_queue_driver(bench, tmp_path, monkeypatch):
+    """Drives the real wait loop: live driver -> sleeps until it exits;
+    queue-child env -> exempt even while the driver is alive; EPERM from
+    kill(0) counts as alive (process exists under another uid)."""
+    sleeps = {"n": 0}
+    alive = {"value": True}
+    monkeypatch.setattr(bench, "_queue_driver_alive",
+                        lambda lock=None: alive["value"])
+
+    def fake_sleep(s):
+        sleeps["n"] += 1
+        if sleeps["n"] >= 3:
+            alive["value"] = False  # driver exits after ~3 polls
+
+    monkeypatch.setattr(bench.time, "sleep", fake_sleep)
+    bench._wait_for_queue_driver()
+    assert sleeps["n"] == 3  # the loop genuinely waited, then proceeded
+
+    # Exemption: the driver's own child must not wait on its parent.
+    sleeps["n"] = 0
+    alive["value"] = True
+    monkeypatch.setenv("BENCH_QUEUE_CHILD", "1")
+    bench._wait_for_queue_driver()
+    assert sleeps["n"] == 0
+
+
+def test_queue_driver_alive_pid_semantics(bench, tmp_path):
+    lock = tmp_path / "driver.pid"
+    # Absent / garbage / dead-pid files read as not-alive.
+    assert not bench._queue_driver_alive(str(lock))
+    lock.write_text("not-a-pid")
+    assert not bench._queue_driver_alive(str(lock))
+    lock.write_text("999999999")
+    assert not bench._queue_driver_alive(str(lock))
+    # A live pid that is NOT a run_tpu_queue process reads as not-alive
+    # (recycled-pid protection): use our own pid.
+    lock.write_text(str(os.getpid()))
+    assert not bench._queue_driver_alive(str(lock))
