@@ -1,0 +1,56 @@
+"""Pidfile liveness shared by the TPU queue driver and bench.
+
+The axon tunnel is single-occupancy: the queue driver
+(examples/benchmark/run_tpu_queue.py) publishes its pid in a lock file,
+and bench.py waits on it before touching the tunnel. Both sides MUST
+judge liveness identically — drift between two hand-rolled copies either
+races the tunnel (false-dead) or stalls for nothing (false-alive) — so
+the one rule lives here.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+
+def holder_alive(lock_path: str, cmdline_token: bytes = b"run_tpu_queue",
+                 fresh_grace_s: float = 60.0) -> Optional[int]:
+    """Who (if anyone) holds the pidfile lock.
+
+    Returns the holder's pid when the file names a live process whose
+    cmdline contains ``cmdline_token`` (recycled-pid protection); ``-1``
+    when the content is unparseable but the file is younger than
+    ``fresh_grace_s`` (a foreign-but-fresh file is treated as live to
+    stay safe — the driver's atomic link publish never leaves partial
+    content, so this only triggers on third-party files); ``None`` when
+    the lock is absent, stale, or held by a dead/unrelated process.
+
+    EPERM from ``kill(pid, 0)`` means the process EXISTS under another
+    uid — that counts as alive, not dead.
+    """
+    try:
+        raw = open(lock_path).read().strip()
+    except OSError:
+        return None
+    try:
+        pid = int(raw)
+    except ValueError:
+        try:
+            age = time.time() - os.stat(lock_path).st_mtime
+        except OSError:
+            return None
+        return -1 if age < fresh_grace_s else None
+    try:
+        os.kill(pid, 0)
+    except PermissionError:
+        pass  # exists, different owner: alive
+    except OSError:
+        return None
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            if cmdline_token not in f.read():
+                return None  # pid recycled by an unrelated process
+    except OSError:
+        pass  # no /proc: trust the existence signal
+    return pid

@@ -458,26 +458,24 @@ QUEUE_DRIVER_PIDFILE = os.path.join(
 )
 
 
+def _load_pidlock():
+    """Load the shared liveness rule by file path: the bench parent stays
+    light (no full autodist_tpu package import before the preflight)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "autodist_tpu", "utils", "pidlock.py")
+    spec = importlib.util.spec_from_file_location("_bench_pidlock", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _queue_driver_alive(lock: str = None) -> bool:
-    """True when the pid in the queue driver's lock file is a live
-    run_tpu_queue process. EPERM from kill(0) means the process EXISTS
-    (owned by another uid) — that counts as alive, not dead."""
-    lock = lock or QUEUE_DRIVER_PIDFILE
-    try:
-        pid = int(open(lock).read().strip())
-    except (OSError, ValueError):
-        return False
-    try:
-        os.kill(pid, 0)
-    except PermissionError:
-        pass  # exists, different owner: alive
-    except OSError:
-        return False
-    try:
-        with open(f"/proc/{pid}/cmdline", "rb") as f:
-            return b"run_tpu_queue" in f.read()
-    except OSError:
-        return True  # no /proc: trust the existence signal
+    """True when the queue driver's lock names a live holder — one shared
+    rule with the driver itself (autodist_tpu/utils/pidlock.py)."""
+    return _load_pidlock().holder_alive(
+        lock or QUEUE_DRIVER_PIDFILE) is not None
 
 
 def _wait_for_queue_driver() -> None:

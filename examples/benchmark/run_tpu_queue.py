@@ -42,6 +42,10 @@ JOBS = [
     ("bert_profile", ["examples/benchmark/profile_ops.py", "--model", "bert_base",
                       "--batch", "64", "--top", "15", "--out",
                       "docs/measured/bert_op_profile.json"], 1800),
+    # CPU-only artifact math: converts membw + the op profiles into the
+    # roofline verdict the ResNet-ceiling question needs (runs after the
+    # profiles; harmless and instant if artifacts are pending).
+    ("roofline_report", ["examples/benchmark/roofline_report.py"], 900),
     ("bert_seq512_flash", ["examples/benchmark/train.py", "--model", "bert_base",
                            "--batch-size", "32", "--steps", "40", "--window", "20",
                            "--pin", "--model-kwargs",
@@ -190,30 +194,17 @@ def main() -> None:
             os.unlink(tmp)
 
     def _holder_alive() -> "int | None":
-        try:
-            old = int(open(lock).read().strip())
-        except OSError:
-            return None
-        except ValueError:
-            # Unparseable content cannot come from _acquire (link publishes
-            # a complete pid); treat a fresh foreign file as live to stay
-            # safe, a decayed one as stale.
-            try:
-                age = time.time() - os.stat(lock).st_mtime
-            except OSError:
-                return None
-            return -1 if age < 60.0 else None
-        try:
-            os.kill(old, 0)
-        except OSError:
-            return None
-        try:
-            with open(f"/proc/{old}/cmdline", "rb") as f:
-                if b"run_tpu_queue" not in f.read():
-                    return None  # pid recycled by an unrelated process
-        except OSError:
-            pass  # no /proc: trust the kill(0) signal
-        return old
+        # One liveness rule, shared with bench.py's wait guard: live
+        # run_tpu_queue pid, or -1 for a fresh unparseable foreign file
+        # (treated live to stay safe). Loaded by path so the driver keeps
+        # zero package imports.
+        import importlib.util
+
+        path = os.path.join(ROOT, "autodist_tpu", "utils", "pidlock.py")
+        spec = importlib.util.spec_from_file_location("_queue_pidlock", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.holder_alive(lock)
 
     if not _acquire():
         old = _holder_alive()

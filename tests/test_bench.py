@@ -219,6 +219,7 @@ def test_wait_for_queue_driver(bench, tmp_path, monkeypatch):
     """Drives the real wait loop: live driver -> sleeps until it exits;
     queue-child env -> exempt even while the driver is alive; EPERM from
     kill(0) counts as alive (process exists under another uid)."""
+    monkeypatch.delenv("BENCH_QUEUE_CHILD", raising=False)
     sleeps = {"n": 0}
     alive = {"value": True}
     monkeypatch.setattr(bench, "_queue_driver_alive",
@@ -242,12 +243,18 @@ def test_wait_for_queue_driver(bench, tmp_path, monkeypatch):
 
 
 def test_queue_driver_alive_pid_semantics(bench, tmp_path):
+    # One shared rule with the driver (autodist_tpu/utils/pidlock.py).
     lock = tmp_path / "driver.pid"
-    # Absent / garbage / dead-pid files read as not-alive.
-    assert not bench._queue_driver_alive(str(lock))
-    lock.write_text("not-a-pid")
+    # Absent / dead-pid files read as not-alive.
     assert not bench._queue_driver_alive(str(lock))
     lock.write_text("999999999")
+    assert not bench._queue_driver_alive(str(lock))
+    # FRESH unparseable content is treated alive (safety: a foreign file
+    # mid-write must not be raced); once it decays past the grace window
+    # it reads stale.
+    lock.write_text("not-a-pid")
+    assert bench._queue_driver_alive(str(lock))
+    os.utime(lock, (os.path.getmtime(lock) - 3600, os.path.getmtime(lock) - 3600))
     assert not bench._queue_driver_alive(str(lock))
     # A live pid that is NOT a run_tpu_queue process reads as not-alive
     # (recycled-pid protection): use our own pid.
