@@ -72,13 +72,17 @@ def _eqn_flops(eqn) -> float:
                          if i not in set(rc) | set(rb)])) or 1
         return 2.0 * batch * m * n * contract
     if eqn.primitive.name == "conv_general_dilated":
+        # 2 x out_elems x (kernel spatial x in-channels) macs. EXACT for
+        # forward-shaped convs; gradient convs (wgrad expressed as a conv
+        # whose "kernel" operand is an activation tensor) over-count with
+        # this shape mapping, so whole-model FLOP totals from a jaxpr walk
+        # run high on conv nets — prefer a vetted per-example FLOP count
+        # (model.flops_per_example) for the arithmetic roofline and treat
+        # this as the fallback. The HBM envelopes are unaffected.
         out = eqn.outvars[0].aval
         rhs = eqn.invars[1].aval
         out_elems = int(np.prod(out.shape))
         rhs_elems = int(np.prod(rhs.shape))
-        # Per output element: 2 x (kernel spatial x in-channels) macs =
-        # 2 x rhs_elems / out_channels. ConvDimensionNumbers.rhs_spec[0]
-        # indexes the output-feature dim of the kernel.
         dn = eqn.params["dimension_numbers"]
         out_c = int(rhs.shape[dn.rhs_spec[0]]) if hasattr(dn, "rhs_spec") \
             else int(rhs.shape[-1])
