@@ -1,0 +1,188 @@
+"""Evaluation metrics over sharded models.
+
+The reference's vendored benchmark trainers tracked task metrics
+(top-1/top-5 for ImageNet, masked-LM accuracy for BERT, HR/NDCG for NCF)
+inside ~12.9k LoC of official-models code; the framework itself shipped
+none. Here metrics are a thin functional layer over the same contract
+the rest of the stack uses: a jitted ``(params, batch) -> {name: value}``
+function evaluated under the plan's parameter shardings, plus a
+weighted-average aggregator for dataset-scale evaluation.
+
+Usage::
+
+    from autodist_tpu import metrics
+
+    mfn = metrics.classification_metrics(model.apply, top_k=(1, 5))
+    results = metrics.evaluate_dataset(step, state, loader, metrics_fn=mfn)
+    # {"loss": 1.93, "top1": 0.71, "top5": 0.90, "examples": 50000}
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "accuracy",
+    "top_k_accuracy",
+    "perplexity",
+    "classification_metrics",
+    "lm_metrics",
+    "evaluate_dataset",
+]
+
+
+# ------------------------------------------------------------- pure metrics
+def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Fraction of rows whose argmax matches the integer label."""
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+
+
+def top_k_accuracy(logits: jnp.ndarray, labels: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Fraction of rows whose label lands in the k highest logits."""
+    _, top = jax.lax.top_k(logits, k)
+    hit = jnp.any(top == labels[..., None], axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))
+
+
+def perplexity(mean_loss) -> float:
+    """exp(cross-entropy) — the LM-convention view of a token loss."""
+    return float(np.exp(np.asarray(mean_loss)))
+
+
+# -------------------------------------------------------- metric factories
+def classification_metrics(
+    apply_fn: Callable[[Any, Any], Any],
+    input_key: str = "images",
+    label_key: str = "labels",
+    top_k: Sequence[int] = (1,),
+) -> Callable[[Any, Any], Dict[str, jnp.ndarray]]:
+    """(params, batch) -> {top1, top5, ...} for dict image/label batches
+    via the model's ``apply`` (every CNN zoo model exposes one)."""
+
+    def metrics_fn(params, batch):
+        logits = apply_fn(params, batch[input_key])
+        labels = batch[label_key]
+        out = {}
+        for k in top_k:
+            name = f"top{k}"
+            out[name] = (accuracy(logits, labels) if k == 1
+                         else top_k_accuracy(logits, labels, k))
+        return out
+
+    return metrics_fn
+
+
+def lm_metrics(
+    apply_fn: Callable[[Any, Any], Any],
+    token_key: str = "tokens",
+    shift: bool = True,
+    pad_id: Optional[int] = None,
+) -> Callable[[Any, Any], Dict[str, jnp.ndarray]]:
+    """(params, batch) -> {token_accuracy} for next-token LMs: the model's
+    logits at position t predict token t+1 (``shift=True``); ``pad_id``
+    positions are masked out of the average."""
+
+    def metrics_fn(params, batch):
+        tokens = batch[token_key]
+        logits = apply_fn(params, tokens)
+        if shift:
+            logits, targets = logits[:, :-1], tokens[:, 1:]
+        else:
+            targets = tokens
+        correct = (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32)
+        if pad_id is not None:
+            # Masked mean PLUS its weight: a per-batch mean over valid
+            # tokens must aggregate across batches weighted by the valid
+            # count, not the row count (the __weight convention
+            # evaluate_dataset consumes).
+            mask = (targets != pad_id).astype(jnp.float32)
+            n_valid = jnp.sum(mask)
+            return {
+                "token_accuracy": jnp.sum(correct * mask)
+                / jnp.maximum(n_valid, 1.0),
+                "token_accuracy__weight": n_valid,
+            }
+        return {"token_accuracy": jnp.mean(correct)}
+
+    return metrics_fn
+
+
+# ----------------------------------------------------------- aggregation
+def _batch_size(batch) -> int:
+    for leaf in jax.tree.leaves(batch):
+        if getattr(leaf, "ndim", 0) >= 1:
+            return int(leaf.shape[0])
+    return 0
+
+
+def _logical_params(step, state):
+    """The user-shaped parameter view the metrics_fn expects: unpadded
+    (pad-and-mask storage sliced back to logical shapes) and HBM-resident
+    (host-offloaded leaves streamed onto device) — the same handling the
+    step's own loss path applies (lowering.py unpad_params / _stream)."""
+    params = getattr(state, "params", state)
+    plan = getattr(step, "plan", None)
+    if plan is None:
+        return params
+    if getattr(plan, "has_offload", False):
+        params = jax.device_put(
+            params, plan.params_shardings(params, device_view=True))
+    if getattr(plan, "has_padding", False):
+        params = plan.unpad_params(params)
+    return params
+
+
+def evaluate_dataset(
+    step,
+    state,
+    batches: Iterable[Any],
+    metrics_fn: Optional[Callable[[Any, Any], Dict[str, Any]]] = None,
+    max_batches: Optional[int] = None,
+) -> Dict[str, float]:
+    """Weighted-average ``step.evaluate`` loss (+ optional task metrics)
+    over an iterable of batches (a DataLoader or any batch iterator).
+
+    Each metric's contribution is weighted by the batch's leading
+    dimension, so ragged tails average correctly; a metrics_fn may
+    override the weight for metric ``k`` by also returning
+    ``"<k>__weight"`` (masked metrics — ``lm_metrics(pad_id=...)`` counts
+    valid tokens this way). ``metrics_fn`` runs jitted against the
+    LOGICAL parameter view (unpadded, HBM-resident — the same handling
+    the step's own loss path applies). Returns
+    ``{"loss": ..., <metrics...>, "examples": N}``.
+    """
+    compiled_metrics = jax.jit(metrics_fn) if metrics_fn is not None else None
+    sums: Dict[str, float] = {}
+    weights: Dict[str, float] = {}
+    n_total = 0
+    logical = None
+    for i, batch in enumerate(batches):
+        if max_batches is not None and i >= max_batches:
+            break
+        n = _batch_size(batch)
+        if n == 0:
+            continue
+        out = step.evaluate(state, batch)
+        vals = {"loss": float(out["loss"])}
+        batch_weights = {}
+        if compiled_metrics is not None:
+            if logical is None:
+                logical = _logical_params(step, state)
+            m = {k: float(v) for k, v in
+                 compiled_metrics(logical, batch).items()}
+            batch_weights = {k[: -len("__weight")]: m.pop(k)
+                             for k in list(m) if k.endswith("__weight")}
+            vals.update(m)
+        for k, v in vals.items():
+            w = batch_weights.get(k, float(n))
+            sums[k] = sums.get(k, 0.0) + v * w
+            weights[k] = weights.get(k, 0.0) + w
+        n_total += n
+    if n_total == 0:
+        return {"examples": 0}
+    result = {k: (sums[k] / weights[k]) if weights[k] else 0.0 for k in sums}
+    result["examples"] = n_total
+    return result

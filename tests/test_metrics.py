@@ -1,0 +1,135 @@
+"""Metrics layer tests: hand-computed values + dataset aggregation over a
+real sharded step (c0 methodology)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import autodist_tpu as ad
+from autodist_tpu import metrics
+from autodist_tpu.models import get_model
+
+
+def test_accuracy_hand_computed():
+    logits = jnp.array([[5.0, 1.0, 0.0],
+                        [0.0, 3.0, 1.0],
+                        [1.0, 0.0, 2.0],
+                        [9.0, 8.0, 7.0]])
+    labels = jnp.array([0, 1, 0, 2])  # hits: row0, row1 -> 0.5
+    assert float(metrics.accuracy(logits, labels)) == pytest.approx(0.5)
+    # top-2: row2's label 0 is the 2nd highest (1.0 vs 2.0) -> hit;
+    # row3's label 2 is 3rd -> miss. 3/4.
+    assert float(metrics.top_k_accuracy(logits, labels, 2)) == pytest.approx(0.75)
+    assert metrics.perplexity(np.log(7.0)) == pytest.approx(7.0)
+
+
+def test_lm_metrics_shift_and_mask():
+    # Vocab 4; logits constructed so position t predicts token t+1 exactly
+    # for the first sequence and never for the second.
+    tokens = jnp.array([[1, 2, 3], [1, 0, 0]])
+
+    def apply_fn(params, toks):
+        # predict next token = toks shifted for row 0; constant 3 for row 1.
+        pred = jnp.where(jnp.arange(toks.shape[0])[:, None] == 0,
+                         jnp.roll(toks, -1, axis=1), 3)
+        return jax.nn.one_hot(pred, 4) * 10.0
+
+    mfn = metrics.lm_metrics(apply_fn)
+    out = mfn(None, {"tokens": tokens})
+    # Row 0: targets [2,3] predicted [2,3] -> 2 hits; row 1: targets [0,0]
+    # predicted [3,3] -> 0 hits. 2/4.
+    assert float(out["token_accuracy"]) == pytest.approx(0.5)
+    # pad_id=0 masks row 1's targets entirely -> 2/2.
+    mfn_m = metrics.lm_metrics(apply_fn, pad_id=0)
+    assert float(mfn_m(None, {"tokens": tokens})["token_accuracy"]) == (
+        pytest.approx(1.0))
+
+
+def test_evaluate_dataset_weighted_average_over_sharded_step():
+    ad.AutoDist.reset_default()
+    model = get_model("mlp", in_dim=8, hidden=(16,), num_classes=4)
+    params = model.init(jax.random.PRNGKey(0))
+    autodist = ad.AutoDist(strategy_builder=ad.strategy.AllReduce())
+    step = autodist.build(model.loss_fn, params, model.example_batch(8))
+    state = step.init(params)
+
+    full = model.example_batch(24)
+    batches = [
+        {k: v[:16] for k, v in full.items()},
+        {k: v[16:] for k, v in full.items()},   # ragged tail (8 rows)
+    ]
+    mfn = metrics.classification_metrics(model.apply, input_key="x", label_key="y", top_k=(1, 2))
+    got = metrics.evaluate_dataset(step, state, batches, metrics_fn=mfn)
+    assert got["examples"] == 24
+
+    # Hand aggregation: weighted by batch size == whole-set evaluation.
+    logits = model.apply(state.params, full["x"])
+    want_top1 = float(metrics.accuracy(logits, full["y"]))
+    want_top2 = float(metrics.top_k_accuracy(logits, full["y"], 2))
+    assert got["top1"] == pytest.approx(want_top1, abs=1e-6)
+    assert got["top2"] == pytest.approx(want_top2, abs=1e-6)
+    # Loss: weighted mean of per-batch losses equals whole-set loss for a
+    # mean-reduced objective.
+    want_loss = float(model.loss_fn(state.params, full))
+    assert got["loss"] == pytest.approx(want_loss, rel=1e-5)
+    assert got["examples"] == 24
+    ad.AutoDist.reset_default()
+
+
+def test_evaluate_dataset_empty_and_max_batches():
+    class FakeStep:
+        def evaluate(self, state, batch):
+            return {"loss": jnp.asarray(2.0)}
+
+    assert metrics.evaluate_dataset(FakeStep(), None, []) == {"examples": 0}
+    batches = [{"x": np.zeros((4, 2))}] * 5
+    got = metrics.evaluate_dataset(FakeStep(), None, batches, max_batches=2)
+    assert got["examples"] == 8 and got["loss"] == pytest.approx(2.0)
+
+
+def test_masked_metric_weighted_by_valid_tokens():
+    # Batch A: 2 valid tokens at accuracy 1.0; batch B: 8 valid tokens at
+    # accuracy 0.5. Row-weighted would say 0.75; token-weighted truth is
+    # (2*1 + 8*0.5) / 10 = 0.6.
+    class FakeStep:
+        def evaluate(self, state, batch):
+            return {"loss": jnp.asarray(0.0)}
+
+    def mfn(params, batch):
+        acc = batch["acc"][0]
+        n = batch["n"][0]
+        return {"token_accuracy": acc, "token_accuracy__weight": n}
+
+    batches = [
+        {"acc": jnp.array([1.0, 1.0]), "n": jnp.array([2.0, 2.0])},
+        {"acc": jnp.array([0.5, 0.5]), "n": jnp.array([8.0, 8.0])},
+    ]
+    got = metrics.evaluate_dataset(FakeStep(), None, batches, metrics_fn=mfn)
+    assert got["token_accuracy"] == pytest.approx(0.6)
+    assert got["loss"] == pytest.approx(0.0)
+
+
+def test_batch_size_skips_scalar_leaves():
+    assert metrics._batch_size({"alpha": jnp.float32(0.5),
+                                "x": np.zeros((7, 3))}) == 7
+    assert metrics._batch_size({"alpha": jnp.float32(0.5)}) == 0
+
+
+def test_metrics_on_padded_plan_uses_logical_params():
+    # Uneven-partition PS pads storage shapes; metrics_fn must see the
+    # LOGICAL shapes the model defines or apply() shape-mismatches.
+    ad.AutoDist.reset_default()
+    model = get_model("mlp", in_dim=7, hidden=(13,), num_classes=4)
+    params = model.init(jax.random.PRNGKey(0))
+    autodist = ad.AutoDist(strategy_builder=ad.strategy.UnevenPartitionedPS())
+    step = autodist.build(model.loss_fn, params, model.example_batch(8))
+    state = step.init(params)
+    full = model.example_batch(16)
+    mfn = metrics.classification_metrics(model.apply, input_key="x",
+                                         label_key="y", top_k=(1,))
+    got = metrics.evaluate_dataset(step, state, [full], metrics_fn=mfn)
+    logits = model.apply(
+        metrics._logical_params(step, state), full["x"])
+    assert got["top1"] == pytest.approx(
+        float(metrics.accuracy(logits, full["y"])), abs=1e-6)
+    ad.AutoDist.reset_default()
