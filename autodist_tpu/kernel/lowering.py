@@ -1387,6 +1387,7 @@ class DistributedTrainStep:
         eval_every: int = 0,
         log_every: int = 0,
         window: int = 0,
+        eval_metrics_fn=None,
     ):
         """Keras-``model.fit``-shaped training loop over an iterable of
         batches (a :class:`~autodist_tpu.data.DataLoader` or any batch
@@ -1395,7 +1396,11 @@ class DistributedTrainStep:
 
         Returns ``(state, history)`` where ``history["loss"]`` is the
         per-step loss and ``history["eval_loss"]`` the periodic eval losses
-        (``eval_every`` > 0 with ``eval_batch``).
+        (``eval_every`` > 0 with ``eval_batch``). ``eval_metrics_fn`` — a
+        ``(params, batch) -> {name: value}`` function (see
+        ``autodist_tpu.metrics`` factories) — adds ``history["eval_<name>"]``
+        series computed at the same eval points against the logical
+        parameter view.
 
         ``window=k`` (k > 1) bridges fit to the windowed hot loop: ``k``
         consecutive batches are stacked host-side and executed as ONE device
@@ -1411,9 +1416,10 @@ class DistributedTrainStep:
         if window and window > 1:
             return self._fit_windowed(
                 state, batches, steps, eval_batch, eval_every, log_every,
-                window)
+                window, eval_metrics_fn)
 
         history = {"loss": []}
+        eval_metrics = self._make_eval_metrics(eval_metrics_fn)
         if eval_every and eval_batch is not None:
             history["eval_loss"] = []
         # islice, not a break-on-index loop: breaking after enumerate() has
@@ -1430,12 +1436,61 @@ class DistributedTrainStep:
             if eval_every and eval_batch is not None and (i + 1) % eval_every == 0:
                 ev_loss = float(self.evaluate(state, eval_batch)["loss"])
                 history["eval_loss"].append(ev_loss)
+                eval_metrics(state, eval_batch, history)
                 if log_every:
                     logging.info("fit step %d: eval_loss=%.6f", i + 1, ev_loss)
         return state, history
 
+    def compile_metrics(self, metrics_fn, state: "TrainState"):
+        """Jit a ``(params, batch) -> {name: value}`` task-metric function
+        against this step's parameter handling: host-offloaded leaves
+        stream into HBM INSIDE the jitted program (the same `_stream`
+        evaluate uses — no eager whole-tree device_put per call) and
+        pad-and-mask storage is sliced back to logical shapes under the
+        trace. The ONE way to run user metrics on live state
+        (autodist_tpu.metrics.evaluate_dataset and fit's eval hook both
+        come through here). ``state`` supplies shapes only."""
+        if self.plan.has_offload:
+            shaped = jax.eval_shape(lambda: state).params
+            host_sh = self.plan.params_shardings(shaped)
+            dev_sh = self.plan.params_shardings(shaped, device_view=True)
+        else:
+            host_sh = dev_sh = None
+
+        def fn(params, batch):
+            if host_sh is not None:
+                params = _stream(params, host_sh, dev_sh)
+            params = self.plan.unpad_params(params)
+            return metrics_fn(params, batch)
+
+        return jax.jit(fn)
+
+    def _make_eval_metrics(self, eval_metrics_fn):
+        """Task-metric hook for fit's eval points: appends ``eval_<name>``
+        series to the history. ``<name>__weight`` entries (the masked-
+        metric convention of autodist_tpu.metrics.evaluate_dataset) are
+        stripped — a point-in-time series has no cross-batch weighting —
+        and a metric named ``loss`` records as ``eval_metrics_loss`` so it
+        can never interleave with the built-in ``eval_loss`` series."""
+        if eval_metrics_fn is None:
+            return lambda state, batch, history: None
+        compiled = None
+
+        def run(state, batch, history):
+            nonlocal compiled
+            if compiled is None:
+                compiled = self.compile_metrics(eval_metrics_fn, state)
+            out = compiled(state.params, batch)
+            for k, v in out.items():
+                if k.endswith("__weight"):
+                    continue
+                name = "eval_metrics_loss" if k == "loss" else f"eval_{k}"
+                history.setdefault(name, []).append(float(v))
+
+        return run
+
     def _fit_windowed(self, state, batches, steps, eval_batch, eval_every,
-                      log_every, window):
+                      log_every, window, eval_metrics_fn=None):
         """The ``fit(window=k)`` body: stack host batches, one dispatch per
         window. See :meth:`fit` for the contract.
 
@@ -1466,6 +1521,7 @@ class DistributedTrainStep:
             it = iter(batches)
 
         history = {"loss": []}
+        eval_metrics = self._make_eval_metrics(eval_metrics_fn)
         if eval_every and eval_batch is not None:
             history["eval_loss"] = []
 
@@ -1521,6 +1577,7 @@ class DistributedTrainStep:
                     and step_i % eval_every == 0):
                 ev_loss = float(self.evaluate(state, eval_batch)["loss"])
                 history["eval_loss"].append(ev_loss)
+                eval_metrics(state, eval_batch, history)
                 if log_every:
                     logging.info("fit step %d: eval_loss=%.6f", step_i, ev_loss)
         return state, history

@@ -119,20 +119,22 @@ def _batch_size(batch) -> int:
 
 
 def _logical_params(step, state):
-    """The user-shaped parameter view the metrics_fn expects: unpadded
-    (pad-and-mask storage sliced back to logical shapes) and HBM-resident
-    (host-offloaded leaves streamed onto device) — the same handling the
-    step's own loss path applies (lowering.py unpad_params / _stream)."""
-    params = getattr(state, "params", state)
-    plan = getattr(step, "plan", None)
-    if plan is None:
-        return params
-    if getattr(plan, "has_offload", False):
-        params = jax.device_put(
-            params, plan.params_shardings(params, device_view=True))
-    if getattr(plan, "has_padding", False):
-        params = plan.unpad_params(params)
-    return params
+    """The user-shaped parameter view — the step's own definition when
+    available (``DistributedTrainStep.logical_params`` handles pad-and-
+    mask storage), raw params otherwise. Offload streaming is handled by
+    ``step.compile_metrics`` inside the jitted program, not here."""
+    if hasattr(step, "logical_params"):
+        return step.logical_params(state)
+    return getattr(state, "params", state)
+
+
+def _compile(step, state, metrics_fn):
+    """Prefer the step's jit (streams offloaded leaves + unpads storage
+    inside the trace — lowering.compile_metrics); plain jit for foreign
+    step objects (tests, custom engines)."""
+    if hasattr(step, "compile_metrics"):
+        return step.compile_metrics(metrics_fn, state), True
+    return jax.jit(metrics_fn), False
 
 
 def evaluate_dataset(
@@ -154,7 +156,9 @@ def evaluate_dataset(
     the step's own loss path applies). Returns
     ``{"loss": ..., <metrics...>, "examples": N}``.
     """
-    compiled_metrics = jax.jit(metrics_fn) if metrics_fn is not None else None
+    compiled_metrics = step_jit = None
+    if metrics_fn is not None:
+        compiled_metrics, step_jit = _compile(step, state, metrics_fn)
     sums: Dict[str, float] = {}
     weights: Dict[str, float] = {}
     n_total = 0
@@ -169,10 +173,15 @@ def evaluate_dataset(
         vals = {"loss": float(out["loss"])}
         batch_weights = {}
         if compiled_metrics is not None:
-            if logical is None:
-                logical = _logical_params(step, state)
+            if step_jit:
+                # The step's jit streams/unpads internally: raw params in.
+                metric_params = getattr(state, "params", state)
+            else:
+                if logical is None:
+                    logical = _logical_params(step, state)
+                metric_params = logical
             m = {k: float(v) for k, v in
-                 compiled_metrics(logical, batch).items()}
+                 compiled_metrics(metric_params, batch).items()}
             batch_weights = {k[: -len("__weight")]: m.pop(k)
                              for k in list(m) if k.endswith("__weight")}
             vals.update(m)

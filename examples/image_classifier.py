@@ -60,6 +60,20 @@ def main():
             print(f"step {i}: loss={loss:.4f}")
     print(f"loss {first:.4f} -> {last:.4f}")
     assert last < first, "loss did not improve"
+
+    # Task metrics over the sharded eval path (autodist_tpu.metrics): the
+    # reference tracked accuracy inside its vendored benchmark trainers.
+    from autodist_tpu import metrics as M
+
+    eval_loader = DataLoader(
+        {"images": images, "labels": labels},
+        batch_size=args.batch_size, epochs=1, seed=2, plan=step.plan,
+    )
+    results = M.evaluate_dataset(
+        step, state, eval_loader,
+        metrics_fn=M.classification_metrics(model.apply, top_k=(1, 5)))
+    print(f"eval: loss={results['loss']:.4f} top1={results['top1']:.3f} "
+          f"top5={results['top5']:.3f} over {results['examples']} examples")
     print("OK")
 
 

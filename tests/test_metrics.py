@@ -133,3 +133,51 @@ def test_metrics_on_padded_plan_uses_logical_params():
     assert got["top1"] == pytest.approx(
         float(metrics.accuracy(logits, full["y"])), abs=1e-6)
     ad.AutoDist.reset_default()
+
+
+def test_fit_records_eval_metrics_series():
+    ad.AutoDist.reset_default()
+    model = get_model("mlp", in_dim=8, hidden=(16,), num_classes=4)
+    params = model.init(jax.random.PRNGKey(0))
+    autodist = ad.AutoDist(strategy_builder=ad.strategy.AllReduce())
+    step = autodist.build(model.loss_fn, params, model.example_batch(8))
+    state = step.init(params)
+    mfn = metrics.classification_metrics(model.apply, input_key="x",
+                                         label_key="y", top_k=(1,))
+    batches = [model.example_batch(8) for _ in range(6)]
+    eval_b = model.example_batch(16)
+    # Plain path.
+    state, hist = step.fit(state, iter(batches), eval_batch=eval_b,
+                           eval_every=2, eval_metrics_fn=mfn)
+    assert len(hist["eval_loss"]) == 3
+    assert len(hist["eval_top1"]) == 3
+    assert all(0.0 <= v <= 1.0 for v in hist["eval_top1"])
+    # Windowed path records the same series shape.
+    state, histw = step.fit(state, iter(batches), eval_batch=eval_b,
+                            eval_every=2, window=2, eval_metrics_fn=mfn)
+    assert len(histw["eval_top1"]) == 3
+    ad.AutoDist.reset_default()
+
+
+def test_fit_hook_strips_weights_and_renames_loss():
+    ad.AutoDist.reset_default()
+    model = get_model("mlp", in_dim=8, hidden=(16,), num_classes=4)
+    params = model.init(jax.random.PRNGKey(0))
+    autodist = ad.AutoDist(strategy_builder=ad.strategy.AllReduce())
+    step = autodist.build(model.loss_fn, params, model.example_batch(8))
+    state = step.init(params)
+
+    def mfn(p, batch):
+        return {"loss": jnp.float32(7.0),          # must NOT interleave
+                "acc": jnp.float32(0.5),
+                "acc__weight": jnp.float32(100.0)}  # must be stripped
+
+    batches = [model.example_batch(8) for _ in range(4)]
+    state, hist = step.fit(state, iter(batches),
+                           eval_batch=model.example_batch(8),
+                           eval_every=2, eval_metrics_fn=mfn)
+    assert len(hist["eval_loss"]) == 2          # built-in series untouched
+    assert hist["eval_metrics_loss"] == [7.0, 7.0]
+    assert hist["eval_acc"] == [0.5, 0.5]
+    assert "eval_acc__weight" not in hist
+    ad.AutoDist.reset_default()
