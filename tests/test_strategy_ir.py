@@ -83,3 +83,84 @@ def test_invalid_allreduce_spec_rejected():
 
 def test_ids_embed_fingerprint():
     assert "cafe0123" in Strategy.new_id("cafe0123")
+
+
+class TestIRFuzz:
+    """Robustness of the strategy-artifact boundary: strategies arrive as
+    JSON files shipped between hosts (the chief-builds/worker-loads
+    contract). The guarantee pinned here: a corrupted artifact either
+    (a) fails to parse with a clean, typed Python exception, or (b)
+    parses into an object that still serializes — never a half-
+    constructed object or a low-level crash. (Field-level type
+    validation happens downstream, at compile/lowering.)"""
+
+    def _valid_blob(self):
+        s = Strategy(id="fuzz")
+        s.graph_config.replicas = ["a:TPU:0", "a:TPU:1"]
+        s.node_config = [
+            NodeConfig(var_name="w",
+                       synchronizer=PSSynchronizer(
+                           reduction_destination="a:CPU:0"),
+                       partitioner="2,1"),
+        ]
+        return s.to_json()
+
+    def test_corrupted_blobs_fail_clean_or_stay_serializable(self):
+        import copy
+        import random
+
+        rng = random.Random(0)
+        base = self._valid_blob()
+
+        def all_paths(d, prefix=()):
+            out = []
+            if isinstance(d, dict):
+                for k, v in d.items():
+                    out.append(prefix + (k,))
+                    out.extend(all_paths(v, prefix + (k,)))
+            elif isinstance(d, list):
+                for i, v in enumerate(d):
+                    out.append(prefix + (i,))
+                    out.extend(all_paths(v, prefix + (i,)))
+            return out
+
+        for trial in range(60):
+            blob = copy.deepcopy(base)
+            path = rng.choice(all_paths(blob))
+            parent = blob
+            for k in path[:-1]:
+                parent = parent[k]
+            action = rng.choice(["delete", "retype", "null"])
+            if action == "delete":
+                # Real deletion for BOTH container kinds (a dict loses the
+                # key, a list genuinely shortens).
+                del parent[path[-1]]
+            elif action == "retype":
+                parent[path[-1]] = ["totally", {"wrong": "type"}]
+            else:
+                parent[path[-1]] = None
+            try:
+                s2 = Strategy.from_json(blob)
+            except (KeyError, ValueError, TypeError, AttributeError,
+                    IndexError):
+                continue  # clean, typed parse failure — the contract
+            # Parsed: must still be a whole object (serializes without
+            # error). Field-level garbage may survive parse by design.
+            s2.to_json()
+
+    def test_unknown_synchronizer_type_rejected_cleanly(self):
+        blob = self._valid_blob()
+        blob["node_config"][0]["synchronizer"]["type"] = "QuantumSynchronizer"
+        with pytest.raises(KeyError):
+            Strategy.from_json(blob)
+
+    def test_partitioner_garbage_rejected_at_validation(self):
+        blob = self._valid_blob()
+        blob["node_config"][0]["partitioner"] = "banana"
+        s = Strategy.from_json(blob)  # parse is lenient...
+        with pytest.raises(ValueError):
+            s.node_config[0].partition_axes  # ...validation is not
+
+    def test_roundtrip_equality_is_exact_for_valid_artifacts(self):
+        blob = self._valid_blob()
+        assert Strategy.from_json(blob).to_json() == blob
