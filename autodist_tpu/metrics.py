@@ -30,6 +30,7 @@ __all__ = [
     "perplexity",
     "classification_metrics",
     "lm_metrics",
+    "ranking_metrics",
     "evaluate_dataset",
 ]
 
@@ -106,6 +107,45 @@ def lm_metrics(
                 "token_accuracy__weight": n_valid,
             }
         return {"token_accuracy": jnp.mean(correct)}
+
+    return metrics_fn
+
+
+def ranking_metrics(
+    score_fn: Callable[[Any, Any, Any], Any],
+    user_key: str = "users",
+    item_key: str = "candidates",
+    k: int = 10,
+) -> Callable[[Any, Any], Dict[str, jnp.ndarray]]:
+    """HR@k / NDCG@k for implicit-feedback recommenders (the reference
+    NCF benchmark's metrics, utils/recommendation eval layout): each row
+    is one user with candidate items ``[C]`` whose POSITIVE sits in
+    column 0 and the rest are sampled negatives. ``score_fn(params,
+    users, items)`` scores equal-length user/item vectors (for the zoo
+    NeuMF: ``lambda p, u, i: model.apply(p, {"users": u, "items": i})``).
+
+    The positive's rank is the number of negatives scored strictly
+    higher (ties resolve in the positive's favor — matching argsort-less
+    hand counting); HR@k = rank < k, NDCG@k = 1/log2(rank+2) when hit.
+    """
+
+    def metrics_fn(params, batch):
+        users = batch[user_key]                    # [B]
+        cands = batch[item_key]                    # [B, C]
+        scores = jax.vmap(
+            lambda u, items: score_fn(
+                # Broadcast u in ITS OWN dtype: casting user ids to the
+                # candidate dtype could silently wrap when the user vocab
+                # outgrows the item dtype.
+                params, jnp.full(items.shape, u, u.dtype), items)
+        )(users, cands)                            # [B, C]
+        pos = scores[:, :1]
+        rank = jnp.sum((scores[:, 1:] > pos).astype(jnp.int32), axis=1)
+        hit = (rank < k).astype(jnp.float32)
+        ndcg = jnp.where(rank < k,
+                         1.0 / jnp.log2(rank.astype(jnp.float32) + 2.0),
+                         0.0)
+        return {f"hr@{k}": jnp.mean(hit), f"ndcg@{k}": jnp.mean(ndcg)}
 
     return metrics_fn
 

@@ -76,5 +76,6 @@ def neumf(
         init=lambda rng: init_params(rng, num_users, num_items, mf_dim, mlp_dims),
         loss_fn=loss_fn,
         example_batch=example_batch,
+        apply=lambda p, b: forward(p, b["users"], b["items"], n_mlp),
         sparse_names=("mf_user", "mf_item", "mlp_user", "mlp_item"),
     )

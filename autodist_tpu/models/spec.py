@@ -22,7 +22,11 @@ class ModelSpec:
     init: Callable[[Any], Any]                  # rng -> params pytree
     loss_fn: Callable[[Any, Any], Any]          # (params, batch) -> scalar loss
     example_batch: Callable[[int], Any]         # batch_size -> batch pytree
-    apply: Optional[Callable[..., Any]] = None  # (params, inputs) -> outputs
+    # (params, inputs) -> outputs. ``inputs`` is the model's raw input
+    # tensor for single-input models; multi-input models (NCF: user AND
+    # item ids) take the batch dict instead — pass a matching adapter to
+    # generic consumers (e.g. metrics.ranking_metrics's score_fn).
+    apply: Optional[Callable[..., Any]] = None
     sparse_names: tuple = ()                    # force-marked sparse params
     expert_names: tuple = ()                    # params with leading expert dim
     config: Any = None

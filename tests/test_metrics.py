@@ -181,3 +181,50 @@ def test_fit_hook_strips_weights_and_renames_loss():
     assert hist["eval_acc"] == [0.5, 0.5]
     assert "eval_acc__weight" not in hist
     ad.AutoDist.reset_default()
+
+
+def test_ranking_metrics_hand_computed():
+    # 3 users x 4 candidates (positive = column 0). Hand ranks:
+    #  u0: pos 0.9 beats all   -> rank 0 -> HR@2 hit, ndcg 1/log2(2)=1.0
+    #  u1: 1 negative higher   -> rank 1 -> HR@2 hit, ndcg 1/log2(3)
+    #  u2: 3 negatives higher  -> rank 3 -> miss, ndcg 0
+    table = jnp.array([
+        [0.9, 0.1, 0.2, 0.3],
+        [0.5, 0.8, 0.2, 0.1],
+        [0.1, 0.5, 0.6, 0.7],
+    ])
+
+    def score_fn(params, users, items):
+        return table[users[0], items]
+
+    batch = {"users": jnp.arange(3),
+             "candidates": jnp.tile(jnp.arange(4), (3, 1))}
+    out = metrics.ranking_metrics(score_fn, k=2)(None, batch)
+    assert float(out["hr@2"]) == pytest.approx(2 / 3)
+    want_ndcg = (1.0 + 1.0 / np.log2(3.0) + 0.0) / 3.0
+    assert float(out["ndcg@2"]) == pytest.approx(want_ndcg, rel=1e-6)
+
+
+def test_ranking_metrics_over_real_ncf():
+    ad.AutoDist.reset_default()
+    model = get_model("ncf", num_users=32, num_items=64, mf_dim=8,
+                      mlp_dims=(16, 8))
+    params = model.init(jax.random.PRNGKey(0))
+    autodist = ad.AutoDist(strategy_builder=ad.strategy.PSLoadBalancing())
+    step = autodist.build(model.loss_fn, params, model.example_batch(8),
+                          sparse_names=model.sparse_names)
+    state = step.init(params)
+    rng = np.random.default_rng(0)
+    eval_batch = {
+        "users": np.arange(8, dtype=np.int32),
+        "items": np.zeros((8,), np.int32),       # step.evaluate needs these
+        "labels": np.ones((8,), np.float32),
+        "candidates": rng.integers(0, 64, (8, 10)).astype(np.int32),
+    }
+    mfn = metrics.ranking_metrics(
+        lambda p, u, i: model.apply(p, {"users": u, "items": i}), k=5)
+    got = metrics.evaluate_dataset(step, state, [eval_batch], metrics_fn=mfn)
+    assert 0.0 <= got["hr@5"] <= 1.0
+    assert 0.0 <= got["ndcg@5"] <= 1.0
+    assert got["examples"] == 8
+    ad.AutoDist.reset_default()
