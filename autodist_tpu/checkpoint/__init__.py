@@ -15,5 +15,7 @@ the jitted apply function to StableHLO via ``jax.export``.
 """
 from autodist_tpu.checkpoint.saver import Saver
 from autodist_tpu.checkpoint.saved_model import SavedModelBuilder, load_saved_model
+from autodist_tpu.checkpoint.orbax_compat import export_orbax, import_orbax
 
-__all__ = ["Saver", "SavedModelBuilder", "load_saved_model"]
+__all__ = ["Saver", "SavedModelBuilder", "load_saved_model",
+           "export_orbax", "import_orbax"]

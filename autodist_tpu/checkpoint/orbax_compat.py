@@ -1,0 +1,100 @@
+"""Orbax interop: export/import train states to the JAX ecosystem format.
+
+The native :class:`~autodist_tpu.checkpoint.saver.Saver` owns the
+production path (sharding-agnostic block layout, owner-written shards,
+async + multi-host barriers, cross-sharding restore). This bridge exists
+for the ecosystem boundary the reference never had to serve: orbax is
+the de-facto JAX checkpoint format, and a user migrating between this
+framework and flax/orbax-based codebases should not need a conversion
+script.
+
+Contract: what crosses the boundary is the LOGICAL state view (every
+leaf in the model's own shapes — ``step.logical_state``), stored as a
+flat ``{"path/to/leaf": array}`` dict. Flat-by-name rather than a raw
+pytree so the restore side never depends on orbax reproducing an exact
+treedef across versions, and so foreign orbax checkpoints with matching
+names load too.
+
+Single-host export (leaves are fetched before writing); import re-pads
+and re-shards onto the live step's plan, so an orbax checkpoint restores
+into any mesh/strategy exactly like a native one.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from autodist_tpu.model_item import _path_to_name
+from autodist_tpu.utils import logging
+
+
+def _flatten(tree) -> dict:
+    """Flat ``{"path/to/leaf": np.array}`` view using THE canonical
+    path-to-name rule (model_item._path_to_name — the same strings var
+    plans and the native Saver key on; lowering.py pins that both sides
+    of any name match must share one implementation)."""
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        flat[_path_to_name(path)] = np.asarray(jax.device_get(leaf))
+    return flat
+
+
+def _unflatten_into(target, flat: dict):
+    """Fill ``target``'s structure from the flat name map; missing names
+    raise (a partial checkpoint must not silently half-restore)."""
+    paths, treedef = jax.tree_util.tree_flatten_with_path(target)
+    leaves = []
+    missing = []
+    for path, leaf in paths:
+        name = _path_to_name(path)
+        if name not in flat:
+            missing.append(name)
+            continue
+        got = np.asarray(flat[name])
+        want_shape = tuple(getattr(leaf, "shape", ()))
+        if tuple(got.shape) != want_shape:
+            raise ValueError(
+                f"orbax leaf {name!r} has shape {got.shape}, expected "
+                f"{want_shape} (checkpoints hold LOGICAL shapes)")
+        leaves.append(got.astype(leaf.dtype) if hasattr(leaf, "dtype") else got)
+    if missing:
+        raise KeyError(
+            f"orbax checkpoint is missing {len(missing)} leaves, e.g. "
+            f"{missing[:4]} — not a checkpoint of this state structure")
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def export_orbax(step, state, directory: str) -> str:
+    """Write ``state`` (logical view) as an orbax PyTree checkpoint."""
+    import orbax.checkpoint as ocp
+
+    logical = step.logical_state(state)
+    flat = _flatten(logical)
+    ocp.PyTreeCheckpointer().save(directory, flat)
+    logging.info("exported %d leaves to orbax -> %s", len(flat), directory)
+    return directory
+
+
+def import_orbax(step, params, directory: str):
+    """Build a fresh state and fill it from an orbax checkpoint written by
+    :func:`export_orbax` (or any orbax PyTree checkpoint whose flat names
+    match). Re-pads and re-shards onto the live plan — mesh/strategy may
+    differ from the writer's."""
+    import orbax.checkpoint as ocp
+
+    restored_tree = ocp.PyTreeCheckpointer().restore(directory)
+    # Normalize through _flatten: a no-op for our own flat round-trip
+    # dicts, and it collapses a foreign NESTED orbax pytree (the usual
+    # flax layout) onto the same slash-joined names.
+    flat = _flatten(restored_tree)
+    state0 = step.init(params)
+    logical_template = step.logical_state(state0)
+    restored_logical = _unflatten_into(logical_template, flat)
+    # pad_state is an identity on padding-free plans.
+    restored = step.plan.pad_state(restored_logical)
+    shardings = step.plan.state_shardings(jax.eval_shape(lambda: state0))
+    out = jax.device_put(restored, shardings)
+    logging.info("imported %d orbax leaves from %s", len(flat), directory)
+    return out
