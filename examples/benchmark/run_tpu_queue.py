@@ -34,6 +34,12 @@ STATE = os.path.join(QDIR, "state.json")
 # round healthy (#2).
 JOBS = [
     ("membw", ["examples/benchmark/membw.py"], 1500),
+    # Fresh headline EARLY: a short BERT-only bench right after membw so
+    # even a brief healthy window refreshes bench_last_accel.json — the
+    # round-end bench's fallback then embeds r5 device evidence instead
+    # of r3's. BENCH_REQUIRE_ACCEL makes a wedged fallback retry rather
+    # than count as done.
+    ("bench_quick", ["bench.py", "--model", "bert"], 1800),
     ("resnet_base", ["examples/benchmark/resnet_bounds.py", "base", "128", "20"], 900),
     ("resnet_dotstats", ["examples/benchmark/resnet_bounds.py", "dotstats", "128", "20"], 900),
     ("resnet_nchw", ["examples/benchmark/resnet_bounds.py", "nchw", "128", "20"], 900),
@@ -65,6 +71,10 @@ JOBS = [
 # budget its 5400s job timeout affords; bench's own default (3300s) is
 # conservative for unknown drivers.
 JOB_ENV = {
+    "bench_quick": {"BENCH_BUDGET_S": "1700",
+                    "BENCH_WORKLOAD_TIMEOUT": "1200",
+                    "BENCH_PREFLIGHT_TIMEOUTS": "120",
+                    "BENCH_REQUIRE_ACCEL": "1"},
     "bench_full": {"BENCH_BUDGET_S": "5100"},
 }
 # Every child the driver spawns is already serialized under the driver's
