@@ -178,12 +178,32 @@ def _preflight(timeouts=None, backoffs=None) -> bool:
 
 
 def _store_last_accel(result: dict) -> None:
-    """Cache a successful accelerator result for later wedge fallbacks."""
+    """Cache a successful accelerator result for later wedge fallbacks.
+
+    MERGES over the existing cache rather than replacing it: a bert-only
+    quick capture must refresh the headline without erasing cached resnet
+    evidence (each key keeps the newest value that ever carried it; keys
+    inherited from an older capture are flagged with their timestamp)."""
     try:
+        merged = dict(result)
+        inherited = []
+        try:
+            with open(LAST_ACCEL_PATH) as fh:
+                cached = json.load(fh)
+            for k, v in cached.get("result", {}).items():
+                if k not in merged and k not in ("stale_fields",
+                                                 "stale_fields_at"):
+                    merged[k] = v
+                    inherited.append(k)
+            if inherited:
+                merged["stale_fields"] = sorted(inherited)
+                merged["stale_fields_at"] = cached.get("at")
+        except (OSError, ValueError):
+            pass  # no prior cache
         with open(LAST_ACCEL_PATH, "w") as fh:
             json.dump({
                 "at": datetime.datetime.now(datetime.timezone.utc).isoformat(),
-                "result": result,
+                "result": merged,
             }, fh, indent=2)
     except OSError as e:
         print(f"bench: could not cache accel result: {e}", file=sys.stderr)
@@ -624,25 +644,28 @@ def main() -> None:
         sys.exit(1)
 
     result, on_accel = _format_result(measured, errors)
+    wedged_fallback = False
     if on_accel:
         _store_last_accel(result)
     elif accel_ok and not wedged_mid_bench:
         # Probe answered but the visible platform is CPU: there is no
         # accelerator on this host — saying "tunnel wedged" would be a
-        # false cause, and embedding cached accel evidence would imply a
-        # chip this host doesn't have.
+        # false cause, embedding cached accel evidence would imply a chip
+        # this host doesn't have, and (REQUIRE_ACCEL) retrying can never
+        # fix a permanent condition.
         result["note"] = "no accelerator visible on this host; CPU smoke run"
     else:
+        wedged_fallback = True
         result["error"] = (
             "accelerator unresponsive (tunnel wedged, retried preflight); "
             "CPU smoke fallback"
         )
         result = _embed_last_accel(result)
     print(json.dumps(result))
-    if not on_accel and os.environ.get("BENCH_REQUIRE_ACCEL"):
-        # Queue mode: a fallback line is not success — exit non-zero so the
-        # wedge-aware driver retries this job on the next healthy window
-        # instead of marking it done with no device data.
+    if wedged_fallback and os.environ.get("BENCH_REQUIRE_ACCEL"):
+        # Queue mode: a wedge fallback is not success — exit 4 (the
+        # driver maps it to 'wedged') so the job retries on the next
+        # healthy window instead of counting as done or genuinely failed.
         sys.exit(4)
 
 

@@ -154,6 +154,13 @@ def run_job(name: str, argv: list, timeout_s: float) -> str:
         if r.stderr:
             f.write("\n--- stderr ---\n" + r.stderr[-8000:])
     dt = time.time() - t0
+    if r.returncode == 4:
+        # The job's own environmental signal (bench BENCH_REQUIRE_ACCEL:
+        # wedge fallback, no device data). Mapped to 'wedged' DIRECTLY —
+        # a post-hoc probe can pass after the wedge cleared and would
+        # misclassify this as a genuine failure, burning the 2-strike cap.
+        _log(f"job {name}: wedged (rc=4, self-reported) in {dt:.0f}s")
+        return "wedged"
     if r.returncode != 0:
         _log(f"job {name}: FAILED rc={r.returncode} in {dt:.0f}s "
              f"(see {os.path.relpath(log_path, ROOT)})")

@@ -260,3 +260,19 @@ def test_queue_driver_alive_pid_semantics(bench, tmp_path):
     # (recycled-pid protection): use our own pid.
     lock.write_text(str(os.getpid()))
     assert not bench._queue_driver_alive(str(lock))
+
+
+def test_store_last_accel_merges_per_workload(bench, tmp_path, monkeypatch):
+    # A bert-only quick capture must refresh the headline WITHOUT erasing
+    # cached resnet evidence; inherited keys are flagged with their age.
+    monkeypatch.setattr(bench, "LAST_ACCEL_PATH",
+                        str(tmp_path / "last.json"))
+    bench._store_last_accel({"metric": "bert_base_mfu", "value": 0.60,
+                             "resnet50_mfu": 0.16})
+    bench._store_last_accel({"metric": "bert_base_mfu", "value": 0.70})
+    line = bench._embed_last_accel({})
+    cached = line["last_verified_accel_result"]
+    assert cached["value"] == 0.70            # newest headline wins
+    assert cached["resnet50_mfu"] == 0.16     # old evidence survives
+    assert "resnet50_mfu" in cached["stale_fields"]
+    assert cached["stale_fields_at"]
