@@ -306,3 +306,42 @@ def test_heterogeneous_node_chips_match_single_device():
         jax.device_get(step.logical_params(new_state)),
         jax.device_get(expected),
     )
+
+
+@pytest.mark.parametrize(
+    "builder",
+    [AllReduce(chunk_size=2), PartitionedPS(), Parallax()],
+    ids=["AllReduce", "PartitionedPS", "Parallax"],
+)
+def test_bf16_compute_tracks_f32_across_builders(builder):
+    """compute_dtype x lowering interaction: the mixed-precision cast wrap
+    (api._cast_compute) must compose with every synchronizer family —
+    including the sparse embedding path, where the integer id leaves must
+    NOT be cast. Master weights stay f32; the step tracks the f32 build
+    within bf16 tolerance."""
+    from autodist_tpu.api import _cast_compute
+
+    params, batch = embed_params(), embed_batch()
+    opt = OptimizerSpec("sgd", {"learning_rate": 0.05})
+    rs = ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+    mi = ModelItem.from_params(
+        params, optimizer_spec=opt, loss_fn=embed_loss, example_batch=batch)
+    assert mi.sparse_variables, "sparse detection must run on the bare loss"
+    strategy = StrategyCompiler(mi).compile(builder.build(mi, rs))
+    plan = GraphTransformer(strategy, mi, build_mesh(rs)).transform()
+    step = DistributedTrainStep(
+        plan, _cast_compute(embed_loss, "bfloat16"), opt.make())
+    state = step.init(params)
+    new_state, metrics = step(state, batch)
+    assert all(leaf.dtype == jnp.float32
+               for leaf in jax.tree.leaves(new_state.params))
+    expected = reference_step(embed_loss, params, batch, opt.make())
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=0.05),
+        jax.device_get(step.logical_params(new_state)),
+        jax.device_get(expected),
+    )
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(embed_loss(params, batch)), rtol=0.02)
