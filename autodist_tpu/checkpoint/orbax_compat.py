@@ -37,7 +37,15 @@ def _flatten(tree) -> dict:
     of any name match must share one implementation)."""
     flat = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        flat[_path_to_name(path)] = np.asarray(jax.device_get(leaf))
+        name = _path_to_name(path)
+        if name in flat:
+            # Possible with adversarial structures (dict key "0" next to a
+            # sequence index 0, or keys containing "/"); silently
+            # overwriting a leaf would corrupt the checkpoint.
+            raise ValueError(
+                f"flat name collision at {name!r}: two distinct leaves map "
+                f"to one checkpoint entry")
+        flat[name] = np.asarray(jax.device_get(leaf))
     return flat
 
 

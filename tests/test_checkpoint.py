@@ -568,3 +568,47 @@ class TestOrbaxInterop:
             np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                        rtol=1e-6)
         AutoDist.reset_default()
+
+
+def test_orbax_flatten_roundtrip_property_randomized():
+    # Property: _flatten/_unflatten_into invert each other over randomized
+    # nested structures (dicts, lists, tuples, mixed dtypes/ranks).
+    from autodist_tpu.checkpoint.orbax_compat import _flatten, _unflatten_into
+
+    rng = np.random.default_rng(3)
+
+    def rand_leaf():
+        rank = int(rng.integers(0, 3))
+        shape = tuple(int(rng.integers(1, 5)) for _ in range(rank))
+        dtype = rng.choice([np.float32, np.int32])
+        return (rng.standard_normal(shape) * 10).astype(dtype)
+
+    def rand_tree(depth):
+        if depth == 0 or rng.random() < 0.3:
+            return rand_leaf()
+        kind = rng.choice(["dict", "list", "tuple"])
+        n = int(rng.integers(1, 4))
+        if kind == "dict":
+            return {f"k{i}": rand_tree(depth - 1) for i in range(n)}
+        children = [rand_tree(depth - 1) for _ in range(n)]
+        return children if kind == "list" else tuple(children)
+
+    for trial in range(10):
+        tree = {"root": rand_tree(3)}   # dict root like a real state
+        flat = _flatten(tree)
+        back = _unflatten_into(tree, flat)
+        for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert len(flat) == len(jax.tree.leaves(tree)), f"trial {trial}"
+
+
+def test_orbax_flatten_rejects_name_collisions():
+    # A sequence index and a dict key containing "/" can map to the same
+    # flat name ("x/0"); silent overwrite would corrupt the checkpoint —
+    # must raise instead.
+    import pytest as _pytest
+
+    from autodist_tpu.checkpoint.orbax_compat import _flatten
+
+    with _pytest.raises(ValueError, match="collision"):
+        _flatten({"x": [np.zeros((2,))], "x/0": np.ones((3,))})

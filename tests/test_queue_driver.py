@@ -1,0 +1,72 @@
+"""Scheduling guards of the TPU experiment-queue driver.
+
+run_tpu_queue serializes all tunnel work; two properties protect the
+round-end bench from racing a straggler job: (a) a job whose timeout
+cannot finish before the driver deadline is never STARTED, and (b) when
+nothing left fits the window the driver stops instead of spinning
+probes. Also pins the rc=4 self-reported-wedge mapping and the atomic
+lock acquisition.
+"""
+import importlib.util
+import os
+import sys
+import types
+
+import pytest
+
+
+@pytest.fixture()
+def qd(tmp_path, monkeypatch):
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "benchmark", "run_tpu_queue.py")
+    spec = importlib.util.spec_from_file_location("queue_driver_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "QDIR", str(tmp_path))
+    monkeypatch.setattr(mod, "STATE", str(tmp_path / "state.json"))
+    return mod
+
+
+def test_deadline_skip_and_early_stop(qd, monkeypatch, capsys):
+    # Healthy tunnel, 0.01h (36s) budget: the 10s job fits and runs; the
+    # 9999s job is skipped; once only-unfittable jobs remain the driver
+    # stops early instead of probing until the clock runs out.
+    ran = []
+    monkeypatch.setattr(qd, "JOBS", [
+        ("tiny", ["x"], 10),
+        ("huge", ["x"], 9999),
+    ])
+    monkeypatch.setattr(qd, "probe", lambda timeout_s=150.0: True)
+    monkeypatch.setattr(qd, "run_job",
+                        lambda name, argv, t: (ran.append(name), "done")[1])
+    monkeypatch.setattr(sys, "argv", ["run_tpu_queue.py", "--max-hours", "0.01"])
+    with pytest.raises(SystemExit) as e:
+        qd.main()
+    assert e.value.code == 1  # incomplete: huge never ran
+    assert ran == ["tiny"]
+    log = (capsys.readouterr().out)
+    assert "skipped (timeout" in log or "none fit the remaining window" in log
+    assert "stopping early" in log
+
+
+def test_rc4_maps_to_wedged_directly(qd, tmp_path, monkeypatch):
+    class R:
+        returncode = 4
+        stdout = '{"metric": "x"}\n'
+        stderr = ""
+
+    monkeypatch.setattr(qd.subprocess, "run", lambda *a, **k: R())
+    status = qd.run_job("bench_quick", ["bench.py"], 60)
+    assert status == "wedged"
+
+
+def test_lock_is_atomic_and_owner_checked(qd, tmp_path, monkeypatch, capsys):
+    # A live foreign lock (our pid, but not a run_tpu_queue cmdline) is
+    # treated stale and reclaimed; main proceeds and cleans up only its
+    # own lock.
+    monkeypatch.setattr(qd, "JOBS", [])
+    monkeypatch.setattr(sys, "argv", ["run_tpu_queue.py", "--max-hours", "0.001"])
+    lock = tmp_path / "driver.pid"
+    lock.write_text(str(os.getpid()))  # not a queue driver -> stale
+    qd.main()
+    assert not lock.exists()  # reclaimed, used, cleaned up
