@@ -195,6 +195,13 @@ def evaluate_dataset(
     LOGICAL parameter view (unpadded, HBM-resident — the same handling
     the step's own loss path applies). Returns
     ``{"loss": ..., <metrics...>, "examples": N}``.
+
+    Multi-host: aggregation here is per-process (host-side Python). On a
+    fleet either feed every process the same eval batches (replicated
+    evaluation — results identical everywhere), or give each process a
+    disjoint shard and combine externally: per-metric sums are
+    recoverable as ``result[k] * result["examples"]`` (row-weighted
+    metrics), so they add across processes.
     """
     compiled_metrics = step_jit = None
     if metrics_fn is not None:
