@@ -71,6 +71,16 @@ HBM_BY_ACCELERATOR = {
     "v3": (16.0, 900.0),
     "v2": (8.0, 700.0),
 }
+def hbm_spec_for_kind(kind: str) -> "Tuple[float, float]":
+    """(HBM GB, HBM GB/s) for a device-kind string (e.g. jax's ``device_kind``
+    \"TPU v5 lite\"), longest-substring-first; DEFAULT_HBM when unknown."""
+    kind = (kind or "").lower()
+    for key in sorted(HBM_BY_ACCELERATOR, key=len, reverse=True):
+        if key in kind:
+            return HBM_BY_ACCELERATOR[key]
+    return DEFAULT_HBM
+
+
 # Unknown/unspecified accelerator: assume the smallest-HBM generation so the
 # cost model's feasibility check is conservative — an optimistic default
 # certifies strategies that OOM at runtime, the exact failure the check
@@ -180,11 +190,7 @@ class TPUTopology:
     def _hbm_defaults(self) -> Tuple[float, float]:
         if self.accelerator is None:
             return DEFAULT_HBM
-        kind = self.accelerator.lower()
-        for key in sorted(HBM_BY_ACCELERATOR, key=len, reverse=True):
-            if key in kind:
-                return HBM_BY_ACCELERATOR[key]
-        return DEFAULT_HBM
+        return hbm_spec_for_kind(self.accelerator)
 
     @property
     def hbm_bytes(self) -> float:

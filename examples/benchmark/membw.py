@@ -7,13 +7,22 @@ tensor sizes, for three access patterns:
 
   copy    y = x + 1            (read N, write N)
   add3    y = a + b + c        (read 3N, write N)
-  reduce  s = sum(x, axis=0)   (read N, write ~0 — the BN-stats shape)
+  reduce  s = (x + s*eps).sum  (read N, write ~0 — the BN-stats shape)
 
-Each pattern runs inside a scanned window (one dispatch, K repeats) with
-inputs pinned on device, mirroring the train-step methodology. If the
-measured ceiling is materially below spec, kernels "6x off the spec
-roofline" may in fact be at the *platform* roofline — that changes the
-conclusion of the bound analysis, which is why this exists.
+Methodology: K *separate chained dispatches* per pattern, with the data
+dependency carried through the full-size tensor (or the stats row) and
+input buffers donated. A scanned window is deliberately NOT used here:
+these bodies are affine, and XLA's algebraic simplifier can collapse a
+scan of ``x+1`` (or ``a+b+c``) into a single fused pass — an earlier
+scan-based version of this file "measured" 740 TB/s on an 819 GB/s part.
+Separate executions cannot be folded across dispatch boundaries, so each
+iteration provably moves its bytes. Async dispatch pipelines the per-call
+RPC overhead; a tiny-tensor control row measures that overhead so the
+large-tensor rows can be read against it.
+
+Every row self-checks against 1.2x the v5e spec; if any row exceeds it
+the artifact is stamped ``"suspect": true`` so downstream roofline math
+refuses to consume it.
 
 Usage::
 
@@ -30,42 +39,37 @@ sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..",
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
-SIZES_MB = (16, 64, 256)
-REPEATS = 50
+from autodist_tpu.resource_spec import HBM_BY_ACCELERATOR, hbm_spec_for_kind
+
+SIZES_MB = tuple(int(s) for s in
+                 os.environ.get("MEMBW_SIZES_MB", "64,256,1024").split(","))
+REPEATS = int(os.environ.get("MEMBW_REPEATS", "30"))
 DTYPE = jnp.bfloat16
 
 
-def _window(body, carry_init, n):
-    def step(c, _):
-        return body(c), None
+def _time_chain(fn, args, chain, repeats=REPEATS, trials=3):
+    """Median wall time per iteration of ``args = chain(fn(*args), args)``.
 
-    return lax.scan(step, carry_init, None, length=n)[0]
-
-
-def bench_pattern(name, make_const, make_carry, body, moved_bytes,
-                  repeats=REPEATS):
-    """Time ``repeats`` iterations of ``body(const, carry) -> carry``.
-
-    ``const`` is a scan-invariant operand (may be ``()``): it lets a pattern
-    read a large tensor each iteration while writing only a tiny carry back.
-    The body must still *depend* on the carry, else XLA hoists the read out
-    of the loop.
+    ``fn`` is a jitted function; ``chain`` rebuilds the next call's args from
+    (output, previous args) so every call depends on the last — the device
+    executes the K dispatches back-to-back while the host runs ahead.
     """
-    const = jax.device_put(make_const())
-    args = jax.device_put(make_carry())
-    jax.block_until_ready((const, args))
-    fn = jax.jit(lambda c, a: _window(lambda s: body(c, s), a, repeats))
-    out = fn(const, args)               # compile + warmup
+    out = fn(*args)                      # compile + warmup
     jax.block_until_ready(out)
-    trials = []
-    for _ in range(3):
+    args = chain(out, args)
+    times = []
+    for _ in range(trials):
         t0 = time.perf_counter()
-        out = fn(const, args)
-        jax.block_until_ready(jax.tree.leaves(out)[0])
-        trials.append(time.perf_counter() - t0)
-    dt = sorted(trials)[1] / repeats
+        for _ in range(repeats):
+            out = fn(*args)
+            args = chain(out, args)
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - t0) / repeats)
+    return sorted(times)[len(times) // 2]
+
+
+def _row(name, dt, moved_bytes):
     gbs = moved_bytes / dt / 1e9
     return {"pattern": name, "moved_mb": round(moved_bytes / 1e6, 1),
             "us_per_iter": round(dt * 1e6, 1), "achieved_gb_s": round(gbs, 1)}
@@ -73,59 +77,88 @@ def bench_pattern(name, make_const, make_carry, body, moved_bytes,
 
 def main() -> None:
     dev = jax.devices()[0]
-    rows = []
+    kind = str(getattr(dev, "device_kind", dev.platform))
+    spec_gb_s = hbm_spec_for_kind(kind)[1]
     bpe = jnp.dtype(DTYPE).itemsize
+    rows = []
+
+    # Control: per-dispatch overhead through this runtime (tiny tensor, the
+    # same chained methodology). Large-tensor rows are only trustworthy where
+    # their us_per_iter comfortably exceeds this.
+    tiny = jnp.ones((8, 128), DTYPE)
+    f_tiny = jax.jit(lambda x: x + jnp.asarray(1, x.dtype))
+    dt = _time_chain(f_tiny, (tiny,), lambda out, args: (out,))
+    rows.append(_row("dispatch_overhead", dt, 0))
+    overhead_us = rows[-1]["us_per_iter"]
+
     for mb in SIZES_MB:
         n = mb * 1_000_000 // bpe
-        # 2D shape with a 128-lane minor dim, like real activations.
-        shape = (n // 128, 128)
+        shape = (n // 128, 128)  # 128-lane minor dim, like real activations
 
-        def mk(shape=shape):
-            return jnp.ones(shape, DTYPE)
+        x = jnp.ones(shape, DTYPE)
+        f_copy = jax.jit(lambda v: v + jnp.asarray(1, v.dtype),
+                         donate_argnums=0)
+        dt = _time_chain(f_copy, (x,), lambda out, args: (out,))
+        rows.append(_row(f"copy_{mb}mb", dt, 2 * n * bpe))
 
-        rows.append(bench_pattern(
-            f"copy_{mb}mb", tuple, mk,
-            lambda _, x: x + jnp.asarray(1, x.dtype),
-            moved_bytes=2 * n * bpe))
-        # Read N, write ~0 (the BN-stats access pattern): x is scan-invariant,
-        # the carry is the [1,128] fp32 stats row. Mixing the carry into the
-        # summand (tiny but nonzero scale) forces a fresh full read each
-        # iteration. Runs in f32 end-to-end: a bf16 input needs an f32
-        # convert for the accumulation, and XLA hoists that loop-invariant
-        # convert OUT of the scan (confirmed in HLO), silently streaming a
-        # materialized f32 copy while the row prices bf16 bytes — same-dtype
-        # f32 leaves nothing to hoist, so moved_bytes is exact. The pattern
-        # (not the element width) is what's being isolated; copy/add3 cover
-        # the bf16 streaming rate.
+        # BN-stats shape: read N, write one [1,128] row. x is reread fully
+        # every call (cross-call hoisting is impossible); the chained stats
+        # row keeps each call dependent on the last. f32 end-to-end so
+        # moved_bytes is exact (no hidden bf16->f32 materialization).
         n32 = mb * 1_000_000 // 4
-        shape32 = (n32 // 128, 128)
-        rows.append(bench_pattern(
-            f"reduce_{mb}mb", lambda s=shape32: jnp.ones(s, jnp.float32),
-            lambda: jnp.zeros((1, 128), jnp.float32),
-            lambda x, s: (x + s * 1e-30).sum(0, keepdims=True),
-            moved_bytes=n32 * 4))
+        x32 = jnp.ones((n32 // 128, 128), jnp.float32)
+        s0 = jnp.zeros((1, 128), jnp.float32)
+        f_red = jax.jit(
+            lambda v, s: (v + s * 1e-30).sum(0, keepdims=True))
+        dt = _time_chain(f_red, (x32, s0),
+                         lambda out, args: (args[0], out))
+        rows.append(_row(f"reduce_{mb}mb", dt, n32 * 4))
 
-        def mk3(shape=shape):
-            return (jnp.ones(shape, DTYPE), jnp.ones(shape, DTYPE),
-                    jnp.ones(shape, DTYPE))
-
-        rows.append(bench_pattern(
-            f"add3_{mb}mb", tuple, mk3,
-            lambda _, abc: (abc[0] + abc[1] + abc[2], abc[1], abc[2]),
-            moved_bytes=4 * n * bpe))
+        a = jnp.ones(shape, DTYPE)
+        b = jnp.ones(shape, DTYPE)
+        c = jnp.ones(shape, DTYPE)
+        f_add3 = jax.jit(lambda p, q, r: p + q + r, donate_argnums=0)
+        dt = _time_chain(f_add3, (a, b, c),
+                         lambda out, args: (out, args[1], args[2]))
+        rows.append(_row(f"add3_{mb}mb", dt, 4 * n * bpe))
+        del a, b, c, x, x32
 
     for r in rows:
-        print(f"{r['pattern']:>14s}: {r['achieved_gb_s']:8.1f} GB/s "
+        print(f"{r['pattern']:>18s}: {r['achieved_gb_s']:8.1f} GB/s "
               f"({r['us_per_iter']:.0f} us/iter, {r['moved_mb']:.0f} MB moved)")
-    best = max(r["achieved_gb_s"] for r in rows)
+    bw_rows = [r for r in rows if r["pattern"] != "dispatch_overhead"]
+    best = max(r["achieved_gb_s"] for r in bw_rows)
+    # The >spec physics check only means something when the device kind is in
+    # the table — against the conservative DEFAULT_HBM fallback it would stamp
+    # legitimate measurements on unknown chips as impossible.
+    spec_known = any(k in kind.lower() for k in HBM_BY_ACCELERATOR)
+    suspect = spec_known and any(
+        r["achieved_gb_s"] > 1.2 * spec_gb_s for r in bw_rows)
+    # Rows timed within ~10x of the dispatch-overhead control are RPC-bound,
+    # not bandwidth-bound (the docstring's trustworthiness criterion): keep
+    # the artifact but mark it so downstream math caveats the verdict.
+    best_row = max(bw_rows, key=lambda r: r["achieved_gb_s"])
+    overhead_dominated = best_row["us_per_iter"] < 10 * max(overhead_us, 1e-3)
     print(f"\nbest achieved: {best:.0f} GB/s "
-          f"(v5e HBM spec 819 GB/s -> {best / 819:.0%} of spec)")
+          f"({kind} HBM spec {spec_gb_s:.0f} GB/s -> {best / spec_gb_s:.0%} of spec)"
+          + ("  [SUSPECT: exceeds physics, artifact flagged]" if suspect else "")
+          + ("  [overhead-dominated: re-run with larger sizes]"
+             if overhead_dominated else ""))
+    # Only a real-TPU run may refresh the canonical artifact the roofline
+    # verdict consumes; CPU smoke runs land beside it, suffixed.
+    fname = ("membw.json" if "TPU" in kind
+             else f"membw_{dev.platform}.json")
     out = os.path.join(os.path.dirname(__file__), "..", "..", "docs",
-                       "measured", "membw.json")
+                       "measured", fname)
     with open(os.path.abspath(out), "w") as fh:
-        json.dump({"device": getattr(dev, "device_kind", dev.platform),
-                   "dtype": "bfloat16", "repeats": REPEATS, "rows": rows,
-                   "best_gb_s": best}, fh, indent=2)
+        json.dump({"device": kind,
+                   "dtype": "bfloat16", "repeats": REPEATS,
+                   "methodology": "chained-dispatch",
+                   "dispatch_overhead_us": overhead_us,
+                   "spec_gb_s": spec_gb_s if spec_known else None,
+                   "rows": rows, "best_gb_s": best,
+                   "overhead_dominated": overhead_dominated,
+                   "suspect": suspect}, fh, indent=2)
     print(f"wrote {os.path.abspath(out)}")
 
 

@@ -89,3 +89,12 @@ def test_report_end_to_end_with_synthetic_artifacts(tmp_path, monkeypatch, capsy
     line = json.loads([l for l in out.splitlines() if l.startswith("{")][-1])
     assert line["metric"] == "roofline_fraction_min"
     assert line["models_analyzed"] == 1
+    # A bandwidth above physics (scan-collapse failure mode) must be
+    # refused, not priced into a verdict.
+    (tmp_path / "membw.json").write_text(json.dumps(
+        {"best_gb_s": 740772.9, "device": "TPU v5 lite", "rows": []}))
+    assert mod.main() == 3
+    (tmp_path / "membw.json").write_text(json.dumps(
+        {"best_gb_s": 600.0, "suspect": True, "device": "TPU v5 lite",
+         "rows": []}))
+    assert mod.main() == 3
