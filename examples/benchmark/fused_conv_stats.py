@@ -134,6 +134,34 @@ def _time(fn, *args, repeats=30):
     return sorted(trials)[1]
 
 
+def _time_scanned(fn, x, w, repeats=30):
+    """Per-iter time with ALL repeats inside one dispatch (lax.scan).
+
+    The per-dispatch loop above pays the tunnel's flow-control cost on every
+    call (~ms for unchained large-output dispatches), which can dwarf the
+    kernel itself. Here the body perturbs x by a y-derived scalar each
+    iteration — a data dependence XLA cannot hoist or fold (the scalar is
+    runtime data), so every iteration re-runs the matmul on a fresh tensor.
+    The extra x-scaling pass is priced into the printed floor by the caller.
+    """
+    def body(carry, _):
+        xc = carry
+        out = fn(xc, w)
+        y = jax.tree.leaves(out)[0]
+        return xc * (1.0 + y[0, 0].astype(xc.dtype) * 1e-30), None
+
+    run = jax.jit(lambda x0: jax.lax.scan(body, x0, None, length=repeats)[0])
+    out = run(x)
+    _sync((out,))
+    trials = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = run(x)
+        _sync((out,))
+        trials.append((time.perf_counter() - t0) / repeats)
+    return sorted(trials)[1]
+
+
 def main() -> None:
     shapes = SHAPES
     if len(sys.argv) == 4:
@@ -151,12 +179,16 @@ def main() -> None:
         t_mm = _time(mm_j, x, w)
         t_pl = _time(functools.partial(
             fused_matmul_stats, interpret=not on_tpu), x, w)
+        t_scan = _time_scanned(xla_j, x, w)
         traffic = (m * k + k * n + m * n) * 2          # bf16 bytes
         floor = traffic / 819e9
+        # The scanned body additionally reads+writes x once per iteration.
+        floor_scan = (3 * m * k + k * n + m * n) * 2 / 819e9
         print(f"[{m:>7d},{k:>3d}]@[{k:>3d},{n:>3d}]  "
               f"xla {t_xla * 1e6:7.1f}us  pallas {t_pl * 1e6:7.1f}us  "
               f"matmul-only {t_mm * 1e6:7.1f}us  "
-              f"(bw floor {floor * 1e6:5.1f}us)")
+              f"scanned {t_scan * 1e6:7.1f}us  "
+              f"(bw floor {floor * 1e6:5.1f}us / scanned {floor_scan * 1e6:5.1f}us)")
 
 
 if __name__ == "__main__":
