@@ -204,7 +204,9 @@ def main():
         "mean_step_s": round(s.get("mean_s", float("nan")) / window, 5),
         "window": window,
         "steps_executed": steps_executed,
-        "first_loss_to_last": [round(first_loss, 4), round(last_loss, 4)],
+        # 6 decimals: slow-start workloads (big-vocab LM, NCF at ln2) move
+        # in the 5th decimal over a short run and 4 would display as frozen.
+        "first_loss_to_last": [round(first_loss, 6), round(last_loss, 6)],
     }
     # Record non-default build knobs so A/B runs are distinguishable in
     # the emitted line (the --pin suffix already marks the feed mode).
