@@ -56,6 +56,42 @@ from autodist_tpu.strategy.ir import (
 ICI_LATENCY_S = 5e-6
 DCN_LATENCY_S = 100e-6
 
+# Predictions closer than this are a tie, not a ranking: the analytical
+# model's per-family deltas (collective-count latency, chunking constants)
+# sit well below both its own fidelity and measured run-to-run variance
+# (~4% on the bench chip, xla_flag_ab base_again control). Within a tie the
+# slate's preference order decides — it is ordered simplest-mechanism-first,
+# and unmodeled overhead (resharding copies, PS residency juggling) only
+# grows with mechanism. TPU-calibrated: the r5 device sweep measured
+# TensorParallel 14% slower than AllReduce on a single chip while the model
+# priced it 0.6% cheaper (docs/measured/resnet.json). On a single chip ALL
+# inter-strategy deltas are unmodeled overhead, hence the wide band; on
+# real meshes the collective terms are the model's actual claim and only
+# sub-percent deltas are noise.
+NEAR_TIE_REL = 0.05          # single-chip meshes
+NEAR_TIE_REL_MULTI = 0.01    # multi-chip meshes
+
+# Canonical preference order on prediction ties: candidate_slate() order
+# (simplest mechanism first), shared by CostModel.rank and
+# preferred_prediction so the two surfaces cannot drift. Names absent from
+# this tuple rank last, alphabetically.
+SLATE_PREFERENCE = (
+    "AllReduce", "PartitionedAR", "TensorParallel", "PSLoadBalancing",
+    "PS(zero3)", "PS(zero1)", "Parallax", "RandomAxisPartitionAR",
+    "PartitionedPS", "UnevenPartitionedPS", "AllReduce+bf16",
+    "AllReduce+topk",
+)
+
+
+def _tie_winner(times: Dict[str, float], order: Sequence[str],
+                rel: float) -> str:
+    """Cheapest entry, except entries within ``rel`` of it form a tie
+    broken by position in ``order`` (unknown names last, alphabetically)."""
+    t0 = min(times.values())
+    tied = [n for n, t in times.items() if t <= t0 * (1.0 + rel)]
+    rank_of = {n: i for i, n in enumerate(order)}
+    return min(tied, key=lambda n: (rank_of.get(n, len(order)), n))
+
 # Activation bytes synchronized per tensor-parallel (partitioned) variable per
 # step (forward + backward each pay one collective). Fallback when the
 # ModelItem carries no captured batch size; with one, the estimate becomes
@@ -145,6 +181,20 @@ OPTIMIZER_SLOT_FACTOR = {
     "lion": 1.0,
     "adafactor": 1.0,  # row/col factors are near-free; count conservatively
 }
+
+
+def preferred_prediction(predicted_s: Dict[str, float],
+                         rel: float = NEAR_TIE_REL) -> str:
+    """Auto's selection rule applied to a ``name → predicted seconds`` table.
+
+    The cheapest prediction wins unless other candidates sit within ``rel``
+    of it, in which case the earliest :data:`SLATE_PREFERENCE` name among
+    the tied wins. Same tie rule as :meth:`CostModel.rank` (which prefers
+    by the caller's candidate order — identical for the canonical slate);
+    the default ``rel`` is the single-chip band, matching the calibrate
+    sweep artifacts this helper exists to interpret.
+    """
+    return _tie_winner(predicted_s, SLATE_PREFERENCE, rel)
 
 
 def candidate_slate(
@@ -369,7 +419,11 @@ class CostModel:
         self.bw_dcn = resource_spec.network_bandwidth * 1e9 / 8.0
         self.hbm_bw = resource_spec.tpu.hbm_bandwidth_bytes
         self.hbm_cap = resource_spec.tpu.hbm_bytes * HBM_USABLE_FRACTION
-        self.latency = ICI_LATENCY_S if self.m == 1 else DCN_LATENCY_S
+        # One chip emits no collectives at all (XLA elides them), so the
+        # per-collective dispatch term must not break prediction ties there.
+        self.latency = (0.0 if self.n <= 1
+                        else ICI_LATENCY_S if self.m == 1
+                        else DCN_LATENCY_S)
         self.slot_factor = OPTIMIZER_SLOT_FACTOR.get(
             model_item.optimizer_spec.name, 2.0
         )
@@ -708,10 +762,22 @@ class CostModel:
         caller still gets the best available answer (with a warning upstream).
         """
         costed = [(name, self.strategy_cost(s)) for name, s in candidates]
-        return sorted(
+        ranked = sorted(
             costed,
             key=lambda nc: (
                 not nc[1].feasible,
                 nc[1].total_s if nc[1].feasible else nc[1].per_chip_bytes,
             ),
         )
+        # Near-tie break: predictions within the mesh's tie band of the
+        # feasible best are indistinguishable; among them the caller's
+        # candidate order (the slate is simplest-mechanism-first) picks the
+        # winner.
+        if ranked and ranked[0][1].feasible:
+            rel = NEAR_TIE_REL if self.n <= 1 else NEAR_TIE_REL_MULTI
+            feas = {name: c.total_s for name, c in ranked if c.feasible}
+            win_name = _tie_winner(feas, [n for n, _ in candidates], rel)
+            winner = next(nc for nc in ranked if nc[0] == win_name)
+            ranked.remove(winner)
+            ranked.insert(0, winner)
+        return ranked

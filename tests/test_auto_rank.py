@@ -41,8 +41,16 @@ def _load(model):
 
 @pytest.mark.parametrize("model", MODELS)
 def test_predicted_winner_measures_competitively(model):
+    # Apply the SAME selection rule Auto applies (near-ties break to the
+    # simplest mechanism in the slate, cost_model.NEAR_TIE_REL): argmin over
+    # raw predictions would rank sub-percent model noise — the r5 device
+    # sweep had TensorParallel predicted 0.6% below AllReduce on resnet but
+    # measuring 14% slower.
+    from autodist_tpu.strategy.cost_model import preferred_prediction
+
     table = _load(model)
-    predicted_winner = min(table, key=lambda k: table[k]["predicted_s"])
+    predicted_winner = preferred_prediction(
+        {k: v["predicted_s"] for k, v in table.items()})
     measured_best = min(table, key=lambda k: table[k]["measured_s"])
     t_pred = table[predicted_winner]["measured_s"]
     t_best = table[measured_best]["measured_s"]
@@ -57,14 +65,22 @@ def test_predicted_winner_measures_competitively(model):
 @pytest.mark.parametrize("model", MODELS)
 def test_predicted_order_not_anticorrelated(model):
     # Beyond top-1: the predicted order must not be an inversion of the
-    # measured order (Kendall tau >= 0 over the complete candidates).
+    # measured order (Kendall tau >= 0 over the DECIDABLE pairs). A pair
+    # whose predictions sit within the model's own tie band carries no
+    # ranking claim — counting it would grade coin flips (the intra-family
+    # deltas are sub-percent while measured run-to-run variance is ~4%).
+    from autodist_tpu.strategy.cost_model import NEAR_TIE_REL
+
     table = _load(model)
     names = sorted(table)
     concordant = discordant = 0
     for i in range(len(names)):
         for j in range(i + 1, len(names)):
             a, b = names[i], names[j]
-            dp = table[a]["predicted_s"] - table[b]["predicted_s"]
+            pa, pb = table[a]["predicted_s"], table[b]["predicted_s"]
+            if max(pa, pb) <= min(pa, pb) * (1.0 + NEAR_TIE_REL):
+                continue  # predicted tie: no claim to grade
+            dp = pa - pb
             dm = table[a]["measured_s"] - table[b]["measured_s"]
             if dp * dm > 0:
                 concordant += 1

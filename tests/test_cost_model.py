@@ -564,3 +564,28 @@ def test_shard_destinations_spread_ps_nic_load():
     assert loads_spread["10.0.0.2"] == pytest.approx(total / 2)
     # Both shards on one host re-accumulate to the full load there.
     assert loads_packed["10.0.0.1"] == pytest.approx(total)
+
+
+def test_slate_preference_matches_candidate_slate_order():
+    """SLATE_PREFERENCE is the tie-break order preferred_prediction uses;
+    it must list candidate_slate's names in the slate's own order or the
+    offline-artifact rule drifts from Auto's live rule."""
+    from autodist_tpu.strategy.cost_model import (SLATE_PREFERENCE,
+                                                  candidate_slate)
+
+    slate_names = [n for n, _ in candidate_slate(full=True)]
+    assert [n for n in SLATE_PREFERENCE if n in slate_names] == slate_names
+
+
+def test_rank_near_tie_prefers_slate_order_single_chip():
+    """Sub-band prediction deltas must not override mechanism preference
+    (r5 device evidence: TP predicted 0.6% under AllReduce, measured 14%
+    over)."""
+    from autodist_tpu.strategy.cost_model import preferred_prediction
+
+    table = {"TensorParallel": 0.000879, "AllReduce": 0.000884,
+             "PartitionedAR": 0.000889, "PS(zero1)": 0.00248}
+    assert preferred_prediction(table) == "AllReduce"
+    # Outside the band the cheap one wins regardless of preference.
+    table = {"TensorParallel": 0.00060, "AllReduce": 0.000884}
+    assert preferred_prediction(table) == "TensorParallel"
