@@ -8,9 +8,11 @@ By default BOTH named BASELINE.json workloads run — the flagship BERT-base
 MLM (AllReduce strategy, the headline ``metric: bert_base_mfu``) and the
 ResNet-50 image workload (``resnet50_mfu``/``resnet50_images_per_sec_per_chip``
 extras in the same line) — so the driver's single ``python bench.py`` call
-externally gates CNN perf too (VERDICT r2 #1/#3). ``--model bert|resnet``
-restricts to one workload for manual runs; docs/performance.md records the
-per-round sweep.
+externally gates CNN perf too (VERDICT r2 #1/#3). When an accelerator
+answers the preflight, BERT-large (the reference's published pretraining
+model) joins as a third workload and rides as ``bert_large_mfu`` extras.
+``--model bert|resnet|bert_large`` restricts to one workload for manual
+runs; docs/performance.md records the per-round sweep.
 """
 from __future__ import annotations
 
@@ -244,6 +246,21 @@ def measure_workload(model_name: str, on_accel: bool) -> dict:
             model_kw = dict(depth=18, image_size=32, num_classes=10)
         spec = get_model("resnet", **model_kw)
         unit_per = "images"
+    elif model_name == "bert_large":
+        # The exact model the reference's published benchmark pretrains
+        # (L=24 H=1024 A=16). Bigger matmuls feed the MXU better than
+        # bert_base: r5 measured 0.730 MFU at b64 vs the base's 0.694.
+        if on_accel:
+            candidate_batches, steps = (32, 64), 20
+            model_kw = dict()
+        else:
+            candidate_batches, steps = (8,), 3
+            model_kw = dict(
+                vocab_size=512, num_layers=2, d_model=64, num_heads=4,
+                d_ff=128, max_seq_len=32,
+            )
+        spec = get_model("bert_large", **model_kw)
+        unit_per = "tokens"
     else:
         if on_accel:
             # 256 rides the sweep's per-candidate OOM guard: its MLM logits
@@ -299,7 +316,7 @@ def measure_workload(model_name: str, on_accel: bool) -> dict:
         batch_size = min(results, key=lambda bs: results[bs][0] / bs)
         dt, last_loss = results[batch_size]
         dev = jax.devices()[0]
-        seq = spec.config.max_seq_len if model_name == "bert" else 1
+        seq = spec.config.max_seq_len if model_name != "resnet" else 1
         examples_per_sec = batch_size * steps / dt
         units_per_sec = examples_per_sec * seq
         flops_per_step = spec.flops_per_example * batch_size
@@ -359,7 +376,8 @@ def _format_result(measured: dict, errors: dict) -> tuple:
     head_name = order[0]
     head = measured[head_name]
     on_accel = bool(head.get("on_accel", False))
-    metric_base = "bert_base_mfu" if head_name == "bert" else "resnet50_mfu"
+    metric_base = {"bert": "bert_base_mfu", "bert_large": "bert_large_mfu",
+                   "resnet": "resnet50_mfu"}[head_name]
     result = {
         "metric": metric_base if on_accel else f"{metric_base}_cpu_smoke",
         "value": round(head["mfu"], 4) if on_accel else round(head["units_per_sec"], 1),
@@ -376,12 +394,13 @@ def _format_result(measured: dict, errors: dict) -> tuple:
         "batch_size": head["batch_size"],
         "loss": round(head["loss"], 4),
     }
-    if head_name == "bert":
+    if head_name != "resnet":
         result["seq_len"] = head["seq"]
     # The non-head workload rides along as extras in BOTH directions —
     # dropping it would make "measured on CPU" indistinguishable from
     # "never ran" in the emitted line.
-    for extra_name, prefix in (("resnet", "resnet50"), ("bert", "bert_base")):
+    for extra_name, prefix in (("resnet", "resnet50"), ("bert", "bert_base"),
+                               ("bert_large", "bert_large")):
         if extra_name == head_name or extra_name not in measured:
             continue
         w = measured[extra_name]
@@ -398,9 +417,13 @@ def _format_result(measured: dict, errors: dict) -> tuple:
     for name, w in measured.items():
         # Per-workload watchdog/partial-sweep notes must survive into the
         # emitted line: a truncated candidate sweep is otherwise
-        # indistinguishable from a complete one.
+        # indistinguishable from a complete one. MERGE with any note the
+        # extras loop already wrote — for bert_large prefix == name, so an
+        # assignment would silently replace its cpu-fallback explanation.
         if w.get("note"):
-            result[f"{name}_note"] = w["note"]
+            key = f"{name}_note"
+            result[key] = "; ".join(filter(None, [result.get(key),
+                                                  w["note"]]))
     for name, err in errors.items():
         result[f"{name}_error"] = err
     return result, on_accel
@@ -552,7 +575,9 @@ def _emergency_line(errors: dict, reason: str) -> dict:
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--model", choices=("bert", "resnet", "both"), default="both")
+    ap.add_argument("--model",
+                    choices=("bert", "resnet", "bert_large", "both"),
+                    default="both")
     ap.add_argument("--one", help=argparse.SUPPRESS)          # child mode
     ap.add_argument("--cpu-smoke", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
@@ -571,6 +596,9 @@ def main() -> None:
     signal.signal(signal.SIGALRM, _alarm)
     signal.alarm(max(10, int(BUDGET.total - 30)))
 
+    # bert_large joins the full sweep only when an accelerator answers the
+    # preflight (appended there): on CPU smoke the two classic workloads
+    # already prove the fallback path, and a third would just slow it.
     workloads = ("bert", "resnet") if args.model == "both" else (args.model,)
     measured, errors = {}, {}
     accel_ok = False
@@ -583,8 +611,11 @@ def main() -> None:
         # parent process NEVER initializes jax — all measurement happens in
         # watchdogged children, so a mid-bench wedge still yields a line.
         accel_ok = _preflight()
-        # Default per-workload watchdog derives from the budget so the two
-        # defaults stay mutually consistent: both workloads must fit inside
+        base_workloads = workloads
+        if accel_ok and args.model == "both":
+            workloads = workloads + ("bert_large",)
+        # Default per-workload watchdog derives from the budget so the
+        # defaults stay mutually consistent: every workload must fit inside
         # BENCH_BUDGET_S even when the first uses its full window. Callers
         # with a roomier driver timeout raise BENCH_BUDGET_S (the queue
         # driver sets 5100s inside its 5400s job limit) and the window
@@ -592,6 +623,10 @@ def main() -> None:
         per_workload_s = float(
             os.environ.get("BENCH_WORKLOAD_TIMEOUT")
             or min(2400.0, BUDGET.total * 0.45))
+        # Budget weights: the flagship's sweep (its 256-batch candidate is
+        # the long pole) must not lose window to the bert_large add-on —
+        # the headline owns the larger share, the add-ons split the rest.
+        weights = {"bert": 2.0}
 
         for i, name in enumerate(workloads):
             if i > 0 and accel_ok and errors:
@@ -600,12 +635,14 @@ def main() -> None:
                 if not _probe_once(120.0):
                     errors[name] = "skipped: tunnel wedged mid-bench"
                     continue
-            # Fair-share the remaining budget across the workloads still to
-            # run: without this, the first sweep could consume nearly the
-            # whole budget and the clamp would truncate every later
-            # workload's sweep even on a healthy round.
-            fair_s = min(per_workload_s,
-                         BUDGET.remaining() / max(1, len(workloads) - i))
+            # Weighted-fair-share the remaining budget across the workloads
+            # still to run: without this, the first sweep could consume
+            # nearly the whole budget and the clamp would truncate every
+            # later workload's sweep even on a healthy round.
+            rest = workloads[i:]
+            share = (weights.get(name, 1.0)
+                     / sum(weights.get(n, 1.0) for n in rest))
+            fair_s = min(per_workload_s, BUDGET.remaining() * share)
             out, err = _measure_in_subprocess(
                 name, cpu_smoke=not accel_ok, timeout_s=fair_s)
             if err is not None:
@@ -625,7 +662,10 @@ def main() -> None:
             # driver still needs a line, so take the CPU smoke path now (the
             # same fallback a failed preflight gets).
             wedged_mid_bench = True
-            for name in workloads:
+            # The CPU-smoke path proves the fallback with the two classic
+            # workloads only; re-running the bert_large add-on would burn
+            # budget already drained by the failed accel attempts.
+            for name in base_workloads:
                 out, err = _measure_in_subprocess(
                     name, cpu_smoke=True, timeout_s=per_workload_s)
                 if err is not None:

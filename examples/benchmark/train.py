@@ -217,8 +217,14 @@ def main():
     if args.accum > 1:
         result["grad_accum_steps"] = args.accum
     if model.flops_per_example:
+        # flops_per_example is per EXAMPLE (per sequence for token models,
+        # bench.py:305 convention) while items_per_sec counts tokens for
+        # item_kind == "tokens" — convert back via tokens-per-example or the
+        # achieved rate over-reports by seq_len.
+        examples_per_sec = (s.get("items_per_sec", 0.0) * batch_size
+                            / max(items_per_step, 1))
         result["model_tflops_per_sec"] = round(
-            model.flops_per_example * s.get("items_per_sec", 0.0) / 1e12, 2
+            model.flops_per_example * examples_per_sec / 1e12, 2
         )
     print(json.dumps(result))
 
