@@ -650,10 +650,12 @@ def main() -> None:
                 print(f"bench[{name}] failed: {err}", file=sys.stderr)
                 continue
             measured[name] = out
-            if out.get("on_accel") and i + 1 < len(workloads):
+            if (out.get("on_accel") and i + 1 < len(workloads)
+                    and measured.get("bert", {}).get("on_accel")):
                 # Persist IMMEDIATELY: a later workload wedging must not erase
                 # this round's verified accelerator evidence (VERDICT r3 weak
-                # #1). The final workload's store happens once, below.
+                # #1). The final workload's store happens once, below. Only
+                # flagship-bearing lines are cached — see below.
                 partial, _ = _format_result(measured, errors)
                 _store_last_accel(partial)
 
@@ -685,9 +687,14 @@ def main() -> None:
 
     result, on_accel = _format_result(measured, errors)
     wedged_fallback = False
-    if on_accel:
+    if on_accel and measured.get("bert", {}).get("on_accel"):
+        # Cache only flagship-bearing lines: the cache is the driver's
+        # wedge-fallback artifact and its head metric (bert_base_mfu) must
+        # stay comparable across rounds — a manual `--model bert_large`
+        # or `--model resnet` experiment (or a round where bert itself
+        # fell back to CPU) must not re-head it.
         _store_last_accel(result)
-    elif accel_ok and not wedged_mid_bench:
+    elif not on_accel and accel_ok and not wedged_mid_bench:
         # Probe answered but the visible platform is CPU: there is no
         # accelerator on this host — saying "tunnel wedged" would be a
         # false cause, embedding cached accel evidence would imply a chip
