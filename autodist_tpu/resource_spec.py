@@ -71,7 +71,14 @@ HBM_BY_ACCELERATOR = {
     "v3": (16.0, 900.0),
     "v2": (8.0, 700.0),
 }
-def hbm_spec_for_kind(kind: str) -> "Tuple[float, float]":
+# Unknown/unspecified accelerator: assume the smallest-HBM generation so the
+# cost model's feasibility check is conservative — an optimistic default
+# certifies strategies that OOM at runtime, the exact failure the check
+# exists to prevent.
+DEFAULT_HBM = min(HBM_BY_ACCELERATOR.values())
+
+
+def hbm_spec_for_kind(kind: str) -> Tuple[float, float]:
     """(HBM GB, HBM GB/s) for a device-kind string (e.g. jax's ``device_kind``
     \"TPU v5 lite\"), longest-substring-first; DEFAULT_HBM when unknown."""
     kind = (kind or "").lower()
@@ -79,13 +86,6 @@ def hbm_spec_for_kind(kind: str) -> "Tuple[float, float]":
         if key in kind:
             return HBM_BY_ACCELERATOR[key]
     return DEFAULT_HBM
-
-
-# Unknown/unspecified accelerator: assume the smallest-HBM generation so the
-# cost model's feasibility check is conservative — an optimistic default
-# certifies strategies that OOM at runtime, the exact failure the check
-# exists to prevent.
-DEFAULT_HBM = min(HBM_BY_ACCELERATOR.values())
 
 
 class DeviceType(Enum):

@@ -179,6 +179,14 @@ def _preflight(timeouts=None, backoffs=None) -> bool:
     return False
 
 
+def _flagship_on_accel(measured: dict) -> bool:
+    """True when the bert flagship itself measured on the accelerator —
+    the cache-eligibility rule: bench_last_accel.json's head metric must
+    stay bert_base_mfu across rounds, so neither a restricted manual run
+    nor a round where bert fell back to CPU may re-head it."""
+    return bool(measured.get("bert", {}).get("on_accel"))
+
+
 def _store_last_accel(result: dict) -> None:
     """Cache a successful accelerator result for later wedge fallbacks.
 
@@ -651,11 +659,10 @@ def main() -> None:
                 continue
             measured[name] = out
             if (out.get("on_accel") and i + 1 < len(workloads)
-                    and measured.get("bert", {}).get("on_accel")):
+                    and _flagship_on_accel(measured)):
                 # Persist IMMEDIATELY: a later workload wedging must not erase
                 # this round's verified accelerator evidence (VERDICT r3 weak
-                # #1). The final workload's store happens once, below. Only
-                # flagship-bearing lines are cached — see below.
+                # #1). The final workload's store happens once, below.
                 partial, _ = _format_result(measured, errors)
                 _store_last_accel(partial)
 
@@ -687,14 +694,14 @@ def main() -> None:
 
     result, on_accel = _format_result(measured, errors)
     wedged_fallback = False
-    if on_accel and measured.get("bert", {}).get("on_accel"):
-        # Cache only flagship-bearing lines: the cache is the driver's
-        # wedge-fallback artifact and its head metric (bert_base_mfu) must
-        # stay comparable across rounds — a manual `--model bert_large`
-        # or `--model resnet` experiment (or a round where bert itself
-        # fell back to CPU) must not re-head it.
-        _store_last_accel(result)
-    elif not on_accel and accel_ok and not wedged_mid_bench:
+    if on_accel:
+        # Cache eligibility is separate from run classification: an
+        # on-accel line without the flagship (restricted --model run, or
+        # bert fell back while another workload measured) is still a
+        # SUCCESSFUL run — it just must not re-head the cache.
+        if _flagship_on_accel(measured):
+            _store_last_accel(result)
+    elif accel_ok and not wedged_mid_bench:
         # Probe answered but the visible platform is CPU: there is no
         # accelerator on this host — saying "tunnel wedged" would be a
         # false cause, embedding cached accel evidence would imply a chip

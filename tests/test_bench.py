@@ -276,3 +276,26 @@ def test_store_last_accel_merges_per_workload(bench, tmp_path, monkeypatch):
     assert cached["resnet50_mfu"] == 0.16     # old evidence survives
     assert "resnet50_mfu" in cached["stale_fields"]
     assert cached["stale_fields_at"]
+
+
+def test_format_result_bert_large_extras_and_head(bench):
+    # bert_large rides as extras beside the bert head (full sweep)...
+    measured = {"bert": _head(), "bert_large": _head(mfu=0.73)}
+    r, on_accel = bench._format_result(measured, {})
+    assert r["metric"] == "bert_base_mfu"
+    assert r["bert_large_mfu"] == 0.73
+    assert r["bert_large_vs_baseline"] == pytest.approx(1.46)
+    # ...and heads its own line (with seq_len) on a restricted run.
+    r, on_accel = bench._format_result({"bert_large": _head(mfu=0.73)}, {})
+    assert r["metric"] == "bert_large_mfu" and r["seq_len"] == 128
+
+
+def test_format_result_note_merges_for_name_equals_prefix(bench):
+    # bert_large's workload name equals its extras prefix: a watchdog note
+    # must MERGE with the cpu-fallback explanation, not overwrite it.
+    w = _head(mfu=float("nan"), on_accel=False)
+    w["note"] = "watchdog killed the sweep after 60s"
+    measured = {"bert": _head(), "bert_large": w}
+    r, _ = bench._format_result(measured, {})
+    assert "mfu omitted" in r["bert_large_note"]
+    assert "watchdog killed" in r["bert_large_note"]
