@@ -66,6 +66,12 @@ JOBS = [
     ("host_offload_ab", ["examples/benchmark/host_offload_ab.py"], 1200),
     ("async_ps", ["examples/async_ps.py"], 900),
     ("bench_full", ["bench.py"], 5400),
+    # r5 post-queue additions: verify the no-flagship classification path on
+    # device (a bert_large-headed line must carry neither the CPU-smoke note
+    # nor the wedge error), then end the round with a fresh 3-workload line
+    # under the final code.
+    ("bench_blarge_head", ["bench.py", "--model", "bert_large"], 1800),
+    ("bench_final", ["bench.py"], 5400),
 ]
 # Per-job env overrides (merged over os.environ). bench_full gets the full
 # budget its 5400s job timeout affords; bench's own default (3300s) is
@@ -76,6 +82,10 @@ JOB_ENV = {
                     "BENCH_PREFLIGHT_TIMEOUTS": "120",
                     "BENCH_REQUIRE_ACCEL": "1"},
     "bench_full": {"BENCH_BUDGET_S": "5100"},
+    "bench_blarge_head": {"BENCH_BUDGET_S": "1700",
+                          "BENCH_PREFLIGHT_TIMEOUTS": "120",
+                          "BENCH_REQUIRE_ACCEL": "1"},
+    "bench_final": {"BENCH_BUDGET_S": "5100", "BENCH_REQUIRE_ACCEL": "1"},
 }
 # Every child the driver spawns is already serialized under the driver's
 # lock — bench.py (and anything that shells out to it) must skip its
