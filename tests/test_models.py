@@ -204,3 +204,22 @@ def test_batchnorm_clamp_regime_vjp_matches_autodiff():
     np.testing.assert_allclose(
         np.asarray(dp_c["scale"])[clamped], np.asarray(dp_a["scale"])[clamped],
         rtol=1e-3, atol=1e-3 * max(np.abs(np.asarray(dp_a["scale"])).max(), 1.0))
+
+
+def test_flops_per_example_is_per_sequence_for_token_models():
+    """Pin the unit convention throughput reporting relies on:
+    ``flops_per_example`` counts one EXAMPLE (= one full sequence for
+    token models, bench.py:305), NOT one token — train.py once multiplied
+    it by tokens/s and over-reported TFLOP/s by seq_len. The count must
+    scale at least linearly in seq_len (super-linear with the s^2
+    attention term) and track parameter count across model sizes."""
+    base = get_model("bert_base")
+    large = get_model("bert_large")
+    # Per-sequence: halving the sequence must at least halve the count.
+    short = get_model("bert_base", max_seq_len=64)
+    assert base.flops_per_example > 2 * short.flops_per_example * 0.99
+    # Larger model, same seq: BERT-large is ~3.1x BERT-base's params.
+    ratio = large.flops_per_example / base.flops_per_example
+    assert 2.5 < ratio < 4.0
+    # Sanity magnitude: ~0.7 GFLOP/token * 128 tokens, within 2x.
+    assert 0.3e11 < base.flops_per_example < 1.5e11
