@@ -72,6 +72,35 @@ JOBS = [
     # under the final code.
     ("bench_blarge_head", ["bench.py", "--model", "bert_large"], 1800),
     ("bench_final", ["bench.py"], 5400),
+    # r5 pipelined-methodology re-measurement (2026-08-02): per-window loss
+    # barriers taxed every window with the tunnel's ~64 ms scalar-fetch
+    # latency, and train.py's warmup fetched loss[0] while the timed loop
+    # fetched loss[-1] — the first timed window paid a ~0.48 s one-off
+    # getitem compile. Both fixed (pinned runs now dispatch all timed
+    # windows back-to-back with ONE end barrier); these jobs refresh every
+    # number the old methodology undersold. Quick single-model headline
+    # first so a brief healthy window still banks a pipelined bench line.
+    ("bench_quick_pipelined", ["bench.py", "--model", "bert"], 1800),
+    ("resnet50_pipelined", ["examples/benchmark/train.py", "--model", "resnet50",
+                            "--batch-size", "128", "--steps", "120", "--warmup", "40",
+                            "--window", "20", "--pin"], 900),
+    ("inception_pipelined", ["examples/benchmark/train.py", "--model", "inceptionv3",
+                             "--batch-size", "128", "--steps", "120", "--warmup", "40",
+                             "--window", "20", "--pin"], 900),
+    ("vgg16_pipelined", ["examples/benchmark/train.py", "--model", "vgg16",
+                         "--batch-size", "128", "--steps", "120", "--warmup", "40",
+                         "--window", "20", "--pin"], 900),
+    ("bert_seq512_flash_pipelined", ["examples/benchmark/train.py", "--model", "bert_base",
+                                     "--batch-size", "32", "--steps", "120", "--warmup", "40",
+                                     "--window", "20", "--pin", "--model-kwargs",
+                                     '{"max_seq_len": 512, "attention_impl": "flash"}'], 1500),
+    ("bert_seq512_dot_pipelined", ["examples/benchmark/train.py", "--model", "bert_base",
+                                   "--batch-size", "32", "--steps", "120", "--warmup", "40",
+                                   "--window", "20", "--pin", "--model-kwargs",
+                                   '{"max_seq_len": 512, "attention_impl": "dot"}'], 1500),
+    ("strategy_coverage_pipelined", ["examples/benchmark/strategy_coverage.py",
+                                     "--steps", "200"], 3600),
+    ("bench_final_pipelined", ["bench.py"], 5400),
 ]
 # Per-job env overrides (merged over os.environ). bench_full gets the full
 # budget its 5400s job timeout affords; bench's own default (3300s) is
@@ -86,6 +115,12 @@ JOB_ENV = {
                           "BENCH_PREFLIGHT_TIMEOUTS": "120",
                           "BENCH_REQUIRE_ACCEL": "1"},
     "bench_final": {"BENCH_BUDGET_S": "5100", "BENCH_REQUIRE_ACCEL": "1"},
+    "bench_quick_pipelined": {"BENCH_BUDGET_S": "1700",
+                              "BENCH_WORKLOAD_TIMEOUT": "1200",
+                              "BENCH_PREFLIGHT_TIMEOUTS": "120",
+                              "BENCH_REQUIRE_ACCEL": "1"},
+    "bench_final_pipelined": {"BENCH_BUDGET_S": "5100",
+                              "BENCH_REQUIRE_ACCEL": "1"},
 }
 # Every child the driver spawns is already serialized under the driver's
 # lock — bench.py (and anything that shells out to it) must skip its

@@ -173,18 +173,38 @@ def main():
     timed_windows = total_windows - warm_windows
     state, metrics = step.run(state, next_batch(), window)
     first_loss = float(metrics["loss"][0])
+    # The timed loop fetches loss[-1]; fetch it here too so its getitem
+    # executable compiles during warmup. (Measured on the axon tunnel:
+    # a first [-1] fetch after only [0] fetches cost ~0.48 s of compile
+    # INSIDE the first timed window — 7.6x undersold NCF at one timed
+    # window, +24% on BERT seq-512.)
+    float(metrics["loss"][-1])
     for _ in range(warm_windows - 1):
         state, metrics = step.run(state, next_batch(), window)
         float(metrics["loss"][-1])
-    timer = StepTimer(items_per_step=items_per_step * window, warmup=0)
-    for _ in range(timed_windows):
-        # Feed upload happens here, while the device is idle: issuing a
-        # device_put against an in-flight dispatch deadlocks the axon
-        # tunnel, so transfers cannot overlap compute on this platform.
-        b = next_batch()
+    steps_per_lap = window * timed_windows if args.pin else window
+    timer = StepTimer(items_per_step=items_per_step * steps_per_lap, warmup=0)
+    if args.pin:
+        # Pinned batch: nothing to feed between windows, so every timed
+        # window dispatches back-to-back (run() returns immediately; the
+        # programs queue and pipeline on the device) and ONE trailing loss
+        # fetch barriers the whole run. A per-window barrier instead taxes
+        # every window with the platform's device->host scalar latency
+        # (~64 ms through the axon tunnel even on a ready array) — measured
+        # 3.4 -> 0.4 ms/step on NCF b4096 w20. One lap = the whole run.
         with timer:
-            state, metrics = step.run(state, b, window)
-            float(metrics["loss"][-1])  # device fetch = trustworthy barrier
+            for _ in range(timed_windows):
+                state, metrics = step.run(state, next_batch(), window)
+            float(metrics["loss"][-1])  # single end barrier
+    else:
+        for _ in range(timed_windows):
+            # Feed upload happens here, while the device is idle: issuing a
+            # device_put against an in-flight dispatch deadlocks the axon
+            # tunnel, so transfers cannot overlap compute on this platform.
+            b = next_batch()
+            with timer:
+                state, metrics = step.run(state, b, window)
+                float(metrics["loss"][-1])  # device fetch = trustworthy barrier
     last_loss = float(metrics["loss"][-1])
     steps_executed = (warm_windows + timed_windows) * window
 
@@ -201,7 +221,7 @@ def main():
         "strategy": args.strategy,
         "global_batch": batch_size,
         "n_devices": n_dev,
-        "mean_step_s": round(s.get("mean_s", float("nan")) / window, 5),
+        "mean_step_s": round(s.get("mean_s", float("nan")) / steps_per_lap, 5),
         "window": window,
         "steps_executed": steps_executed,
         # 6 decimals: slow-start workloads (big-vocab LM, NCF at ln2) move
