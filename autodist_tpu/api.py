@@ -51,6 +51,11 @@ if TYPE_CHECKING:  # circular at runtime: async_ps imports nothing from api
 _default_autodist: Optional["AutoDist"] = None
 
 
+# Windows per tune() trial, dispatched back-to-back with one trailing sync
+# (see tune's timing loop). 4 amortizes the device->host fetch latency to
+# ~2 ms/step-window on the axon tunnel while keeping the sweep short.
+_TUNE_TRIAL_WINDOWS = 4
+
 # Non-factory jax.checkpoint_policies usable directly as a remat policy
 # (factories like save_only_these_names need arguments and are out of scope
 # for the string shorthand).
@@ -481,8 +486,10 @@ class AutoDist:
         window: int = 8,
         **build_kwargs,
     ) -> DistributedTrainStep:
-        """Measured strategy selection: build each candidate strategy, time a
-        short device-side window of real training steps, keep the fastest.
+        """Measured strategy selection: build each candidate strategy, time
+        ``_TUNE_TRIAL_WINDOWS`` (4) back-to-back device-side windows of
+        ``window`` real training steps each (plus one warmup window), keep
+        the fastest.
 
         The analytical :class:`~autodist_tpu.strategy.cost_model.CostModel`
         behind :class:`~autodist_tpu.strategy.Auto` *predicts*; ``tune``
@@ -533,10 +540,17 @@ class AutoDist:
                 state = step.init(params)
                 state, _ = step.run(state, bench_batch, window)  # compile+warm
                 _sync(state.params)
+                # Back-to-back windows with one trailing sync: run() returns
+                # immediately and the programs pipeline on the device, so the
+                # platform's device->host fetch latency (~64 ms through the
+                # axon tunnel) is paid once, not per window — it biased
+                # every candidate's absolute ms/step equally (fair ranking,
+                # skewed calibration). 4 windows amortize it ~4x.
                 t0 = time.perf_counter()
-                state, _ = step.run(state, bench_batch, window)
+                for _ in range(_TUNE_TRIAL_WINDOWS):
+                    state, _ = step.run(state, bench_batch, window)
                 _sync(state.params)
-                dt = (time.perf_counter() - t0) / window
+                dt = (time.perf_counter() - t0) / (_TUNE_TRIAL_WINDOWS * window)
             except Exception as e:  # noqa: BLE001 - candidate-level isolation
                 # Fleet alignment: chief-only build failures ship a sentinel
                 # through the strategy broadcast so every process raises (and

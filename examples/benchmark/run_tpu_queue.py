@@ -100,6 +100,8 @@ JOBS = [
                                    '{"max_seq_len": 512, "attention_impl": "dot"}'], 1500),
     ("strategy_coverage_pipelined", ["examples/benchmark/strategy_coverage.py",
                                      "--steps", "200"], 3600),
+    ("calibrate_pipelined", ["examples/benchmark/calibrate.py",
+                             "--out", "docs/measured"], 2700),
     ("bench_final_pipelined", ["bench.py"], 5400),
 ]
 # Per-job env overrides (merged over os.environ). bench_full gets the full
