@@ -13,7 +13,7 @@ Where the reference rewired TF graphs op-by-op
 lets XLA GSPMD insert the collectives — the idiomatic TPU mechanism with the
 same user-visible contract (single-device model in, distributed execution out).
 """
-from autodist_tpu import checkpoint, const, metrics, runtime, strategy
+from autodist_tpu import checkpoint, const, metrics, runtime, serve, strategy
 from autodist_tpu.api import AutoDist, get_default_autodist
 from autodist_tpu.kernel import DistributedTrainStep, TrainState
 from autodist_tpu.model_item import ModelItem, OptimizerSpec
@@ -32,6 +32,7 @@ __all__ = [
     "const",
     "get_default_autodist",
     "runtime",
+    "serve",
     "strategy",
     "__version__",
 ]

@@ -448,6 +448,48 @@ class AutoDist:
             "(%d workers, staleness=%d)", n_workers, staleness)
         return trainer
 
+    # ------------------------------------------------------------ inference
+    def build_inference(
+        self,
+        params: Any,
+        apply_fn: Optional[Callable] = None,
+        decode_model=None,
+        checkpoint: Optional[str] = None,
+        n_slots: int = 8,
+        bucket_lens: Optional[Sequence[int]] = None,
+        max_len: Optional[int] = None,
+    ):
+        """Compile a sharded *inference* engine over this AutoDist's mesh —
+        the serving counterpart of :meth:`build` (same capture → strategy →
+        compile → lower pipeline, a forward/decode step instead of a train
+        step; docs/serving.md).
+
+        ``apply_fn(params, batch)`` enables one-shot inference
+        (:meth:`~autodist_tpu.serve.InferenceEngine.infer`); ``decode_model``
+        (e.g. ``autodist_tpu.models.transformer.decode_model(cfg)``) enables
+        autoregressive KV-cache decode behind the continuous batcher.
+        ``checkpoint`` restores parameters from a ``checkpoint/saver.py``
+        checkpoint directly into the plan's shardings (partial parallel
+        reads — no host ever holds the full logical arrays). The strategy
+        comes from this AutoDist's builder with the usual chief-builds/
+        workers-receive handoff, so a fleet serves one consistent plan.
+        """
+        from autodist_tpu.serve.engine import InferenceEngine
+
+        model_item = ModelItem.from_params(params)
+        strategy = self._build_or_load_strategy(model_item)
+        compiled = StrategyCompiler(model_item).compile(strategy)
+        plan = GraphTransformer(compiled, model_item, self.mesh).transform()
+        logging.debug("inference sharding plan:\n%s", plan.describe())
+        if checkpoint is not None:
+            params = InferenceEngine.restore_params(checkpoint, params, plan)
+        engine = InferenceEngine(
+            params, plan, apply_fn=apply_fn, decode_model=decode_model,
+            n_slots=n_slots, bucket_lens=bucket_lens, max_len=max_len,
+        )
+        self._strategy, self._model_item = compiled, model_item
+        return engine
+
     # ------------------------------------------------------------- pipeline
     def build_pipeline(
         self,

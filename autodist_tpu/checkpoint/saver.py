@@ -488,6 +488,27 @@ class Saver:
             )
         return jax.tree_util.tree_unflatten(treedef, out_leaves)
 
+    def restore_subtree(self, path: str, prefix: str, target: Any = None,
+                        shardings: Any = None) -> Any:
+        """Restore only the entries under ``<prefix>/`` of a checkpoint,
+        matched against ``target``'s UNPREFIXED names.
+
+        The serving loader's primitive: a training checkpoint stores the
+        whole logical state (``step``, ``params/...``, ``opt_state/...``),
+        but inference wants just the parameter subtree in its own pytree
+        shape — ``restore_subtree(path, "params", params_template,
+        shardings)`` reads exactly the ``params/`` blocks (still the
+        partial, parallel, re-sharding read) and never touches optimizer
+        slots. Works for any subtree name. With ``prefix=""`` it degrades
+        to plain :meth:`restore`.
+        """
+        if not prefix:
+            return self.restore(path, target=target, shardings=shardings)
+        wrapped_target = {prefix: target}
+        wrapped_sh = {prefix: shardings} if shardings is not None else None
+        return self.restore(
+            path, target=wrapped_target, shardings=wrapped_sh)[prefix]
+
     # ------------------------------------------------------------- utilities
     @staticmethod
     def read_metadata(path: str) -> dict:

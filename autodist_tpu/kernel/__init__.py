@@ -11,7 +11,7 @@ from autodist_tpu.kernel.lowering import (
     TrainState,
     VarPlan,
 )
-from autodist_tpu.kernel.mesh import build_mesh, data_axis
+from autodist_tpu.kernel.mesh import build_mesh, data_axis, data_sharding
 
 __all__ = [
     "DistributedTrainStep",
@@ -22,4 +22,5 @@ __all__ = [
     "VarPlan",
     "build_mesh",
     "data_axis",
+    "data_sharding",
 ]

@@ -194,3 +194,22 @@ def data_axis(mesh: Mesh) -> str:
     return _data_axis_name(
         mesh.axis_names, dict(zip(mesh.axis_names, mesh.devices.shape))
     )
+
+
+def data_sharding(mesh: Mesh, rank: int, dim: int = 0):
+    """NamedSharding for a rank-``rank`` array batch-sharded on ``dim``.
+
+    The generic "this dimension is per-example/per-slot work" placement:
+    training batches use dim 0 (``ShardingPlan.batch_shardings``), the
+    serving engine's KV-cache pools use dim 1 (``[layers, slots, ...]``).
+    Replicates when the data axis is trivial — a size-1 axis in the spec
+    would be legal but noisier to read in sharding dumps.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    ax = data_axis(mesh)
+    if dict(zip(mesh.axis_names, mesh.devices.shape))[ax] <= 1:
+        return NamedSharding(mesh, PartitionSpec())
+    spec = [None] * rank
+    spec[dim] = ax
+    return NamedSharding(mesh, PartitionSpec(*spec))

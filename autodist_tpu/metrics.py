@@ -18,6 +18,7 @@ Usage::
 """
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
 
 import jax
@@ -32,6 +33,11 @@ __all__ = [
     "lm_metrics",
     "ranking_metrics",
     "evaluate_dataset",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "registry",
 ]
 
 
@@ -242,3 +248,164 @@ def evaluate_dataset(
     result = {k: (sums[k] / weights[k]) if weights[k] else 0.0 for k in sums}
     result["examples"] = n_total
     return result
+
+
+# ------------------------------------------------------- operational registry
+# The functions above evaluate *task* metrics (accuracy, perplexity) over a
+# dataset. Serving needs *operational* metrics — latency percentiles, queue
+# depth, token throughput — observed from hot host threads. This registry is
+# the process-wide export surface the serve subsystem (and anything else
+# host-driven, e.g. the async PS trainer) publishes through: prometheus-style
+# named counters/gauges/histograms, thread-safe, renderable as text or a
+# snapshot dict.
+
+
+class Counter:
+    """Monotonic counter (requests served, tokens generated)."""
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Point-in-time value (queue depth, active slots, tokens/sec)."""
+
+    def __init__(self):
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        self._value = float(v)
+
+    def add(self, n: float = 1.0) -> None:
+        self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Latency-style distribution with exact count/sum and sampled quantiles.
+
+    Keeps up to ``max_samples`` observations; past that, reservoir sampling
+    (Vitter's algorithm R) keeps the retained set a uniform sample of the
+    stream, so percentiles stay unbiased at serving volumes while memory
+    stays bounded.
+    """
+
+    def __init__(self, max_samples: int = 4096):
+        self._samples: list = []
+        self._max = max_samples
+        self._count = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+        self._rng = np.random.default_rng(0)
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if len(self._samples) < self._max:
+                self._samples.append(v)
+            else:
+                j = int(self._rng.integers(0, self._count))
+                if j < self._max:
+                    self._samples[j] = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def percentile(self, p: float) -> float:
+        """p in [0, 100]; nan when nothing was observed."""
+        with self._lock:
+            if not self._samples:
+                return float("nan")
+            return float(np.percentile(np.asarray(self._samples), p))
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "count": float(self._count),
+            "sum": self._sum,
+            "mean": (self._sum / self._count) if self._count else float("nan"),
+            "p50": self.percentile(50.0),
+            "p90": self.percentile(90.0),
+            "p99": self.percentile(99.0),
+        }
+
+
+class MetricsRegistry:
+    """Named metric table: get-or-create by name, snapshot/render for export.
+
+    One process-wide default lives at ``metrics.registry``; components take a
+    registry argument so tests can isolate (the serve selftest passes its
+    own to keep its numbers clean of earlier runs).
+    """
+
+    def __init__(self):
+        self._metrics: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls()
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """{name: value | histogram summary dict} for JSON export."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out: Dict[str, Any] = {}
+        for name, m in items:
+            out[name] = m.summary() if isinstance(m, Histogram) else m.value
+        return out
+
+    def render_text(self) -> str:
+        """Prometheus-exposition-style lines (`name value`, quantiles as
+        `name{quantile="0.5"}` — the summary-metric convention scrapers
+        expect), for the serve front end's /metrics route."""
+        lines = []
+        for name, val in sorted(self.snapshot().items()):
+            if isinstance(val, dict):
+                for q, label in (("p50", "0.5"), ("p90", "0.9"),
+                                 ("p99", "0.99")):
+                    lines.append(
+                        f'{name}{{quantile="{label}"}} {val[q]:.6g}')
+                lines.append(f"{name}_count {val['count']:.6g}")
+                lines.append(f"{name}_sum {val['sum']:.6g}")
+            else:
+                lines.append(f"{name} {val:.6g}")
+        return "\n".join(lines) + "\n"
+
+
+#: Process-default registry (the serve subsystem's export surface).
+registry = MetricsRegistry()

@@ -173,6 +173,140 @@ def loss_fn(params, batch, cfg: TransformerConfig):
     return per_tok.sum() / jnp.maximum(mask.sum(), 1.0)
 
 
+# ------------------------------------------------------------ KV-cache decode
+def init_kv_cache(cfg: TransformerConfig, n_slots: int, max_len: int,
+                  dtype: Any = None) -> Dict[str, Any]:
+    """Preallocated decode cache for ``n_slots`` concurrent sequences.
+
+    One stacked array per projection — ``[num_layers, slots, max_len, heads,
+    head_dim]`` — so a whole decode step updates the cache with two
+    ``scatter``s instead of ``2 * num_layers`` and the serving engine can
+    donate it through the jitted step (in-place on device). ``max_len`` is
+    the slot's total timeline (prompt + generated), chosen per length bucket
+    by the engine; dtype defaults to the model's compute dtype.
+    """
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, n_slots, max_len, cfg.num_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def forward_prefill(params, tokens, length, cache, slot, cfg: TransformerConfig):
+    """Prompt pass: run the normal causal forward on ``tokens`` ``[1, S]``
+    (padded to the bucket), write each layer's k/v into cache row ``slot``
+    at positions ``[0, S)``, and return the greedy next token.
+
+    The attention itself is the UNCACHED forward (queries at position i
+    attend keys 0..i), so prefill logits match :func:`forward` exactly;
+    the cache is populated as a side product. Positions ``>= length`` hold
+    pad garbage, but the decode step's mask only admits positions
+    ``<= current`` and decode overwrites position ``length`` before first
+    attending it, so the garbage is never read.
+
+    Returns ``(next_token [1] int32, cache)`` where the token is the argmax
+    of the logits at position ``length - 1`` — the first generated token.
+    """
+    b, s = tokens.shape
+    x = L.embedding_lookup(params["embed"], tokens).astype(cfg.dtype)
+    pos = jnp.arange(s)
+    x = x + L.embedding_lookup(params["pos_embed"], pos).astype(cfg.dtype)
+    for i in range(cfg.num_layers):
+        block_params = params[f"layers_{i}"]
+        h = L.layernorm(block_params["ln1"], x)
+        attn_p = block_params["attn"]
+        q = L.dense(attn_p["wq"], h, compute_dtype=cfg.dtype)
+        k = L.dense(attn_p["wk"], h, compute_dtype=cfg.dtype)
+        v = L.dense(attn_p["wv"], h, compute_dtype=cfg.dtype)
+        q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        cache_dtype = cache["k"].dtype
+        cache["k"] = jax.lax.dynamic_update_slice(
+            cache["k"], k.astype(cache_dtype)[None],
+            (i, slot, 0, 0, 0))
+        cache["v"] = jax.lax.dynamic_update_slice(
+            cache["v"], v.astype(cache_dtype)[None],
+            (i, slot, 0, 0, 0))
+        o = _dot_attention(q, k, v, causal=True).reshape(b, s, cfg.d_model)
+        x = x + L.dense(attn_p["wo"], o, compute_dtype=cfg.dtype)
+        h = L.layernorm(block_params["ln2"], x)
+        h = L.dense(block_params["mlp"]["fc1"], h, compute_dtype=cfg.dtype)
+        h = jax.nn.gelu(h)
+        h = L.dense(block_params["mlp"]["fc2"], h, compute_dtype=cfg.dtype)
+        x = x + h
+    x = L.layernorm(params["ln_f"], x)
+    last = x[jnp.arange(b), length - 1]                      # [B, D]
+    logits = (last.astype(cfg.dtype)
+              @ params["embed"]["embedding"].T.astype(cfg.dtype))
+    return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32), cache
+
+
+def forward_decode_step(params, tokens, positions, cache, cfg: TransformerConfig):
+    """One incremental decode step over every cache slot.
+
+    ``tokens [B] int32`` is each slot's current token (B == slot count),
+    ``positions [B]`` its absolute timeline index. Each layer writes the
+    token's k/v into ``cache[:, b, positions[b]]`` and attends over the
+    cache with the mask ``j <= positions[b]`` — the incremental equivalent
+    of the causal forward's row ``positions[b]``. Inactive slots compute
+    garbage under the same mask (cheap; the engine ignores their outputs).
+
+    Returns ``(next_token [B] int32, cache)``.
+    """
+    b = tokens.shape[0]
+    max_len = cache["k"].shape[2]
+    rows = jnp.arange(b)
+    x = L.embedding_lookup(params["embed"], tokens).astype(cfg.dtype)
+    x = x + L.embedding_lookup(params["pos_embed"], positions).astype(cfg.dtype)
+    mask = (jnp.arange(max_len)[None, :] <= positions[:, None])  # [B, L]
+    for i in range(cfg.num_layers):
+        block_params = params[f"layers_{i}"]
+        h = L.layernorm(block_params["ln1"], x)
+        attn_p = block_params["attn"]
+        q = L.dense(attn_p["wq"], h, compute_dtype=cfg.dtype)
+        k = L.dense(attn_p["wk"], h, compute_dtype=cfg.dtype)
+        v = L.dense(attn_p["wv"], h, compute_dtype=cfg.dtype)
+        q = q.reshape(b, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, cfg.num_heads, cfg.head_dim)
+        v = v.reshape(b, cfg.num_heads, cfg.head_dim)
+        cache_dtype = cache["k"].dtype
+        cache["k"] = cache["k"].at[i, rows, positions].set(k.astype(cache_dtype))
+        cache["v"] = cache["v"].at[i, rows, positions].set(v.astype(cache_dtype))
+        ck = cache["k"][i].astype(cfg.dtype)                 # [B, L, H, D]
+        cv = cache["v"][i].astype(cfg.dtype)
+        logits = jnp.einsum("bhd,blhd->bhl", q, ck).astype(jnp.float32)
+        logits = logits / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
+        logits = jnp.where(mask[:, None, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        o = jnp.einsum("bhl,blhd->bhd", probs, cv).reshape(b, cfg.d_model)
+        x = x + L.dense(attn_p["wo"], o, compute_dtype=cfg.dtype)
+        h = L.layernorm(block_params["ln2"], x)
+        h = L.dense(block_params["mlp"]["fc1"], h, compute_dtype=cfg.dtype)
+        h = jax.nn.gelu(h)
+        h = L.dense(block_params["mlp"]["fc2"], h, compute_dtype=cfg.dtype)
+        x = x + h
+    x = L.layernorm(params["ln_f"], x)
+    logits = (x.astype(cfg.dtype)
+              @ params["embed"]["embedding"].T.astype(cfg.dtype))
+    return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32), cache
+
+
+def decode_model(cfg: TransformerConfig, eos_id: Optional[int] = None):
+    """The transformer's serving adapter — the pure cache functions bound to
+    one config, in the shape :class:`autodist_tpu.serve.InferenceEngine`
+    consumes (see serve/engine.py DecodeModel)."""
+    from autodist_tpu.serve.engine import DecodeModel
+
+    return DecodeModel(
+        init_cache=lambda n_slots, max_len: init_kv_cache(cfg, n_slots, max_len),
+        prefill=lambda params, tokens, length, cache, slot: forward_prefill(
+            params, tokens, length, cache, slot, cfg),
+        decode_step=lambda params, tokens, positions, cache: forward_decode_step(
+            params, tokens, positions, cache, cfg),
+        eos_id=eos_id,
+        max_len=cfg.max_seq_len,
+    )
+
+
 # ------------------------------------------------------------------- modelspec
 @register_model("transformer")
 def transformer_lm(**overrides) -> ModelSpec:

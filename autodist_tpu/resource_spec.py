@@ -238,6 +238,7 @@ class ResourceSpec:
         self._tpu = TPUTopology()
         self._mesh_override: Optional[Dict[str, int]] = None
         self._ssh_configs: Dict[str, SSHConfig] = {}
+        self._allow_uneven_chips = bool(self._raw.get("allow_uneven_chips", False))
         self._parse(self._raw)
         self._validate()
 
@@ -306,6 +307,28 @@ class ResourceSpec:
             raise ValueError("multi-node resource specs cannot contain loopback addresses")
         if any(n.chips < 0 for n in self._nodes):
             raise ValueError("chips must be >= 0")
+        # TPU homogeneity check (VERDICT open item 6): every host in a real
+        # TPU slice carries the SAME chip count — v4/v5/v6 pods expose 4 (or
+        # 8) chips per host, uniformly. An uneven `chips:` table therefore
+        # almost always means a typo'd spec (the reference's uneven-GPU case
+        # needed weighted gradient averaging; here chips are the replica
+        # unit, so *semantics* stay exact, but jax.distributed still expects
+        # every process to contribute the same local device count and the
+        # mesh math inherits that assumption). Fail loudly at parse time —
+        # not as a mesh/runtime mismatch three layers later. Genuinely
+        # heterogeneous clusters (CPU sims, GPU fleets wearing the TPU spec
+        # shape) can declare intent with `allow_uneven_chips: true`.
+        counts = sorted({n.chips for n in self._nodes})
+        if len(self._nodes) > 1 and len(counts) > 1 and not self._allow_uneven_chips:
+            detail = ", ".join(f"{n.address}={n.chips}" for n in self._nodes)
+            raise ValueError(
+                f"uneven per-host chips counts ({detail}): TPU slices are "
+                f"homogeneous — every host exposes the same number of chips "
+                f"— so this spec is almost certainly a typo. If this cluster "
+                f"really is heterogeneous (CPU simulation, mixed GPU hosts), "
+                f"set `allow_uneven_chips: true` in the resource spec. See "
+                f"docs/parity.md (heterogeneity position)."
+            )
         if self._mesh_override:
             if math.prod(self._mesh_override.values()) != self.num_chips:
                 raise ValueError(
@@ -477,6 +500,7 @@ class ResourceSpec:
                 ),
             },
             **({"mesh": dict(self._mesh_override)} if self._mesh_override else {}),
+            **({"allow_uneven_chips": True} if self._allow_uneven_chips else {}),
         }
 
     def fingerprint(self) -> str:

@@ -1,0 +1,41 @@
+"""Inference serving (L5b): sharded engine + continuous batching front end.
+
+The training half of the framework ends at a compiled
+:class:`~autodist_tpu.kernel.DistributedTrainStep`; this package opens the
+inference half of the ROADMAP north star ("serves heavy traffic"): the same
+``Strategy``/``ShardingPlan`` substrate compiles a *forward/decode* step
+instead of a train step (GSPMD sharding annotations scale to inference
+unchanged — arxiv 2105.04663 §6), a continuous batcher keeps the device fed
+across requests of ragged lengths, and a thin asyncio front end exposes it.
+
+Layers:
+
+- :mod:`autodist_tpu.serve.engine` — :class:`InferenceEngine`: params
+  restored from a checkpoint into plan shardings, a jitted one-shot apply,
+  and a preallocated length-bucketed KV-cache decode loop (slots × buckets).
+- :mod:`autodist_tpu.serve.batcher` — :class:`ContinuousBatcher`: bounded
+  admission queue with backpressure, dynamic batch assembly under a token
+  budget, per-request deadlines, slot recycling mid-batch.
+- :mod:`autodist_tpu.serve.server` — asyncio HTTP front end and the
+  ``python -m autodist_tpu.serve --selftest`` CPU-sim proof.
+
+Entry point: ``autodist.build_inference(...)`` (api.py) or
+:meth:`InferenceEngine.build` directly.
+"""
+from autodist_tpu.serve.batcher import (
+    Backpressure,
+    ContinuousBatcher,
+    GenRequest,
+    RequestState,
+)
+from autodist_tpu.serve.engine import DecodeModel, InferenceEngine, Slot
+
+__all__ = [
+    "Backpressure",
+    "ContinuousBatcher",
+    "DecodeModel",
+    "GenRequest",
+    "InferenceEngine",
+    "RequestState",
+    "Slot",
+]

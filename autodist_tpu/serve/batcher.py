@@ -1,0 +1,385 @@
+"""Continuous batching: bounded admission queue + slot scheduler.
+
+The serving analog of the training data pipeline's "keep the device fed"
+contract. Requests enter a bounded FIFO (``submit`` raises
+:class:`Backpressure` when full — admission control, never silent drops);
+a single scheduler thread assembles the active batch dynamically under a
+max-token budget, prefills new requests into free engine slots, runs one
+decode step per tick across every active slot, and retires sequences the
+moment they finish (EOS / ``max_new_tokens`` / deadline / bucket capacity),
+recycling their slot in the same tick — no batch barrier, a request never
+waits for its batchmates (Orca-style iteration-level scheduling).
+
+Progress is guaranteed by construction: every active sequence has a finite
+timeline (its bucket length bounds it even if EOS never fires), so slots
+always free; a queued request that can never be placed (longer than the
+largest bucket) is rejected at submit time rather than head-blocking the
+FIFO. Liveness is therefore a property, not a tuning outcome — the
+``--selftest`` acceptance bar (zero dropped/deadlocked) tests it.
+
+Metrics (through :mod:`autodist_tpu.metrics`' registry):
+``serve_queue_depth`` / ``serve_active_slots`` gauges,
+``serve_requests_{submitted,completed,timeout,rejected}_total`` counters,
+``serve_tokens_generated_total`` counter, ``serve_tokens_per_sec`` gauge
+(rolling), and ``serve_request_latency_s`` / ``serve_ttft_s`` histograms
+(p50/p99 exported by the registry).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from autodist_tpu import metrics as M
+from autodist_tpu.serve.engine import InferenceEngine, Slot
+from autodist_tpu.utils import logging
+
+
+class Backpressure(RuntimeError):
+    """Admission queue full — the client should retry/shed (HTTP 429)."""
+
+
+class RequestState(Enum):
+    QUEUED = "queued"
+    ACTIVE = "active"
+    DONE = "done"
+    TIMEOUT = "timeout"
+    REJECTED = "rejected"
+
+
+_ids = itertools.count()
+
+
+@dataclass
+class GenRequest:
+    """One generation request and its lifecycle."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    deadline: Optional[float] = None      # absolute time.monotonic() cutoff
+    id: int = field(default_factory=lambda: next(_ids))
+    t_submit: float = field(default_factory=time.monotonic)
+    t_first_token: Optional[float] = None
+    t_done: Optional[float] = None
+    tokens: List[int] = field(default_factory=list)
+    state: RequestState = RequestState.QUEUED
+    error: str = ""
+    _event: threading.Event = field(default_factory=threading.Event, repr=False)
+    _cb_lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _callbacks: List[Callable[["GenRequest"], None]] = field(
+        default_factory=list, repr=False)
+
+    def wait(self, timeout: Optional[float] = None) -> "GenRequest":
+        """Block until terminal; returns self (check ``state``)."""
+        self._event.wait(timeout)
+        return self
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        return None if self.t_done is None else self.t_done - self.t_submit
+
+    def add_done_callback(self, fn: Callable[["GenRequest"], None]) -> None:
+        """Run ``fn(request)`` on completion, from the scheduler thread —
+        the asyncio bridge (the server wraps it in call_soon_threadsafe).
+        Fires immediately if already terminal. The lock closes the
+        check-then-append race against a concurrent ``_finish``: without
+        it, a request finishing between the two would strand the callback
+        unfired (a hung HTTP client)."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _finish(self, state: RequestState, error: str = "") -> None:
+        with self._cb_lock:
+            self.state = state
+            self.error = error
+            self.t_done = time.monotonic()
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            try:
+                fn(self)
+            except Exception:  # noqa: BLE001 - a bad callback can't kill the loop
+                logging.warning("request %d done-callback raised", self.id,
+                                exc_info=True)
+
+
+class ContinuousBatcher:
+    """Request queue + scheduler around one :class:`InferenceEngine`.
+
+    ``max_queue`` bounds admission (backpressure); ``max_active_tokens``
+    bounds the assembled batch by *allocated timeline tokens* (sum of
+    admitted requests' bucket lengths — capacity actually reserved in HBM),
+    defaulting to the engine's full slot pool. ``start()`` spawns the
+    scheduler thread; ``submit`` is thread-safe and wakes it.
+    """
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        max_queue: int = 256,
+        max_active_tokens: Optional[int] = None,
+        registry: Optional[M.MetricsRegistry] = None,
+    ):
+        if engine.decode_model is None:
+            raise ValueError("ContinuousBatcher needs an engine with a "
+                             "decode_model")
+        self.engine = engine
+        self.max_queue = max_queue
+        self.max_active_tokens = max_active_tokens or (
+            engine.n_slots * engine.max_len * len(engine._bucket_lens))
+        self._queue: deque[GenRequest] = deque()
+        self._active: Dict[Slot, GenRequest] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._running = False
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        self._tick_tokens: deque = deque(maxlen=64)  # (t, n) for tokens/sec
+
+        reg = registry or M.registry
+        self._m_depth = reg.gauge("serve_queue_depth")
+        self._m_active = reg.gauge("serve_active_slots")
+        self._m_submitted = reg.counter("serve_requests_submitted_total")
+        self._m_completed = reg.counter("serve_requests_completed_total")
+        self._m_timeout = reg.counter("serve_requests_timeout_total")
+        self._m_rejected = reg.counter("serve_requests_rejected_total")
+        self._m_tokens = reg.counter("serve_tokens_generated_total")
+        self._m_tps = reg.gauge("serve_tokens_per_sec")
+        self._m_latency = reg.histogram("serve_request_latency_s")
+        self._m_ttft = reg.histogram("serve_ttft_s")
+
+    # ---------------------------------------------------------------- clients
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int = 32,
+        timeout_s: Optional[float] = None,
+    ) -> GenRequest:
+        """Enqueue a request. Raises :class:`Backpressure` when the queue is
+        at ``max_queue``; raises ValueError when the request can never fit a
+        bucket (so impossibility surfaces at the edge, not as a stuck queue
+        head). ``timeout_s`` sets the request deadline relative to now."""
+        prompt = np.asarray(prompt, np.int32).ravel()
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if self.engine.bucket_for(len(prompt) + max_new_tokens) is None:
+            self._m_rejected.inc()
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
+                f"exceeds the largest decode bucket ({self.engine.max_len})")
+        req = GenRequest(
+            prompt=prompt,
+            max_new_tokens=max_new_tokens,
+            deadline=(time.monotonic() + timeout_s) if timeout_s else None,
+        )
+        with self._wake:
+            if self._stopped:
+                # Accepting work that will never run would hang the client
+                # in wait() forever. (Pre-start submission is fine — the
+                # queue drains once start() runs.)
+                self._m_rejected.inc()
+                raise Backpressure("batcher is stopped")
+            if len(self._queue) >= self.max_queue:
+                self._m_rejected.inc()
+                raise Backpressure(
+                    f"admission queue full ({self.max_queue} requests)")
+            self._queue.append(req)
+            self._m_submitted.inc()
+            self._m_depth.set(len(self._queue))
+            self._wake.notify()
+        return req
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self) -> "ContinuousBatcher":
+        with self._lock:
+            if self._running:
+                return self
+            self._running = True
+            self._stopped = False
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-batcher", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Stop the scheduler; ``drain=True`` finishes in-flight + queued
+        work first (bounded by each request's own limits). Whatever is
+        still undone when the scheduler exits — drain disabled, drain
+        timeout, or work submitted before start() of a batcher that never
+        started — is failed terminally, so no client ever blocks in
+        ``wait()`` on a request nobody will run."""
+        deadline = time.monotonic() + timeout_s
+        if drain and self._thread is not None:
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not self._queue and not self._active:
+                        break
+                time.sleep(0.01)
+        with self._wake:
+            self._running = False
+            self._stopped = True
+            self._wake.notify()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+        self._fail_all("batcher stopped before this request completed")
+
+    def __enter__(self) -> "ContinuousBatcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -------------------------------------------------------------- scheduler
+    def _loop(self) -> None:
+        while True:
+            with self._wake:
+                if not self._running:
+                    break
+                if not self._queue and not self._active:
+                    self._wake.wait(timeout=0.5)
+                    continue
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 - scheduler must survive
+                # A tick failure (e.g. transient compile/OOM) fails the
+                # requests it touched via _fail_active below rather than
+                # killing the loop silently.
+                logging.warning("batcher tick failed", exc_info=True)
+                self._fail_all("scheduler tick failed; see server log")
+
+    def _fail_all(self, msg: str) -> None:
+        with self._lock:
+            active = list(self._active.items())
+            self._active.clear()
+            queued = list(self._queue)
+            self._queue.clear()
+            self._m_depth.set(0)
+        for slot, req in active:
+            self.engine.release(slot)
+            req._finish(RequestState.REJECTED, msg)
+        for req in queued:
+            req._finish(RequestState.REJECTED, msg)
+        self._m_rejected.inc(len(active) + len(queued))
+
+    def _tick(self) -> None:
+        """One scheduler iteration: expire → admit → decode → retire."""
+        now = time.monotonic()
+
+        # Queued requests whose deadline already passed will only get staler
+        # waiting for a slot: time them out from the queue.
+        with self._lock:
+            expired = [r for r in self._queue
+                       if r.deadline is not None and now > r.deadline]
+            for r in expired:
+                self._queue.remove(r)
+            self._m_depth.set(len(self._queue))
+        for r in expired:
+            self._m_timeout.inc()
+            r._finish(RequestState.TIMEOUT, "deadline expired in queue")
+
+        # Admission: fill free slots FIFO under the token budget. Prefill
+        # (including any first-use XLA compile) runs OUTSIDE self._lock —
+        # only this scheduler thread ever pops, so the peeked head is
+        # stable, and submit()/the asyncio event loop never block on the
+        # device. The budget rides into admit() so a full small bucket
+        # cannot spill into a larger one past max_active_tokens.
+        while True:
+            dead = None
+            with self._lock:
+                if not self._queue:
+                    break
+                head = self._queue[0]
+                if head.deadline is not None and time.monotonic() > head.deadline:
+                    # Submitted after this tick's expiry sweep: never admit
+                    # an already-dead request. (_finish runs outside the
+                    # lock — a done-callback may re-enter submit.)
+                    dead = self._queue.popleft()
+                    self._m_depth.set(len(self._queue))
+            if dead is not None:
+                self._m_timeout.inc()
+                dead._finish(RequestState.TIMEOUT, "deadline expired in queue")
+                continue
+            budget = self.max_active_tokens - self.engine.active_tokens
+            admitted = self.engine.admit(
+                head.prompt, head.max_new_tokens, token_budget=budget)
+            if admitted is None:
+                break  # no free slot / over budget; retire will wake us again
+            slot, first = admitted
+            with self._lock:
+                self._queue.popleft()
+                self._m_depth.set(len(self._queue))
+                head.state = RequestState.ACTIVE
+                head.t_first_token = time.monotonic()
+                head.tokens.append(first)
+                self._active[slot] = head
+            self._m_ttft.observe(head.t_first_token - head.t_submit)
+            self._count_tokens(1)
+            self._maybe_retire(slot, head)
+
+        # One decode step over every active slot (all buckets).
+        with self._lock:
+            have_active = bool(self._active)
+        if have_active:
+            emitted = self.engine.step()
+            self._count_tokens(len(emitted))
+            for slot, token in emitted.items():
+                with self._lock:
+                    req = self._active.get(slot)
+                if req is None:
+                    continue
+                req.tokens.append(token)
+                self._maybe_retire(slot, req)
+        with self._lock:
+            self._m_active.set(len(self._active))
+
+    def _maybe_retire(self, slot: Slot, req: GenRequest) -> None:
+        """Finish + recycle the slot when the sequence is done."""
+        now = time.monotonic()
+        eos = self.engine.decode_model.eos_id
+        state = None
+        if req.deadline is not None and now > req.deadline:
+            state, why = RequestState.TIMEOUT, "deadline expired mid-decode"
+        elif eos is not None and req.tokens and req.tokens[-1] == eos:
+            state, why = RequestState.DONE, ""
+        elif len(req.tokens) >= req.max_new_tokens:
+            state, why = RequestState.DONE, ""
+        elif self.engine.slot_len(slot) >= slot.bucket:
+            # Bucket timeline exhausted (cannot happen when admit sized the
+            # bucket to prompt+max_new, but a defensive bound keeps liveness
+            # even if a model emits past its positional ceiling).
+            state, why = RequestState.DONE, "bucket timeline exhausted"
+        if state is None:
+            return
+        with self._lock:
+            self._active.pop(slot, None)
+        self.engine.release(slot)
+        (self._m_timeout if state is RequestState.TIMEOUT
+         else self._m_completed).inc()
+        req._finish(state, why)
+        self._m_latency.observe(time.monotonic() - req.t_submit)
+        with self._wake:
+            self._wake.notify()  # a slot freed: admission may proceed
+
+    def _count_tokens(self, n: int) -> None:
+        self._m_tokens.inc(n)
+        now = time.monotonic()
+        self._tick_tokens.append((now, n))
+        window = [(t, k) for t, k in self._tick_tokens if now - t <= 5.0]
+        if len(window) >= 2:
+            dt = now - window[0][0]
+            if dt > 0:
+                self._m_tps.set(sum(k for _, k in window) / dt)

@@ -1,0 +1,417 @@
+"""Sharded inference engine: one-shot apply + KV-cache decode over a plan.
+
+The engine is the inference counterpart of
+:class:`~autodist_tpu.kernel.DistributedTrainStep`: it consumes the SAME
+lowering artifacts — a :class:`~autodist_tpu.kernel.ShardingPlan` produced by
+``StrategyCompiler`` + ``GraphTransformer`` from any strategy builder — so a
+strategy searched for training reuses directly for serving (the Automap
+argument, arxiv 2112.02958: the search substrate is workload-agnostic).
+Params land in their plan shardings (optionally restored straight from a
+``checkpoint/saver.py`` checkpoint via the partial, parallel sharded-read
+path), batches shard over the mesh data axis, and GSPMD inserts the
+collectives for model-sharded parameters exactly as in training.
+
+Decode state is **preallocated and length-bucketed**: the engine owns a
+fixed pool of slots per bucket length (powers-of-two timelines up to the
+model's ``max_len``), each bucket one stacked KV-cache array donated through
+its jitted decode step (in-place on device, no per-step allocation). A
+request is routed to the smallest bucket that fits ``prompt + max_new``;
+within a bucket, decode always runs the full slot batch with finished slots
+masked host-side — admission (prefill into a free slot) and retirement never
+recompile anything. Compiled programs: one prefill + one decode per bucket.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from autodist_tpu.kernel import GraphTransformer, ShardingPlan, build_mesh, data_axis
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.utils import logging
+
+DEFAULT_BUCKET_LENS = (32, 64, 128, 256, 512, 1024)
+
+
+@dataclass
+class DecodeModel:
+    """Model adapter for autoregressive decode — pure functions, one config.
+
+    - ``init_cache(n_slots, max_len) -> cache`` pytree of device arrays with
+      slot dim 1 (after any leading stack dims — the engine shards dim 1 of
+      rank>=2 leaves over the data axis);
+    - ``prefill(params, tokens [1,S], length, cache, slot) ->
+      (next_token [1], cache)`` — writes the prompt's k/v into cache row
+      ``slot`` and returns the greedy first token;
+    - ``decode_step(params, tokens [B], positions [B], cache) ->
+      (next_token [B], cache)`` with ``B == n_slots``;
+    - ``eos_id``: generation stops when emitted (None = length-only);
+    - ``max_len``: the model's positional ceiling (caps bucket lengths).
+
+    ``autodist_tpu.models.transformer.decode_model(cfg)`` builds one for the
+    zoo transformer; any model matching the contract serves the same way.
+    """
+
+    init_cache: Callable[[int, int], Any]
+    prefill: Callable[..., Tuple[Any, Any]]
+    decode_step: Callable[..., Tuple[Any, Any]]
+    eos_id: Optional[int] = None
+    max_len: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class Slot:
+    """One occupied decode slot: (bucket timeline length, row index)."""
+
+    bucket: int
+    index: int
+
+
+@dataclass
+class _Bucket:
+    """Host-side bookkeeping for one bucket's device cache."""
+
+    length: int                 # timeline capacity per slot
+    n_slots: int
+    cache: Any                  # device pytree, donated through decode
+    lengths: np.ndarray         # [slots] int32 — next write position
+    active: np.ndarray          # [slots] bool
+    last_token: np.ndarray      # [slots] int32 — token to feed next step
+    prefill_fn: Any = None      # compiled lazily
+    decode_fn: Any = None
+
+
+class InferenceEngine:
+    """Serve a (possibly sharded) model: ``infer`` for one-shot batches,
+    ``admit``/``step``/``release`` for continuous-batching decode.
+
+    The admit/step/release surface is deliberately scheduler-free: the
+    :class:`~autodist_tpu.serve.batcher.ContinuousBatcher` owns queueing,
+    deadlines and retirement policy; the engine owns device state. All three
+    methods must be called from one scheduler thread (they mutate host-side
+    slot tables without locking — single-writer by contract).
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        plan: ShardingPlan,
+        apply_fn: Optional[Callable] = None,
+        decode_model: Optional[DecodeModel] = None,
+        n_slots: int = 8,
+        bucket_lens: Optional[Sequence[int]] = None,
+        max_len: Optional[int] = None,
+    ):
+        if apply_fn is None and decode_model is None:
+            raise ValueError(
+                "InferenceEngine needs apply_fn (one-shot), decode_model "
+                "(autoregressive), or both")
+        self.plan = plan
+        self.mesh = plan.mesh
+        self._data_axis = data_axis(self.mesh)
+        self._data_degree = dict(
+            zip(self.mesh.axis_names, self.mesh.devices.shape))[self._data_axis]
+        # Storage view + plan shardings: the same parameter contract the
+        # train step uses (pad-and-mask plans store padded; the wrapped fns
+        # below unpad under the trace). device_view: serving ignores
+        # host-offload markers — params stay HBM-resident (offload is a
+        # training-memory bargain inference has no reason to pay per step).
+        self.params = jax.device_put(
+            plan.pad_params(params),
+            plan.params_shardings(
+                jax.eval_shape(lambda: plan.pad_params(params)),
+                device_view=True),
+        )
+        self._apply_fn = apply_fn
+        self._apply_jit = (
+            jax.jit(lambda p, b: apply_fn(plan.unpad_params(p), b))
+            if apply_fn is not None else None
+        )
+        self.decode_model = decode_model
+
+        self._buckets: Dict[int, _Bucket] = {}
+        if decode_model is not None:
+            # Slot batch must divide over the data axis (cache dim 1 shards
+            # there); round up rather than reject.
+            if n_slots % self._data_degree:
+                n_slots += self._data_degree - n_slots % self._data_degree
+            self.n_slots = n_slots
+            ceiling = min(
+                x for x in (max_len, decode_model.max_len) if x is not None
+            ) if (max_len or decode_model.max_len) else None
+            lens = list(bucket_lens or DEFAULT_BUCKET_LENS)
+            if ceiling is not None:
+                lens = [l for l in lens if l < ceiling] + [ceiling]
+            self._bucket_lens = tuple(sorted(set(lens)))
+            self.max_len = self._bucket_lens[-1]
+            cache_sh = self._cache_shardings(decode_model.init_cache)
+            for length in self._bucket_lens:
+                cache = jax.device_put(
+                    decode_model.init_cache(n_slots, length), cache_sh)
+                self._buckets[length] = _Bucket(
+                    length=length,
+                    n_slots=n_slots,
+                    cache=cache,
+                    lengths=np.zeros(n_slots, np.int32),
+                    active=np.zeros(n_slots, bool),
+                    last_token=np.zeros(n_slots, np.int32),
+                )
+
+    # ------------------------------------------------------------ construction
+    @classmethod
+    def build(
+        cls,
+        params: Any,
+        apply_fn: Optional[Callable] = None,
+        decode_model: Optional[DecodeModel] = None,
+        *,
+        strategy_builder=None,
+        resource_spec=None,
+        mesh=None,
+        checkpoint: Optional[str] = None,
+        **engine_kwargs,
+    ) -> "InferenceEngine":
+        """Standalone construction: capture → strategy → lower → engine.
+
+        The one-call path for scripts that don't hold an
+        :class:`~autodist_tpu.api.AutoDist` (which offers the same through
+        ``build_inference`` with the chief/worker strategy handoff).
+        ``checkpoint`` restores params from a ``Saver`` checkpoint directly
+        into the plan's shardings — each process reads only the file regions
+        its devices need, so loading a sharded model never materializes the
+        full logical arrays on one host.
+        """
+        from autodist_tpu.resource_spec import ResourceSpec
+        from autodist_tpu.strategy import AllReduce
+        from autodist_tpu.strategy.base import StrategyCompiler
+
+        if resource_spec is None and mesh is None:
+            resource_spec = ResourceSpec.from_local_devices()
+        if mesh is None:
+            mesh = build_mesh(resource_spec)
+        # Inference default is AllReduce (replicated params, data-sharded
+        # batch): with no gradient wire, PS/ZeRO residency choices only add
+        # gathers to the forward. Model-partitioned builders (TensorParallel,
+        # PartitionedAR) carry over as-is — their pspecs shard the serving
+        # params the same way they sharded training.
+        builder = strategy_builder or AllReduce()
+        model_item = ModelItem.from_params(params)
+        strategy = builder.build(model_item, resource_spec) if resource_spec \
+            else builder.build(model_item, ResourceSpec.from_local_devices())
+        compiled = StrategyCompiler(model_item).compile(strategy)
+        plan = GraphTransformer(compiled, model_item, mesh).transform()
+        if checkpoint is not None:
+            params = cls.restore_params(checkpoint, params, plan)
+        return cls(params, plan, apply_fn=apply_fn, decode_model=decode_model,
+                   **engine_kwargs)
+
+    @staticmethod
+    def restore_params(checkpoint: str, params_template: Any,
+                       plan: ShardingPlan) -> Any:
+        """Checkpoint → params in plan shardings (partial, parallel read).
+
+        ``checkpoint`` is a checkpoint dir (``.../ckpt-N``) or a Saver
+        directory (the newest ``ckpt-*`` inside is taken). The template
+        supplies the pytree structure + logical shapes; a training
+        checkpoint's extra entries (optimizer slots, step) are ignored —
+        saving ``state.params`` or the whole logical state both serve.
+        """
+        import os
+
+        from autodist_tpu.checkpoint.saver import Saver
+
+        if os.path.exists(os.path.join(checkpoint, "metadata.json")):
+            saver, path = Saver(os.path.dirname(checkpoint)), checkpoint
+        else:
+            saver = Saver(checkpoint)
+            path = saver.latest_checkpoint()
+            if path is None:
+                raise FileNotFoundError(f"no ckpt-* under {checkpoint!r}")
+        shaped = jax.eval_shape(lambda: params_template)
+        # Serving keeps params HBM-resident regardless of training-time
+        # offload markers (device_view): offload trades HBM for per-step
+        # streaming, a training-memory bargain inference has no reason to pay.
+        shardings = plan.params_shardings(shaped, device_view=True)
+        # A checkpoint written from a full train state (step.save) prefixes
+        # every parameter with "params/"; restore just that subtree so the
+        # optimizer/step entries are never read.
+        from autodist_tpu.model_item import _path_to_name
+
+        leaves, _ = jax.tree_util.tree_flatten_with_path(shaped)
+        probe = _path_to_name(leaves[0][0]) if leaves else ""
+        entries = Saver.read_metadata(path)["entries"]
+        if probe and probe not in entries and f"params/{probe}" in entries:
+            return saver.restore_subtree(path, "params", shaped, shardings)
+        return saver.restore(path, target=shaped, shardings=shardings)
+
+    # --------------------------------------------------------------- one-shot
+    def infer(self, batch: Any) -> Any:
+        """One-shot forward (classification, scoring): batch shards over the
+        data axis, output stays a device pytree."""
+        if self._apply_jit is None:
+            raise ValueError("engine built without apply_fn; one-shot "
+                             "inference unavailable")
+        batch = jax.device_put(
+            batch, self.plan.batch_shardings(batch, strict=False))
+        return self._apply_jit(self.params, batch)
+
+    # ------------------------------------------------------------ decode pool
+    def _cache_shardings(self, init_cache):
+        """Slot dim (dim 1 of rank>=2 leaves) over the data axis; scalars and
+        vectors replicate. Evaluated on abstract shapes — no device cache is
+        built to derive its own sharding."""
+        from autodist_tpu.kernel.mesh import data_sharding
+
+        shaped = jax.eval_shape(lambda: init_cache(self.n_slots, 8))
+
+        def leaf_sh(leaf):
+            if len(leaf.shape) >= 2 and leaf.shape[1] == self.n_slots:
+                return data_sharding(self.mesh, len(leaf.shape), dim=1)
+            return NamedSharding(self.mesh, P())
+
+        return jax.tree_util.tree_map(leaf_sh, shaped)
+
+    def bucket_for(self, total_len: int) -> Optional[int]:
+        """Smallest bucket whose timeline fits ``total_len``; None = too long."""
+        for length in self._bucket_lens:
+            if total_len <= length:
+                return length
+        return None
+
+    @property
+    def free_slots(self) -> int:
+        return sum(int((~b.active).sum()) for b in self._buckets.values())
+
+    @property
+    def active_slots(self) -> int:
+        return sum(int(b.active.sum()) for b in self._buckets.values())
+
+    @property
+    def active_tokens(self) -> int:
+        """Allocated timeline tokens across active slots — the admission
+        budget's currency (capacity reserved, not yet-decoded length)."""
+        return sum(
+            int(b.active.sum()) * b.length for b in self._buckets.values())
+
+    def _compile_bucket(self, bucket: _Bucket) -> None:
+        dm = self.decode_model
+        # donate the cache: decode/prefill rewrite it in place on device.
+        bucket.prefill_fn = jax.jit(
+            lambda p, tokens, length, cache, slot: dm.prefill(
+                self.plan.unpad_params(p), tokens, length, cache, slot),
+            donate_argnums=(3,))
+        bucket.decode_fn = jax.jit(
+            lambda p, tokens, positions, cache: dm.decode_step(
+                self.plan.unpad_params(p), tokens, positions, cache),
+            donate_argnums=(3,))
+
+    def admit(self, prompt: np.ndarray, max_new_tokens: int,
+              token_budget: Optional[int] = None) -> Optional[Tuple[Slot, int]]:
+        """Prefill ``prompt`` into a free slot of the smallest fitting bucket.
+
+        Returns ``(slot, first_token)`` — prefill already emits the first
+        generated token — or None when every fitting bucket is full (the
+        batcher keeps the request queued). ``token_budget`` caps the
+        timeline length this admission may *allocate*: a full small bucket
+        must not spill into a larger one past the batcher's max-token
+        budget. Raises ValueError when ``len(prompt) + max_new_tokens``
+        exceeds the largest bucket: such a request can never be placed, and
+        queueing it would head-block the FIFO forever (the deadlock the
+        acceptance bar forbids).
+        """
+        if self.decode_model is None:
+            raise ValueError("engine built without decode_model")
+        prompt = np.asarray(prompt, np.int32).ravel()
+        total = len(prompt) + max_new_tokens
+        fit = self.bucket_for(total)
+        if fit is None:
+            raise ValueError(
+                f"request needs a {total}-token timeline; largest bucket is "
+                f"{self._bucket_lens[-1]} (prompt {len(prompt)} + "
+                f"max_new_tokens {max_new_tokens})")
+        for length in self._bucket_lens:
+            if length < fit:
+                continue
+            if token_budget is not None and length > token_budget:
+                break  # every later bucket is bigger still
+            bucket = self._buckets[length]
+            free = np.flatnonzero(~bucket.active)
+            if not len(free):
+                continue
+            idx = int(free[0])
+            if bucket.prefill_fn is None:
+                self._compile_bucket(bucket)
+            padded = np.zeros((1, length), np.int32)
+            padded[0, : len(prompt)] = prompt
+            first, bucket.cache = bucket.prefill_fn(
+                self.params, jnp.asarray(padded),
+                jnp.int32(len(prompt)), bucket.cache, jnp.int32(idx))
+            first = int(jax.device_get(first)[0])
+            bucket.active[idx] = True
+            bucket.lengths[idx] = len(prompt)
+            bucket.last_token[idx] = first
+            return Slot(length, idx), first
+        return None
+
+    def step(self) -> Dict[Slot, int]:
+        """One decode step over every bucket with active slots.
+
+        Feeds each slot its last emitted token at its current position,
+        returns ``{slot: next_token}`` for active slots only. Host-side
+        lengths advance here — the emitted token's k/v will be written at
+        the advanced position next step.
+        """
+        out: Dict[Slot, int] = {}
+        for length, bucket in self._buckets.items():
+            if not bucket.active.any():
+                continue
+            if bucket.decode_fn is None:
+                self._compile_bucket(bucket)
+            tokens, bucket.cache = bucket.decode_fn(
+                self.params,
+                jnp.asarray(bucket.last_token),
+                jnp.asarray(bucket.lengths),
+                bucket.cache)
+            tokens = np.asarray(jax.device_get(tokens))
+            for idx in np.flatnonzero(bucket.active):
+                idx = int(idx)
+                bucket.lengths[idx] += 1
+                bucket.last_token[idx] = tokens[idx]
+                out[Slot(length, idx)] = int(tokens[idx])
+        return out
+
+    def slot_len(self, slot: Slot) -> int:
+        return int(self._buckets[slot.bucket].lengths[slot.index])
+
+    def release(self, slot: Slot) -> None:
+        """Recycle a slot mid-batch: the row is immediately admittable; its
+        cache rows are dead weight overwritten by the next prefill."""
+        bucket = self._buckets[slot.bucket]
+        bucket.active[slot.index] = False
+        bucket.lengths[slot.index] = 0
+        bucket.last_token[slot.index] = 0
+
+    # ------------------------------------------------------------- generation
+    def generate(self, prompt: np.ndarray, max_new_tokens: int) -> List[int]:
+        """Single-request greedy decode — the sequential baseline (and the
+        correctness oracle's cached side). Production traffic should go
+        through the batcher; this admits one request and steps it alone.
+        """
+        admitted = self.admit(prompt, max_new_tokens)
+        if admitted is None:
+            raise RuntimeError("no free slot for a single-request generate()")
+        slot, first = admitted
+        tokens = [first]
+        eos = self.decode_model.eos_id
+        try:
+            while len(tokens) < max_new_tokens and (eos is None or tokens[-1] != eos):
+                tokens.append(self.step()[slot])
+        finally:
+            self.release(slot)
+        return tokens

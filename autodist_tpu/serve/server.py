@@ -1,0 +1,277 @@
+"""Asyncio front end + the ``--selftest`` CPU-sim proof.
+
+The front end is deliberately thin: a dependency-free HTTP/1.1 listener on
+``asyncio.start_server`` that bridges requests onto the
+:class:`~autodist_tpu.serve.batcher.ContinuousBatcher`'s scheduler thread
+(completion callbacks resolve asyncio futures via ``call_soon_threadsafe``
+— the event loop never blocks on the device). Routes:
+
+- ``POST /generate`` ``{"tokens": [...], "max_new_tokens": N,
+  "timeout_s": T?}`` → ``{"tokens": [...], "state": "done"}``;
+  429 on backpressure, 400 on an unservable request.
+- ``GET /metrics`` → the metrics registry in prometheus text form.
+- ``GET /healthz`` → queue/slot gauges as JSON.
+
+``python -m autodist_tpu.serve --selftest`` is the zero-hardware proof the
+acceptance bar names: a tiny CPU transformer served to >=64 concurrent mock
+requests with zero drops/deadlocks, p50/p99 latency and tokens/sec from the
+metrics registry, and batched throughput measured strictly above the
+sequential single-request baseline.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from autodist_tpu import metrics as M
+from autodist_tpu.serve.batcher import Backpressure, ContinuousBatcher, RequestState
+from autodist_tpu.utils import logging
+
+
+async def async_generate(
+    batcher: ContinuousBatcher,
+    tokens,
+    max_new_tokens: int = 32,
+    timeout_s: Optional[float] = None,
+):
+    """Submit + await one request from the event loop (shared by the HTTP
+    handler and the selftest's mock clients)."""
+    loop = asyncio.get_running_loop()
+    fut: asyncio.Future = loop.create_future()
+    req = batcher.submit(tokens, max_new_tokens, timeout_s=timeout_s)
+    req.add_done_callback(
+        lambda r: loop.call_soon_threadsafe(
+            lambda: fut.done() or fut.set_result(r)))
+    return await fut
+
+
+class ServeFrontend:
+    """Minimal HTTP server over one batcher."""
+
+    def __init__(self, batcher: ContinuousBatcher, host: str = "127.0.0.1",
+                 port: int = 8476, registry: Optional[M.MetricsRegistry] = None):
+        self.batcher = batcher
+        self.host, self.port = host, port
+        self.registry = registry or M.registry
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> "ServeFrontend":
+        self.batcher.start()
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        addr = self._server.sockets[0].getsockname()
+        logging.info("serve frontend listening on %s:%d", *addr[:2])
+        return self
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        self.batcher.stop()
+
+    # ----------------------------------------------------------------- http
+    @staticmethod
+    async def _read_request(reader) -> Optional[tuple]:
+        line = await reader.readline()
+        if not line:
+            return None
+        try:
+            method, path, _ = line.decode().split(None, 2)
+        except ValueError:
+            return None
+        headers: Dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        body = b""
+        n = int(headers.get("content-length", 0) or 0)
+        if n:
+            body = await reader.readexactly(n)
+        return method.upper(), path, headers, body
+
+    @staticmethod
+    def _respond(writer, status: int, payload, content_type="application/json"):
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  429: "Too Many Requests", 500: "Internal Server Error"}
+        body = (json.dumps(payload).encode()
+                if content_type == "application/json" else payload.encode())
+        writer.write(
+            f"HTTP/1.1 {status} {reason.get(status, '')}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: close\r\n\r\n".encode() + body)
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            parsed = await self._read_request(reader)
+            if parsed is None:
+                return
+            method, path, _, body = parsed
+            if method == "GET" and path == "/metrics":
+                self._respond(writer, 200, self.registry.render_text(),
+                              content_type="text/plain")
+            elif method == "GET" and path == "/healthz":
+                self._respond(writer, 200, {
+                    "ok": True,
+                    "queue_depth": len(self.batcher._queue),
+                    "active_slots": self.batcher.engine.active_slots,
+                })
+            elif method == "POST" and path == "/generate":
+                await self._generate(writer, body)
+            else:
+                self._respond(writer, 404, {"error": f"no route {path}"})
+            await writer.drain()
+        except Exception as e:  # noqa: BLE001 - per-connection isolation
+            try:
+                self._respond(writer, 500, {"error": str(e)})
+                await writer.drain()
+            except Exception:  # noqa: BLE001
+                pass
+        finally:
+            writer.close()
+
+    async def _generate(self, writer, body: bytes) -> None:
+        try:
+            payload = json.loads(body.decode() or "{}")
+            tokens = payload["tokens"]
+            max_new = int(payload.get("max_new_tokens", 32))
+        except (ValueError, KeyError) as e:
+            self._respond(writer, 400, {"error": f"bad request body: {e}"})
+            return
+        try:
+            req = await async_generate(
+                self.batcher, tokens, max_new,
+                timeout_s=payload.get("timeout_s"))
+        except Backpressure as e:
+            self._respond(writer, 429, {"error": str(e)})
+            return
+        except ValueError as e:
+            self._respond(writer, 400, {"error": str(e)})
+            return
+        self._respond(writer, 200, {
+            "id": req.id,
+            "state": req.state.value,
+            "tokens": req.tokens,
+            "latency_s": req.latency_s,
+        })
+
+
+# ---------------------------------------------------------------- selftest
+def _tiny_engine(n_slots: int = 8):
+    """CPU-sim engine: a tiny fp32 transformer through the full
+    ``AutoDist.build_inference`` path (strategy → plan → engine)."""
+    import jax
+    import jax.numpy as jnp
+
+    from autodist_tpu.api import AutoDist
+    from autodist_tpu.models.transformer import (
+        TransformerConfig, decode_model, init_params)
+
+    cfg = TransformerConfig(
+        vocab_size=128, num_layers=2, d_model=32, num_heads=2, d_ff=64,
+        max_seq_len=64, causal=True, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    AutoDist.reset_default()
+    autodist = AutoDist()
+    engine = autodist.build_inference(
+        params,
+        decode_model=decode_model(cfg),
+        n_slots=n_slots,
+        bucket_lens=(16, 32, 64),
+    )
+    AutoDist.reset_default()
+    return engine
+
+
+def selftest(n_requests: int = 64, n_slots: int = 8, max_new: int = 12,
+             seed: int = 0) -> int:
+    """The acceptance proof; returns a process exit code.
+
+    Phase 1 (sequential baseline): single requests generated back-to-back
+    through the engine — one active slot, no batching. Phase 2 (batched):
+    ``n_requests`` concurrent mock clients through the asyncio bridge and
+    the continuous batcher. Asserts zero dropped/deadlocked requests and
+    batched tokens/sec strictly above sequential, then prints one JSON line
+    with p50/p99 latency and throughput from the metrics registry.
+    """
+    registry = M.MetricsRegistry()
+    rng = np.random.default_rng(seed)
+    engine = _tiny_engine(n_slots=n_slots)
+
+    def mock_prompt():
+        return rng.integers(1, 127, size=int(rng.integers(3, 12)))
+
+    # Warm the compile caches outside both timed phases (compile time is a
+    # one-off; the throughput comparison is about steady-state batching).
+    engine.generate(mock_prompt(), max_new)
+
+    t0 = time.monotonic()
+    seq_tokens = 0
+    for _ in range(max(4, n_slots)):
+        seq_tokens += len(engine.generate(mock_prompt(), max_new))
+    seq_tps = seq_tokens / (time.monotonic() - t0)
+
+    batcher = ContinuousBatcher(engine, max_queue=max(n_requests, 64),
+                                registry=registry)
+
+    async def run_clients():
+        async def client(i):
+            # Stagger arrivals slightly: a realistic open-loop trickle, and
+            # it exercises admission racing retirement.
+            await asyncio.sleep(0.001 * (i % 8))
+            return await async_generate(batcher, mock_prompt(), max_new)
+
+        return await asyncio.gather(*(client(i) for i in range(n_requests)))
+
+    batcher.start()
+    t1 = time.monotonic()
+    try:
+        results = asyncio.run(asyncio.wait_for(run_clients(), timeout=300))
+    finally:
+        batcher.stop(drain=False)
+    dt_batched = time.monotonic() - t1
+
+    batched_tokens = sum(len(r.tokens) for r in results)
+    batched_tps = batched_tokens / dt_batched
+    states = {s: sum(1 for r in results if r.state is s) for s in RequestState}
+    snap = registry.snapshot()
+    lat = snap.get("serve_request_latency_s", {})
+    ok = (
+        states.get(RequestState.DONE, 0) == n_requests
+        and batched_tps > seq_tps
+    )
+    line = {
+        "selftest": "autodist_tpu.serve",
+        "ok": bool(ok),
+        "n_requests": n_requests,
+        "completed": states.get(RequestState.DONE, 0),
+        "dropped": n_requests - states.get(RequestState.DONE, 0),
+        "p50_latency_s": round(lat.get("p50", float("nan")), 4),
+        "p99_latency_s": round(lat.get("p99", float("nan")), 4),
+        "batched_tokens_per_sec": round(batched_tps, 1),
+        "sequential_tokens_per_sec": round(seq_tps, 1),
+        "speedup": round(batched_tps / seq_tps, 2) if seq_tps else None,
+        "tokens_generated": int(snap.get("serve_tokens_generated_total", 0)),
+        "queue_depth_final": int(snap.get("serve_queue_depth", 0)),
+        "n_slots": n_slots,
+        "device": __import__("jax").devices()[0].platform,
+    }
+    print(json.dumps(line))
+    if not ok:
+        logging.warning("selftest failed: states=%s seq=%.1f batched=%.1f",
+                        {s.value: n for s, n in states.items() if n},
+                        seq_tps, batched_tps)
+    return 0 if ok else 1

@@ -62,13 +62,15 @@ class _Budget:
     patience and bench got killed before emitting even its fallback line.
     Every sleep, probe, and child watchdog is now clamped to the remaining
     budget, so the final ``print(json.dumps(...))`` always runs with time to
-    spare. ``BENCH_BUDGET_S`` overrides (default 3300s ≈ 55 min, inside the
-    queue driver's 5400s job timeout and any sane round-driver limit).
+    spare. ``BENCH_BUDGET_S`` overrides (default 1200s — r5's lesson: the
+    3300s default outlived the round driver's patience and the cached
+    fallback line never printed; callers with a roomier deadline, like the
+    queue driver's 5400s job window, raise it explicitly).
     """
 
     def __init__(self):
         self.t0 = time.monotonic()
-        self.total = float(os.environ.get("BENCH_BUDGET_S", "3300"))
+        self.total = float(os.environ.get("BENCH_BUDGET_S", "1200"))
 
     def remaining(self, reserve: float = 45.0) -> float:
         """Seconds left after keeping ``reserve`` for formatting + emit."""
@@ -538,15 +540,20 @@ def _queue_driver_alive(lock: str = None) -> bool:
         lock or QUEUE_DRIVER_PIDFILE) is not None
 
 
-def _wait_for_queue_driver() -> None:
+def _wait_for_queue_driver() -> bool:
     """If the TPU experiment-queue driver (run_tpu_queue.py) is mid-run,
     wait for it — two processes through the axon tunnel deadlock it, and
     the driver serializes all its own TPU work, so bench must not race a
     queue job (or even its probe) with its own. Bounded: at most a third
     of the bench budget, then proceed regardless (the emergency-line
-    guarantee still holds)."""
+    guarantee still holds).
+
+    Returns True when the driver STILL holds the tunnel after the wait
+    budget — the r5 failure mode: probing an occupied tunnel burns the
+    whole budget on timeouts, so the caller must skip the preflight ladder
+    entirely and emit the cached fallback line instead."""
     if os.environ.get("BENCH_QUEUE_CHILD"):
-        return  # spawned BY the driver: already serialized under it
+        return False  # spawned BY the driver: already serialized under it
     wait_budget = BUDGET.total / 3.0
     waited = 0.0
     while (_queue_driver_alive() and waited < wait_budget
@@ -556,12 +563,42 @@ def _wait_for_queue_driver() -> None:
                   "finish (tunnel is single-occupancy)", file=sys.stderr)
         time.sleep(20.0)
         waited += 20.0
-    if waited and not _queue_driver_alive():
+    still_running = _queue_driver_alive()
+    if waited and not still_running:
         print(f"bench: queue driver exited after {waited:.0f}s; proceeding",
               file=sys.stderr)
-    elif waited >= wait_budget:
-        print("bench: queue driver still running after the wait budget; "
-              "proceeding anyway", file=sys.stderr)
+    elif still_running:
+        # Includes the zero-wait case (budget already near-exhausted at
+        # entry): an occupied tunnel is occupied however little we waited,
+        # and probing it would burn whatever budget remains (r5).
+        print("bench: queue driver still holds the tunnel; skipping the "
+              "accelerator preflight (cached-fallback path)", file=sys.stderr)
+    return still_running
+
+
+def _promote_cached_headline(result: dict) -> dict:
+    """Head a fallback line with the last verified accelerator number.
+
+    The r5/r6 contract (VERDICT top_next): when the accelerator can't be
+    probed this round, the driver must still parse a REAL number — the
+    cached one, explicitly labeled ``"cached": true`` with its capture
+    timestamp — never ``parsed: null``. The fallback measurement that did
+    run (CPU smoke) stays in the line under its own keys; only the
+    headline metric/value/unit/vs_baseline switch to the cache. No-op when
+    no cache exists."""
+    cached = result.get("last_verified_accel_result")
+    if not cached:
+        return result
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        if key in result:
+            result[f"cpu_smoke_{key}"] = result[key]
+    result["metric"] = cached.get("metric", "bench")
+    result["value"] = cached.get("value", 0.0)
+    result["unit"] = cached.get("unit", "none")
+    result["vs_baseline"] = cached.get("vs_baseline")
+    result["cached"] = True
+    result["cached_at"] = result.get("last_verified_accel_at")
+    return result
 
 
 def _emergency_line(errors: dict, reason: str) -> dict:
@@ -578,16 +615,9 @@ def _emergency_line(errors: dict, reason: str) -> dict:
     }
     for name, err in errors.items():
         result[f"{name}_error"] = err
-    result = _embed_last_accel(result)
-    cached = result.get("last_verified_accel_result")
-    if cached:
-        # Promote the cached headline so metric/value stay meaningful,
-        # clearly marked stale (the *_at timestamp says how stale).
-        result["metric"] = str(cached.get("metric", "bench")) + "_stale_cached"
-        result["value"] = cached.get("value", 0.0)
-        result["unit"] = cached.get("unit", "none")
-        result["vs_baseline"] = cached.get("vs_baseline")
-    return result
+    # One promotion convention for every fallback path (wedge and
+    # emergency): plain cached metric name + cached:true/cached_at labels.
+    return _promote_cached_headline(_embed_last_accel(result))
 
 
 def main() -> None:
@@ -620,14 +650,18 @@ def main() -> None:
     measured, errors = {}, {}
     accel_ok = False
     wedged_mid_bench = False
+    tunnel_busy = False
     try:
-        _wait_for_queue_driver()
+        tunnel_busy = _wait_for_queue_driver()
         # Probe BEFORE touching any backend: when the tunnel is wedged even
         # jax.devices() blocks forever. On probe failure fall back to the CPU
         # smoke measurement rather than hanging or reporting nothing. The
         # parent process NEVER initializes jax — all measurement happens in
         # watchdogged children, so a mid-bench wedge still yields a line.
-        accel_ok = _preflight()
+        # An occupied tunnel skips the ladder entirely (r5: six probes
+        # against a busy tunnel burned the budget the cached-fallback line
+        # needed).
+        accel_ok = False if tunnel_busy else _preflight()
         base_workloads = workloads
         if accel_ok and args.model == "both":
             workloads = workloads + ("bert_large",)
@@ -720,10 +754,16 @@ def main() -> None:
     else:
         wedged_fallback = True
         result["error"] = (
+            "queue driver held the tunnel through the wait budget; "
+            "preflight skipped; CPU smoke fallback" if tunnel_busy else
             "accelerator unresponsive (tunnel wedged, retried preflight); "
             "CPU smoke fallback"
         )
-        result = _embed_last_accel(result)
+        # The driver reads metric/value: head the line with the cached
+        # accelerator number, labeled cached:true — a wedge round must
+        # never regress the official record to a CPU-smoke headline
+        # (VERDICT r5 top_next).
+        result = _promote_cached_headline(_embed_last_accel(result))
     print(json.dumps(result))
     if wedged_fallback and os.environ.get("BENCH_REQUIRE_ACCEL"):
         # Queue mode: a wedge fallback is not success — exit 4 (the

@@ -174,7 +174,11 @@ def test_emergency_line_promotes_cached_accel(bench, tmp_path, monkeypatch):
     bench._store_last_accel({"metric": "bert_base_mfu", "value": 0.69,
                              "unit": "mfu", "vs_baseline": 1.38})
     line = bench._emergency_line({"bert": "timed out"}, "budget expired")
-    assert line["metric"] == "bert_base_mfu_stale_cached"
+    # One convention across all fallback paths: plain cached metric name,
+    # labeled cached:true (the old *_stale_cached suffix gave the driver a
+    # second spelling of the same condition).
+    assert line["metric"] == "bert_base_mfu"
+    assert line["cached"] is True
     assert line["value"] == 0.69 and line["vs_baseline"] == 1.38
     assert line["bert_error"] == "timed out"
     assert line["last_verified_accel_result"]["value"] == 0.69
@@ -299,3 +303,58 @@ def test_format_result_note_merges_for_name_equals_prefix(bench):
     r, _ = bench._format_result(measured, {})
     assert "mfu omitted" in r["bert_large_note"]
     assert "watchdog killed" in r["bert_large_note"]
+
+
+def test_promote_cached_headline_labels_cached(bench, tmp_path, monkeypatch):
+    """Satellite (BENCH_r05 regression): a wedge round must head its line
+    with the last cached accelerator number labeled cached:true — never a
+    CPU-smoke metric (or nothing) while verified evidence exists."""
+    monkeypatch.setattr(bench, "LAST_ACCEL_PATH",
+                        str(tmp_path / "bench_last_accel.json"))
+    bench._store_last_accel({"metric": "bert_base_mfu", "value": 0.69,
+                             "unit": "mfu", "vs_baseline": 1.38})
+    smoke = {"metric": "bert_base_mfu_cpu_smoke", "value": 1234.5,
+             "unit": "tokens/sec", "vs_baseline": None}
+    line = bench._promote_cached_headline(bench._embed_last_accel(smoke))
+    assert line["metric"] == "bert_base_mfu"
+    assert line["value"] == 0.69 and line["unit"] == "mfu"
+    assert line["cached"] is True and line["cached_at"]
+    # The smoke measurement stays visible under its own keys.
+    assert line["cpu_smoke_metric"] == "bert_base_mfu_cpu_smoke"
+    assert line["cpu_smoke_value"] == 1234.5
+
+
+def test_promote_cached_headline_noop_without_cache(bench, tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "LAST_ACCEL_PATH", str(tmp_path / "absent.json"))
+    smoke = {"metric": "bert_base_mfu_cpu_smoke", "value": 9.0}
+    line = bench._promote_cached_headline(bench._embed_last_accel(dict(smoke)))
+    assert line["metric"] == "bert_base_mfu_cpu_smoke"
+    assert "cached" not in line
+
+
+def test_wait_for_queue_driver_reports_still_busy(bench, monkeypatch):
+    """r5 failure mode: when the driver still holds the tunnel after the
+    wait budget, the caller must learn it (and skip the preflight ladder)."""
+    monkeypatch.delenv("BENCH_QUEUE_CHILD", raising=False)
+    monkeypatch.setattr(bench, "_queue_driver_alive", lambda lock=None: True)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench._wait_for_queue_driver() is True
+    # Driver-exited path still reports free.
+    alive = {"v": True}
+    monkeypatch.setattr(bench, "_queue_driver_alive",
+                        lambda lock=None: alive["v"])
+
+    def sleep_then_exit(s):
+        alive["v"] = False
+
+    monkeypatch.setattr(bench.time, "sleep", sleep_then_exit)
+    assert bench._wait_for_queue_driver() is False
+
+
+def test_emergency_line_cached_label(bench, tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "LAST_ACCEL_PATH",
+                        str(tmp_path / "bench_last_accel.json"))
+    bench._store_last_accel({"metric": "bert_base_mfu", "value": 0.69,
+                             "unit": "mfu", "vs_baseline": 1.38})
+    line = bench._emergency_line({}, "budget expired")
+    assert line["cached"] is True and line["cached_at"]

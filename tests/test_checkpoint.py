@@ -612,3 +612,23 @@ def test_orbax_flatten_rejects_name_collisions():
 
     with _pytest.raises(ValueError, match="collision"):
         _flatten({"x": [np.zeros((2,))], "x/0": np.ones((3,))})
+
+
+def test_restore_subtree_reads_only_the_prefix(tmp_path):
+    """Saver.restore_subtree: pull one subtree (the serving loader's params
+    path) out of a full-state checkpoint without touching sibling entries."""
+    saver = Saver(str(tmp_path))
+    state = {
+        "step": np.int32(7),
+        "params": {"dense": {"kernel": np.arange(6.0).reshape(2, 3)}},
+        "opt_state": {"mu": {"dense": {"kernel": np.zeros((2, 3))}}},
+    }
+    path = saver.save(state, step=7)
+    template = jax.eval_shape(
+        lambda: {"dense": {"kernel": jnp.zeros((2, 3))}})
+    out = saver.restore_subtree(path, "params", template)
+    np.testing.assert_array_equal(
+        np.asarray(out["dense"]["kernel"]), state["params"]["dense"]["kernel"])
+    # prefix="" degrades to a plain full restore.
+    full = saver.restore_subtree(path, "", target=jax.eval_shape(lambda: state))
+    assert int(np.asarray(full["step"])) == 7

@@ -289,10 +289,16 @@ def test_heterogeneous_node_chips_match_single_device():
     full-batch gradient — each chip averages its equal batch share and the
     mesh mean weights every example once. Assert that explicitly on a
     heterogeneous spec."""
-    rs_het = ResourceSpec(resource_dict={"nodes": [
+    nodes = [
         {"address": "10.0.0.1", "chips": 3, "chief": True},
         {"address": "10.0.0.2", "chips": 5},
-    ]})
+    ]
+    # Uneven per-host chips now require declared intent (TPU slices are
+    # homogeneous; resource_spec._validate rejects the typo case loudly).
+    with pytest.raises(ValueError, match="homogeneous"):
+        ResourceSpec(resource_dict={"nodes": nodes})
+    rs_het = ResourceSpec(
+        resource_dict={"nodes": nodes, "allow_uneven_chips": True})
     assert rs_het.num_chips == 8  # matches the virtual mesh
     params, batch = dense_params(), dense_batch()
     opt = OptimizerSpec("sgd", {"learning_rate": 0.05})

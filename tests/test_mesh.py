@@ -92,3 +92,24 @@ def test_training_step_runs_on_hybrid_mesh():
     assert np.isfinite(float(metrics["loss"]))
     state, m2 = step(state, batch)
     assert float(m2["loss"]) < float(metrics["loss"])
+
+
+def test_data_sharding_helper():
+    """data_sharding: batch placement for any rank/dim (serving KV-cache
+    pools shard slots on dim 1; batches on dim 0)."""
+    from jax.sharding import PartitionSpec as P
+
+    from autodist_tpu.kernel.mesh import data_sharding
+    from autodist_tpu.resource_spec import ResourceSpec
+
+    rs = ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+    mesh = build_mesh(rs, axes=("data", "model"))
+    assert data_sharding(mesh, 5, dim=1).spec == P(None, "data", None, None, None)
+    assert data_sharding(mesh, 2).spec == P("data", None)
+    # Trivial data axis -> replicated (readable sharding dumps).
+    rs1 = ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+        "mesh": {"data": 1, "model": 8}})
+    mesh1 = build_mesh(rs1, axes=("data", "model"))
+    assert data_sharding(mesh1, 3, dim=1).spec == P()
