@@ -13,8 +13,9 @@ Where the reference rewired TF graphs op-by-op
 lets XLA GSPMD insert the collectives — the idiomatic TPU mechanism with the
 same user-visible contract (single-device model in, distributed execution out).
 """
-from autodist_tpu import checkpoint, const, metrics, runtime, serve, strategy
+from autodist_tpu import checkpoint, const, ft, metrics, runtime, serve, strategy
 from autodist_tpu.api import AutoDist, get_default_autodist
+from autodist_tpu.ft import FTConfig
 from autodist_tpu.kernel import DistributedTrainStep, TrainState
 from autodist_tpu.model_item import ModelItem, OptimizerSpec
 from autodist_tpu.resource_spec import ResourceSpec
@@ -24,12 +25,14 @@ __version__ = "0.1.0"
 __all__ = [
     "AutoDist",
     "DistributedTrainStep",
+    "FTConfig",
     "ModelItem",
     "OptimizerSpec",
     "ResourceSpec",
     "TrainState",
     "checkpoint",
     "const",
+    "ft",
     "get_default_autodist",
     "runtime",
     "serve",

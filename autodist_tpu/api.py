@@ -46,6 +46,7 @@ from autodist_tpu.strategy import PSLoadBalancing, Strategy, StrategyBuilder, St
 from autodist_tpu.utils import is_broadcast_leaf, logging
 
 if TYPE_CHECKING:  # circular at runtime: async_ps imports nothing from api
+    from autodist_tpu.ft import FTConfig, FTRuntime
     from autodist_tpu.runtime.async_ps import AsyncPSTrainer
 
 _default_autodist: Optional["AutoDist"] = None
@@ -131,6 +132,7 @@ class AutoDist:
         strategy_builder: Optional[StrategyBuilder] = None,
         resource_spec: Optional[ResourceSpec] = None,
         mesh_axes: Sequence[str] = ("data", "model"),
+        fault_tolerance: "Optional[FTConfig]" = None,
     ):
         global _default_autodist
         if _default_autodist is not None:
@@ -166,6 +168,14 @@ class AutoDist:
         # Filled by tune(): {"table": {name: {measured_s, predicted_s}},
         # "calibration": Calibration, "calibration_path": str}.
         self.last_tune_results: Optional[dict] = None
+        # Fault tolerance (docs/fault_tolerance.md): a started HealthMonitor
+        # + SnapshotManager bundle, or None when the knob is off (zero
+        # overhead on the default path).
+        self.ft: "Optional[FTRuntime]" = None
+        if fault_tolerance is not None:
+            from autodist_tpu.ft import FTRuntime
+
+            self.ft = FTRuntime(fault_tolerance)
         _default_autodist = self
 
     @classmethod
@@ -518,6 +528,49 @@ class AutoDist:
             mesh=self.mesh, donate_state=donate_state,
         )
 
+    # -------------------------------------------------------------- elastic
+    def elastic_rebuild(
+        self,
+        loss_fn: Callable,
+        params: Any,
+        example_batch: Any = None,
+        devices: Optional[Sequence] = None,
+        optimizer: Union[OptimizerSpec, optax.GradientTransformation, None] = None,
+        **recompile_kwargs,
+    ):
+        """Elastic restart onto the SURVIVING devices: re-derive the
+        resource spec from whatever is still alive, recompile the
+        Strategy→ShardingPlan on the resized mesh, and restore the newest
+        integrity-verified snapshot into the new shardings
+        (``ft/elastic.py``; requires ``fault_tolerance=FTConfig(...)``).
+
+        Returns ``(step, state)``. This AutoDist's ``resource_spec`` /
+        ``mesh`` are repointed at the surviving cluster so subsequent
+        ``build``/``build_inference`` calls compile for the same resized
+        mesh the restored state lives on.
+        """
+        if self.ft is None:
+            raise RuntimeError(
+                "elastic_rebuild needs fault tolerance enabled: construct "
+                "AutoDist(fault_tolerance=FTConfig(...))")
+        from autodist_tpu.ft.elastic import surviving_resource_spec
+
+        devices = list(devices) if devices is not None else jax.devices()
+        recompile_kwargs.setdefault("mesh_axes", self.mesh_axes)
+        step, state = self.ft.elastic.resume(
+            loss_fn, params, example_batch,
+            devices=devices,
+            strategy_builder=self.strategy_builder,
+            optimizer=optimizer,
+            spec_template=self.resource_spec,
+            **recompile_kwargs,
+        )
+        self.resource_spec = surviving_resource_spec(
+            devices, template=self.resource_spec)
+        self._mesh = step.plan.mesh
+        self._built = step
+        return step, state
+
     # ----------------------------------------------------------------- tune
     def tune(
         self,
@@ -575,10 +628,21 @@ class AutoDist:
             self.strategy_builder = builder
             try:
                 step = self.build(loss_fn, params, example_batch, **build_kwargs)
-                bench_batch = (
-                    self._fleet_bench_batch(step.plan, example_batch)
-                    if multi else example_batch
-                )
+                if multi:
+                    # Already device-resident global arrays (assembled via
+                    # plan.global_batch_from_local).
+                    bench_batch = self._fleet_bench_batch(step.plan, example_batch)
+                else:
+                    # Pin ONCE in HBM, synced before the warmup run
+                    # (mirroring bench.py's measure()): the pipelined
+                    # windows below dispatch back-to-back, and re-uploading
+                    # a host batch against an in-flight dispatch is the
+                    # documented tunnel-deadlock trigger (train.py fed-path
+                    # note) — besides serializing the transfer into the
+                    # timed region and skewing calibration absolutes.
+                    bench_batch = jax.device_put(
+                        example_batch, step.plan.batch_shardings(example_batch))
+                jax.block_until_ready(bench_batch)
                 state = step.init(params)
                 state, _ = step.run(state, bench_batch, window)  # compile+warm
                 _sync(state.params)

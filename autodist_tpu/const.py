@@ -17,6 +17,10 @@ DEFAULT_TRACE_DIR = os.path.join(DEFAULT_WORKING_DIR, "traces")
 DEFAULT_LOG_DIR = os.path.join(DEFAULT_WORKING_DIR, "logs")
 DEFAULT_HLO_DIR = os.path.join(DEFAULT_WORKING_DIR, "hlo")
 DEFAULT_CHECKPOINT_DIR = os.path.join(DEFAULT_WORKING_DIR, "checkpoints")
+# Fault-tolerance state (heartbeats, snapshot ring, persisted serve queue);
+# overridable per-fleet via AUTODIST_FT_DIR (the launcher exports it so every
+# process of one fleet shares a base).
+DEFAULT_FT_DIR = os.path.join(DEFAULT_WORKING_DIR, "ft")
 
 # Coordination service port range (reference used 15000-16000 for TF grpc
 # servers, const.py:38; we use it for the jax.distributed coordinator).
@@ -86,6 +90,9 @@ class ENV:
     AUTODIST_NUM_PROCESSES = _EnvVar(1)
     AUTODIST_PROCESS_ID = _EnvVar(0)
     AUTODIST_DUMP_HLO = _EnvVar(False)
+    # Base dir for ft/ state (heartbeats/snapshots/serve queue); set by the
+    # launcher so chief, workers, and the supervisor watch the same files.
+    AUTODIST_FT_DIR = _EnvVar("")
     SYS_DATA_PATH = _EnvVar("")
     SYS_RESOURCE_PATH = _EnvVar("")
 

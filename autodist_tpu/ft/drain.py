@@ -1,0 +1,195 @@
+"""Graceful serve degradation: drain in-flight work, persist the rest,
+replay on restart.
+
+A SIGTERM'd server that hard-stops loses two kinds of work: decodes that
+were seconds from finishing, and queued requests nobody started. The
+:class:`DrainController` closes both holes around one
+:class:`~autodist_tpu.serve.batcher.ContinuousBatcher`:
+
+1. **quiesce** — the batcher stops admitting (new ``submit``s are refused
+   with :class:`~autodist_tpu.serve.batcher.Backpressure`, queued entries
+   stop being promoted to slots);
+2. **finish in-flight** — active decodes keep stepping until done, bounded
+   by ``drain_deadline_s``;
+3. **persist** — whatever is still undone (the untouched queue + any
+   decode the deadline cut off) is written atomically to
+   ``queue_persist_path`` and each such request is finished terminally as
+   ``PREEMPTED`` (no client ever blocks on work this process will not do);
+4. **replay** — a restarted server calls :meth:`DrainController.replay`
+   (or :func:`replay_requests`): persisted entries are resubmitted and the
+   file consumed, so a request is served exactly once — completed work is
+   never persisted, persisted work was never completed.
+
+The persist format is deliberately prompt-level (prompt tokens +
+``max_new_tokens`` + remaining deadline), not KV-cache state: replay
+re-decodes from scratch on whatever mesh/shardings the restarted server
+compiled, which composes with elastic resizes for free.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+from typing import List, Optional
+
+from autodist_tpu import metrics as M
+from autodist_tpu.utils import logging
+
+
+def persist_requests(path: str, requests) -> int:
+    """Atomically write the replay file for ``requests`` (anything with
+    ``prompt`` / ``max_new_tokens`` / ``deadline`` — i.e. ``GenRequest``).
+    Deadlines are stored as remaining seconds (absolute monotonic times do
+    not survive a process restart). Returns the entry count."""
+    import time
+
+    now = time.monotonic()
+    entries = [
+        {
+            "prompt": [int(t) for t in r.prompt],
+            "max_new_tokens": int(r.max_new_tokens),
+            "timeout_s": (max(0.001, r.deadline - now)
+                          if r.deadline is not None else None),
+        }
+        for r in requests
+    ]
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"format_version": 1, "entries": entries}, f)
+    os.replace(tmp, path)
+    return len(entries)
+
+
+def replay_requests(path: str, batcher) -> List:
+    """Resubmit every persisted entry to ``batcher``; consume the file.
+
+    Returns the new ``GenRequest`` list (empty when no replay file
+    exists). Restart-path hardening — replay must never crash server
+    startup or double-serve:
+
+    - a corrupt/unreadable file is renamed aside (``.corrupt``) and
+      skipped, not raised;
+    - an entry the restarted server can never run (``ValueError`` — e.g.
+      an elastic resize shrank the decode buckets below the prompt) is
+      dropped with a warning, since re-persisting it would wedge every
+      future restart on the same entry;
+    - :class:`~autodist_tpu.serve.batcher.Backpressure` (replaying more
+      entries than the new queue admits) stops the replay and atomically
+      RE-PERSISTS the not-yet-submitted remainder, so already-submitted
+      entries are consumed from the file (no duplicates) and the rest
+      survive for the next drain cycle (no loss).
+    """
+    from autodist_tpu.serve.batcher import Backpressure
+
+    try:
+        with open(path, encoding="utf-8") as f:
+            payload = json.load(f)
+        entries = list(payload.get("entries", []))
+    except OSError:
+        return []
+    except ValueError:
+        logging.warning("replay file %s is corrupt; moving it aside", path)
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:
+            pass
+        return []
+    reqs = []
+    remainder: List[dict] = []
+    for i, e in enumerate(entries):
+        try:
+            reqs.append(batcher.submit(
+                e["prompt"], max_new_tokens=e["max_new_tokens"],
+                timeout_s=e.get("timeout_s")))
+        except Backpressure:
+            remainder = entries[i:]
+            logging.warning(
+                "replay hit backpressure after %d of %d entries; "
+                "re-persisting the remaining %d", len(reqs), len(entries),
+                len(remainder))
+            break
+        except (ValueError, KeyError) as err:
+            logging.warning("dropping unservable persisted entry %r (%s)",
+                            e, err)
+    if remainder:
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"format_version": 1, "entries": remainder}, f)
+        os.replace(tmp, path)
+    else:
+        os.remove(path)
+    logging.info("replayed %d persisted serve requests from %s",
+                 len(reqs), path)
+    return reqs
+
+
+class DrainController:
+    """SIGTERM-armed drain/persist/replay around one batcher."""
+
+    def __init__(
+        self,
+        batcher,
+        persist_path: str,
+        drain_deadline_s: float = 30.0,
+        registry: Optional[M.MetricsRegistry] = None,
+    ):
+        self.batcher = batcher
+        self.persist_path = persist_path
+        self.drain_deadline_s = drain_deadline_s
+        self._prev_handler = None
+        self._done = threading.Event()
+        reg = registry or M.registry
+        self._c_persisted = reg.counter("serve_requests_persisted_total")
+        self._c_replayed = reg.counter("serve_requests_replayed_total")
+        self._g_drain_s = reg.gauge("serve_last_drain_duration_s")
+
+    # ------------------------------------------------------------- shutdown
+    def shutdown(self) -> dict:
+        """Run the full drain sequence; idempotent. Returns
+        ``{"drained": n_finished_during_drain, "persisted": n}``."""
+        import time
+
+        if self._done.is_set():
+            return {"drained": 0, "persisted": 0}
+        self._done.set()
+        t0 = time.monotonic()
+        drained, leftovers = self.batcher.drain(self.drain_deadline_s)
+        persisted = 0
+        if leftovers:
+            persisted = persist_requests(self.persist_path, leftovers)
+            self._c_persisted.inc(persisted)
+            logging.info(
+                "drain: %d in-flight finished, %d undrained persisted -> %s",
+                drained, persisted, self.persist_path)
+        self._g_drain_s.set(time.monotonic() - t0)
+        return {"drained": drained, "persisted": persisted}
+
+    def replay(self) -> List:
+        """Resubmit any previously persisted queue (restart path)."""
+        reqs = replay_requests(self.persist_path, self.batcher)
+        self._c_replayed.inc(len(reqs))
+        return reqs
+
+    # --------------------------------------------------------------- signal
+    def install_preempt_hook(self, signum: int = signal.SIGTERM) -> None:
+        """Arm ``signum`` to run :meth:`shutdown`, then hand the signal
+        back — chaining a previous Python handler (a training-side snapshot
+        hook on the same signal still fires) or honoring the default
+        terminate disposition once the queue is safely persisted.
+        Main-thread only (CPython signal rule)."""
+        if self._prev_handler is not None:
+            return
+
+        def handler(sig, frame):
+            logging.info("signal %d: draining serve batcher", sig)
+            try:
+                self.shutdown()
+            except Exception:  # noqa: BLE001 - exit path must not throw
+                logging.warning("serve drain failed", exc_info=True)
+            from autodist_tpu.ft.snapshot import _chain_signal
+
+            _chain_signal(sig, frame, self._prev_handler)
+
+        self._prev_handler = signal.signal(signum, handler) or signal.SIG_DFL

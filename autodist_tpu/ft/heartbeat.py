@@ -1,0 +1,419 @@
+"""Heartbeat exchange + peer health classification.
+
+The reference's only liveness signal was "the SSH child's exit code"
+(``coordinator.py:98-110`` monitor threads): binary, post-mortem, and blind
+to hangs. The :class:`HealthMonitor` here is the positive-signal
+complement: every process *publishes* a periodic heartbeat and *sweeps*
+everyone else's, classifying each peer ``HEALTHY → SUSPECT → DEAD`` with
+exponential backoff between escalations so one dropped beat never flaps a
+peer. State is exported through
+:class:`~autodist_tpu.metrics.MetricsRegistry` gauges
+(``ft_peers_{healthy,suspect,dead}``, ``ft_heartbeat_max_age_s``), and the
+launcher's supervisor consumes :meth:`HealthMonitor.verdict` instead of
+blind exit-code counting (``runtime/launcher.py``).
+
+Heartbeats travel through a pluggable transport:
+
+- :class:`FileTransport` — one atomically-replaced JSON file per process
+  under a shared directory. This is the production default: the Saver
+  already assumes a shared filesystem for multi-host checkpoints, the
+  local-fleet emulation shares ``/tmp``, and — critically — the launcher
+  process (which is NOT a jax.distributed member) can observe the fleet
+  through the same files.
+- :class:`CoordinatorTransport` — rides the jax.distributed
+  coordination-service key-value store (the same chief-hosted RPC service
+  the async Saver uses for barriers), for fleets without a shared
+  filesystem. Best-effort: constructed only when a coordination client
+  exists.
+- :class:`MemoryTransport` — in-process dict, for tests and the
+  single-process degenerate case.
+
+The monitor's classification step is factored into :meth:`HealthMonitor.tick`
+(pure function of transport contents + a clock) so tests drive the state
+machine deterministically with a synthetic clock; the daemon thread just
+calls ``tick`` on a cadence.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Dict, List, Optional
+
+from autodist_tpu import metrics as M
+from autodist_tpu.ft.config import FTConfig
+from autodist_tpu.utils import logging
+
+
+class PeerState(Enum):
+    HEALTHY = "healthy"
+    SUSPECT = "suspect"
+    DEAD = "dead"
+
+
+class FleetVerdict(Enum):
+    """Aggregate view the supervisor consumes."""
+
+    HEALTHY = "healthy"    # every known peer healthy
+    DEGRADED = "degraded"  # some peers suspect/dead, some alive
+    DEAD = "dead"          # every known peer dead (fleet-wide hang/loss)
+    UNKNOWN = "unknown"    # no heartbeat ever observed
+
+
+@dataclass
+class PeerInfo:
+    """Host-side record for one peer."""
+
+    process_id: int
+    state: PeerState = PeerState.HEALTHY
+    last_seen: float = 0.0         # transport timestamp of the last beat
+    last_payload: dict = field(default_factory=dict)
+    misses: int = 0                # consecutive escalation windows missed
+    next_check: float = 0.0        # monotonic deadline of the next escalation
+    backoff_s: float = 0.0
+
+
+# ------------------------------------------------------------- transports
+class MemoryTransport:
+    """In-process heartbeat board (tests, single-process)."""
+
+    def __init__(self):
+        self._board: Dict[int, dict] = {}
+        self._lock = threading.Lock()
+
+    def publish(self, process_id: int, payload: dict) -> None:
+        with self._lock:
+            self._board[int(process_id)] = dict(payload)
+
+    def sweep(self) -> Dict[int, dict]:
+        with self._lock:
+            return {pid: dict(p) for pid, p in self._board.items()}
+
+
+class FileTransport:
+    """One ``hb-<pid>.json`` per process under a shared directory.
+
+    Writes are atomic (tmp + rename) so a sweeping reader never sees a
+    torn beat; the payload carries its own ``time`` stamp (``time.time()``
+    — wall clock, comparable across hosts to heartbeat precision)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def publish(self, process_id: int, payload: dict) -> None:
+        path = os.path.join(self.directory, f"hb-{int(process_id)}.json")
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+
+    def sweep(self) -> Dict[int, dict]:
+        out: Dict[int, dict] = {}
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith("hb-") and name.endswith(".json")):
+                continue
+            try:
+                pid = int(name[3:-5])
+                with open(os.path.join(self.directory, name),
+                          encoding="utf-8") as f:
+                    out[pid] = json.load(f)
+            except (OSError, ValueError):
+                continue  # mid-replace / foreign file: catch it next sweep
+        return out
+
+
+class CoordinatorTransport:
+    """Heartbeats through the jax.distributed coordination-service KV store.
+
+    The store is append-oriented, so each beat lands under a fresh
+    sequence-suffixed key (``ft/hb/<pid>/<seq>``) and sweeps take the
+    newest sequence per peer via ``key_value_dir_get``. Keys are tiny and
+    heartbeat cadence is seconds, so growth over a training run is
+    negligible next to the service's barrier traffic.
+    """
+
+    PREFIX = "ft/hb"
+
+    def __init__(self, client=None):
+        if client is None:
+            from autodist_tpu.checkpoint.saver import Saver
+
+            client = Saver._coordination_client()
+        if client is None:
+            raise RuntimeError(
+                "CoordinatorTransport needs a jax.distributed coordination "
+                "client (no multi-process runtime is initialized)")
+        self._client = client
+        # Wall-clock-seeded so a RESTARTED process's keys sort after its
+        # previous incarnation's (a 0-seeded counter would leave the fresh
+        # beats shadowed by stale higher-seq keys forever); sweep()
+        # additionally prefers the newest payload timestamp as the tiebreak
+        # authority, so even clock skew cannot pin a peer to an old beat.
+        self._seq = int(time.time() * 1000)
+
+    def publish(self, process_id: int, payload: dict) -> None:
+        self._seq += 1
+        try:
+            self._client.key_value_set(
+                f"{self.PREFIX}/{int(process_id)}/{self._seq:012d}",
+                json.dumps(payload))
+        except Exception as e:  # noqa: BLE001 - liveness signal, never fatal
+            logging.warning("heartbeat publish failed (%s)", e)
+
+    def sweep(self) -> Dict[int, dict]:
+        try:
+            entries = self._client.key_value_dir_get(self.PREFIX)
+        except Exception:  # noqa: BLE001 - service may be mid-teardown
+            return {}
+        out: Dict[int, dict] = {}
+        for key, value in entries:
+            parts = str(key).strip("/").split("/")
+            if len(parts) < 2:
+                continue
+            try:
+                pid = int(parts[-2])
+                payload = json.loads(value)
+            except ValueError:
+                continue
+            # Newest PAYLOAD TIMESTAMP wins, not the highest key sequence:
+            # a restarted peer's fresh beats must never be shadowed by its
+            # pre-restart keys.
+            if (pid not in out
+                    or payload.get("time", 0) > out[pid].get("time", 0)):
+                out[pid] = payload
+        return out
+
+
+# ---------------------------------------------------------------- monitor
+class HealthMonitor:
+    """Per-process health daemon: publish own beat, classify everyone's.
+
+    ``process_id`` identifies this process on the transport;
+    ``publish=False`` makes a pure observer (the launcher's fleet watchdog
+    — it is not a fleet member and must not appear as a peer).
+    ``expected`` optionally names the process ids that SHOULD exist, so a
+    peer that never manages a single beat still shows up (as ``DEAD`` once
+    the dead window passes from monitor start).
+
+    Thread-safe: ``tick`` may be driven by the daemon thread (``start``)
+    or directly by tests with a synthetic clock.
+    """
+
+    def __init__(
+        self,
+        transport,
+        process_id: int = 0,
+        config: Optional[FTConfig] = None,
+        publish: bool = True,
+        expected: Optional[List[int]] = None,
+        registry: Optional[M.MetricsRegistry] = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.transport = transport
+        self.process_id = int(process_id)
+        self.config = config or FTConfig()
+        self.publish = publish
+        self.clock = clock
+        self._peers: Dict[int, PeerInfo] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._started_at: Optional[float] = None
+        self._transitions: List[Callable[[int, PeerState, PeerState], None]] = []
+        self._step = 0  # training progress carried in the beat payload
+
+        reg = registry or M.registry
+        self._g_healthy = reg.gauge("ft_peers_healthy")
+        self._g_suspect = reg.gauge("ft_peers_suspect")
+        self._g_dead = reg.gauge("ft_peers_dead")
+        self._g_age = reg.gauge("ft_heartbeat_max_age_s")
+        self._c_sent = reg.counter("ft_heartbeats_sent_total")
+        self._c_trans = reg.counter("ft_peer_transitions_total")
+
+        if expected:
+            now = self.clock()
+            cfg = self.config
+            for pid in expected:
+                if publish and int(pid) == self.process_id:
+                    continue
+                self._peers[int(pid)] = PeerInfo(
+                    process_id=int(pid), state=PeerState.HEALTHY,
+                    last_seen=now,
+                    next_check=now + cfg.suspect_after_misses * cfg.heartbeat_interval_s,
+                )
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "HealthMonitor":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._started_at = self.clock()
+        self._thread = threading.Thread(
+            target=self._loop, name="ft-heartbeat", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5 * self.config.heartbeat_interval_s)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - the monitor must outlive glitches
+                logging.warning("health monitor tick failed", exc_info=True)
+            self._stop.wait(self.config.heartbeat_interval_s)
+
+    def set_step(self, step: int) -> None:
+        """Record training progress; travels in the next beat's payload so
+        peers (and the supervisor) can see who is advancing."""
+        self._step = int(step)
+
+    def on_transition(
+        self, fn: Callable[[int, PeerState, PeerState], None]
+    ) -> None:
+        """Run ``fn(pid, old_state, new_state)`` on every classification
+        change, from the monitor thread (or the tick caller)."""
+        self._transitions.append(fn)
+
+    # ---------------------------------------------------------------- tick
+    def tick(self, now: Optional[float] = None) -> None:
+        """One publish + sweep + classify round (idempotent, reentrant-safe
+        under the instance lock)."""
+        now = self.clock() if now is None else now
+        if self._started_at is None:
+            self._started_at = now
+        if self.publish:
+            self.transport.publish(self.process_id, {
+                "time": now, "step": self._step, "pid": os.getpid(),
+            })
+            self._c_sent.inc()
+        beats = self.transport.sweep()
+        fired = []
+        with self._lock:
+            cfg = self.config
+            interval = cfg.heartbeat_interval_s
+            for pid, payload in beats.items():
+                if self.publish and pid == self.process_id:
+                    continue
+                seen = float(payload.get("time", now))
+                peer = self._peers.get(pid)
+                if peer is None:
+                    peer = self._peers[pid] = PeerInfo(process_id=pid)
+                if seen > peer.last_seen:
+                    # Fresh beat: whatever the peer was, it is healthy now,
+                    # and the escalation backoff resets.
+                    if peer.state is not PeerState.HEALTHY:
+                        fired.append((pid, peer.state, PeerState.HEALTHY))
+                    peer.state = PeerState.HEALTHY
+                    peer.last_seen = seen
+                    peer.last_payload = payload
+                    peer.misses = 0
+                    peer.backoff_s = 0.0
+                    peer.next_check = now + cfg.suspect_after_misses * interval
+            for pid, peer in self._peers.items():
+                if peer.state is PeerState.DEAD:
+                    continue
+                if now < peer.next_check:
+                    continue
+                # Escalation window expired without a fresh beat.
+                peer.misses += 1
+                old = peer.state
+                if peer.state is PeerState.HEALTHY:
+                    peer.state = PeerState.SUSPECT
+                if peer.misses >= max(
+                        1, cfg.dead_after_misses - cfg.suspect_after_misses):
+                    peer.state = PeerState.DEAD
+                # Exponential backoff between escalations: a transient miss
+                # costs one SUSPECT round; repeated misses wait doubling
+                # windows before the next (so flapping can't thrash states).
+                peer.backoff_s = min(
+                    cfg.backoff_max_s,
+                    (peer.backoff_s * 2) if peer.backoff_s
+                    else (cfg.backoff_initial_s or interval),
+                )
+                peer.next_check = now + peer.backoff_s
+                if peer.state is not old:
+                    fired.append((pid, old, peer.state))
+            states = [p.state for p in self._peers.values()]
+            self._g_healthy.set(sum(s is PeerState.HEALTHY for s in states))
+            self._g_suspect.set(sum(s is PeerState.SUSPECT for s in states))
+            self._g_dead.set(sum(s is PeerState.DEAD for s in states))
+            ages = [now - p.last_seen for p in self._peers.values()
+                    if p.last_seen > 0]
+            self._g_age.set(max(ages) if ages else 0.0)
+        for pid, old, new in fired:
+            self._c_trans.inc()
+            logging.info("peer %d: %s -> %s", pid, old.value, new.value)
+            for fn in self._transitions:
+                try:
+                    fn(pid, old, new)
+                except Exception:  # noqa: BLE001 - callbacks can't kill the loop
+                    logging.warning("peer-transition callback raised",
+                                    exc_info=True)
+
+    # ------------------------------------------------------------- queries
+    def peers(self) -> Dict[int, PeerInfo]:
+        with self._lock:
+            return {
+                pid: PeerInfo(
+                    process_id=p.process_id, state=p.state,
+                    last_seen=p.last_seen, last_payload=dict(p.last_payload),
+                    misses=p.misses, next_check=p.next_check,
+                    backoff_s=p.backoff_s,
+                )
+                for pid, p in self._peers.items()
+            }
+
+    def surviving(self) -> List[int]:
+        """Process ids not classified DEAD — the membership an elastic
+        restart rebuilds the ResourceSpec from."""
+        with self._lock:
+            return sorted(pid for pid, p in self._peers.items()
+                          if p.state is not PeerState.DEAD)
+
+    def max_observed_step(self) -> int:
+        """Highest training step any beat has carried (``set_step``) —
+        the supervisor's progress signal."""
+        with self._lock:
+            peer_max = max(
+                (int(p.last_payload.get("step", 0))
+                 for p in self._peers.values()), default=0)
+        return max(peer_max, self._step)
+
+    def verdict(self, now: Optional[float] = None) -> FleetVerdict:
+        """Aggregate classification of everything observed so far."""
+        with self._lock:
+            states = [p.state for p in self._peers.values()]
+        if not states:
+            return FleetVerdict.UNKNOWN
+        if all(s is PeerState.HEALTHY for s in states):
+            return FleetVerdict.HEALTHY
+        if all(s is PeerState.DEAD for s in states):
+            return FleetVerdict.DEAD
+        return FleetVerdict.DEGRADED
+
+    def fleet_hung(self, now: Optional[float] = None) -> bool:
+        """Launcher watchdog predicate: at least one beat was ever seen and
+        EVERY peer's last beat is older than ``hang_after_misses``
+        intervals. Distinct from ``verdict() is DEAD`` only in its longer,
+        dedicated window — killing a live-but-slow fleet is worse than
+        waiting a few extra intervals."""
+        now = self.clock() if now is None else now
+        window = self.config.hang_after_misses * self.config.heartbeat_interval_s
+        with self._lock:
+            seen = [p.last_seen for p in self._peers.values() if p.last_seen > 0]
+        if not seen:
+            return False
+        return all(now - t > window for t in seen)

@@ -1,0 +1,369 @@
+"""Preemption-aware train-state snapshots: async ring + integrity manifest.
+
+Checkpointing (``checkpoint/saver.py``) answers "persist this state";
+snapshots answer the *fault-tolerance* question: keep a short ring of
+recent states always on disk, written off the critical path, each entry
+verifiable, so a preempted or crashed run resumes from seconds-old work —
+on the same mesh or a reshaped one (``ft/elastic.py``).
+
+Mechanics per snapshot:
+
+1. **device→host copy on the calling thread** — mandatory before
+   returning, because the train step donates its state buffers: the next
+   ``step()`` invalidates the device values. The copy itself is cheap
+   (the dispatch queue keeps the device busy; the host blocks only on the
+   transfer).
+2. **background write** through the existing
+   :class:`~autodist_tpu.checkpoint.saver.Saver` (atomic stage→swap, one
+   file per shard block). One snapshot in flight at a time: if the
+   previous write is still running, the new request is *skipped* (counted
+   in ``ft_snapshots_skipped_total``) rather than queued — snapshots are
+   a freshness ring, not a log.
+3. **manifest**: after the swap, ``MANIFEST.json`` inside the snapshot dir
+   records the step + a sha256 per file. :meth:`SnapshotManager.verify`
+   re-hashes; :meth:`latest_valid` walks the ring newest→oldest skipping
+   corrupt entries, so a torn or bit-rotted newest snapshot degrades to
+   the previous ring slot instead of a failed restore.
+4. **ring prune**: newest ``keep`` snapshots retained.
+
+``install_preempt_hook`` arms SIGTERM — the TPU preemption signal — to
+force a final synchronous snapshot from a registered state provider before
+the process exits, chaining to any previously-installed handler.
+
+Snapshot dirs use the Saver's ``ckpt-<step>`` naming, so every Saver
+facility (``latest_checkpoint``, ``restore``, serving's
+``restore_params``) works on a snapshot directory unchanged.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import signal
+import threading
+import time
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+
+from autodist_tpu import metrics as M
+from autodist_tpu.checkpoint.saver import Saver, _to_host
+from autodist_tpu.ft.config import FTConfig
+from autodist_tpu.utils import logging
+
+MANIFEST = "MANIFEST.json"
+
+
+def _chain_signal(sig, frame, prev) -> None:
+    """Hand a caught signal on to whatever was installed before us: call a
+    Python handler; re-deliver under ``SIG_DFL`` when the default
+    disposition (terminate) was in place; do nothing for ``SIG_IGN``."""
+    if callable(prev):
+        prev(sig, frame)
+    elif prev == signal.SIG_DFL:
+        signal.signal(sig, signal.SIG_DFL)
+        os.kill(os.getpid(), sig)
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def latest_snapshot_step(directory: str) -> Optional[int]:
+    """Step of the newest *manifest-carrying* snapshot under ``directory``,
+    or None. Cheap (no hashing) — the supervisor's progress probe."""
+    saver = Saver(directory)
+    for name in reversed(saver._list_checkpoints()):
+        mpath = os.path.join(directory, name, MANIFEST)
+        try:
+            with open(mpath, encoding="utf-8") as f:
+                return int(json.load(f)["step"])
+        except (OSError, ValueError, KeyError):
+            continue
+    return None
+
+
+class SnapshotManager:
+    """Async ring of verified train-state snapshots.
+
+    ``every_steps`` / ``every_s`` drive :meth:`maybe_snapshot`'s cadence
+    (either trigger fires it; both 0 = only explicit :meth:`snapshot`
+    calls). ``keep`` bounds the ring. All writes go through an internal
+    :class:`Saver` rooted at ``directory``.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        keep: int = 3,
+        every_steps: int = 0,
+        every_s: float = 0.0,
+        registry: Optional[M.MetricsRegistry] = None,
+    ):
+        self.directory = directory
+        self.keep = max(1, int(keep))
+        self.every_steps = int(every_steps)
+        self.every_s = float(every_s)
+        self.saver = Saver(directory, max_to_keep=0)  # ring pruned here
+        self._worker: Optional[threading.Thread] = None
+        self._worker_error: Optional[BaseException] = None
+        self._last_step: Optional[int] = None
+        self._last_time = 0.0
+        self._state_provider: Optional[Callable[[], Tuple[Any, int]]] = None
+        self._prev_handler = None
+        self._hook_lock = threading.Lock()
+        self.preempted = False
+        # Signal whose termination was deferred because the provider's state
+        # was donated mid-step; re-delivered after the deferred snapshot.
+        self._pending_signal: Optional[int] = None
+
+        reg = registry or M.registry
+        self._c_taken = reg.counter("ft_snapshots_taken_total")
+        self._c_skipped = reg.counter("ft_snapshots_skipped_total")
+        self._c_corrupt = reg.counter("ft_snapshots_corrupt_total")
+        self._c_preempt = reg.counter("ft_preempt_snapshots_total")
+        self._g_step = reg.gauge("ft_snapshot_last_step")
+
+    @classmethod
+    def from_config(cls, config: FTConfig,
+                    registry: Optional[M.MetricsRegistry] = None
+                    ) -> "SnapshotManager":
+        cfg = config.resolved()
+        return cls(
+            cfg.snapshot_dir, keep=cfg.keep_snapshots,
+            every_steps=cfg.snapshot_every_steps,
+            every_s=cfg.snapshot_every_s, registry=registry,
+        )
+
+    # ------------------------------------------------------------------ take
+    def maybe_snapshot(self, state: Any, step: Optional[int] = None,
+                       step_obj: Any = None) -> Optional[str]:
+        """Snapshot iff the step/time cadence says one is due (or a
+        preemption flag is pending). Returns the target path when a
+        snapshot was initiated, else None. Never blocks on file IO."""
+        step = self._resolve_step(state, step)
+        due = self.preempted
+        if self.every_steps > 0 and (
+                self._last_step is None
+                or step - self._last_step >= self.every_steps):
+            due = True
+        if self.every_s > 0 and (
+                time.monotonic() - self._last_time >= self.every_s):
+            due = True
+        if not due:
+            return None
+        path = self.snapshot(state, step=step, step_obj=step_obj,
+                             block=self.preempted)
+        if self._pending_signal is not None and path is not None:
+            # The signal handler deferred termination because its registered
+            # state was donated mid-step; THIS state is fresh. The deferred
+            # snapshot is on disk — complete the preemption now.
+            sig, self._pending_signal = self._pending_signal, None
+            self._c_preempt.inc()
+            logging.info(
+                "deferred preemption snapshot written at step %d; "
+                "re-delivering signal %d", step, sig)
+            _chain_signal(sig, None, self._prev_handler)
+        return path
+
+    def snapshot(self, state: Any, step: Optional[int] = None,
+                 step_obj: Any = None, block: bool = False) -> Optional[str]:
+        """Take one snapshot now.
+
+        ``step_obj`` (a :class:`~autodist_tpu.kernel.DistributedTrainStep`)
+        converts pad-and-mask storage to logical shapes first — the same
+        contract as ``step.save``. ``block=True`` waits for the write
+        (preemption path); otherwise only the device→host copy happens
+        here and the file IO runs on the background worker.
+        """
+        if self._busy():
+            if not block:
+                self._c_skipped.inc()
+                logging.warning(
+                    "snapshot at step %s skipped: previous write still in "
+                    "flight", step)
+                return None
+            # A forced (preemption/final) snapshot must not be skippable:
+            # drain the in-flight write first.
+            self.wait()
+        step = self._resolve_step(state, step)
+        tree = step_obj.logical_state(state) if step_obj is not None else state
+        # Host materialization on the calling thread — donation safety (the
+        # caller's next train step invalidates these device buffers).
+        host_tree = jax.tree.map(_to_host, tree)
+        path = os.path.join(self.directory, f"ckpt-{step}")
+        self._last_step, self._last_time = step, time.monotonic()
+        self._worker_error = None
+        self._worker = threading.Thread(
+            target=self._write, args=(host_tree, path, step),
+            name="ft-snapshot", daemon=False,
+        )
+        self._worker.start()
+        if block:
+            self.wait()
+        return path
+
+    def _busy(self) -> bool:
+        return self._worker is not None and self._worker.is_alive()
+
+    def wait(self) -> None:
+        """Join any in-flight snapshot write; re-raise its failure."""
+        if self._worker is not None:
+            self._worker.join()
+            self._worker = None
+        err, self._worker_error = self._worker_error, None
+        if err is not None:
+            raise RuntimeError("snapshot write failed") from err
+
+    @staticmethod
+    def _resolve_step(state: Any, step: Optional[int]) -> int:
+        if step is not None:
+            return int(step)
+        s = getattr(state, "step", None)
+        try:
+            return int(s) if s is not None else 0
+        except TypeError:
+            return 0
+
+    def _write(self, host_tree: Any, path: str, step: int) -> None:
+        try:
+            if jax.process_count() > 1:
+                # The Saver's own async path runs its stage/swap barriers on
+                # the coordination service (pure RPC — safe off-thread);
+                # its blocking path would enqueue device collectives from
+                # this background thread, racing the train step's.
+                self.saver.save(host_tree, path=path, step=step, block=False)
+                self.saver.wait()
+            else:
+                self.saver.save(host_tree, path=path, step=step, block=True)
+            if jax.process_index() == 0:
+                self._write_manifest(path, step)
+                self._prune()
+            self._c_taken.inc()
+            self._g_step.set(step)
+        except BaseException as e:  # noqa: BLE001 - surfaced via wait()
+            self._worker_error = e
+            logging.warning("snapshot write to %s failed", path, exc_info=True)
+
+    def _write_manifest(self, path: str, step: int) -> None:
+        files = {}
+        for root, _, names in os.walk(path):
+            for name in names:
+                if name == MANIFEST:
+                    continue
+                full = os.path.join(root, name)
+                files[os.path.relpath(full, path)] = _sha256(full)
+        manifest = {"step": step, "time": time.time(), "files": files}
+        tmp = os.path.join(path, MANIFEST + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+        os.replace(tmp, os.path.join(path, MANIFEST))
+
+    def _prune(self) -> None:
+        names = self.saver._list_checkpoints()
+        for stale in names[: -self.keep]:
+            shutil.rmtree(os.path.join(self.directory, stale),
+                          ignore_errors=True)
+
+    # ---------------------------------------------------------------- verify
+    def verify(self, path: str) -> bool:
+        """True iff the snapshot's manifest exists and every listed file
+        hashes to its recorded digest (and none is missing)."""
+        try:
+            with open(os.path.join(path, MANIFEST), encoding="utf-8") as f:
+                manifest = json.load(f)
+            for rel, digest in manifest["files"].items():
+                if _sha256(os.path.join(path, rel)) != digest:
+                    return False
+        except (OSError, ValueError, KeyError):
+            return False
+        return True
+
+    def latest_valid(self) -> Optional[str]:
+        """Newest snapshot that passes :meth:`verify`, walking the ring
+        newest→oldest; corrupt entries are skipped (counted + logged)."""
+        self.wait()
+        for name in reversed(self.saver._list_checkpoints()):
+            path = os.path.join(self.directory, name)
+            if self.verify(path):
+                return path
+            self._c_corrupt.inc()
+            logging.warning(
+                "snapshot %s failed integrity verification; falling back to "
+                "the previous ring entry", path)
+        return None
+
+    def restore_latest_valid(self, target: Any = None,
+                             shardings: Any = None) -> Optional[Any]:
+        """Restore the newest verified snapshot (None when the ring holds
+        no valid entry). The sharded-read path is the Saver's — each
+        process reads only the regions its devices need, so this is also
+        the resharded-resume primitive ``ft/elastic.py`` builds on."""
+        path = self.latest_valid()
+        if path is None:
+            return None
+        logging.info("restoring snapshot %s", path)
+        return self.saver.restore(path, target=target, shardings=shardings)
+
+    def latest_step(self) -> Optional[int]:
+        return latest_snapshot_step(self.directory)
+
+    # --------------------------------------------------------------- preempt
+    def register_state_provider(
+            self, fn: Callable[[], Tuple[Any, int]]) -> None:
+        """``fn() -> (state_tree, step)`` called by the preemption hook to
+        get the freshest snapshot-able state. Training loops typically
+        register ``lambda: (step.logical_state(state), int(state.step))``
+        and refresh the closure each iteration (or use
+        :meth:`maybe_snapshot`, which observes state every call)."""
+        self._state_provider = fn
+
+    def install_preempt_hook(self, signum: int = signal.SIGTERM) -> None:
+        """Arm ``signum`` (default SIGTERM — the TPU preemption notice) to
+        force a final blocking snapshot, then hand the signal back: a
+        previously installed Python handler is chained; the default
+        disposition is HONORED by re-delivering the signal with ``SIG_DFL``
+        restored (a preempted process must still die once its snapshot is
+        safe — swallowing the signal would just convert the preemption
+        notice into the un-notified SIGKILL that follows). Must be called
+        from the main thread (CPython signal rule)."""
+        if self._prev_handler is not None:
+            return
+
+        def handler(sig, frame):
+            self.preempted = True
+            saved = True
+            with self._hook_lock:
+                if self._state_provider is not None:
+                    try:
+                        state, step = self._state_provider()
+                        logging.info(
+                            "preemption signal %d: forcing final snapshot at "
+                            "step %d", sig, step)
+                        self.snapshot(state, step=step, block=True)
+                        self._c_preempt.inc()
+                    except Exception:  # noqa: BLE001 - exit path must not throw
+                        # Dominant cause: the registered state's buffers were
+                        # DONATED by the train step that is executing right
+                        # now ("Array has been deleted"). Dying here would
+                        # lose the final snapshot, so termination is
+                        # DEFERRED: the flag below makes the loop's next
+                        # maybe_snapshot call — which holds the fresh,
+                        # un-donated state — take the forced snapshot and
+                        # then re-deliver this signal to finish the exit.
+                        saved = False
+                        self._pending_signal = sig
+                        logging.warning(
+                            "preemption snapshot from the signal handler "
+                            "failed (state likely donated mid-step); "
+                            "deferring to the next maybe_snapshot",
+                            exc_info=True)
+            if saved:
+                _chain_signal(sig, frame, self._prev_handler)
+
+        self._prev_handler = signal.signal(signum, handler) or signal.SIG_DFL

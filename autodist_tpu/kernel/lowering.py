@@ -1640,7 +1640,7 @@ class DistributedTrainStep:
         return saver.save(self.logical_state(state), path=path, step=step,
                           block=block)
 
-    def init_or_restore(self, params, saver) -> TrainState:
+    def init_or_restore(self, params, saver=None, restore_fn=None) -> TrainState:
         """Fresh state, or the latest checkpoint when one exists — the
         crash-resume entry point (the reference's closest fault-tolerance
         mechanism was checkpoint/resume, SURVEY §5). The restored state is
@@ -1649,15 +1649,22 @@ class DistributedTrainStep:
         *logical* shapes (write them with
         ``saver.save(step.logical_state(state))``); a padded plan re-pads
         the loaded leaves into its storage view here.
+
+        ``restore_fn(target=..., shardings=...)`` overrides where the state
+        comes from (default: ``saver.restore_latest``) — the ft subsystem
+        passes ``SnapshotManager.restore_latest_valid`` so elastic resume
+        rides this exact path with integrity-verified snapshots.
         """
+        if restore_fn is None:
+            restore_fn = saver.restore_latest
         state = self.init(params)
         if not self.plan.has_padding:
-            restored = saver.restore_latest(
+            restored = restore_fn(
                 target=jax.eval_shape(lambda: state), shardings=self._state_shardings
             )
             return restored if restored is not None else state
         logical_shapes = jax.eval_shape(self.plan.unpad_state, state)
-        restored = saver.restore_latest(target=logical_shapes)
+        restored = restore_fn(target=logical_shapes)
         if restored is None:
             return state
         return jax.device_put(self.plan.pad_state(restored), self._state_shardings)

@@ -25,7 +25,8 @@ import argparse
 import os
 import subprocess
 import sys
-from typing import List, Optional, Sequence
+import threading
+from typing import List, Optional, Sequence, TYPE_CHECKING
 
 from autodist_tpu import const
 from autodist_tpu.const import ENV
@@ -33,6 +34,9 @@ from autodist_tpu.resource_spec import ResourceSpec
 from autodist_tpu.runtime.cluster import Cluster, clean_stale_processes, write_pidfile
 from autodist_tpu.runtime.coordinator import Coordinator
 from autodist_tpu.utils import logging
+
+if TYPE_CHECKING:
+    from autodist_tpu.ft import FTConfig
 
 
 def _scrub_role_vars(env: dict) -> dict:
@@ -57,6 +61,84 @@ def _scrub_role_vars(env: dict) -> dict:
     return {k: v for k, v in env.items() if k not in role_vars}
 
 
+class _FleetWatch:
+    """Launcher-side fleet observer: a non-publishing
+    :class:`~autodist_tpu.ft.heartbeat.HealthMonitor` over the fleet's
+    heartbeat directory, plus a watchdog thread that terminates the chief
+    when the whole fleet goes silent (``fleet_hung``).
+
+    This is the capability blind exit-code supervision cannot have: a hung
+    fleet never *exits*, so ``--max-restarts`` alone would wait on it
+    forever. The watchdog converts the HealthMonitor's verdict into a
+    chief termination, which surfaces as a non-zero ``launch`` return the
+    supervisor can act on.
+    """
+
+    def __init__(self, ft_config: "FTConfig"):
+        from autodist_tpu.ft import FileTransport, HealthMonitor
+
+        self.config = ft_config.resolved()
+        # Sweep beats left by a previous incarnation: their stale stamps
+        # would otherwise read as an immediately-hung fleet.
+        hb_dir = self.config.heartbeat_dir
+        os.makedirs(hb_dir, exist_ok=True)
+        for name in os.listdir(hb_dir):
+            if name.startswith("hb-"):
+                try:
+                    os.remove(os.path.join(hb_dir, name))
+                except OSError:
+                    pass
+        self.monitor = HealthMonitor(
+            FileTransport(hb_dir), publish=False, config=self.config)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.hang_detected = False
+
+    def env(self) -> dict:
+        """Role env every fleet process needs to heartbeat into the same
+        base dir the watchdog sweeps."""
+        return {ENV.AUTODIST_FT_DIR.name: self.config.base_dir}
+
+    def start(self, chief: subprocess.Popen) -> None:
+        def watch():
+            while not self._stop.is_set():
+                try:
+                    self.monitor.tick()
+                    if self.monitor.fleet_hung():
+                        self.hang_detected = True
+                        logging.error(
+                            "fleet heartbeats silent for %d intervals "
+                            "(verdict %s); terminating chief for restart",
+                            self.config.hang_after_misses,
+                            self.monitor.verdict().value,
+                        )
+                        chief.terminate()
+                        return
+                except Exception:  # noqa: BLE001 - watchdog must not die
+                    logging.warning("fleet watchdog tick failed", exc_info=True)
+                self._stop.wait(self.config.heartbeat_interval_s)
+
+        self._thread = threading.Thread(
+            target=watch, name="ft-fleet-watch", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def verdict(self) -> str:
+        return self.monitor.verdict().value
+
+    def progress_step(self) -> Optional[int]:
+        """Newest snapshot step the fleet persisted (the supervisor's
+        progress signal)."""
+        from autodist_tpu.ft.snapshot import latest_snapshot_step
+
+        return latest_snapshot_step(self.config.snapshot_dir)
+
+
 def launch(
     resource_spec: ResourceSpec,
     argv: Sequence[str],
@@ -64,6 +146,7 @@ def launch(
     coordinator_port: Optional[int] = None,
     extra_env: Optional[dict] = None,
     supervised: bool = False,
+    ft_config: "Optional[FTConfig]" = None,
 ) -> int:
     """Launch ``argv`` across the cluster; returns the chief's exit code.
 
@@ -77,15 +160,24 @@ def launch(
     worker-death fail-fast from ``os._exit(1)`` to terminating the chief
     subprocess, so this function *returns* non-zero instead of killing the
     calling process — required by :func:`launch_supervised`'s restart loop.
+    ``ft_config`` additionally arms the fleet watchdog: every process gets
+    ``AUTODIST_FT_DIR`` pointing at one shared base, and a launcher-side
+    :class:`~autodist_tpu.ft.heartbeat.HealthMonitor` observer terminates a
+    fleet whose heartbeats all go silent (a hang never exits on its own).
     """
     clean_stale_processes()
     argv = list(argv)
     extra_env = dict(extra_env or {})
+    watch = None
+    if ft_config is not None:
+        watch = _FleetWatch(ft_config)
+        extra_env = {**watch.env(), **extra_env}
 
     if num_local_processes > 1:
         base = {**_scrub_role_vars(dict(os.environ)), **extra_env}
         return _launch_local_fleet(
-            argv, num_local_processes, coordinator_port, base_env=base)
+            argv, num_local_processes, coordinator_port, base_env=base,
+            watch=watch)
 
     cluster = Cluster(resource_spec, coordinator_port=coordinator_port)
     coordinator = Coordinator(cluster, argv=argv, extra_env=extra_env)
@@ -105,7 +197,19 @@ def launch(
     chief = subprocess.Popen(argv, env={**_scrub_role_vars(dict(os.environ)), **env})
     if supervised:
         coordinator.set_failure_action(chief.terminate)
+    if watch is not None:
+        watch.start(chief)
     code = chief.wait()
+    if watch is not None:
+        watch.stop()
+        if watch.hang_detected and code == 0:
+            # A SIGTERM'd chief that exits 0 (its preemption hook ran clean)
+            # must still read as a failed attempt, or the supervisor would
+            # declare a hung fleet done.
+            code = 1
+        if code != 0:
+            logging.error("fleet attempt failed rc=%d; health verdict: %s",
+                          code, watch.verdict())
     if code == 0:
         coordinator.join()
         if coordinator.any_failed:
@@ -127,6 +231,7 @@ def launch_supervised(
     num_local_processes: int = 0,
     coordinator_port: Optional[int] = None,
     restart_backoff_s: float = 5.0,
+    ft_config: "Optional[FTConfig]" = None,
 ) -> int:
     """:func:`launch` under a restart supervisor (checkpoint-resume loop).
 
@@ -144,10 +249,29 @@ def launch_supervised(
     supervisor needs no protocol with the script. Each attempt carries
     ``AUTODIST_RESTART`` (0 on the first run) in every process's env —
     chief, local workers, and SSH-launched remote workers alike.
+
+    With ``ft_config`` the supervisor stops being a blind exit-code
+    counter and consumes the ft subsystem's verdicts instead:
+
+    - each :func:`launch` runs under the fleet watchdog (a hung fleet is
+      terminated and restarted rather than waited on forever);
+    - the restart budget counts restarts *since the fleet last made
+      progress*: when the newest snapshot step advanced across an attempt
+      (``ft.snapshot.latest_snapshot_step``), the counter resets — a run
+      that keeps progressing between preemptions is never "given up on"
+      by an absolute cap sized for genuine crash loops.
     """
     import time
 
+    def _progress() -> Optional[int]:
+        if ft_config is None:
+            return None
+        from autodist_tpu.ft.snapshot import latest_snapshot_step
+
+        return latest_snapshot_step(ft_config.resolved().snapshot_dir)
+
     attempt = 0
+    last_progress = _progress()
     while True:
         code = launch(
             resource_spec, argv,
@@ -156,14 +280,28 @@ def launch_supervised(
             extra_env={"AUTODIST_RESTART": str(attempt)},
             # max_restarts=0 keeps exact unsupervised fail-fast semantics
             # (immediate os._exit on worker death) — there is no restart
-            # loop to protect, so the reference behavior wins.
+            # loop to protect, so the reference behavior wins. ft_config
+            # passes through REGARDLESS: the hang watchdog and the
+            # AUTODIST_FT_DIR export are useful with zero restarts too (a
+            # hung fleet still becomes a reportable non-zero exit).
             supervised=max_restarts > 0,
+            ft_config=ft_config,
         )
+        if code != 0:
+            step_now = _progress()
+            if step_now is not None and (
+                    last_progress is None or step_now > last_progress):
+                if attempt:
+                    logging.info(
+                        "fleet progressed to snapshot step %d since the last "
+                        "restart; resetting the restart budget", step_now)
+                attempt = 0
+                last_progress = step_now
         if code == 0 or attempt >= max_restarts:
             if code != 0:
                 logging.error(
-                    "fleet failed rc=%d after %d restart(s); giving up",
-                    code, attempt,
+                    "fleet failed rc=%d after %d restart(s) without "
+                    "progress; giving up", code, attempt,
                 )
             return code
         attempt += 1
@@ -176,7 +314,7 @@ def launch_supervised(
 
 def _launch_local_fleet(
     argv: List[str], n: int, coordinator_port: Optional[int],
-    base_env: Optional[dict] = None,
+    base_env: Optional[dict] = None, watch: Optional[_FleetWatch] = None,
 ) -> int:
     """Emulate an n-host cluster on one machine (testing path).
 
@@ -207,7 +345,13 @@ def _launch_local_fleet(
         ENV.AUTODIST_PROCESS_ID.name: "0",
     }
     chief = subprocess.Popen(argv, env=env)
+    if watch is not None:
+        watch.start(chief)
     code = chief.wait()
+    if watch is not None:
+        watch.stop()
+        if watch.hang_detected and code == 0:
+            code = 1
     for p in procs:
         try:
             p.wait(timeout=60)
@@ -262,6 +406,13 @@ def main(args: Optional[Sequence[str]] = None) -> int:
              "using init_or_restore resume from their latest checkpoint",
     )
     parser.add_argument("--restart-backoff", type=float, default=5.0)
+    parser.add_argument(
+        "--ft-dir", default="",
+        help="enable fault-tolerance supervision rooted at this shared "
+             "dir: fleet processes heartbeat under it, a hung fleet is "
+             "terminated for restart, and the restart budget resets "
+             "whenever the snapshot ring advances (docs/fault_tolerance.md)",
+    )
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="-- python train.py ...")
     ns = parser.parse_args(args)
@@ -271,12 +422,18 @@ def main(args: Optional[Sequence[str]] = None) -> int:
     spec = (
         ResourceSpec(ns.resource_spec) if ns.resource_spec else ResourceSpec.from_local_devices()
     )
+    ft_config = None
+    if ns.ft_dir:
+        from autodist_tpu.ft import FTConfig
+
+        ft_config = FTConfig(base_dir=ns.ft_dir)
     return launch_supervised(
         spec, command,
         max_restarts=ns.max_restarts,
         num_local_processes=ns.num_local_processes,
         coordinator_port=ns.coordinator_port or None,
         restart_backoff_s=ns.restart_backoff,
+        ft_config=ft_config,
     )
 
 

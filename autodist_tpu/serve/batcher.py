@@ -51,6 +51,10 @@ class RequestState(Enum):
     DONE = "done"
     TIMEOUT = "timeout"
     REJECTED = "rejected"
+    # Terminal because the SERVER is shutting down, not because the request
+    # failed: the ft drain controller persists these for replay on restart
+    # (autodist_tpu/ft/drain.py).
+    PREEMPTED = "preempted"
 
 
 _ids = itertools.count()
@@ -146,6 +150,7 @@ class ContinuousBatcher:
         self._wake = threading.Condition(self._lock)
         self._running = False
         self._stopped = False
+        self._draining = False  # quiesced: no new admissions, finish active
         self._thread: Optional[threading.Thread] = None
         self._tick_tokens: deque = deque(maxlen=64)  # (t, n) for tokens/sec
 
@@ -192,6 +197,11 @@ class ContinuousBatcher:
                 # queue drains once start() runs.)
                 self._m_rejected.inc()
                 raise Backpressure("batcher is stopped")
+            if self._draining:
+                # Graceful shutdown in progress: shed at the edge so the
+                # client retries against the replacement server.
+                self._m_rejected.inc()
+                raise Backpressure("batcher is draining")
             if len(self._queue) >= self.max_queue:
                 self._m_rejected.inc()
                 raise Backpressure(
@@ -209,6 +219,7 @@ class ContinuousBatcher:
                 return self
             self._running = True
             self._stopped = False
+            self._draining = False
         self._thread = threading.Thread(
             target=self._loop, name="serve-batcher", daemon=True)
         self._thread.start()
@@ -236,6 +247,58 @@ class ContinuousBatcher:
             self._thread.join(timeout=timeout_s)
             self._thread = None
         self._fail_all("batcher stopped before this request completed")
+
+    def quiesce(self) -> None:
+        """Stop admitting — new ``submit``s are refused and queued entries
+        are no longer promoted to slots — while active decodes keep
+        stepping. The first phase of a graceful drain (ft/drain.py)."""
+        with self._wake:
+            self._draining = True
+            self._wake.notify()
+
+    def drain(self, deadline_s: float = 30.0):
+        """Graceful shutdown: quiesce, let in-flight decodes finish within
+        ``deadline_s``, then stop the scheduler.
+
+        Returns ``(n_finished_during_drain, leftovers)`` where
+        ``leftovers`` are the requests this process will never run — the
+        untouched queue plus any decode the deadline cut off — each
+        already finished terminally as :attr:`RequestState.PREEMPTED` (so
+        no client blocks forever). The caller decides their fate; the ft
+        :class:`~autodist_tpu.ft.drain.DrainController` persists them for
+        exactly-once replay on restart.
+        """
+        before = self._m_completed.value
+        self.quiesce()
+        deadline = time.monotonic() + deadline_s
+        started = self._thread is not None
+        while started and time.monotonic() < deadline:
+            with self._lock:
+                if not self._active:
+                    break
+            time.sleep(0.005)
+        with self._wake:
+            self._running = False
+            self._stopped = True
+            self._wake.notify()
+        if self._thread is not None:
+            self._thread.join(timeout=max(1.0, deadline_s))
+            self._thread = None
+        with self._lock:
+            active = list(self._active.items())
+            self._active.clear()
+            leftovers = list(self._queue)
+            self._queue.clear()
+            self._m_depth.set(0)
+            self._m_active.set(0)
+        for slot, req in active:
+            self.engine.release(slot)
+        leftovers = [req for _, req in active] + leftovers
+        for req in leftovers:
+            req._finish(RequestState.PREEMPTED,
+                        "server draining; request persisted for replay")
+        finished = int(self._m_completed.value - before)
+        return finished, leftovers
 
     def __enter__(self) -> "ContinuousBatcher":
         return self.start()
@@ -300,7 +363,9 @@ class ContinuousBatcher:
         while True:
             dead = None
             with self._lock:
-                if not self._queue:
+                if self._draining or not self._queue:
+                    # Draining: queued entries stay untouched for the drain
+                    # controller to persist; only active slots keep stepping.
                     break
                 head = self._queue[0]
                 if head.deadline is not None and time.monotonic() > head.deadline:

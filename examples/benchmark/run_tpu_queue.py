@@ -130,6 +130,25 @@ JOB_ENV = {
 BASE_JOB_ENV = {"BENCH_QUEUE_CHILD": "1"}
 MAX_FAILED_ATTEMPTS = 2   # genuine non-zero exits: the job itself is broken
 MAX_WEDGED_ATTEMPTS = 6   # environmental kills (tunnel wedge) retry more
+# Grace between SIGTERM and SIGKILL on a timed-out job. A hard kill
+# mid-dispatch is the documented tunnel-wedge trigger (docs/performance.md
+# r5 notes: a harness timeout killing a run mid-dispatch began the 27h
+# wedge); SIGTERM first lets the job's trailing dispatch barrier drain and
+# its ft preemption hook snapshot before the group is killed.
+STOP_GRACE_S = 60.0
+
+
+def _graceful_stop(proc, grace_s: float = STOP_GRACE_S):
+    """SIGTERM + grace + SIGKILL via autodist_tpu/ft/procdrain.py, loaded
+    by path (like pidlock) so the driver keeps zero package imports.
+    Returns (stdout, stderr) from the reaped child."""
+    import importlib.util
+
+    path = os.path.join(ROOT, "autodist_tpu", "ft", "procdrain.py")
+    spec = importlib.util.spec_from_file_location("_queue_procdrain", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.stop_gracefully(proc, grace_s=grace_s)
 
 
 def _load_state() -> dict:
@@ -172,35 +191,43 @@ def probe(timeout_s: float = 150.0) -> bool:
 
 def run_job(name: str, argv: list, timeout_s: float) -> str:
     """Run one experiment; returns done|wedged|failed. Output is teed to
-    ``docs/measured/queue/<name>.log`` for post-hoc inspection."""
+    ``docs/measured/queue/<name>.log`` for post-hoc inspection.
+
+    A job that outruns its timeout is stopped GRACEFULLY — SIGTERM to its
+    process group, ``STOP_GRACE_S`` to drain, SIGKILL only then — instead
+    of the old hard kill, which could sever an in-flight dispatch and
+    wedge the tunnel for every job after it."""
     log_path = os.path.join(QDIR, f"{name}.log")
     _log(f"job {name}: starting (timeout {timeout_s:.0f}s)")
     t0 = time.time()
+    proc = subprocess.Popen(
+        [sys.executable] + argv, cwd=ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,  # own group: graceful stop signals the tree
+        env={**os.environ, **BASE_JOB_ENV, **JOB_ENV.get(name, {})},
+    )
+    timed_out = False
     try:
-        r = subprocess.run(
-            [sys.executable] + argv, cwd=ROOT,
-            timeout=timeout_s, capture_output=True, text=True,
-            env={**os.environ, **BASE_JOB_ENV, **JOB_ENV.get(name, {})},
-        )
-    except subprocess.TimeoutExpired as e:
-        def _txt(x):
-            if isinstance(x, bytes):
-                return x.decode(errors="replace")
-            return x or ""
-        with open(log_path, "a") as f:
-            f.write(f"\n===== attempt @ {time.strftime('%H:%M:%S')} =====\n")
-            f.write(_txt(e.stdout))
-            if e.stderr:
-                f.write("\n--- stderr ---\n" + _txt(e.stderr)[-8000:])
-            f.write("\n--- TIMEOUT ---\n")
-        _log(f"job {name}: TIMED OUT after {timeout_s:.0f}s (tunnel wedge?)")
-        return "wedged"
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        _log(f"job {name}: timeout after {timeout_s:.0f}s — SIGTERM, "
+             f"{STOP_GRACE_S:.0f}s grace to drain")
+        stdout, stderr = _graceful_stop(proc)
     with open(log_path, "a") as f:
         f.write(f"\n===== attempt @ {time.strftime('%H:%M:%S')} =====\n")
-        f.write(r.stdout)
-        if r.stderr:
-            f.write("\n--- stderr ---\n" + r.stderr[-8000:])
+        f.write(stdout or "")
+        if stderr:
+            f.write("\n--- stderr ---\n" + stderr[-8000:])
+        if timed_out:
+            f.write("\n--- TIMEOUT (graceful stop) ---\n")
     dt = time.time() - t0
+    if timed_out:
+        _log(f"job {name}: TIMED OUT after {dt:.0f}s (tunnel wedge?); "
+             f"stopped gracefully")
+        return "wedged"
+    r = proc
+    r.stdout, r.stderr = stdout or "", stderr or ""
     if r.returncode == 4:
         # The job's own environmental signal (bench BENCH_REQUIRE_ACCEL:
         # wedge fallback, no device data). Mapped to 'wedged' DIRECTLY —

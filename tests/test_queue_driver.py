@@ -49,15 +49,63 @@ def test_deadline_skip_and_early_stop(qd, monkeypatch, capsys):
     assert "stopping early" in log
 
 
-def test_rc4_maps_to_wedged_directly(qd, tmp_path, monkeypatch):
-    class R:
-        returncode = 4
-        stdout = '{"metric": "x"}\n'
-        stderr = ""
+class _FakeProc:
+    def __init__(self, returncode=0, stdout='{"metric": "x"}\n', stderr="",
+                 hang=False):
+        self.returncode = returncode
+        self._out, self._err = stdout, stderr
+        self._hang = hang
 
-    monkeypatch.setattr(qd.subprocess, "run", lambda *a, **k: R())
+    def communicate(self, timeout=None):
+        if self._hang:
+            self._hang = False  # the graceful stop's communicate succeeds
+            raise qd_subprocess_timeout(timeout)
+        return self._out, self._err
+
+
+def qd_subprocess_timeout(timeout):
+    import subprocess
+
+    return subprocess.TimeoutExpired(cmd=["x"], timeout=timeout)
+
+
+def test_rc4_maps_to_wedged_directly(qd, tmp_path, monkeypatch):
+    monkeypatch.setattr(qd.subprocess, "Popen",
+                        lambda *a, **k: _FakeProc(returncode=4))
     status = qd.run_job("bench_quick", ["bench.py"], 60)
     assert status == "wedged"
+
+
+def test_timeout_stops_gracefully_not_hard_kill(qd, tmp_path, monkeypatch):
+    """A timed-out job goes through the SIGTERM-grace-SIGKILL path (ft
+    procdrain), is logged as wedged, and its partial output still lands in
+    the job log."""
+    stopped = []
+    proc = _FakeProc(returncode=-15, stdout="partial\n", hang=True)
+    monkeypatch.setattr(qd.subprocess, "Popen", lambda *a, **k: proc)
+    monkeypatch.setattr(
+        qd, "_graceful_stop",
+        lambda p, grace_s=qd.STOP_GRACE_S: (stopped.append(p),
+                                            p.communicate())[1])
+    status = qd.run_job("bench_quick", ["bench.py"], 1)
+    assert status == "wedged"
+    assert stopped == [proc]
+    log = (tmp_path / "bench_quick.log").read_text()
+    assert "partial" in log and "graceful stop" in log
+
+
+def test_graceful_stop_loader_reaches_procdrain(qd):
+    # The by-path loader must resolve the real module (zero package
+    # imports in the driver itself).
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
+    qd._graceful_stop(proc, grace_s=10.0)
+    assert proc.returncode is not None  # reaped
 
 
 def test_lock_is_atomic_and_owner_checked(qd, tmp_path, monkeypatch, capsys):

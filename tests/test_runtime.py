@@ -693,7 +693,7 @@ class TestRestartSupervisor:
 
         def fake_launch(spec, argv, num_local_processes=0,
                         coordinator_port=None, extra_env=None,
-                        supervised=False):
+                        supervised=False, ft_config=None):
             self.last_supervised = supervised
             calls.append((extra_env or {}).get("AUTODIST_RESTART"))
             return codes[len(calls) - 1]
