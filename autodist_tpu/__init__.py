@@ -13,9 +13,10 @@ Where the reference rewired TF graphs op-by-op
 lets XLA GSPMD insert the collectives — the idiomatic TPU mechanism with the
 same user-visible contract (single-device model in, distributed execution out).
 """
-from autodist_tpu import checkpoint, const, ft, metrics, runtime, serve, strategy
+from autodist_tpu import checkpoint, const, ft, metrics, obs, runtime, serve, strategy
 from autodist_tpu.api import AutoDist, get_default_autodist
 from autodist_tpu.ft import FTConfig
+from autodist_tpu.obs import ObsConfig
 from autodist_tpu.kernel import DistributedTrainStep, TrainState
 from autodist_tpu.model_item import ModelItem, OptimizerSpec
 from autodist_tpu.resource_spec import ResourceSpec
@@ -27,6 +28,7 @@ __all__ = [
     "DistributedTrainStep",
     "FTConfig",
     "ModelItem",
+    "ObsConfig",
     "OptimizerSpec",
     "ResourceSpec",
     "TrainState",
@@ -34,6 +36,7 @@ __all__ = [
     "const",
     "ft",
     "get_default_autodist",
+    "obs",
     "runtime",
     "serve",
     "strategy",

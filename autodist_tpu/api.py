@@ -47,6 +47,7 @@ from autodist_tpu.utils import is_broadcast_leaf, logging
 
 if TYPE_CHECKING:  # circular at runtime: async_ps imports nothing from api
     from autodist_tpu.ft import FTConfig, FTRuntime
+    from autodist_tpu.obs import ObsConfig, ObsRuntime
     from autodist_tpu.runtime.async_ps import AsyncPSTrainer
 
 _default_autodist: Optional["AutoDist"] = None
@@ -133,6 +134,7 @@ class AutoDist:
         resource_spec: Optional[ResourceSpec] = None,
         mesh_axes: Sequence[str] = ("data", "model"),
         fault_tolerance: "Optional[FTConfig]" = None,
+        observability: "Optional[ObsConfig]" = None,
     ):
         global _default_autodist
         if _default_autodist is not None:
@@ -176,6 +178,17 @@ class AutoDist:
             from autodist_tpu.ft import FTRuntime
 
             self.ft = FTRuntime(fault_tolerance)
+        # Observability (docs/observability.md): spans + exporters +
+        # cross-host aggregation, or None when the knob is off (zero
+        # overhead on the default path — mirrors the ft pattern).
+        self.obs: "Optional[ObsRuntime]" = None
+        if observability is not None:
+            from autodist_tpu.obs import ObsRuntime
+
+            self.obs = ObsRuntime(observability)
+            if self.ft is not None:
+                # Straggler scores escalate through the ft HealthMonitor.
+                self.obs.attach_monitor(self.ft.monitor)
         _default_autodist = self
 
     @classmethod
@@ -723,6 +736,7 @@ class AutoDist:
                 "tune (fleet) selected %s — chief-measured; local %.3f ms/step",
                 best_name, results[idx][1] * 1e3,
             )
+            self._record_tune_obs(results, best_name)
             self.strategy_builder = dict(candidates)[best_name]
             return self.build(loss_fn, params, example_batch, **build_kwargs)
 
@@ -730,6 +744,7 @@ class AutoDist:
             raise RuntimeError("tune(): every candidate strategy failed to build/run")
         best_name, best_dt, best_builder, best_step, best_strategy, best_item = best
         logging.info("tune selected %s (%.3f ms/step)", best_name, best_dt * 1e3)
+        self._record_tune_obs(results, best_name)
         # Leave every selection-visible surface pointing at the WINNER, not
         # the last candidate tried: the builder (future build() calls) and
         # the strategy id env (coordinator-relaunched workers load by it).
@@ -739,6 +754,43 @@ class AutoDist:
             best_step, best_strategy, best_item,
         )
         return best_step
+
+    def _record_tune_obs(self, results, selected: str) -> None:
+        """Auditable strategy selection: every candidate's name and measured
+        seconds (inf = failed) plus the winner land in the process metrics
+        registry and the obs span timeline, and ride
+        ``last_tune_results["measured"]/["selected"]`` — so *why this
+        strategy* is answerable after the fact from any export surface,
+        not just the tune call's log lines. Best-effort: never fails a tune.
+        """
+        import time as _time
+
+        try:
+            from autodist_tpu import metrics as M
+            from autodist_tpu.obs import spans as _spans
+
+            reg = M.registry
+            reg.counter("tune_runs_total").inc()
+            reg.gauge("tune_candidates").set(len(results))
+            now = _time.time()
+            for name, dt in results:
+                failed = not (dt < float("inf"))
+                if not failed:
+                    reg.gauge(f"tune_measured_ms_{name}").set(dt * 1e3)
+                _spans.add_span(
+                    "tune.candidate", now, 0.0 if failed else dt,
+                    candidate=name, failed=failed,
+                    selected=(name == selected))
+            sel_dt = dict(results).get(selected)
+            if sel_dt is not None and sel_dt < float("inf"):
+                reg.gauge("tune_selected_ms").set(sel_dt * 1e3)
+            self.last_tune_results = {
+                **(self.last_tune_results or {}),
+                "measured": {n: dt for n, dt in results},
+                "selected": selected,
+            }
+        except Exception:  # noqa: BLE001 - diagnostics must not break tune
+            logging.warning("tune: obs audit recording failed", exc_info=True)
 
     def _record_calibration(self, results, predicted) -> None:
         """Close the predict→measure loop (VERDICT r1 next #10): pair each

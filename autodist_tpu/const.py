@@ -93,6 +93,12 @@ class ENV:
     # Base dir for ft/ state (heartbeats/snapshots/serve queue); set by the
     # launcher so chief, workers, and the supervisor watch the same files.
     AUTODIST_FT_DIR = _EnvVar("")
+    # Observability contract (docs/observability.md): one trace id shared by
+    # every process of a launch (launcher exports it, children inherit) so
+    # their spans stitch into a single cross-process timeline; TRACE_OUT
+    # names a shared directory each process flushes its span part-file into.
+    AUTODIST_TRACE_ID = _EnvVar("")
+    AUTODIST_TRACE_OUT = _EnvVar("")
     SYS_DATA_PATH = _EnvVar("")
     SYS_RESOURCE_PATH = _EnvVar("")
 

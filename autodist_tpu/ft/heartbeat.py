@@ -353,15 +353,49 @@ class HealthMonitor:
             ages = [now - p.last_seen for p in self._peers.values()
                     if p.last_seen > 0]
             self._g_age.set(max(ages) if ages else 0.0)
+        self._fire(fired)
+
+    def _fire(self, fired, reason: str = "") -> None:
+        """Dispatch classification changes: bump the counter, log, run the
+        registered callbacks (outside the lock — a callback may query the
+        monitor). ONE path for tick() and escalate()."""
         for pid, old, new in fired:
             self._c_trans.inc()
-            logging.info("peer %d: %s -> %s", pid, old.value, new.value)
+            logging.info("peer %d: %s -> %s%s", pid, old.value, new.value,
+                         f" ({reason})" if reason else "")
             for fn in self._transitions:
                 try:
                     fn(pid, old, new)
                 except Exception:  # noqa: BLE001 - callbacks can't kill the loop
                     logging.warning("peer-transition callback raised",
                                     exc_info=True)
+
+    def _refresh_state_gauges(self) -> None:
+        with self._lock:
+            states = [p.state for p in self._peers.values()]
+        self._g_healthy.set(sum(s is PeerState.HEALTHY for s in states))
+        self._g_suspect.set(sum(s is PeerState.SUSPECT for s in states))
+        self._g_dead.set(sum(s is PeerState.DEAD for s in states))
+
+    def escalate(self, pid: int, reason: str = "") -> None:
+        """External suspicion feed: force peer ``pid`` to SUSPECT scrutiny
+        now (obs straggler scores use this — a host can be alive-but-sick
+        long before it misses a beat). A DEAD peer stays dead; a healthy
+        beat after escalation clears it through the normal tick path. The
+        next escalation window opens immediately, so a straggler that also
+        stops beating reaches DEAD on the short path."""
+        fired = []
+        with self._lock:
+            peer = self._peers.get(int(pid))
+            if peer is None:
+                peer = self._peers[int(pid)] = PeerInfo(process_id=int(pid))
+            if peer.state is PeerState.HEALTHY:
+                fired.append((int(pid), peer.state, PeerState.SUSPECT))
+                peer.state = PeerState.SUSPECT
+                peer.next_check = self.clock()  # escalate on the next tick
+        if fired:
+            self._refresh_state_gauges()
+        self._fire(fired, reason=reason or "external escalation")
 
     # ------------------------------------------------------------- queries
     def peers(self) -> Dict[int, PeerInfo]:

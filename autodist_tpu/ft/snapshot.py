@@ -50,6 +50,7 @@ import jax
 from autodist_tpu import metrics as M
 from autodist_tpu.checkpoint.saver import Saver, _to_host
 from autodist_tpu.ft.config import FTConfig
+from autodist_tpu.obs import spans as obs_spans
 from autodist_tpu.utils import logging
 
 MANIFEST = "MANIFEST.json"
@@ -195,7 +196,8 @@ class SnapshotManager:
         tree = step_obj.logical_state(state) if step_obj is not None else state
         # Host materialization on the calling thread — donation safety (the
         # caller's next train step invalidates these device buffers).
-        host_tree = jax.tree.map(_to_host, tree)
+        with obs_spans.span("ft.snapshot.device_to_host", step=step):
+            host_tree = jax.tree.map(_to_host, tree)
         path = os.path.join(self.directory, f"ckpt-{step}")
         self._last_step, self._last_time = step, time.monotonic()
         self._worker_error = None
@@ -232,18 +234,22 @@ class SnapshotManager:
 
     def _write(self, host_tree: Any, path: str, step: int) -> None:
         try:
-            if jax.process_count() > 1:
-                # The Saver's own async path runs its stage/swap barriers on
-                # the coordination service (pure RPC — safe off-thread);
-                # its blocking path would enqueue device collectives from
-                # this background thread, racing the train step's.
-                self.saver.save(host_tree, path=path, step=step, block=False)
-                self.saver.wait()
-            else:
-                self.saver.save(host_tree, path=path, step=step, block=True)
-            if jax.process_index() == 0:
-                self._write_manifest(path, step)
-                self._prune()
+            with obs_spans.span("ft.snapshot.write", step=step):
+                if jax.process_count() > 1:
+                    # The Saver's own async path runs its stage/swap barriers
+                    # on the coordination service (pure RPC — safe
+                    # off-thread); its blocking path would enqueue device
+                    # collectives from this background thread, racing the
+                    # train step's.
+                    self.saver.save(host_tree, path=path, step=step,
+                                    block=False)
+                    self.saver.wait()
+                else:
+                    self.saver.save(host_tree, path=path, step=step,
+                                    block=True)
+                if jax.process_index() == 0:
+                    self._write_manifest(path, step)
+                    self._prune()
             self._c_taken.inc()
             self._g_step.set(step)
         except BaseException as e:  # noqa: BLE001 - surfaced via wait()

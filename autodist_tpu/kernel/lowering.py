@@ -33,6 +33,7 @@ insert the collectives:
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -845,6 +846,9 @@ class DistributedTrainStep:
         self._compiled = None
         self._compiled_runs: Dict[Any, Any] = {}
         self._compiled_eval: Dict[Any, Any] = {}
+        # Fresh-program first-call latencies (compile happens synchronously
+        # inside that call): the obs StepProfiler's compile count/time feed.
+        self.compile_log: List[Dict[str, Any]] = []
         self._state_shardings = None
         self._compressors = self._resolve_compressors(plan)
         self._stale = {
@@ -1334,6 +1338,27 @@ class DistributedTrainStep:
                         f"leading dim num_steps={num_steps}; got shape "
                         f"{getattr(leaf, 'shape', ())}")
         key = (int(num_steps), stacked, _force_unroll)
+        fresh = key not in self._compiled_runs
+        fn = self._window_program(state, batch, num_steps, stacked,
+                                  _force_unroll)
+        if fresh:
+            # The first call of a fresh program compiles synchronously
+            # before dispatching; its latency is the compile-time signal
+            # the obs StepProfiler reports.
+            t0 = time.perf_counter()
+            out = fn(state, batch)
+            self.compile_log.append({
+                "program": f"run[{num_steps}{'/stacked' if stacked else ''}]",
+                "first_call_s": time.perf_counter() - t0,
+            })
+            return out
+        return fn(state, batch)
+
+    def _window_program(self, state: TrainState, batch, num_steps: int,
+                        stacked: bool, _force_unroll: bool):
+        """Build-or-fetch the jitted window program for one ``run`` shape
+        (shared by :meth:`run` and :meth:`window_cost`)."""
+        key = (int(num_steps), stacked, _force_unroll)
         fn = self._compiled_runs.get(key)
         if fn is None:
             if self._state_shardings is None:
@@ -1375,7 +1400,44 @@ class DistributedTrainStep:
                 donate_argnums=(0,) if self._donate else (),
             )
             self._compiled_runs[key] = fn
-        return fn(state, batch)
+        return fn
+
+    def window_cost(self, state: TrainState, batch, num_steps: int = 1,
+                    stacked: bool = False) -> Dict[str, float]:
+        """FLOPs / HBM traffic of the compiled window program, from XLA's
+        own per-executable cost analysis (not an analytical model) — the
+        measured-over-measured MFU numerator the obs
+        :class:`~autodist_tpu.obs.profiler.StepProfiler` reports.
+
+        ``state``/``batch`` supply shapes only (nothing executes). Returns
+        ``{"flops", "bytes_accessed"}`` plus ``memory_analysis`` sizes when
+        the backend exposes them. See the in-body note on scan-body
+        counting: request ``num_steps=1`` for per-step numbers.
+        """
+        fn = self._window_program(state, batch, num_steps, stacked, False)
+        compiled = fn.lower(state, batch).compile()
+        ca = compiled.cost_analysis()
+        d = ca[0] if isinstance(ca, (list, tuple)) and ca else (ca or {})
+        # NB: XLA's cost analysis counts a while/scan body ONCE regardless
+        # of trip count, so for a scanned window these numbers are per-BODY
+        # (≈ per step), not per window. Per-step consumers should ask for
+        # ``num_steps=1`` explicitly (the obs StepProfiler does) rather
+        # than divide a window's numbers by its length.
+        out = {
+            "flops": float(d.get("flops", 0.0)),
+            "bytes_accessed": float(d.get("bytes accessed", 0.0)),
+        }
+        try:
+            mem = compiled.memory_analysis()
+        except Exception:  # noqa: BLE001 - optional backend API
+            mem = None
+        if mem is not None:
+            out["argument_bytes"] = float(
+                getattr(mem, "argument_size_in_bytes", 0))
+            out["output_bytes"] = float(
+                getattr(mem, "output_size_in_bytes", 0))
+            out["temp_bytes"] = float(getattr(mem, "temp_size_in_bytes", 0))
+        return out
 
     # ------------------------------------------------------------------ fit
     def fit(
@@ -1682,7 +1744,14 @@ class DistributedTrainStep:
         return out, trace_dir
 
     def __call__(self, state: TrainState, batch) -> Tuple[TrainState, Dict[str, Any]]:
+        fresh = self._compiled is None
         fn = self._compiled or self._compile(state, batch)
+        if fresh:
+            t0 = time.perf_counter()
+            out = fn(state, batch)
+            self.compile_log.append(
+                {"program": "step", "first_call_s": time.perf_counter() - t0})
+            return out
         return fn(state, batch)
 
     def lower_text(self, state: TrainState, batch) -> str:

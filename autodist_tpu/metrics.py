@@ -390,21 +390,13 @@ class MetricsRegistry:
         return out
 
     def render_text(self) -> str:
-        """Prometheus-exposition-style lines (`name value`, quantiles as
-        `name{quantile="0.5"}` — the summary-metric convention scrapers
-        expect), for the serve front end's /metrics route."""
-        lines = []
-        for name, val in sorted(self.snapshot().items()):
-            if isinstance(val, dict):
-                for q, label in (("p50", "0.5"), ("p90", "0.9"),
-                                 ("p99", "0.99")):
-                    lines.append(
-                        f'{name}{{quantile="{label}"}} {val[q]:.6g}')
-                lines.append(f"{name}_count {val['count']:.6g}")
-                lines.append(f"{name}_sum {val['sum']:.6g}")
-            else:
-                lines.append(f"{name} {val:.6g}")
-        return "\n".join(lines) + "\n"
+        """Text exposition of this registry — delegates to THE renderer
+        (``autodist_tpu.obs.exporter.render_openmetrics``) so every export
+        surface emits one format; kept as a convenience method (lazy
+        import: obs imports metrics at module load)."""
+        from autodist_tpu.obs.exporter import render_openmetrics
+
+        return render_openmetrics(self)
 
 
 #: Process-default registry (the serve subsystem's export surface).

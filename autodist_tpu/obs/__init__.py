@@ -1,0 +1,69 @@
+"""Unified observability (L1.5): spans, step profiling, metrics export.
+
+The production triad's third leg (after ``serve/`` and ``ft/``): the layer
+that tells you *where the time and bytes went* across a multi-host fleet.
+Supersedes the earlier islands — ``utils/tracing.py``'s StepTimer (now a
+compat shim over :mod:`~autodist_tpu.obs.profiler`), the ad-hoc prometheus
+text in serve, and the unexported roofline/metrics plumbing:
+
+- :mod:`~autodist_tpu.obs.spans` — cross-process span tracer: context
+  manager/decorator spans into a thread-safe ring, one trace id propagated
+  through the launcher's ``AUTODIST_*`` env so launcher → coordinator →
+  worker spans stitch into a single chrome-trace/Perfetto JSON.
+- :mod:`~autodist_tpu.obs.profiler` — :class:`StepProfiler`: dispatch-gap
+  vs device-compute split per run window (one end barrier, bench.py
+  discipline), live MFU from the compiled program's own cost analysis,
+  roofline position, compile counts, HBM high-water.
+- :mod:`~autodist_tpu.obs.exporter` — ONE OpenMetrics renderer for every
+  export surface (serve ``GET /metrics`` and the headless
+  :class:`FileExporter` are byte-identical), plus the matching parser.
+- :mod:`~autodist_tpu.obs.aggregate` — per-host step-time quantiles over
+  the ft coordination transports; straggler scores feed the
+  HealthMonitor's suspect escalation.
+
+Entry points: ``AutoDist(observability=ObsConfig(...))`` → ``autodist.obs``
+(:class:`ObsRuntime`), and ``python -m autodist_tpu.obs --selftest`` — the
+zero-hardware CPU proof. See docs/observability.md.
+"""
+from __future__ import annotations
+
+from autodist_tpu.obs.aggregate import HostAggregator
+from autodist_tpu.obs.config import ObsConfig, ObsRuntime
+from autodist_tpu.obs.exporter import (
+    FileExporter,
+    parse_openmetrics,
+    render_openmetrics,
+)
+from autodist_tpu.obs.profiler import StepProfiler, StepTimer, detect_peak_flops
+from autodist_tpu.obs.spans import (
+    Span,
+    SpanTracer,
+    add_span,
+    current_trace_id,
+    enable_trace_out,
+    get_tracer,
+    span,
+    stitch,
+    traced,
+)
+
+__all__ = [
+    "FileExporter",
+    "HostAggregator",
+    "ObsConfig",
+    "ObsRuntime",
+    "Span",
+    "SpanTracer",
+    "StepProfiler",
+    "StepTimer",
+    "add_span",
+    "current_trace_id",
+    "detect_peak_flops",
+    "enable_trace_out",
+    "get_tracer",
+    "parse_openmetrics",
+    "render_openmetrics",
+    "span",
+    "stitch",
+    "traced",
+]

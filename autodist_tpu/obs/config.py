@@ -1,0 +1,129 @@
+"""Observability configuration + the per-process runtime bundle.
+
+``AutoDist(observability=ObsConfig(...))`` constructs an :class:`ObsRuntime`
+on ``autodist.obs`` — the same knob-object pattern the ft subsystem uses
+(``fault_tolerance=FTConfig(...)`` → ``autodist.ft``). Everything is off by
+default and each piece is independent: spans alone, a metrics file alone,
+or the full bundle (spans + file exporter + cross-host aggregation).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from autodist_tpu import const, metrics as M
+from autodist_tpu.const import ENV
+from autodist_tpu.obs import spans as _spans
+from autodist_tpu.obs.aggregate import HostAggregator
+from autodist_tpu.obs.exporter import FileExporter
+from autodist_tpu.obs.profiler import StepProfiler
+
+__all__ = ["ObsConfig", "ObsRuntime"]
+
+
+@dataclass
+class ObsConfig:
+    """Knobs for spans, metrics export and cross-host aggregation.
+
+    - ``trace_out``: shared directory for chrome-trace span part-files
+      (every process flushes at exit; ``obs.spans.stitch`` merges).
+      Falls back to ``AUTODIST_TRACE_OUT`` when empty.
+    - ``span_capacity``: default tracer ring size (spans, not bytes).
+    - ``metrics_path`` / ``metrics_interval_s``: periodic OpenMetrics file
+      exporter for headless training ("" disables).
+    - ``aggregate``: publish per-host step-time quantiles and sweep the
+      fleet's over a file transport rooted at ``aggregate_dir`` (default:
+      ``<ft base>/obs`` so one shared dir serves both subsystems).
+    - ``straggler_threshold`` / ``escalate_after``: a host whose step-time
+      p50 exceeds ``threshold ×`` the fleet median for ``escalate_after``
+      consecutive aggregation ticks is escalated to the HealthMonitor's
+      SUSPECT state (no-op when no monitor is attached).
+    """
+
+    trace_out: str = ""
+    span_capacity: int = 4096
+    metrics_path: str = ""
+    metrics_interval_s: float = 10.0
+    aggregate: bool = False
+    aggregate_dir: str = ""
+    aggregate_interval_s: float = 5.0
+    straggler_threshold: float = 1.5
+    escalate_after: int = 3
+
+    def resolved(self) -> "ObsConfig":
+        """Fill env/derived defaults (same pattern as ``FTConfig.resolved``)."""
+        out = ObsConfig(**self.__dict__)
+        if not out.trace_out:
+            out.trace_out = ENV.AUTODIST_TRACE_OUT.val
+        if out.aggregate and not out.aggregate_dir:
+            base = ENV.AUTODIST_FT_DIR.val or const.DEFAULT_FT_DIR
+            out.aggregate_dir = os.path.join(base, "obs")
+        return out
+
+
+class ObsRuntime:
+    """Started observability components for one process.
+
+    ``tracer`` is always the process-default :class:`~autodist_tpu.obs.spans
+    .SpanTracer` (so library instrumentation and user spans land in one
+    timeline); ``exporter``/``aggregator`` exist only when configured.
+    :meth:`profiler` wraps a built step; :meth:`observe_step` feeds the
+    aggregator (no-op without one); :meth:`close` flushes and stops.
+    """
+
+    def __init__(self, config: Optional[ObsConfig] = None,
+                 registry: Optional[M.MetricsRegistry] = None,
+                 monitor=None):
+        self.config = (config or ObsConfig()).resolved()
+        self.registry = registry or M.registry
+        if self.config.trace_out:
+            _spans.enable_trace_out(self.config.trace_out)
+        self.tracer = _spans.get_tracer()
+        if self.config.span_capacity != self.tracer._spans.maxlen:
+            self.tracer.set_capacity(self.config.span_capacity)
+        self.exporter: Optional[FileExporter] = None
+        if self.config.metrics_path:
+            self.exporter = FileExporter(
+                self.config.metrics_path, registry=self.registry,
+                interval_s=self.config.metrics_interval_s).start()
+        self.aggregator: Optional[HostAggregator] = None
+        if self.config.aggregate:
+            from autodist_tpu.ft.heartbeat import FileTransport
+
+            self.aggregator = HostAggregator(
+                FileTransport(self.config.aggregate_dir),
+                process_id=ENV.AUTODIST_PROCESS_ID.val,
+                registry=self.registry,
+                interval_s=self.config.aggregate_interval_s,
+                monitor=monitor,
+                straggler_threshold=self.config.straggler_threshold,
+                escalate_after=self.config.escalate_after,
+            ).start()
+
+    def profiler(self, step, **kwargs) -> StepProfiler:
+        """A :class:`StepProfiler` over ``step`` wired into this runtime's
+        registry and tracer."""
+        kwargs.setdefault("registry", self.registry)
+        kwargs.setdefault("tracer", self.tracer)
+        return StepProfiler(step, **kwargs)
+
+    def observe_step(self, seconds: float) -> None:
+        if self.aggregator is not None:
+            self.aggregator.observe_step(seconds)
+
+    def attach_monitor(self, monitor) -> None:
+        """Late-bind a HealthMonitor (ft starts after obs in AutoDist)."""
+        if self.aggregator is not None:
+            self.aggregator.monitor = monitor
+
+    def close(self) -> None:
+        if self.aggregator is not None:
+            self.aggregator.stop()
+        if self.exporter is not None:
+            self.exporter.stop()
+        if self.config.trace_out and self.tracer.spans():
+            try:
+                self.tracer.flush_part(self.config.trace_out)
+            except OSError:
+                pass

@@ -1,0 +1,167 @@
+"""One OpenMetrics text renderer for every export surface.
+
+Before this module, metric text lived in two ad-hoc places: serve's
+``GET /metrics`` route rendered ``MetricsRegistry.render_text`` and
+headless training had nothing. Now a single :func:`render_openmetrics`
+produces the canonical exposition — serve's route and the
+:class:`FileExporter` both call it, so the two surfaces are *byte-identical*
+on the same registry snapshot (the acceptance bar pins this).
+
+Format (OpenMetrics-flavored prometheus text):
+
+- ``# TYPE`` comment per family — ``counter`` for ``*_total`` names (the
+  family is the name minus the suffix, per the OpenMetrics convention),
+  ``summary`` for histograms, ``gauge`` otherwise;
+- histogram quantiles as ``name{quantile="0.5"}`` plus ``_count``/``_sum``
+  (quantile lines are omitted while the histogram is empty — ``nan`` is
+  not a valid exposition token);
+- deterministic ordering (sorted by name) and a trailing ``# EOF``.
+
+:func:`parse_openmetrics` is the matching reader — the selftest and the
+shared serve/file-exporter test validate every surface through it, so a
+renderer regression cannot ship malformed text silently.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from autodist_tpu import metrics as M
+from autodist_tpu.utils import logging
+
+__all__ = ["FileExporter", "parse_openmetrics", "render_openmetrics"]
+
+_QUANTILES = (("p50", "0.5"), ("p90", "0.9"), ("p99", "0.99"))
+
+
+def _fmt(v: float) -> str:
+    return f"{float(v):.6g}"
+
+
+def render_openmetrics(registry: Optional[M.MetricsRegistry] = None,
+                       snapshot: Optional[Dict[str, Any]] = None) -> str:
+    """The canonical exposition of a registry (or a frozen ``snapshot``
+    from :meth:`~autodist_tpu.metrics.MetricsRegistry.snapshot` — pass one
+    when several surfaces must render the exact same instant)."""
+    if snapshot is None:
+        snapshot = (registry or M.registry).snapshot()
+    lines = []
+    for name in sorted(snapshot):
+        val = snapshot[name]
+        if isinstance(val, dict):  # histogram summary
+            lines.append(f"# TYPE {name} summary")
+            if val.get("count"):
+                for key, label in _QUANTILES:
+                    lines.append(
+                        f'{name}{{quantile="{label}"}} {_fmt(val[key])}')
+            lines.append(f"{name}_count {_fmt(val.get('count', 0))}")
+            lines.append(f"{name}_sum {_fmt(val.get('sum', 0.0))}")
+        elif name.endswith("_total"):
+            lines.append(f"# TYPE {name[:-len('_total')]} counter")
+            lines.append(f"{name} {_fmt(val)}")
+        else:
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_fmt(val)}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def parse_openmetrics(text: str) -> Dict[Tuple[str, str], float]:
+    """Parse an exposition back into ``{(name, labels): value}``.
+
+    Validates structure the way a scraper would: every sample line is
+    ``name[{labels}] value`` with a finite-or-inf float value, and the
+    document ends with ``# EOF``. Raises ``ValueError`` on malformed input
+    (the selftest's exit-nonzero contract rides on this).
+    """
+    import math
+
+    lines = text.splitlines()
+    if not lines or lines[-1].strip() != "# EOF":
+        raise ValueError("exposition missing trailing # EOF")
+    out: Dict[Tuple[str, str], float] = {}
+    for ln in lines[:-1]:
+        ln = ln.strip()
+        if not ln or ln.startswith("#"):
+            if ln.startswith("#") and not ln.startswith(("# TYPE", "# HELP",
+                                                         "# UNIT", "# EOF")):
+                raise ValueError(f"unknown comment line: {ln!r}")
+            continue
+        name, _, rest = ln.partition(" ")
+        if not rest:
+            raise ValueError(f"sample line without value: {ln!r}")
+        labels = ""
+        if "{" in name:
+            if not name.endswith("}"):
+                raise ValueError(f"unterminated label set: {ln!r}")
+            name, _, labels = name.partition("{")
+            labels = labels[:-1]
+        v = float(rest.split()[0])  # raises on non-numeric
+        if math.isnan(v):
+            raise ValueError(f"NaN sample value: {ln!r}")
+        out[(name, labels)] = v
+    return out
+
+
+class FileExporter:
+    """Periodic OpenMetrics file writer for headless training.
+
+    A training job with no HTTP front end still needs scrapeable metrics;
+    this writes :func:`render_openmetrics` to ``path`` atomically (tmp +
+    replace — a scraper never reads a torn file) every ``interval_s``
+    from a daemon thread, plus on :meth:`stop`. ``write_once`` is the
+    synchronous form (tests, end-of-run flush).
+    """
+
+    def __init__(self, path: str, registry: Optional[M.MetricsRegistry] = None,
+                 interval_s: float = 10.0):
+        self.path = path
+        self.registry = registry or M.registry
+        self.interval_s = float(interval_s)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def write_once(self, snapshot: Optional[Dict[str, Any]] = None) -> str:
+        text = render_openmetrics(self.registry, snapshot=snapshot)
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{self.path}.tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(text)
+        os.replace(tmp, self.path)
+        return text
+
+    def start(self) -> "FileExporter":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.write_once()
+                except OSError as e:
+                    logging.warning("metrics file export failed: %s", e)
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(
+            target=loop, name="obs-file-exporter", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(5.0, self.interval_s))
+            self._thread = None
+        try:
+            self.write_once()  # final flush: the file reflects run end
+        except OSError:
+            pass
+
+    def __enter__(self) -> "FileExporter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

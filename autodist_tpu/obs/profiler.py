@@ -1,0 +1,362 @@
+"""Step profiler: dispatch-gap vs device-compute split, live MFU, roofline.
+
+:class:`StepProfiler` wraps a :class:`~autodist_tpu.kernel.DistributedTrainStep`
+(or any object with the same ``run(state, batch, num_steps)`` contract) and
+times each windowed run with the one-end-barrier discipline ``bench.py``
+established: ``run`` returns as soon as the window program is *dispatched*
+(host latency — the dispatch gap), and a single trailing device→host fetch
+of the last loss is the only trustworthy barrier on every platform
+(``block_until_ready`` returns early through the axon tunnel). Per window:
+
+- ``dispatch_gap_s`` — time for ``run()`` to return (host dispatch, plus
+  XLA compile on a window's first execution);
+- ``wall_s`` — dispatch → barrier (the whole window);
+- ``device_s`` — ``wall_s - dispatch_gap_s``, the device-side residue.
+
+FLOPs and HBM bytes come from the **compiled program's own cost analysis**
+(``DistributedTrainStep.window_cost`` → XLA's per-executable numbers), not
+an analytical model, so live MFU is measured-over-measured:
+``mfu = flops_per_step / (device_s_per_step × peak_flops)``. Roofline
+position reuses :mod:`autodist_tpu.utils.roofline`'s time conversion with
+the compiled byte counts. Compile counts/times ride the step's
+``compile_log`` (fresh-program first-call latencies) and the HBM
+high-water mark comes from ``device.memory_stats()`` where the platform
+exposes one (TPU; None on CPU).
+
+:class:`StepTimer` (plain wall-clock step timing, previously
+``utils/tracing.py``) lives here now; the old import path remains as a
+compat shim.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from autodist_tpu import metrics as M
+from autodist_tpu.obs import spans as _spans
+from autodist_tpu.utils import logging
+
+__all__ = ["StepProfiler", "StepTimer", "detect_peak_flops"]
+
+# Peak bf16 FLOPs/s per chip by TPU generation (public figures; the same
+# table bench.py matches against Device.device_kind, longest key first).
+_PEAK_FLOPS = {
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v6e": 918e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+}
+
+
+def detect_peak_flops(device) -> Optional[float]:
+    """Per-chip peak for a recognized accelerator; None when unknown (CPU,
+    unlisted generation) — an MFU against a guessed peak misleads."""
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return None
+
+
+def _hbm_high_water() -> Optional[int]:
+    """Max ``peak_bytes_in_use`` across local devices; None when the
+    platform exposes no memory stats (CPU host platform)."""
+    import jax
+
+    peaks = []
+    for dev in jax.local_devices():
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # noqa: BLE001 - optional platform API
+            stats = None
+        if stats and "peak_bytes_in_use" in stats:
+            peaks.append(int(stats["peak_bytes_in_use"]))
+    return max(peaks) if peaks else None
+
+
+class StepProfiler:
+    """Profile windowed train-step execution with near-zero overhead.
+
+    Usage::
+
+        prof = obs.StepProfiler(step)
+        for _ in range(n_windows):
+            state, metrics = prof.run(state, batch, window)
+        print(json.dumps(prof.report()))
+
+    Each profiled window adds one host-side timing pair and one span; the
+    device program is untouched (the overhead guard in tests/test_obs.py
+    pins enabled-vs-disabled cost). ``registry`` receives ``obs_*`` gauges
+    on every window so exporters see live values.
+    """
+
+    def __init__(
+        self,
+        step,
+        registry: Optional[M.MetricsRegistry] = None,
+        tracer: Optional[_spans.SpanTracer] = None,
+        peak_flops_per_chip: Optional[float] = None,
+        hbm_bw_bytes_per_s: Optional[float] = None,
+    ):
+        import jax
+
+        self.step = step
+        self.tracer = tracer or _spans.get_tracer()
+        self._n_devices = jax.device_count()
+        self.peak_flops_per_chip = (
+            peak_flops_per_chip
+            if peak_flops_per_chip is not None
+            else detect_peak_flops(jax.devices()[0]))
+        self.hbm_bw_bytes_per_s = hbm_bw_bytes_per_s
+        self.windows: List[Dict[str, float]] = []
+        self._cost: Dict[int, Dict[str, float]] = {}
+        # Cost analysis runs OFF the training thread: it AOT-compiles the
+        # single-step program, which on a big TPU model takes minutes — a
+        # synchronous call inside the first profiled window would stall
+        # training. report() joins the thread.
+        self._cost_thread: Optional[threading.Thread] = None
+
+        reg = registry or M.registry
+        self._h_wall = reg.histogram("obs_step_wall_s")
+        self._g_dispatch = reg.gauge("obs_dispatch_gap_s")
+        self._g_device = reg.gauge("obs_device_compute_s")
+        self._g_mfu = reg.gauge("obs_mfu")
+        self._g_flops = reg.gauge("obs_flops_per_step")
+        self._g_hbm = reg.gauge("obs_hbm_high_water_bytes")
+        self._g_compiles = reg.gauge("obs_programs_compiled")
+        self._c_windows = reg.counter("obs_profiled_windows_total")
+
+    # ------------------------------------------------------------------ run
+    def run(self, state, batch, num_steps: int, stacked: bool = False):
+        """``step.run`` with the window profiled; returns its result."""
+        t_wall = time.time()
+        t0 = time.perf_counter()
+        state, metrics = self.step.run(state, batch, num_steps,
+                                       stacked=stacked)
+        dispatch = time.perf_counter() - t0
+        # ONE end barrier per window (bench.py discipline): a device→host
+        # scalar fetch of the final loss.
+        loss = metrics.get("loss") if isinstance(metrics, dict) else None
+        if loss is not None:
+            float(np.asarray(loss).ravel()[-1])
+        else:
+            import jax
+
+            jax.block_until_ready(metrics)
+        wall = time.perf_counter() - t0
+        self._record(num_steps, stacked, dispatch, wall, t_wall, state, batch)
+        return state, metrics
+
+    def _record(self, num_steps, stacked, dispatch, wall, t_wall,
+                state, batch) -> None:
+        device_s = max(wall - dispatch, 0.0)
+        cost = self._step_cost(state, batch, stacked)
+        flops_step = cost.get("flops", 0.0)
+        rec = {
+            "steps": float(num_steps),
+            "dispatch_gap_s": dispatch,
+            "wall_s": wall,
+            "device_s": device_s,
+        }
+        self.windows.append(rec)
+        self._c_windows.inc()
+        self._h_wall.observe(wall)
+        self._g_dispatch.set(dispatch)
+        self._g_device.set(device_s)
+        self._g_compiles.set(len(getattr(self.step, "compile_log", ())))
+        if flops_step:  # cost analysis may still be compiling in background
+            self._g_flops.set(flops_step)
+            mfu = self._mfu(flops_step, device_s / max(num_steps, 1))
+            if mfu is not None:
+                self._g_mfu.set(mfu)
+        hbm = _hbm_high_water()
+        if hbm is not None:
+            self._g_hbm.set(hbm)
+        self.tracer.add_span(
+            "profiler.window", t_wall, wall, steps=num_steps,
+            dispatch_gap_ms=round(dispatch * 1e3, 3),
+        )
+
+    def _step_cost(self, state, batch, stacked: bool) -> Dict[str, float]:
+        """Per-step FLOPs/bytes = the SINGLE-STEP compiled program's cost
+        analysis (XLA counts a scan body once regardless of trip count, so
+        dividing a window's numbers by its length would under-report — see
+        DistributedTrainStep.window_cost; the numbers are PER-DEVICE: cost
+        analysis sees the partitioned module). A stacked window's batch
+        carries a leading num_steps axis; one slice of it is the per-step
+        batch, so costing the whole stack as one step would over-report by
+        the window factor.
+
+        Non-blocking: the AOT compile runs on a background thread (first
+        call kicks it off; until it lands this returns ``{}`` and the
+        flops/mfu gauges stay unset). :meth:`report` joins it."""
+        cached = self._cost.get(1)
+        if cached is not None:
+            return cached
+        if self._cost_thread is None:
+            wc = getattr(self.step, "window_cost", None)
+            if wc is None:
+                self._cost[1] = {}
+                return self._cost[1]
+            import jax
+
+            if stacked:
+                batch = jax.tree.map(lambda x: x[0], batch)
+            # Abstract shapes captured NOW, on the caller thread: the next
+            # profiled window donates the live state's buffers, and the
+            # background lower() must never touch them.
+            state_shapes = jax.eval_shape(lambda: state)
+            batch_shapes = jax.eval_shape(lambda: batch)
+
+            def compute():
+                try:
+                    self._cost[1] = wc(state_shapes, batch_shapes, 1)
+                except Exception as e:  # noqa: BLE001 - never fail training
+                    logging.debug("window_cost unavailable: %s", e)
+                    self._cost[1] = {}
+
+            self._cost_thread = threading.Thread(
+                target=compute, name="obs-step-cost", daemon=True)
+            self._cost_thread.start()
+        return {}
+
+    # --------------------------------------------------------------- report
+    def _mfu(self, flops_per_step: float,
+             device_s_per_step: float) -> Optional[float]:
+        """Measured MFU. ``flops_per_step`` is PER-DEVICE (XLA's cost
+        analysis sees the partitioned module), so the denominator is the
+        per-CHIP peak — multiplying by device_count would under-report
+        fleet MFU by exactly that factor."""
+        if (not flops_per_step or not device_s_per_step
+                or self.peak_flops_per_chip is None):
+            return None
+        return flops_per_step / (device_s_per_step * self.peak_flops_per_chip)
+
+    def report(self) -> Dict[str, Any]:
+        """Aggregated profile: median window split, per-step FLOPs, MFU,
+        roofline position (with a bandwidth), compile log, HBM high-water.
+        Joins the background cost-analysis compile (bounded) so the FLOPs
+        fields are final."""
+        if self._cost_thread is not None and self._cost_thread.is_alive():
+            self._cost_thread.join(timeout=600.0)
+        out: Dict[str, Any] = {
+            "windows": len(self.windows),
+            "n_devices": self._n_devices,
+        }
+        if not self.windows:
+            return out
+        med = lambda k: float(np.median([w[k] for w in self.windows]))  # noqa: E731
+        steps = self.windows[-1]["steps"] or 1.0
+        cost = self._cost.get(1) or {}
+        out.update({
+            "steps_per_window": steps,
+            "dispatch_gap_s": med("dispatch_gap_s"),
+            "wall_s": med("wall_s"),
+            "device_s": med("device_s"),
+            "step_wall_s": med("wall_s") / steps,
+            "step_device_s": med("device_s") / steps,
+            # Per-device numbers (partitioned module) — see _mfu.
+            "flops_per_step": cost.get("flops", 0.0),
+            "bytes_per_step": cost.get("bytes_accessed", 0.0),
+        })
+        if out["flops_per_step"]:
+            self._g_flops.set(out["flops_per_step"])
+        mfu = self._mfu(out["flops_per_step"], out["step_device_s"])
+        if mfu is not None:
+            out["mfu"] = mfu
+            self._g_mfu.set(mfu)
+        if self.hbm_bw_bytes_per_s and self.peak_flops_per_chip:
+            from autodist_tpu.utils import roofline
+
+            # Per-device flops/bytes against per-chip peak and per-chip
+            # bandwidth: consistent units, so vs_roofline ~ 1 means AT the
+            # hardware ceiling on any mesh size.
+            bounds = {
+                "flops": out["flops_per_step"],
+                "lower_bytes": out["bytes_per_step"],
+                "upper_bytes": out["bytes_per_step"],
+            }
+            times = roofline.roofline_times(
+                bounds, self.peak_flops_per_chip, self.hbm_bw_bytes_per_s)
+            out["roofline"] = {
+                **times,
+                # >1: measured step above the hardware bound (overhead to
+                # hunt); ~1: at the ceiling.
+                "vs_roofline": (out["step_device_s"] / times["t_roofline_s"]
+                                if times["t_roofline_s"] else float("nan")),
+            }
+        compile_log = list(getattr(self.step, "compile_log", ()))
+        out["compiles"] = {
+            "count": len(compile_log),
+            "total_first_call_s": round(
+                sum(e.get("first_call_s", 0.0) for e in compile_log), 4),
+        }
+        hbm = _hbm_high_water()
+        if hbm is not None:
+            out["hbm_high_water_bytes"] = hbm
+        return out
+
+    def log_report(self, prefix: str = "profile") -> Dict[str, Any]:
+        rep = self.report()
+        logging.info("%s: %s", prefix, json.dumps(rep, sort_keys=True,
+                                                  default=float))
+        return rep
+
+
+# ----------------------------------------------------------------- StepTimer
+class StepTimer:
+    """Wall-clock step timing + throughput summary.
+
+    ``items_per_step`` (e.g. global batch size, or tokens/step) turns times
+    into throughput. First ``warmup`` steps are excluded (compile + cache
+    effects). Use as a callable context around each step.
+    """
+
+    def __init__(self, items_per_step: float = 0.0, warmup: int = 2):
+        self.items_per_step = items_per_step
+        self.warmup = warmup
+        self.times: List[float] = []
+        self._t0: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        assert self._t0 is not None
+        self.times.append(time.perf_counter() - self._t0)
+        self._t0 = None
+        return False
+
+    @property
+    def measured(self) -> List[float]:
+        return self.times[self.warmup:] if len(self.times) > self.warmup else []
+
+    def summary(self) -> Dict[str, Any]:
+        xs = sorted(self.measured)
+        if not xs:
+            return {"steps": len(self.times), "measured": 0}
+        n = len(xs)
+        mean = sum(xs) / n
+        out = {
+            "steps": len(self.times),
+            "measured": n,
+            "mean_s": mean,
+            "p50_s": xs[n // 2],
+            "p90_s": xs[min(n - 1, int(n * 0.9))],
+            "min_s": xs[0],
+        }
+        if self.items_per_step:
+            out["items_per_sec"] = self.items_per_step / mean
+        return out
+
+    def log_summary(self, prefix: str = "steps") -> Dict[str, Any]:
+        s = self.summary()
+        logging.info("%s: %s", prefix, json.dumps(s, sort_keys=True))
+        return s

@@ -52,6 +52,7 @@ import jax
 import numpy as np
 import optax
 
+from autodist_tpu import metrics as M
 from autodist_tpu.utils import logging
 
 
@@ -174,6 +175,7 @@ class AsyncPSTrainer:
         schedule: str = "threads",
         has_aux: bool = False,
         devices: Optional[Sequence] = None,
+        registry: Optional[M.MetricsRegistry] = None,
     ):
         if schedule not in ("threads", "round_robin"):
             raise ValueError(f"unknown schedule {schedule!r}")
@@ -188,10 +190,25 @@ class AsyncPSTrainer:
         self.devices = list(devices) if devices else jax.local_devices()
         self._vg = jax.jit(jax.value_and_grad(loss_fn, has_aux=has_aux))
         self._server: Optional[ParamServer] = None
+        # Operational export surface: the trainer publishes through the
+        # SAME registry serve does, so the one OpenMetrics renderer
+        # (obs/exporter.py — serve /metrics, headless file exporter) covers
+        # async-PS training without any bespoke text path.
+        reg = registry or M.registry
+        self._c_pushes = reg.counter("async_ps_pushes_total")
+        self._g_version = reg.gauge("async_ps_version")
+        self._g_loss = reg.gauge("async_ps_last_loss")
+        self._g_pps = reg.gauge("async_ps_pushes_per_sec")
+        self._h_lag = reg.histogram("async_ps_push_lag")
+        # Pushes already exported for the CURRENT server (its per-push lists
+        # restart at zero whenever a fresh ParamServer is adopted, while the
+        # registry counter — possibly shared process-wide — never resets).
+        self._published = 0
 
     # ------------------------------------------------------------------ api
     def init(self, params) -> AsyncServerState:
         self._server = ParamServer(params, self.tx, staleness=self.staleness)
+        self._published = 0
         return self._server.state
 
     def _worker_loop(self, server: ParamServer, worker: int,
@@ -232,6 +249,7 @@ class AsyncPSTrainer:
             server = ParamServer(None, self.tx, staleness=self.staleness,
                                  state=state)
             self._server = server
+            self._published = 0
         t0 = time.perf_counter()
         if self.schedule == "round_robin":
             self._run_round_robin(server, next_batch, n_pushes)
@@ -252,12 +270,30 @@ class AsyncPSTrainer:
                 t.join()
         server.metrics.wall_s += time.perf_counter() - t0
         m = server.metrics
+        self._publish(server)
         return server.state, {
             "loss": np.asarray(m.losses, np.float32),
             "lag": np.asarray(m.lags, np.int32),
             "worker": np.asarray(m.workers, np.int32),
             **m.summary(),
         }
+
+    def _publish(self, server: ParamServer) -> None:
+        """Refresh the registry from this run's per-push records (delta
+        counters, point-in-time gauges)."""
+        m = server.metrics
+        new_pushes = len(m.losses) - self._published
+        if new_pushes > 0:
+            self._published = len(m.losses)
+            self._c_pushes.inc(new_pushes)
+            for lag in m.lags[-new_pushes:]:
+                self._h_lag.observe(float(lag))
+        self._g_version.set(server.state.version)
+        if m.losses:
+            self._g_loss.set(m.losses[-1])
+        s = m.summary()
+        if s["pushes_per_sec"] == s["pushes_per_sec"]:  # not NaN
+            self._g_pps.set(s["pushes_per_sec"])
 
     def _run_round_robin(self, server: ParamServer,
                          next_batch: Callable[[int], Any], n_pushes: int):

@@ -26,6 +26,7 @@ import os
 import subprocess
 import sys
 import threading
+import time
 from typing import List, Optional, Sequence, TYPE_CHECKING
 
 from autodist_tpu import const
@@ -173,11 +174,37 @@ def launch(
         watch = _FleetWatch(ft_config)
         extra_env = {**watch.env(), **extra_env}
 
+    # Observability contract (docs/observability.md): ONE trace id for the
+    # whole launch, exported to every process (chief, local workers, SSH
+    # remotes) so their spans stitch into a single cross-process timeline.
+    # current_trace_id() also pins it into this launcher's own env, so the
+    # launcher's spans carry the same id.
+    from autodist_tpu.obs.spans import current_trace_id
+
+    # A caller-supplied extra_env id/dir wins over this launcher's env;
+    # either way the launcher process pins the SAME values into its own
+    # env so its spans (launcher.fleet) join the fleet's trace and the
+    # stitch below sees the right dir.
+    trace_id = extra_env.get(ENV.AUTODIST_TRACE_ID.name)
+    if trace_id:
+        os.environ[ENV.AUTODIST_TRACE_ID.name] = trace_id
+    else:
+        trace_id = extra_env[ENV.AUTODIST_TRACE_ID.name] = current_trace_id()
+    trace_out = (extra_env.get(ENV.AUTODIST_TRACE_OUT.name)
+                 or ENV.AUTODIST_TRACE_OUT.val)
+    if trace_out:
+        extra_env.setdefault(ENV.AUTODIST_TRACE_OUT.name, trace_out)
+        os.environ[ENV.AUTODIST_TRACE_OUT.name] = trace_out
+    t_launch = time.time()
+
     if num_local_processes > 1:
         base = {**_scrub_role_vars(dict(os.environ)), **extra_env}
-        return _launch_local_fleet(
+        code = _launch_local_fleet(
             argv, num_local_processes, coordinator_port, base_env=base,
             watch=watch)
+        _finish_trace(trace_out, trace_id, t_launch, num_local_processes,
+                      code)
+        return code
 
     cluster = Cluster(resource_spec, coordinator_port=coordinator_port)
     coordinator = Coordinator(cluster, argv=argv, extra_env=extra_env)
@@ -221,7 +248,31 @@ def launch(
             logging.error("chief exited 0 but a worker failed; reporting failure")
             code = 1
     cluster.terminate()
+    _finish_trace(trace_out, trace_id, t_launch, cluster.num_processes, code)
     return code
+
+
+def _finish_trace(trace_out: str, trace_id: str, t_launch: float,
+                  n_processes: int, code: int) -> None:
+    """Close the launch's observability loop: record the launcher's own
+    fleet span, flush it, and stitch every process's part-file into ONE
+    chrome-trace JSON (``trace-<id>.json`` under the trace-out dir).
+    Best-effort — tracing must never change a launch's outcome."""
+    if not trace_out:
+        return
+    try:
+        from autodist_tpu.obs.spans import get_tracer, stitch
+
+        tracer = get_tracer()
+        tracer.add_span("launcher.fleet", t_launch, time.time() - t_launch,
+                        processes=n_processes, exit_code=code)
+        tracer.flush_part(trace_out)
+        merged = stitch(trace_out, trace_id=trace_id)
+        if merged:
+            logging.info("stitched fleet trace -> %s (load in Perfetto or "
+                         "chrome://tracing)", merged)
+    except Exception:  # noqa: BLE001 - observability is never fatal here
+        logging.warning("trace stitch failed", exc_info=True)
 
 
 def launch_supervised(
@@ -261,8 +312,6 @@ def launch_supervised(
       that keeps progressing between preemptions is never "given up on"
       by an absolute cap sized for genuine crash loops.
     """
-    import time
-
     def _progress() -> Optional[int]:
         if ft_config is None:
             return None
@@ -413,6 +462,13 @@ def main(args: Optional[Sequence[str]] = None) -> int:
              "terminated for restart, and the restart budget resets "
              "whenever the snapshot ring advances (docs/fault_tolerance.md)",
     )
+    parser.add_argument(
+        "--trace-out", default="",
+        help="shared dir for cross-process span tracing: every fleet "
+             "process flushes a chrome-trace part-file here and the "
+             "launcher stitches them into one trace-<id>.json after the "
+             "run (docs/observability.md)",
+    )
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="-- python train.py ...")
     ns = parser.parse_args(args)
@@ -427,6 +483,10 @@ def main(args: Optional[Sequence[str]] = None) -> int:
         from autodist_tpu.ft import FTConfig
 
         ft_config = FTConfig(base_dir=ns.ft_dir)
+    if ns.trace_out:
+        # launch() reads the env contract; exporting here covers both the
+        # launcher's own spans and every process it starts.
+        os.environ[ENV.AUTODIST_TRACE_OUT.name] = ns.trace_out
     return launch_supervised(
         spec, command,
         max_restarts=ns.max_restarts,

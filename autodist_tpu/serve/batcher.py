@@ -37,6 +37,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from autodist_tpu import metrics as M
+from autodist_tpu.obs import spans as obs_spans
 from autodist_tpu.serve.engine import InferenceEngine, Slot
 from autodist_tpu.utils import logging
 
@@ -379,10 +380,20 @@ class ContinuousBatcher:
                 dead._finish(RequestState.TIMEOUT, "deadline expired in queue")
                 continue
             budget = self.max_active_tokens - self.engine.active_tokens
+            # Wall anchor taken BEFORE admit(): the span must end where the
+            # prefill span begins, and admit() runs the prefill (plus a
+            # bucket's first-use compile) before returning.
+            t_admit, t_admit_wall = time.monotonic(), time.time()
             admitted = self.engine.admit(
                 head.prompt, head.max_new_tokens, token_budget=budget)
             if admitted is None:
                 break  # no free slot / over budget; retire will wake us again
+            # Queue-wait span, recorded retroactively now the wait is known
+            # (submit → prefill start; the prefill span follows it on the
+            # same timeline, so a request reads as wait → prefill → decode).
+            wait_s = max(t_admit - head.t_submit, 0.0)
+            obs_spans.add_span("serve.queue_wait", t_admit_wall - wait_s,
+                               wait_s, request_id=head.id)
             slot, first = admitted
             with self._lock:
                 self._queue.popleft()

@@ -34,6 +34,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from autodist_tpu.kernel import GraphTransformer, ShardingPlan, build_mesh, data_axis
 from autodist_tpu.model_item import ModelItem
+from autodist_tpu.obs import spans as obs_spans
 from autodist_tpu.utils import logging
 
 DEFAULT_BUCKET_LENS = (32, 64, 128, 256, 512, 1024)
@@ -349,10 +350,12 @@ class InferenceEngine:
                 self._compile_bucket(bucket)
             padded = np.zeros((1, length), np.int32)
             padded[0, : len(prompt)] = prompt
-            first, bucket.cache = bucket.prefill_fn(
-                self.params, jnp.asarray(padded),
-                jnp.int32(len(prompt)), bucket.cache, jnp.int32(idx))
-            first = int(jax.device_get(first)[0])
+            with obs_spans.span("serve.prefill", bucket=length,
+                                prompt_len=len(prompt)):
+                first, bucket.cache = bucket.prefill_fn(
+                    self.params, jnp.asarray(padded),
+                    jnp.int32(len(prompt)), bucket.cache, jnp.int32(idx))
+                first = int(jax.device_get(first)[0])
             bucket.active[idx] = True
             bucket.lengths[idx] = len(prompt)
             bucket.last_token[idx] = first
@@ -373,12 +376,14 @@ class InferenceEngine:
                 continue
             if bucket.decode_fn is None:
                 self._compile_bucket(bucket)
-            tokens, bucket.cache = bucket.decode_fn(
-                self.params,
-                jnp.asarray(bucket.last_token),
-                jnp.asarray(bucket.lengths),
-                bucket.cache)
-            tokens = np.asarray(jax.device_get(tokens))
+            with obs_spans.span("serve.decode_step", bucket=length,
+                                active=int(bucket.active.sum())):
+                tokens, bucket.cache = bucket.decode_fn(
+                    self.params,
+                    jnp.asarray(bucket.last_token),
+                    jnp.asarray(bucket.lengths),
+                    bucket.cache)
+                tokens = np.asarray(jax.device_get(tokens))
             for idx in np.flatnonzero(bucket.active):
                 idx = int(idx)
                 bucket.lengths[idx] += 1

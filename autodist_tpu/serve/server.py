@@ -9,7 +9,9 @@ The front end is deliberately thin: a dependency-free HTTP/1.1 listener on
 - ``POST /generate`` ``{"tokens": [...], "max_new_tokens": N,
   "timeout_s": T?}`` → ``{"tokens": [...], "state": "done"}``;
   429 on backpressure, 400 on an unservable request.
-- ``GET /metrics`` → the metrics registry in prometheus text form.
+- ``GET /metrics`` → the metrics registry as OpenMetrics text, rendered by
+  the one shared exporter (``autodist_tpu.obs.exporter`` — byte-identical
+  to the headless file exporter's output on the same snapshot).
 - ``GET /healthz`` → queue/slot gauges as JSON.
 
 ``python -m autodist_tpu.serve --selftest`` is the zero-hardware proof the
@@ -121,6 +123,8 @@ class ServeFrontend:
                 return
             method, path, _, body = parsed
             if method == "GET" and path == "/metrics":
+                # render_text delegates to THE OpenMetrics renderer
+                # (obs/exporter.py) — one rendering path for every surface.
                 self._respond(writer, 200, self.registry.render_text(),
                               content_type="text/plain")
             elif method == "GET" and path == "/healthz":

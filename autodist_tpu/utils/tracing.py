@@ -1,30 +1,29 @@
-"""Tracing / profiling / compile-artifact dumps.
+"""jax.profiler wrappers + compile-artifact dumps (partly a compat shim).
 
-TPU-native analog of the reference's observability hooks:
+.. note:: The unified observability subsystem lives in
+   :mod:`autodist_tpu.obs` now (docs/observability.md carries the span
+   model, the reference citations that used to live here, and the export
+   formats). This module keeps two things:
 
-- chrome-trace timeline per traced ``session.run``
-  (``/root/reference/autodist/runner.py:64-75,123-131``) → ``trace()``
-  context manager around ``jax.profiler`` writing TensorBoard-loadable
-  traces (the TPU profile includes the real xplane timeline: device compute,
-  ICI collectives, host transfers).
-- per-stage graph snapshots to TensorBoard
-  (``utils/visualization_util.py:24-36``, called at each transform stage
-  ``graph_transformer.py:62-90``) → ``dump_hlo()`` snapshots of the lowered
-  StableHLO / optimized HLO per compile, named by stage.
-- step timing: ``StepTimer`` collects wall-times and derives throughput
-  percentiles — the role the vendored benchmark loggers played
-  (``examples/benchmark/utils/logs/logger.py``).
+   - the ``jax.profiler`` device-timeline wrappers (:func:`trace`,
+     :func:`annotate`) and the per-compile HLO dumps (:func:`dump_hlo`,
+     :func:`dump_compiled`) — xplane/TensorBoard tooling, distinct from
+     the host-side span tracer in ``obs.spans``;
+   - a **compat shim** for :class:`StepTimer`, which moved to
+     :mod:`autodist_tpu.obs.profiler` — import it from there in new code.
 """
 from __future__ import annotations
 
 import contextlib
-import json
 import os
 import time
-from typing import Any, Dict, List, Optional
+from typing import List, Optional
 
 from autodist_tpu import const
 from autodist_tpu.const import ENV
+# Compat shim: StepTimer's home is the obs subsystem now; this re-export
+# keeps the historical `utils.tracing.StepTimer` path working.
+from autodist_tpu.obs.profiler import StepTimer  # noqa: F401
 from autodist_tpu.utils import logging
 
 
@@ -85,54 +84,3 @@ def dump_compiled(tag: str, lowered, compiled=None, hlo_dir: Optional[str] = Non
     return paths
 
 
-# ----------------------------------------------------------------- StepTimer
-class StepTimer:
-    """Wall-clock step timing + throughput summary.
-
-    ``items_per_step`` (e.g. global batch size, or tokens/step) turns times
-    into throughput. First ``warmup`` steps are excluded (compile + cache
-    effects). Use as a callable context around each step.
-    """
-
-    def __init__(self, items_per_step: float = 0.0, warmup: int = 2):
-        self.items_per_step = items_per_step
-        self.warmup = warmup
-        self.times: List[float] = []
-        self._t0: Optional[float] = None
-
-    def __enter__(self):
-        self._t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc):
-        assert self._t0 is not None
-        self.times.append(time.perf_counter() - self._t0)
-        self._t0 = None
-        return False
-
-    @property
-    def measured(self) -> List[float]:
-        return self.times[self.warmup:] if len(self.times) > self.warmup else []
-
-    def summary(self) -> Dict[str, Any]:
-        xs = sorted(self.measured)
-        if not xs:
-            return {"steps": len(self.times), "measured": 0}
-        n = len(xs)
-        mean = sum(xs) / n
-        out = {
-            "steps": len(self.times),
-            "measured": n,
-            "mean_s": mean,
-            "p50_s": xs[n // 2],
-            "p90_s": xs[min(n - 1, int(n * 0.9))],
-            "min_s": xs[0],
-        }
-        if self.items_per_step:
-            out["items_per_sec"] = self.items_per_step / mean
-        return out
-
-    def log_summary(self, prefix: str = "steps") -> Dict[str, Any]:
-        s = self.summary()
-        logging.info("%s: %s", prefix, json.dumps(s, sort_keys=True))
-        return s

@@ -648,6 +648,29 @@ def main() -> None:
     # already prove the fallback path, and a third would just slow it.
     workloads = ("bert", "resnet") if args.model == "both" else (args.model,)
     measured, errors = {}, {}
+
+    def _sigterm(_sig, _frm):
+        # Driver timeout: `timeout -k` sends SIGTERM (the run then reports
+        # rc=124). The round record must STILL carry a parsed TPU number —
+        # whatever was measured so far, else the cached last-verified
+        # accelerator line labeled cached:true — never nothing at all.
+        # os._exit because this interrupts arbitrary frames (a blocking
+        # subprocess.run wait): normal unwinding could re-enter them.
+        try:
+            if measured:
+                res, on_acc = _format_result(measured, errors)
+                res["error"] = "driver timeout (SIGTERM) cut the run short"
+                if not on_acc:
+                    res = _promote_cached_headline(_embed_last_accel(res))
+                print(json.dumps(res), flush=True)
+            else:
+                print(json.dumps(_emergency_line(
+                    errors, "driver timeout (SIGTERM) before any workload "
+                            "completed")), flush=True)
+        finally:
+            os._exit(124)
+
+    signal.signal(signal.SIGTERM, _sigterm)
     accel_ok = False
     wedged_mid_bench = False
     tunnel_busy = False

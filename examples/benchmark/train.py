@@ -33,7 +33,7 @@ sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..",
 import autodist_tpu as ad
 from autodist_tpu.data import DataLoader
 from autodist_tpu.models import get_model
-from autodist_tpu.utils.tracing import StepTimer
+from autodist_tpu.obs import StepTimer, spans as obs_spans
 
 # model key -> (zoo name, factory kwargs, items metric)
 MODELS = {
@@ -83,6 +83,10 @@ def parse_args():
                         "methodology in docs/performance.md) instead of "
                         "paying a host upload per window ('fed')")
     p.add_argument("--trace", action="store_true", help="profile one step to TensorBoard")
+    p.add_argument("--trace-out", default="",
+                   help="write a chrome-trace/Perfetto JSON of the run's "
+                        "host-side spans (warmup/timed windows, compiles) "
+                        "to this path (docs/observability.md)")
     p.add_argument("--model-kwargs", default="",
                    help='JSON overrides for the model factory, e.g. \'{"num_layers": 2}\'')
     return p.parse_args()
@@ -171,8 +175,9 @@ def main():
     total_windows = max(2, args.steps // window)
     warm_windows = min(max(1, -(-args.warmup // window)), total_windows - 1)
     timed_windows = total_windows - warm_windows
-    state, metrics = step.run(state, next_batch(), window)
-    first_loss = float(metrics["loss"][0])
+    with obs_spans.span("bench.warmup", window=window):
+        state, metrics = step.run(state, next_batch(), window)
+        first_loss = float(metrics["loss"][0])
     # The timed loop fetches loss[-1]; fetch it here too so its getitem
     # executable compiles during warmup. (Measured on the axon tunnel:
     # a first [-1] fetch after only [0] fetches cost ~0.48 s of compile
@@ -197,7 +202,8 @@ def main():
         # transient host/tunnel hiccup straight into the published row
         # (bench.py takes the median of 3 trials for the same reason).
         for _ in range(pin_laps):
-            with timer:
+            with obs_spans.span("bench.lap", windows=timed_windows,
+                                window=window), timer:
                 for _ in range(timed_windows):
                     state, metrics = step.run(state, next_batch(), window)
                 float(metrics["loss"][-1])  # single end barrier per lap
@@ -207,7 +213,7 @@ def main():
             # device_put against an in-flight dispatch deadlocks the axon
             # tunnel, so transfers cannot overlap compute on this platform.
             b = next_batch()
-            with timer:
+            with obs_spans.span("bench.window", window=window), timer:
                 state, metrics = step.run(state, b, window)
                 float(metrics["loss"][-1])  # device fetch = trustworthy barrier
     last_loss = float(metrics["loss"][-1])
@@ -216,6 +222,10 @@ def main():
     if args.trace:
         (_, _), trace_dir = step.trace_step(state, next_batch())
         print(f"trace -> {trace_dir}")
+    if args.trace_out:
+        # Host-side span timeline (chrome-trace JSON): warmup/timed windows
+        # plus any library spans recorded during the run.
+        print(f"trace-out -> {obs_spans.export(args.trace_out)}")
 
     s = timer.summary()
     if args.pin:
