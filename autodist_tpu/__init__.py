@@ -13,7 +13,7 @@ Where the reference rewired TF graphs op-by-op
 lets XLA GSPMD insert the collectives — the idiomatic TPU mechanism with the
 same user-visible contract (single-device model in, distributed execution out).
 """
-from autodist_tpu import checkpoint, const, ft, metrics, obs, runtime, serve, strategy
+from autodist_tpu import checkpoint, const, ft, metrics, obs, plan, runtime, serve, strategy
 from autodist_tpu.api import AutoDist, get_default_autodist
 from autodist_tpu.ft import FTConfig
 from autodist_tpu.obs import ObsConfig
@@ -37,6 +37,7 @@ __all__ = [
     "ft",
     "get_default_autodist",
     "obs",
+    "plan",
     "runtime",
     "serve",
     "strategy",

@@ -130,7 +130,7 @@ class AutoDist:
     def __init__(
         self,
         resource_spec_file: Optional[str] = None,
-        strategy_builder: Optional[StrategyBuilder] = None,
+        strategy_builder: Union[StrategyBuilder, str, None] = None,
         resource_spec: Optional[ResourceSpec] = None,
         mesh_axes: Sequence[str] = ("data", "model"),
         fault_tolerance: "Optional[FTConfig]" = None,
@@ -160,7 +160,13 @@ class AutoDist:
             self.resource_spec = ResourceSpec(ENV.AUTODIST_RESOURCE_SPEC.val)
         else:
             self.resource_spec = ResourceSpec.from_local_devices()
-        # Default strategy builder (autodist.py:70).
+        # Default strategy builder (autodist.py:70). A string names a
+        # builder class ("AllReduce", "Auto", ...) or the search-based
+        # auto-planner ("plan" — docs/planner.md).
+        if isinstance(strategy_builder, str):
+            from autodist_tpu.strategy import from_name
+
+            strategy_builder = from_name(strategy_builder)
         self.strategy_builder = strategy_builder or PSLoadBalancing()
         self.mesh_axes = tuple(mesh_axes)
         self._mesh = None
@@ -823,10 +829,32 @@ class AutoDist:
                 pass
             calib = Calibration.fit(pred, meas, device=device)
             path = calib.save() if jax.process_index() == 0 else None
+            plan_calib = None
+            if jax.process_index() == 0:
+                # The same sweep feeds the planner's per-topology
+                # per-component calibration (docs/planner.md): every
+                # measured candidate becomes a CalibrationRecord, so a
+                # later `strategy_builder="plan"` run prices THIS topology
+                # instead of nominal constants.
+                try:
+                    from autodist_tpu.plan.calibrate import (
+                        CalibrationRecord, calibrate_from_records)
+
+                    plan_calib = calibrate_from_records(
+                        [CalibrationRecord.from_cost(
+                            predicted[n], dt, name=n)
+                         for n, dt in results
+                         if n in predicted and dt < float("inf")],
+                        self.resource_spec, device_kind=device)
+                except Exception:  # noqa: BLE001 - planner feed is optional
+                    logging.warning(
+                        "tune: plan calibration recording failed",
+                        exc_info=True)
             self.last_tune_results = {
                 "table": table,
                 "calibration": calib,
                 "calibration_path": path,
+                "plan_calibration": plan_calib,
             }
             logging.info(
                 "tune calibration: measured ≈ %.3fms + %.2f × predicted "

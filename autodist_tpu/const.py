@@ -21,6 +21,10 @@ DEFAULT_CHECKPOINT_DIR = os.path.join(DEFAULT_WORKING_DIR, "checkpoints")
 # overridable per-fleet via AUTODIST_FT_DIR (the launcher exports it so every
 # process of one fleet shares a base).
 DEFAULT_FT_DIR = os.path.join(DEFAULT_WORKING_DIR, "ft")
+# Planner state (docs/planner.md): per-topology calibrations live directly
+# under it, the persistent plan cache in plan/cache (AUTODIST_PLAN_CACHE
+# overrides the cache location per-fleet/per-CI-job).
+DEFAULT_PLAN_DIR = os.path.join(DEFAULT_WORKING_DIR, "plan")
 
 # Coordination service port range (reference used 15000-16000 for TF grpc
 # servers, const.py:38; we use it for the jax.distributed coordinator).
@@ -99,6 +103,9 @@ class ENV:
     # names a shared directory each process flushes its span part-file into.
     AUTODIST_TRACE_ID = _EnvVar("")
     AUTODIST_TRACE_OUT = _EnvVar("")
+    # Plan-cache base dir for the search-based planner (docs/planner.md);
+    # empty = DEFAULT_PLAN_DIR/cache.
+    AUTODIST_PLAN_CACHE = _EnvVar("")
     SYS_DATA_PATH = _EnvVar("")
     SYS_RESOURCE_PATH = _EnvVar("")
 

@@ -1166,7 +1166,7 @@ class DistributedTrainStep:
         Assumes ``loss_fn`` computes a *mean* over the batch (the reference's
         merge=Add final=Div semantics, all_reduce_synchronizer.py:100-126).
         """
-        from jax import shard_map
+        from autodist_tpu.utils.compat import shard_map
 
         mesh = self.plan.mesh
         ax = data_axis(mesh)

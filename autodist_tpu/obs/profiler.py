@@ -308,6 +308,18 @@ class StepProfiler:
                                                   default=float))
         return rep
 
+    def calibration_record(self, cost, name: str = ""):
+        """This profile as a planner calibration point: pair the measured
+        per-step wall split (and the compiled program's FLOPs/bytes) with
+        the analytic :class:`~autodist_tpu.strategy.cost_model.StrategyCost`
+        of the strategy that ran. Feed the result to
+        :func:`autodist_tpu.plan.calibrate.calibrate_from_records` and the
+        planner's cost model starts predicting THIS topology
+        (docs/planner.md § calibration loop)."""
+        from autodist_tpu.plan.calibrate import record_from_profiler
+
+        return record_from_profiler(self.report(), cost, name=name)
+
 
 # ----------------------------------------------------------------- StepTimer
 class StepTimer:

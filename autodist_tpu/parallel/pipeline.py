@@ -26,6 +26,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from autodist_tpu import const
+from autodist_tpu.utils.compat import shard_map
 
 
 def pipeline_apply_local(
@@ -310,7 +311,7 @@ def pipeline_apply(
             n_stages=n_stages,
             axis_name=axis_name,
         )
-    sm = jax.shard_map(
+    sm = shard_map(
         local,
         mesh=mesh,
         in_specs=(spec_params, P()),
@@ -501,7 +502,7 @@ def pipeline_value_and_grad(
         None if targets is None
         else jax.tree_util.tree_map(lambda _: P(), targets)
     )
-    sm = jax.shard_map(
+    sm = shard_map(
         local,
         mesh=mesh,
         in_specs=(spec_params, P(), tgt_spec),

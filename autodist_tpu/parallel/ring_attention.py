@@ -168,7 +168,9 @@ def _seq_sharded(fn_local, q, k, v, causal, mesh, axis_name):
             f"{axis_name!r}={axis_size}"
         )
     spec = P(None, axis_name, None, None)
-    sm = jax.shard_map(
+    from autodist_tpu.utils.compat import shard_map
+
+    sm = shard_map(
         functools.partial(fn_local, causal=causal, axis_name=axis_name),
         mesh=mesh,
         in_specs=(spec, spec, spec),

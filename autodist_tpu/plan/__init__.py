@@ -1,0 +1,61 @@
+"""plan: the search-based offline auto-planner (docs/planner.md).
+
+Sits above the fixed ``strategy/`` builders: a beam search over the
+per-variable strategy space (``search.py``) scored by the analytic cost
+model through a per-topology measurement calibration (``calibrate.py``),
+with a persistent plan cache keyed by (model fingerprint, resource digest,
+package version) so a repeated question skips search entirely
+(``cache.py``). ``Plan`` packages the three as an ordinary StrategyBuilder
+— ``AutoDist(strategy_builder="plan")`` — and
+``python -m autodist_tpu.plan --selftest`` is the zero-hardware proof.
+"""
+from autodist_tpu.plan.builder import Plan, PlanConfig
+from autodist_tpu.plan.cache import (
+    CacheEntry,
+    PlanCache,
+    default_cache_dir,
+    dryrun_lowers,
+    model_fingerprint,
+    plan_key,
+)
+from autodist_tpu.plan.calibrate import (
+    CalibrationRecord,
+    TopologyCalibration,
+    calibrate_from_records,
+    prediction_error,
+    record_from_profiler,
+    topology_key,
+)
+from autodist_tpu.plan.search import (
+    PlanSearch,
+    SearchConfig,
+    SearchResult,
+    VarGene,
+    genome_to_strategy,
+    search,
+    strategy_to_genome,
+)
+
+__all__ = [
+    "CacheEntry",
+    "CalibrationRecord",
+    "Plan",
+    "PlanCache",
+    "PlanConfig",
+    "PlanSearch",
+    "SearchConfig",
+    "SearchResult",
+    "TopologyCalibration",
+    "VarGene",
+    "calibrate_from_records",
+    "default_cache_dir",
+    "dryrun_lowers",
+    "genome_to_strategy",
+    "model_fingerprint",
+    "plan_key",
+    "prediction_error",
+    "record_from_profiler",
+    "search",
+    "strategy_to_genome",
+    "topology_key",
+]

@@ -1,0 +1,139 @@
+"""``Plan``: the search-based auto-planner as an ordinary StrategyBuilder.
+
+Sits above the fixed builders the same way ``Auto`` does, but instead of
+ranking a fixed slate it (1) consults the persistent plan cache — an
+identical (model, resources, version) question returns the cached winner
+byte-identically with zero search; (2) otherwise runs the beam search over
+the per-variable strategy space (``plan/search.py``), scored through the
+per-topology measurement calibration when one has been recorded
+(``plan/calibrate.py``); (3) stores the winner + provenance back into the
+cache. Decision flow vs ``Auto`` is documented in docs/planner.md.
+
+Usage — the builder slots anywhere a builder goes, including by name::
+
+    ad = AutoDist(strategy_builder="plan")          # default PlanConfig
+    ad = AutoDist(strategy_builder=Plan(PlanConfig(
+        cache_dir="/fast/plan-cache", generations=8)))
+
+After ``build``, ``Plan.last_result`` holds the provenance (rendered by
+``strategy/explain.py``'s ``explain_provenance``), and ``Plan.cache.stats``
+the hit/miss counters bench.py's ``--plan-cache`` flag reports.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, replace
+from typing import Dict, Optional
+
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.base import StrategyBuilder
+from autodist_tpu.strategy.ir import Strategy
+from autodist_tpu.utils import logging
+
+from autodist_tpu.plan.cache import PlanCache, default_cache_dir
+from autodist_tpu.plan.calibrate import TopologyCalibration
+from autodist_tpu.plan.search import PlanSearch, SearchConfig
+
+
+@dataclass
+class PlanConfig:
+    """Knobs for the planner (search + cache + calibration)."""
+
+    # Cache: None disables persistence (always search).
+    cache_dir: Optional[str] = field(default_factory=default_cache_dir)
+    # Dry-run-lower cached plans before trusting them (cheap; see cache.py).
+    validate_cache: bool = True
+    # Search shape (see SearchConfig for semantics).
+    beam_width: int = 4
+    generations: int = 4
+    mutations_per_survivor: int = 8
+    seed: int = 0
+    search_mesh: bool = False
+    # Calibration: "auto" loads the per-topology file a prior profile
+    # recorded (no-op when none exists); None disables; or pass a
+    # TopologyCalibration directly.
+    calibration: object = "auto"
+
+    def search_config(self) -> SearchConfig:
+        return SearchConfig(
+            beam_width=self.beam_width,
+            generations=self.generations,
+            mutations_per_survivor=self.mutations_per_survivor,
+            seed=self.seed,
+            search_mesh=self.search_mesh,
+        )
+
+
+class Plan(StrategyBuilder):
+    """Search-based planner with a persistent plan/compile cache."""
+
+    def __init__(self, config: Optional[PlanConfig] = None, **overrides):
+        cfg = config or PlanConfig()
+        if overrides:
+            cfg = replace(cfg, **overrides)
+        self.config = cfg
+        self.cache: Optional[PlanCache] = None
+        if cfg.cache_dir is not None:
+            self.cache = PlanCache(cache_dir=cfg.cache_dir,
+                                   validate=cfg.validate_cache)
+        # After build(): {"cache_hit", "key", "n_visited", "provenance"}.
+        self.last_result: Optional[Dict] = None
+
+    # ------------------------------------------------------------ calibration
+    def _calibration(self, resource_spec: ResourceSpec):
+        cal = self.config.calibration
+        if cal is None:
+            return None
+        if isinstance(cal, TopologyCalibration):
+            return cal
+        if cal == "auto":
+            kind = ""
+            try:
+                import jax
+
+                kind = str(jax.devices()[0].device_kind)
+            except Exception:  # noqa: BLE001 - planning may run backend-less
+                pass
+            return TopologyCalibration.load_for(resource_spec, kind)
+        raise ValueError(
+            f"PlanConfig.calibration must be None, 'auto', or a "
+            f"TopologyCalibration; got {cal!r}")
+
+    # ----------------------------------------------------------------- build
+    def build(self, model_item: ModelItem,
+              resource_spec: ResourceSpec) -> Strategy:
+        if self.cache is not None:
+            entry = self.cache.get(model_item, resource_spec)
+            if entry is not None:
+                self.last_result = {
+                    "cache_hit": True,
+                    "key": entry.key,
+                    "n_visited": 0,
+                    "provenance": entry.provenance,
+                    "path": entry.path,
+                }
+                return entry.strategy
+        calibration = self._calibration(resource_spec)
+        result = PlanSearch(
+            model_item, resource_spec, self.config.search_config(),
+            calibration=calibration,
+        ).run()
+        self.last_result = {
+            "cache_hit": False,
+            "key": None,
+            "n_visited": result.n_visited,
+            "provenance": result.provenance,
+        }
+        if self.cache is not None:
+            try:
+                path = self.cache.put(
+                    model_item, resource_spec, result.strategy,
+                    provenance=result.provenance)
+                self.last_result["path"] = path
+                self.last_result["key"] = os.path.basename(path)
+            except OSError as e:
+                # A read-only cache dir must not fail planning.
+                logging.warning("plan cache store failed (%s); continuing "
+                                "uncached", e)
+        return result.strategy

@@ -1,0 +1,261 @@
+"""Persistent plan cache: search once, reuse the winning Strategy forever.
+
+Every prior surface re-planned (and re-ranked, and re-compiled) from
+scratch on each invocation. The cache keys the *question* — a model
+fingerprint from :class:`~autodist_tpu.model_item.ModelItem` (variable
+names/shapes/dtypes/flags + optimizer), the
+:class:`~autodist_tpu.resource_spec.ResourceSpec` digest, and the package
+version — and stores the *answer*: the winning serialized Strategy plus its
+full search provenance. A re-run with the same question skips search
+entirely and goes straight to lowering with byte-identical Strategy JSON.
+
+Trust model: a cached plan is VALIDATED before it is believed —
+
+- integrity: ``meta.json`` carries a sha256 over the strategy bytes; any
+  mismatch (torn write, hand-edit, bitrot) is a loud warning + fresh
+  search, never a crash;
+- liveness: the plan is compiled against the current model
+  (``StrategyCompiler``) and dry-run lowered to a ShardingPlan over the
+  live mesh (``kernel/lowering.py`` dryrun machinery) when the runtime has
+  the spec's device count — a plan that no longer lowers (shape drift the
+  key missed, lowering rule changes inside one package version) is evicted.
+
+Layout: ``<dir>/<key>/{strategy.json, provenance.json, meta.json}``, one
+directory per key, writes staged in a temp dir and atomically renamed.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.ir import Strategy
+from autodist_tpu.utils import logging
+
+CACHE_FORMAT = 1
+
+
+def default_cache_dir() -> str:
+    from autodist_tpu import const
+    from autodist_tpu.const import ENV
+
+    return ENV.AUTODIST_PLAN_CACHE.val or os.path.join(
+        const.DEFAULT_PLAN_DIR, "cache")
+
+
+def model_fingerprint(model_item: ModelItem) -> str:
+    """Stable digest of everything the planner's answer depends on in the
+    model: the full serialized ModelItem (variables with shapes/dtypes/
+    sparse/expert/tp-role flags, optimizer spec, captured batch size)."""
+    blob = json.dumps(model_item.to_json(), sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def plan_key(model_item: ModelItem, resource_spec: ResourceSpec,
+             version: Optional[str] = None) -> str:
+    """The cache key: (model fingerprint, resource digest, package version).
+
+    The version is IN the key — a package upgrade may change lowering or
+    cost-model semantics, so an old winner must re-search, not silently
+    load (the dry-run validation is the second line of defense for drift
+    within one version)."""
+    if version is None:
+        import autodist_tpu
+
+        version = autodist_tpu.__version__
+    blob = "\n".join([
+        f"format={CACHE_FORMAT}",
+        f"model={model_fingerprint(model_item)}",
+        f"resources={resource_spec.fingerprint()}",
+        f"version={version}",
+    ]).encode()
+    return hashlib.sha256(blob).hexdigest()[:32]
+
+
+def dryrun_lowers(strategy: Strategy, model_item: ModelItem,
+                  resource_spec: ResourceSpec) -> bool:
+    """True when the strategy still lowers against the current model on a
+    mesh of the spec's shape — the no-execution slice of the driver's
+    ``dryrun_multichip`` contract: StrategyCompiler validation + a full
+    ``GraphTransformer.transform()`` into a ShardingPlan (sharding
+    assignment only; nothing jits, nothing executes).
+
+    Skips (returns True with a debug log) when the live runtime doesn't
+    have the spec's device count — validation needs a real mesh, and a
+    chief planning offline for a bigger fleet is a legitimate caller."""
+    import copy
+
+    import jax
+
+    from autodist_tpu.kernel import GraphTransformer, build_mesh
+    from autodist_tpu.strategy.base import StrategyCompiler
+
+    n = resource_spec.num_chips
+    try:
+        have = jax.device_count()
+    except Exception:  # noqa: BLE001 - no backend: cannot validate
+        have = -1
+    if have != n:
+        logging.debug(
+            "plan cache: dryrun validation skipped (runtime has %s devices, "
+            "spec wants %d)", have, n)
+        return True
+    # Deep-copy first: StrategyCompiler prunes node_config in place, and a
+    # validation pass must not mutate the artifact it validates.
+    candidate = copy.deepcopy(strategy)
+    compiled = StrategyCompiler(model_item).compile(candidate)
+    mesh = build_mesh(resource_spec)
+    GraphTransformer(compiled, model_item, mesh).transform()
+    return True
+
+
+@dataclass
+class CacheEntry:
+    strategy: Strategy
+    provenance: Dict
+    path: str
+    key: str
+    strategy_bytes: bytes = b""
+
+
+@dataclass
+class PlanCache:
+    """Filesystem plan cache with hit/miss accounting."""
+
+    cache_dir: str = field(default_factory=default_cache_dir)
+    validate: bool = True
+    stats: Dict[str, int] = field(default_factory=lambda: {
+        "hits": 0, "misses": 0, "invalidated": 0})
+
+    def _entry_dir(self, key: str) -> str:
+        return os.path.join(self.cache_dir, key)
+
+    def _read_files(self, key: str) -> Optional[CacheEntry]:
+        """One integrity-checked read of the entry's files (no lowering);
+        raises on any defect, returns None when the entry doesn't exist."""
+        d = self._entry_dir(key)
+        spath = os.path.join(d, "strategy.json")
+        if not os.path.exists(spath):
+            return None
+        with open(spath, "rb") as f:
+            raw = f.read()
+        with open(os.path.join(d, "meta.json"), "r", encoding="utf-8") as f:
+            meta = json.load(f)
+        if meta.get("strategy_sha256") != hashlib.sha256(raw).hexdigest():
+            raise ValueError("strategy.json checksum mismatch")
+        strategy = Strategy.from_json(json.loads(raw.decode("utf-8")))
+        if not strategy.node_config:
+            raise ValueError("cached strategy has no node configs")
+        try:
+            with open(os.path.join(d, "provenance.json"), "r",
+                      encoding="utf-8") as f:
+                provenance = json.load(f)
+        except (OSError, ValueError):
+            provenance = {}  # provenance is advisory; plan integrity isn't
+        return CacheEntry(strategy=strategy, provenance=provenance,
+                          path=d, key=key, strategy_bytes=raw)
+
+    # ------------------------------------------------------------------- get
+    def get(self, model_item: ModelItem, resource_spec: ResourceSpec,
+            version: Optional[str] = None) -> Optional[CacheEntry]:
+        """The cached winner for this (model, resources, version), fully
+        validated — or None (counted as a miss; corrupt entries are evicted
+        with a warning and also return None, never raise)."""
+        import time
+
+        key = plan_key(model_item, resource_spec, version)
+        d = self._entry_dir(key)
+        try:
+            try:
+                entry = self._read_files(key)
+            except Exception:  # noqa: BLE001 - retry the READ once
+                # A same-key writer replacing the entry mid-read produces a
+                # mixed old/new view (strategy bytes from one generation,
+                # meta checksum from the other). One short retry sees the
+                # settled files. Only the cheap file-read phase retries —
+                # dry-run validation failures below are deterministic and
+                # re-lowering would just double the miss latency.
+                time.sleep(0.05)
+                entry = self._read_files(key)
+            if entry is not None and self.validate:
+                dryrun_lowers(entry.strategy, model_item, resource_spec)
+        except Exception as e:  # noqa: BLE001 - ANY defect => fresh search
+            logging.warning(
+                "plan cache: entry %s is invalid (%s); evicting and falling "
+                "back to a fresh search", key, e)
+            self.stats["invalidated"] += 1
+            self.stats["misses"] += 1
+            shutil.rmtree(d, ignore_errors=True)
+            return None
+        if entry is None:
+            self.stats["misses"] += 1
+            return None
+        self.stats["hits"] += 1
+        # NB: entry.strategy.path stays as serialized — mutating it would
+        # break the byte-identical round-trip contract (selftest claim 3);
+        # the entry's filesystem location rides CacheEntry.path instead.
+        logging.info("plan cache HIT %s (%s)", key, entry.path)
+        return entry
+
+    # ------------------------------------------------------------------- put
+    def put(self, model_item: ModelItem, resource_spec: ResourceSpec,
+            strategy: Strategy, provenance: Optional[Dict] = None,
+            version: Optional[str] = None) -> str:
+        """Persist a winner; returns the entry directory.
+
+        Crash-safe: files are staged in a temp dir and renamed into place,
+        so a killed writer never leaves a half-written entry at the final
+        path. Same-key concurrency is last-writer-wins: the brief
+        remove-then-rename window can hand a racing reader a mixed view
+        (``get`` retries once to ride it out) or a racing writer an
+        ``ENOTEMPTY`` (retried once here; on a second loss the other
+        writer's equally valid entry stands)."""
+        key = plan_key(model_item, resource_spec, version)
+        d = self._entry_dir(key)
+        os.makedirs(self.cache_dir, exist_ok=True)
+        tmp = os.path.join(self.cache_dir, f".tmp-{os.getpid()}-{key}")
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        if not strategy.id:
+            strategy.id = Strategy.new_id(resource_spec.fingerprint())
+        raw = json.dumps(strategy.to_json(), indent=2,
+                         sort_keys=True).encode("utf-8")
+        with open(os.path.join(tmp, "strategy.json"), "wb") as f:
+            f.write(raw)
+        with open(os.path.join(tmp, "provenance.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(provenance or {}, f, indent=2, sort_keys=True,
+                      default=float)
+        with open(os.path.join(tmp, "meta.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump({
+                "format": CACHE_FORMAT,
+                "key": key,
+                "strategy_id": strategy.id,
+                "strategy_sha256": hashlib.sha256(raw).hexdigest(),
+                "model_fingerprint": model_fingerprint(model_item),
+                "resource_fingerprint": resource_spec.fingerprint(),
+            }, f, indent=2, sort_keys=True)
+        shutil.rmtree(d, ignore_errors=True)
+        try:
+            os.replace(tmp, d)
+        except OSError:
+            # A concurrent same-key writer recreated the target between our
+            # rmtree and rename. Their entry answers the identical
+            # question; retry once for last-writer-wins, then defer.
+            shutil.rmtree(d, ignore_errors=True)
+            try:
+                os.replace(tmp, d)
+            except OSError as e:
+                shutil.rmtree(tmp, ignore_errors=True)
+                logging.warning(
+                    "plan cache: concurrent writer won entry %s (%s); "
+                    "keeping theirs", key, e)
+                return d
+        logging.info("plan cache STORE %s -> %s", key, d)
+        return d

@@ -1,0 +1,359 @@
+"""Measurement calibration: fit the cost model's constants to this topology.
+
+The analytic :class:`~autodist_tpu.strategy.cost_model.CostModel` prices a
+strategy as ``comm + update + latency + act_sync`` seconds from *nominal*
+bandwidth/latency constants, and deliberately excludes the strategy-
+invariant compute floor. PR 3's obs :class:`~autodist_tpu.obs.profiler.
+StepProfiler` measures what actually happened (one-end-barrier step wall
+time, dispatch gap, the compiled program's own FLOPs/bytes). This module
+closes the loop: a set of ``(predicted components, measured seconds)``
+records fits per-component efficiency coefficients
+
+    measured_s ≈ base + a·comm_s + b·update_s + c·latency_s + d·act_sync_s
+
+where ``base`` absorbs the compute floor (plus fixed dispatch overhead) and
+``a..d`` the achieved fraction of each nominal peak. The fit REPORTS its
+own ranking error (mean |rel| error before vs after), and is persisted
+per-topology — one file per (accelerator kind × chip count × mesh shape) —
+so it shrinks with use and a calibration measured on one cluster never
+silently prices another.
+
+Relationship to ``strategy.cost_model.Calibration``: that is the older
+scalar (base + scale·total) fit ``AutoDist.tune`` records and ``explain``
+displays; this is its per-component superset for the planner. When fewer
+than :data:`MIN_COMPONENT_POINTS` records exist (or the component matrix
+is degenerate), the fit degrades to exactly the scalar form, so sparse
+profiles never produce wild extrapolations.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.cost_model import StrategyCost
+from autodist_tpu.utils import logging
+
+COMPONENTS = ("comm_s", "update_s", "latency_s", "act_sync_s")
+# Below this many distinct records the per-component least squares is
+# underdetermined; fall back to the scalar base+scale fit.
+MIN_COMPONENT_POINTS = len(COMPONENTS) + 2
+
+
+def default_calibration_dir() -> str:
+    from autodist_tpu import const
+
+    return const.DEFAULT_PLAN_DIR
+
+
+def topology_key(resource_spec: ResourceSpec, device_kind: str = "") -> str:
+    """Filesystem-safe identity of the thing a calibration was measured on:
+    accelerator kind (runtime ``device_kind`` wins over the spec's
+    ``accelerator``), chip count, and logical mesh shape. NOT the full spec
+    fingerprint — addresses/SSH blocks don't change achieved bandwidth."""
+    kind = device_kind or resource_spec.tpu.accelerator or "unknown"
+    mesh = resource_spec.mesh_shape(("data", "model"))
+    shape = "x".join(f"{k}{v}" for k, v in sorted(mesh.items()) if v > 1) or "1"
+    raw = f"{kind}-c{resource_spec.num_chips}-{shape}"
+    return re.sub(r"[^A-Za-z0-9_.-]+", "_", raw).lower()
+
+
+@dataclass
+class CalibrationRecord:
+    """One (predicted, measured) pair — a strategy that actually ran."""
+
+    comm_s: float
+    update_s: float
+    latency_s: float
+    act_sync_s: float
+    measured_s: float
+    name: str = ""
+    dispatch_gap_s: float = 0.0
+    flops_per_step: float = 0.0
+    bytes_per_step: float = 0.0
+
+    @property
+    def predicted_s(self) -> float:
+        return self.comm_s + self.update_s + self.latency_s + self.act_sync_s
+
+    @classmethod
+    def from_cost(cls, cost: StrategyCost, measured_s: float,
+                  name: str = "", **extra) -> "CalibrationRecord":
+        return cls(
+            comm_s=cost.comm_s, update_s=cost.update_s,
+            latency_s=cost.latency_s, act_sync_s=cost.act_sync_s,
+            measured_s=float(measured_s), name=name, **extra,
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "comm_s": self.comm_s, "update_s": self.update_s,
+            "latency_s": self.latency_s, "act_sync_s": self.act_sync_s,
+            "measured_s": self.measured_s,
+            **({"name": self.name} if self.name else {}),
+            **({"dispatch_gap_s": self.dispatch_gap_s}
+               if self.dispatch_gap_s else {}),
+            **({"flops_per_step": self.flops_per_step}
+               if self.flops_per_step else {}),
+            **({"bytes_per_step": self.bytes_per_step}
+               if self.bytes_per_step else {}),
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "CalibrationRecord":
+        return cls(
+            comm_s=float(d["comm_s"]), update_s=float(d["update_s"]),
+            latency_s=float(d["latency_s"]),
+            act_sync_s=float(d["act_sync_s"]),
+            measured_s=float(d["measured_s"]), name=str(d.get("name", "")),
+            dispatch_gap_s=float(d.get("dispatch_gap_s", 0.0)),
+            flops_per_step=float(d.get("flops_per_step", 0.0)),
+            bytes_per_step=float(d.get("bytes_per_step", 0.0)),
+        )
+
+
+def record_from_profiler(report: Dict, cost: StrategyCost,
+                         name: str = "") -> CalibrationRecord:
+    """Pair an obs ``StepProfiler.report()`` with the analytic cost of the
+    strategy it profiled. Measured time is the per-step WALL split (the
+    one-end-barrier discipline makes it trustworthy on every platform);
+    dispatch gap and the compiled program's FLOPs/bytes ride along for
+    provenance."""
+    steps = float(report.get("steps_per_window", 1.0)) or 1.0
+    return CalibrationRecord.from_cost(
+        cost,
+        measured_s=float(report.get("step_wall_s", 0.0)),
+        name=name,
+        dispatch_gap_s=float(report.get("dispatch_gap_s", 0.0)) / steps,
+        flops_per_step=float(report.get("flops_per_step", 0.0)),
+        bytes_per_step=float(report.get("bytes_per_step", 0.0)),
+    )
+
+
+@dataclass
+class TopologyCalibration:
+    """Fitted per-component correction for one topology."""
+
+    coefficients: Dict[str, float] = field(
+        default_factory=lambda: {c: 1.0 for c in COMPONENTS})
+    base_s: float = 0.0
+    device: str = ""
+    topology: str = ""
+    n_points: int = 0
+    # Mean |measured - predicted| / measured, uncalibrated vs calibrated —
+    # the "is the simulator getting better with use" headline.
+    error_before: float = float("nan")
+    error_after: float = float("nan")
+
+    # ----------------------------------------------------------------- apply
+    def predict_s(self, cost: StrategyCost) -> float:
+        """Calibrated seconds for anything exposing the four component
+        attributes — a :class:`~autodist_tpu.strategy.cost_model.
+        StrategyCost` or a :class:`CalibrationRecord` (one formula, so the
+        error grader and the search objective can never drift apart)."""
+        c = self.coefficients
+        return (
+            self.base_s
+            + c.get("comm_s", 1.0) * cost.comm_s
+            + c.get("update_s", 1.0) * cost.update_s
+            + c.get("latency_s", 1.0) * cost.latency_s
+            + c.get("act_sync_s", 1.0) * cost.act_sync_s
+        )
+
+    def describe(self) -> dict:
+        return {
+            "coefficients": dict(self.coefficients),
+            "base_ms": self.base_s * 1e3,
+            "device": self.device,
+            "topology": self.topology,
+            "n_points": self.n_points,
+            "mean_abs_rel_err_before": self.error_before,
+            "mean_abs_rel_err_after": self.error_after,
+        }
+
+    # ------------------------------------------------------------------- fit
+    @classmethod
+    def fit(cls, records: Sequence[CalibrationRecord], device: str = "",
+            topology: str = "") -> "TopologyCalibration":
+        recs = [r for r in records
+                if np.isfinite(r.measured_s) and r.measured_s > 0]
+        out = cls(device=device, topology=topology, n_points=len(recs))
+        if not recs:
+            return out
+        out.error_before = prediction_error(recs, None)
+
+        fitted = False
+        if len(recs) >= MIN_COMPONENT_POINTS:
+            A = np.array(
+                [[r.comm_s, r.update_s, r.latency_s, r.act_sync_s, 1.0]
+                 for r in recs], np.float64)
+            y = np.array([r.measured_s for r in recs], np.float64)
+            # Columns that never vary carry no signal; zero them so lstsq
+            # can't spend them on noise (their coefficient stays 1.0).
+            active = [i for i in range(4) if float(np.ptp(A[:, i])) > 1e-12]
+            if active:
+                cols = active + [4]
+                coef, *_ = np.linalg.lstsq(A[:, cols], y, rcond=None)
+                comp_coef = {c: 1.0 for c in COMPONENTS}
+                for i, col in enumerate(active):
+                    comp_coef[COMPONENTS[col]] = float(coef[i])
+                base = float(coef[-1])
+                # Negative efficiency coefficients mean the fit is chasing
+                # noise (a "speedup" from sending more bytes); reject the
+                # component fit rather than let it invert rankings.
+                if base >= 0 and all(v > 0 for v in comp_coef.values()):
+                    out.coefficients = comp_coef
+                    out.base_s = base
+                    fitted = True
+        if not fitted:
+            # Scalar fallback: measured ≈ base + scale × predicted_total
+            # (the tune()-era fit; see module docstring).
+            pred = np.array([r.predicted_s for r in recs], np.float64)
+            meas = np.array([r.measured_s for r in recs], np.float64)
+            if len(recs) == 1 or float(np.ptp(pred)) < 1e-12:
+                scale, base = 1.0, float(np.mean(meas - pred))
+            else:
+                scale, base = np.polyfit(pred, meas, 1)
+                if scale <= 0:
+                    scale, base = 1.0, float(np.mean(meas - pred))
+            out.coefficients = {c: float(scale) for c in COMPONENTS}
+            out.base_s = max(float(base), 0.0)
+        out.error_after = prediction_error(recs, out)
+        return out
+
+    # ---------------------------------------------------------- persistence
+    def path_for(self, directory: Optional[str] = None) -> str:
+        d = directory or default_calibration_dir()
+        return os.path.join(d, f"calibration-{self.topology or 'default'}.json")
+
+    def save(self, path: Optional[str] = None,
+             records: Sequence[CalibrationRecord] = ()) -> str:
+        path = path or self.path_for()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        doc = {
+            "coefficients": self.coefficients,
+            "base_s": self.base_s,
+            "device": self.device,
+            "topology": self.topology,
+            "n_points": self.n_points,
+            "error_before": self.error_before,
+            "error_after": self.error_after,
+            "records": [r.to_json() for r in records],
+        }
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2, sort_keys=True, default=float)
+        os.replace(tmp, path)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> Optional["TopologyCalibration"]:
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                d = json.load(f)
+            coef = {c: float(d["coefficients"].get(c, 1.0))
+                    for c in COMPONENTS}
+            return cls(
+                coefficients=coef,
+                base_s=float(d.get("base_s", 0.0)),
+                device=str(d.get("device", "")),
+                topology=str(d.get("topology", "")),
+                n_points=int(d.get("n_points", 0)),
+                error_before=float(d.get("error_before", float("nan"))),
+                error_after=float(d.get("error_after", float("nan"))),
+            )
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            # A torn/stale file degrades to "no calibration", loudly.
+            logging.warning("plan calibration unreadable at %s (%s); "
+                            "ignoring it", path, e)
+            return None
+
+    @classmethod
+    def load_for(cls, resource_spec: ResourceSpec, device_kind: str = "",
+                 directory: Optional[str] = None,
+                 ) -> Optional["TopologyCalibration"]:
+        key = topology_key(resource_spec, device_kind)
+        d = directory or default_calibration_dir()
+        return cls.load(os.path.join(d, f"calibration-{key}.json"))
+
+
+def load_records(path: str) -> List[CalibrationRecord]:
+    """Replay a persisted profile's records (the calibration file keeps
+    them so refits can extend rather than restart)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            d = json.load(f)
+        return [CalibrationRecord.from_json(r) for r in d.get("records", [])]
+    except (OSError, ValueError, KeyError, TypeError):
+        return []
+
+
+def prediction_error(records: Sequence[CalibrationRecord],
+                  calibration: Optional[TopologyCalibration]) -> float:
+    """Mean |predicted - measured| / measured over the records; with
+    ``calibration=None`` the raw analytic totals are graded (the "before"
+    column). NaN when no record qualifies."""
+    errs = []
+    for r in records:
+        if not (np.isfinite(r.measured_s) and r.measured_s > 0):
+            continue
+        pred = (r.predicted_s if calibration is None
+                else calibration.predict_s(r))
+        errs.append(abs(pred - r.measured_s) / r.measured_s)
+    return float(np.mean(errs)) if errs else float("nan")
+
+
+# Persisted-profile bound: newest records win. Keeps the calibration file
+# O(1) across unbounded tune() invocations and stops one over-tuned
+# configuration from drowning the fit (least squares weights every record
+# equally).
+MAX_PERSISTED_RECORDS = 512
+
+
+def _merge_records(old: Sequence[CalibrationRecord],
+                   new: Sequence[CalibrationRecord],
+                   ) -> List[CalibrationRecord]:
+    """old + new with exact duplicates collapsed (newest kept) and the
+    total capped to the newest :data:`MAX_PERSISTED_RECORDS`."""
+    merged: Dict[tuple, CalibrationRecord] = {}
+    for r in list(old) + list(new):
+        sig = (r.name, r.comm_s, r.update_s, r.latency_s, r.act_sync_s,
+               r.measured_s)
+        merged.pop(sig, None)  # re-insert so the newest occurrence is last
+        merged[sig] = r
+    return list(merged.values())[-MAX_PERSISTED_RECORDS:]
+
+
+def calibrate_from_records(
+    records: Sequence[CalibrationRecord],
+    resource_spec: ResourceSpec,
+    device_kind: str = "",
+    directory: Optional[str] = None,
+    persist: bool = True,
+) -> TopologyCalibration:
+    """Fit + (optionally) persist the per-topology calibration, merging the
+    new records with any the existing file already holds (exact duplicates
+    collapsed, capped to the newest :data:`MAX_PERSISTED_RECORDS`)."""
+    key = topology_key(resource_spec, device_kind)
+    d = directory or default_calibration_dir()
+    path = os.path.join(d, f"calibration-{key}.json")
+    merged = _merge_records(load_records(path), records)
+    calib = TopologyCalibration.fit(merged, device=device_kind, topology=key)
+    if persist:
+        calib.save(path, records=merged)
+        logging.info(
+            "plan calibration (%s): %d points, mean |rel err| %.1f%% -> "
+            "%.1f%% -> %s", key, calib.n_points,
+            calib.error_before * 100 if np.isfinite(calib.error_before)
+            else float("nan"),
+            calib.error_after * 100 if np.isfinite(calib.error_after)
+            else float("nan"), path,
+        )
+    return calib

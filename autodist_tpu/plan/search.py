@@ -1,0 +1,481 @@
+"""Beam search over the per-variable strategy space (the offline planner).
+
+The fixed ``Auto`` slate ranks ~10 whole-model policies; the actual decision
+space is per-variable — every trainable variable independently chooses a
+synchronizer mechanism (AllReduce / PS residency variants), a partition
+axis, and a collective fusion group, and the mesh itself has shape choices.
+Automap (arXiv 2112.02958) showed that *searching* this space beats fixed
+heuristics and GSPMD (arXiv 2105.04663) that per-tensor decisions compose
+into end-to-end wins; this module is the search half of that loop.
+
+Search is entirely analytic — candidates are scored by
+:class:`~autodist_tpu.strategy.cost_model.CostModel` (optionally through a
+fitted :class:`~autodist_tpu.plan.calibrate.TopologyCalibration`) and NO
+candidate is ever compiled, so visiting hundreds of plans costs
+milliseconds. The emitted winner is an ordinary Strategy IR artifact: it
+lowers through the same ``kernel/lowering.py`` path as any hand-picked
+builder, and the plan cache (``plan/cache.py``) dry-runs that lowering
+before trusting a cached winner.
+
+Genome encoding (one :class:`VarGene` per trainable variable, model order):
+
+- ``kind``: ``"ar"`` (AllReduce), ``"ps1"`` (PS, ZeRO-1 residency),
+  ``"ps3"`` (PS, ZeRO-3);
+- ``axis``: partition axis (``None`` = unpartitioned) — renders as the IR
+  ``partitioner`` string, axis-shard count capped by the mesh degree and
+  the axis length (same grammar the reference partitioner used);
+- ``group``: collective fusion group id (AllReduce only, advisory on TPU);
+- ``dest``: PS reduction-destination index into ``reduction_devices``.
+
+Seeds come from the live ``candidate_slate()`` builders, so search starts
+from every policy ``Auto`` already knows and can only improve on the best
+of them (the ``--selftest`` acceptance bound).
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.cost_model import CostModel, StrategyCost, candidate_slate
+from autodist_tpu.strategy.base import reduction_devices
+from autodist_tpu.strategy.ir import (
+    AllReduceSynchronizer,
+    NodeConfig,
+    PSSynchronizer,
+    Strategy,
+)
+from autodist_tpu.utils import logging
+
+KINDS = ("ar", "ps1", "ps3")
+CHUNK_SIZES = (1, 32, 128, 512)
+
+
+@dataclass(frozen=True)
+class VarGene:
+    """One variable's slot in the genome."""
+
+    kind: str = "ar"
+    axis: Optional[int] = None
+    group: int = 0
+    dest: int = 0
+
+
+Genome = Tuple[VarGene, ...]
+
+
+def _shard_count(dim: int, degree: int) -> int:
+    """Axis-shard count the partitioner string carries: the largest divisor
+    of ``dim`` that is ≤ the mesh shard ``degree`` (lowering pads when the
+    user forces a non-divisor; the planner never needs to)."""
+    for k in range(min(dim, degree), 1, -1):
+        if dim % k == 0:
+            return k
+    return 1
+
+
+def genome_to_strategy(
+    genome: Genome, model_item: ModelItem, resource_spec: ResourceSpec,
+) -> Strategy:
+    """Render a genome as ordinary Strategy IR (node-level configs only —
+    no per-shard ``part_config`` tables, which exist for reference-format
+    parity and fold back to node-level settings at lowering anyway)."""
+    from autodist_tpu.strategy.base import replica_devices
+
+    variables = model_item.trainable_variables
+    if len(genome) != len(variables):
+        raise ValueError(
+            f"genome length {len(genome)} != {len(variables)} trainable vars")
+    dests = reduction_devices(resource_spec)
+    mesh_shape = resource_spec.mesh_shape(("data", "model"))
+    n_model = max(int(mesh_shape.get("model", 1)), 1)
+    n_data = max(int(mesh_shape.get("data", 1)), 1)
+    degree = n_model if n_model > 1 else n_data
+
+    strategy = Strategy(id=Strategy.new_id(resource_spec.fingerprint()))
+    strategy.graph_config.replicas = replica_devices(resource_spec)
+    for var, gene in zip(variables, genome):
+        partitioner = ""
+        if gene.axis is not None and gene.axis < len(var.shape):
+            k = _shard_count(int(var.shape[gene.axis]), degree)
+            if k > 1:
+                parts = [1] * len(var.shape)
+                parts[gene.axis] = k
+                partitioner = ",".join(map(str, parts))
+        if gene.kind == "ar":
+            sync = AllReduceSynchronizer(group=gene.group)
+        else:
+            sync = PSSynchronizer(
+                reduction_destination=dests[gene.dest % len(dests)],
+                local_replication=(gene.kind == "ps1"),
+            )
+        strategy.node_config.append(
+            NodeConfig(var_name=var.name, synchronizer=sync,
+                       partitioner=partitioner)
+        )
+    return strategy
+
+
+def strategy_to_genome(strategy: Strategy, model_item: ModelItem,
+                       resource_spec: ResourceSpec) -> Genome:
+    """Project a built Strategy onto the genome space (seeding). Per-shard
+    tables collapse to their node-level settings; unknown destinations map
+    to index 0."""
+    dests = {d: i for i, d in enumerate(reduction_devices(resource_spec))}
+    genes: List[VarGene] = []
+    for var in model_item.trainable_variables:
+        node = strategy.node_config_for(var.name)
+        if node is None:
+            genes.append(VarGene())
+            continue
+        sync = node.synchronizer
+        try:
+            axis = node.active_partition_axis
+        except ValueError:
+            axis = None  # multi-active-axis tables have no genome rendering
+        if isinstance(sync, AllReduceSynchronizer):
+            genes.append(VarGene(kind="ar", axis=axis, group=sync.group))
+        else:
+            genes.append(VarGene(
+                kind="ps1" if sync.local_replication else "ps3",
+                axis=axis,
+                dest=dests.get(sync.reduction_destination, 0),
+            ))
+    return tuple(genes)
+
+
+def _objective(cost: StrategyCost, calibration=None) -> Tuple[bool, float]:
+    """Feasible-first score, lower better — same shape as CostModel.rank:
+    infeasible candidates compare on footprint so a model too big to
+    replicate still yields the least-over-budget plan."""
+    if not cost.feasible:
+        return (True, cost.per_chip_bytes)
+    if calibration is not None:
+        return (False, calibration.predict_s(cost))
+    return (False, cost.total_s)
+
+
+@dataclass
+class SearchConfig:
+    """Search knobs. Defaults visit ~100 candidates in ~100 ms of pure
+    cost-model arithmetic (nothing compiles during search)."""
+
+    beam_width: int = 4
+    generations: int = 4
+    mutations_per_survivor: int = 8
+    seed: int = 0
+    include_sparse_seeds: bool = True
+    # Also evaluate alternative (data, model) mesh factorizations of the
+    # chip count (advisory: the winner strategy is mesh-agnostic IR; the
+    # recommended shape rides the provenance for the user's `mesh:` block).
+    search_mesh: bool = False
+    max_mesh_candidates: int = 6
+
+
+@dataclass
+class SearchResult:
+    strategy: Strategy
+    cost: StrategyCost
+    genome: Genome
+    n_visited: int
+    provenance: Dict = field(default_factory=dict)
+
+
+class PlanSearch:
+    """Beam search seeded by the Auto slate, scored by the cost model."""
+
+    def __init__(
+        self,
+        model_item: ModelItem,
+        resource_spec: ResourceSpec,
+        config: Optional[SearchConfig] = None,
+        calibration=None,
+    ):
+        self.model_item = model_item
+        self.spec = resource_spec
+        self.config = config or SearchConfig()
+        self.calibration = calibration
+        self.cost_model = CostModel(model_item, resource_spec)
+        self._rng = random.Random(self.config.seed)
+        self._axes_by_var = [
+            # Candidate partition axes: every axis that could shard at
+            # degree >= 2 on SOME mesh, plus "unpartitioned".
+            [None] + [i for i, d in enumerate(v.shape) if int(d) >= 2]
+            for v in self.model_item.trainable_variables
+        ]
+        self._n_dests = max(len(reduction_devices(resource_spec)), 1)
+
+    # ------------------------------------------------------------------ seeds
+    def _seed_slate(self) -> Tuple[Dict[str, Strategy], Dict[str, Genome]]:
+        """(lossless built slate strategies, their genome projections).
+
+        The BUILT strategies compete directly in the candidate pool — a
+        genome projection can lose builder details (per-shard group tables,
+        reference shard counts), and the winner-never-worse-than-Auto bound
+        must hold against what Auto would actually emit, not against a
+        projection. Lossy compressed slate members (AllReduce+bf16/topk)
+        are excluded from direct competition: compression changes numerics,
+        so the planner must never auto-pick one silently — the same policy
+        Auto and explain's "recommended:" line apply. Their genome
+        projections (compressor dropped) still seed mutation.
+        """
+        from autodist_tpu.kernel.compressor import is_active_compressor
+        from autodist_tpu.strategy.ir import iter_synchronizers
+
+        def lossy(strategy: Strategy) -> bool:
+            return any(
+                is_active_compressor(getattr(s, "compressor", "") or "")
+                for node in strategy.node_config
+                for s in iter_synchronizers(node)
+            )
+
+        built: Dict[str, Strategy] = {}
+        genomes: Dict[str, Genome] = {}
+        slate = candidate_slate(
+            include_sparse=self.config.include_sparse_seeds, full=True)
+        for name, builder in slate:
+            try:
+                strategy = builder.build(self.model_item, self.spec)
+            except Exception as e:  # noqa: BLE001 - skip unbuildable seeds
+                logging.debug("plan search: seed %s failed to build (%s)",
+                              name, e)
+                continue
+            if not lossy(strategy):
+                built[name] = strategy
+            genomes[name] = strategy_to_genome(
+                strategy, self.model_item, self.spec)
+        if not genomes:
+            # Degenerate fallback: all-AllReduce (always buildable).
+            genomes["AllReduce"] = tuple(
+                VarGene() for _ in self.model_item.trainable_variables)
+        return built, genomes
+
+    # -------------------------------------------------------------- mutation
+    def _mutate(self, genome: Genome) -> Genome:
+        genes = list(genome)
+        if not genes:  # model with no trainable variables: nothing to move
+            return genome
+        i = self._rng.randrange(len(genes))
+        g = genes[i]
+        move = self._rng.random()
+        if move < 0.4:
+            g = VarGene(kind=self._rng.choice(KINDS), axis=g.axis,
+                        group=g.group, dest=g.dest)
+        elif move < 0.7:
+            g = VarGene(kind=g.kind,
+                        axis=self._rng.choice(self._axes_by_var[i]),
+                        group=g.group, dest=g.dest)
+        elif move < 0.85 and g.kind != "ar":
+            g = VarGene(kind=g.kind, axis=g.axis, group=g.group,
+                        dest=self._rng.randrange(self._n_dests))
+        else:
+            # Re-chunk the whole genome's fusion groups (advisory on TPU,
+            # but it keeps the group-id surface inside the search space).
+            chunk = self._rng.choice(CHUNK_SIZES)
+            genes = [
+                VarGene(kind=x.kind, axis=x.axis, group=j // chunk,
+                        dest=x.dest)
+                for j, x in enumerate(genes)
+            ]
+            return tuple(genes)
+        genes[i] = g
+        return tuple(genes)
+
+    # ----------------------------------------------------------------- score
+    def _score(self, genome: Genome) -> Tuple[Tuple[bool, float], StrategyCost]:
+        strategy = genome_to_strategy(genome, self.model_item, self.spec)
+        cost = self.cost_model.strategy_cost(strategy)
+        return _objective(cost, self.calibration), cost
+
+    # ------------------------------------------------------------------- run
+    def run(self) -> SearchResult:
+        cfg = self.config
+        slate, seeds = self._seed_slate()
+        scored: Dict[Genome, Tuple[Tuple[bool, float], StrategyCost]] = {}
+        origin: Dict[Genome, str] = {}
+        seed_rows = {}
+        # Direct slate candidates: the exact strategies Auto's builders emit.
+        slate_scored = {}
+        for name, s in slate.items():
+            cost = self.cost_model.strategy_cost(s)
+            slate_scored[name] = (_objective(cost, self.calibration), cost)
+        for name, (obj, cost) in slate_scored.items():
+            seed_rows[name] = {
+                "predicted_s": cost.total_s,
+                "feasible": cost.feasible,
+                "per_chip_gb": cost.per_chip_bytes / 1e9,
+            }
+        for name, genome in seeds.items():
+            if genome not in scored:
+                scored[genome] = self._score(genome)
+                origin[genome] = f"seed:{name}"
+            obj, cost = scored[genome]
+            seed_rows.setdefault(name, {
+                "predicted_s": cost.total_s,
+                "feasible": cost.feasible,
+                "per_chip_gb": cost.per_chip_bytes / 1e9,
+            })
+        # The bound the winner must meet: the best DIRECT slate strategy
+        # (what Auto would emit); genome projections only fill in when the
+        # whole slate failed to build.
+        pool = slate_scored or {n: scored[g] for n, g in seeds.items()}
+        best_seed = min(pool, key=lambda n: pool[n][0])
+        best_seed_obj, best_seed_cost = pool[best_seed]
+
+        beam = sorted(set(seeds.values()), key=lambda g: scored[g][0])
+        beam = beam[: cfg.beam_width]
+        trajectory = [{
+            "generation": 0,
+            "best_predicted_s": scored[beam[0]][1].total_s,
+            "visited": len(scored) + len(slate_scored),
+        }]
+        for gen in range(1, cfg.generations + 1):
+            for parent in list(beam):
+                for _ in range(cfg.mutations_per_survivor):
+                    child = self._mutate(parent)
+                    if child in scored:
+                        continue
+                    scored[child] = self._score(child)
+                    origin.setdefault(
+                        child, f"{origin.get(parent, '?')}+g{gen}")
+            beam = sorted(scored, key=lambda g: scored[g][0])[: cfg.beam_width]
+            trajectory.append({
+                "generation": gen,
+                "best_predicted_s": scored[beam[0]][1].total_s,
+                "visited": len(scored) + len(slate_scored),
+            })
+
+        winner = beam[0]
+        win_obj, win_cost = scored[winner]
+        n_visited = len(scored) + len(slate_scored)
+        if win_obj <= best_seed_obj or best_seed not in slate:
+            strategy = genome_to_strategy(winner, self.model_item, self.spec)
+            winner_origin = origin.get(winner, "?")
+        else:
+            # A genome projection can price above the exact slate strategy
+            # it was projected from (per-shard tables, reference shard
+            # counts); the planner must never emit worse than Auto would —
+            # the best slate member wins outright. The reported genome is
+            # then that strategy's PROJECTION (lossy; the emitted artifact
+            # is the strategy itself).
+            strategy = slate[best_seed]
+            win_obj, win_cost = best_seed_obj, best_seed_cost
+            winner_origin = f"slate:{best_seed}"
+            winner = seeds.get(best_seed, winner)
+
+        mesh_info = None
+        if cfg.search_mesh:
+            # Sweep the EMITTED strategy (mesh-agnostic IR), not a genome
+            # re-render — the recommendation must describe the plan the
+            # caller actually gets.
+            mesh_info = self._mesh_sweep(strategy)
+
+        improvement = 0.0
+        best_seed_s = seed_rows[best_seed]["predicted_s"]
+        if best_seed_s > 0:
+            improvement = 1.0 - win_cost.total_s / best_seed_s
+        why = (
+            f"predicted {win_cost.total_s * 1e3:.3f} ms/step vs best seed "
+            f"{best_seed} at {best_seed_s * 1e3:.3f} ms "
+            f"({improvement * 100:+.1f}%), "
+            f"{'fits' if win_cost.feasible else 'OVER'} "
+            f"{win_cost.per_chip_bytes / 1e9:.2f} GB/chip"
+        )
+        provenance = {
+            "n_visited": n_visited,
+            "beam_width": cfg.beam_width,
+            "generations": cfg.generations,
+            "search_seed": cfg.seed,
+            "seeds": seed_rows,
+            "best_seed": best_seed,
+            "winner": {
+                "origin": winner_origin,
+                "predicted_s": win_cost.total_s,
+                "comm_s": win_cost.comm_s,
+                "update_s": win_cost.update_s,
+                "latency_s": win_cost.latency_s,
+                "act_sync_s": win_cost.act_sync_s,
+                "per_chip_gb": win_cost.per_chip_bytes / 1e9,
+                "feasible": win_cost.feasible,
+            },
+            "improvement_vs_best_seed": improvement,
+            "trajectory": trajectory,
+            "why": why,
+        }
+        if self.calibration is not None:
+            provenance["calibration"] = {
+                "applied": True,
+                "predicted_calibrated_s":
+                    self.calibration.predict_s(win_cost),
+                **self.calibration.describe(),
+            }
+        if mesh_info is not None:
+            provenance["mesh"] = mesh_info
+        logging.info("plan search: %s (visited %d candidates)",
+                     why, n_visited)
+        return SearchResult(
+            strategy=strategy, cost=win_cost, genome=winner,
+            n_visited=n_visited, provenance=provenance,
+        )
+
+    # ------------------------------------------------------------------ mesh
+    def _mesh_factorizations(self) -> List[Dict[str, int]]:
+        n = max(self.spec.num_chips, 1)
+        shapes = []
+        for model in range(1, n + 1):
+            # data must stay non-trivial on a multi-chip cluster: the cost
+            # model excludes (strategy-invariant) compute, so a data=1 mesh
+            # looks free on paper while actually forfeiting all data
+            # parallelism — pure model parallelism is an explicit user
+            # choice, never a planner recommendation.
+            if n % model == 0 and (n // model >= 2 or n == 1):
+                shapes.append({"data": n // model, "model": model})
+        # Prefer modest model degrees first (they're the realistic ones);
+        # cap the sweep.
+        shapes.sort(key=lambda s: s["model"])
+        return shapes[: self.config.max_mesh_candidates]
+
+    def _mesh_sweep(self, strategy: Strategy) -> Dict:
+        """Score the winning strategy under alternative mesh factorizations.
+
+        Advisory output: the Strategy IR itself is mesh-agnostic (lowering
+        reads the live mesh), so the chosen shape is a recommendation for
+        the resource spec's ``mesh:`` block, recorded in provenance."""
+        rows = {}
+        base = dict(self.spec.mesh_shape(("data", "model")))
+        for shape in self._mesh_factorizations():
+            try:
+                variant = ResourceSpec(resource_dict={
+                    **self.spec.to_dict(), "mesh": shape})
+                cost = CostModel(
+                    self.model_item, variant).strategy_cost(strategy)
+            except Exception as e:  # noqa: BLE001 - skip invalid shapes
+                logging.debug("plan search: mesh %s skipped (%s)", shape, e)
+                continue
+            rows[f"data={shape['data']},model={shape['model']}"] = {
+                "predicted_s": cost.total_s,
+                "feasible": cost.feasible,
+                "per_chip_gb": cost.per_chip_bytes / 1e9,
+            }
+        if not rows:
+            return {"searched": True, "candidates": {}}
+        feasible = {k: v for k, v in rows.items() if v["feasible"]} or rows
+        chosen = min(feasible, key=lambda k: feasible[k]["predicted_s"])
+        return {
+            "searched": True,
+            "current": {k: int(v) for k, v in base.items()},
+            "chosen": chosen,
+            "candidates": rows,
+        }
+
+
+def search(
+    model_item: ModelItem,
+    resource_spec: ResourceSpec,
+    config: Optional[SearchConfig] = None,
+    calibration=None,
+) -> SearchResult:
+    """One-call façade over :class:`PlanSearch`."""
+    return PlanSearch(model_item, resource_spec, config, calibration).run()

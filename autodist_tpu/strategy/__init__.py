@@ -36,10 +36,19 @@ BUILTIN_BUILDERS = {
 
 def from_name(name: str, **kwargs) -> StrategyBuilder:
     """Builder by class name — the reference benchmarks' --autodist_strategy
-    flag contract (``/root/reference/examples/benchmark/imagenet.py:52-66``)."""
+    flag contract (``/root/reference/examples/benchmark/imagenet.py:52-66``).
+    ``"plan"``/``"Plan"`` resolves to the search-based auto-planner
+    (``autodist_tpu.plan.Plan``, docs/planner.md) — imported lazily because
+    plan/ sits ABOVE this package and importing it here eagerly would be
+    circular."""
+    if name in ("plan", "Plan"):
+        from autodist_tpu.plan import Plan
+
+        return Plan(**kwargs)
     if name not in BUILTIN_BUILDERS:
         raise ValueError(
-            f"unknown strategy {name!r}; choose from {sorted(BUILTIN_BUILDERS)}"
+            f"unknown strategy {name!r}; choose from "
+            f"{sorted(BUILTIN_BUILDERS) + ['Plan']}"
         )
     return BUILTIN_BUILDERS[name](**kwargs)
 

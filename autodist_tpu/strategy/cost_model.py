@@ -84,13 +84,22 @@ SLATE_PREFERENCE = (
 
 
 def _tie_winner(times: Dict[str, float], order: Sequence[str],
-                rel: float) -> str:
-    """Cheapest entry, except entries within ``rel`` of it form a tie
-    broken by position in ``order`` (unknown names last, alphabetically)."""
+                rel: float,
+                memory: Optional[Dict[str, float]] = None) -> str:
+    """Cheapest entry, except entries within ``rel`` of it form a tie.
+
+    The tie breaks DETERMINISTICALLY, in a way no caller can perturb:
+    position in ``order`` first (the canonical mechanism preference), then
+    — for names ``order`` does not distinguish, e.g. planner-generated
+    candidates — lower per-chip ``memory``, then stable name order. Input
+    ordering of ``times`` never matters, so a near-tie can't flap between
+    runs (tests/test_cost_model.py pins this)."""
     t0 = min(times.values())
     tied = [n for n, t in times.items() if t <= t0 * (1.0 + rel)]
     rank_of = {n: i for i, n in enumerate(order)}
-    return min(tied, key=lambda n: (rank_of.get(n, len(order)), n))
+    mem = memory or {}
+    return min(tied, key=lambda n: (rank_of.get(n, len(order)),
+                                    mem.get(n, float("inf")), n))
 
 # Activation bytes synchronized per tensor-parallel (partitioned) variable per
 # step (forward + backward each pay one collective). Fallback when the
@@ -189,10 +198,11 @@ def preferred_prediction(predicted_s: Dict[str, float],
 
     The cheapest prediction wins unless other candidates sit within ``rel``
     of it, in which case the earliest :data:`SLATE_PREFERENCE` name among
-    the tied wins. Same tie rule as :meth:`CostModel.rank` (which prefers
-    by the caller's candidate order — identical for the canonical slate);
-    the default ``rel`` is the single-chip band, matching the calibrate
-    sweep artifacts this helper exists to interpret.
+    the tied wins (unknown names: stable name order — this helper sees no
+    memory column; :meth:`CostModel.rank` additionally prefers lower
+    per-chip memory for them). The default ``rel`` is the single-chip
+    band, matching the calibrate sweep artifacts this helper exists to
+    interpret.
     """
     return _tie_winner(predicted_s, SLATE_PREFERENCE, rel)
 
@@ -770,13 +780,18 @@ class CostModel:
             ),
         )
         # Near-tie break: predictions within the mesh's tie band of the
-        # feasible best are indistinguishable; among them the caller's
-        # candidate order (the slate is simplest-mechanism-first) picks the
-        # winner.
+        # feasible best are indistinguishable; among them the CANONICAL
+        # preference order (SLATE_PREFERENCE, simplest-mechanism-first)
+        # picks the winner — never the caller's candidate ordering, which
+        # may come from a dict/set and silently flip between runs. Names
+        # the canon doesn't know (planner-generated candidates, custom
+        # slates) break by lower per-chip memory, then stable name order,
+        # so the choice is deterministic for ANY candidate list.
         if ranked and ranked[0][1].feasible:
             rel = NEAR_TIE_REL if self.n <= 1 else NEAR_TIE_REL_MULTI
             feas = {name: c.total_s for name, c in ranked if c.feasible}
-            win_name = _tie_winner(feas, [n for n, _ in candidates], rel)
+            mem = {name: c.per_chip_bytes for name, c in ranked if c.feasible}
+            win_name = _tie_winner(feas, SLATE_PREFERENCE, rel, memory=mem)
             winner = next(nc for nc in ranked if nc[0] == win_name)
             ranked.remove(winner)
             ranked.insert(0, winner)

@@ -18,6 +18,7 @@ factory overrides as ``k=v`` pairs (ints/floats auto-coerced).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import List, Optional, Tuple
 
@@ -163,12 +164,97 @@ def explain(
     return ranked
 
 
+def explain_provenance(provenance: dict, out=None) -> None:
+    """Render a plan-search provenance record (``autodist_tpu.plan``) —
+    candidates visited, the seed table, predicted (and calibrated /
+    measured, when recorded) costs, and why the winner won. The record is
+    what ``Plan.last_result["provenance"]`` holds and what the plan cache
+    persists next to every winner (``provenance.json``)."""
+    out = out if out is not None else sys.stdout
+    if not provenance:
+        print("(empty provenance: cached entry predates search provenance)",
+              file=out)
+        return
+    print(
+        f"plan search: {provenance.get('n_visited', '?')} candidates "
+        f"visited (beam {provenance.get('beam_width', '?')} × "
+        f"{provenance.get('generations', '?')} generations, "
+        f"seed {provenance.get('search_seed', '?')})",
+        file=out,
+    )
+    seeds = provenance.get("seeds", {})
+    if seeds:
+        print(f"\n{'seed':22s} {'predicted':>11s} {'mem/chip':>10s} "
+              f"{'fits':>5s}", file=out)
+        for name in sorted(seeds, key=lambda n: seeds[n].get(
+                "predicted_s", float("inf"))):
+            row = seeds[name]
+            print(
+                f"{name:22s} {row.get('predicted_s', 0.0) * 1e3:9.3f}ms "
+                f"{row.get('per_chip_gb', 0.0):8.2f}GB "
+                f"{'yes' if row.get('feasible') else 'NO':>5s}",
+                file=out,
+            )
+    w = provenance.get("winner", {})
+    print(
+        f"\nwinner: {w.get('origin', '?')} — "
+        f"predicted {w.get('predicted_s', 0.0) * 1e3:.3f} ms/step "
+        f"(comm {w.get('comm_s', 0.0) * 1e3:.3f}, "
+        f"update {w.get('update_s', 0.0) * 1e3:.3f}, "
+        f"lat {w.get('latency_s', 0.0) * 1e3:.3f}, "
+        f"act {w.get('act_sync_s', 0.0) * 1e3:.3f}), "
+        f"{w.get('per_chip_gb', 0.0):.2f} GB/chip "
+        f"{'ok' if w.get('feasible') else 'OVER'}",
+        file=out,
+    )
+    calib = provenance.get("calibration")
+    if calib:
+        print(
+            f"calibrated: {calib.get('predicted_calibrated_s', 0.0) * 1e3:.3f}"
+            f" ms/step ({calib.get('n_points', 0)} measured points on "
+            f"{calib.get('device') or 'unknown device'}; model error "
+            f"{calib.get('mean_abs_rel_err_before', float('nan')) * 100:.1f}%"
+            f" -> {calib.get('mean_abs_rel_err_after', float('nan')) * 100:.1f}"
+            f"% after fit)",
+            file=out,
+        )
+    if w.get("measured_s"):
+        print(f"measured: {w['measured_s'] * 1e3:.3f} ms/step", file=out)
+    mesh = provenance.get("mesh")
+    if mesh and mesh.get("chosen"):
+        print(f"mesh recommendation: {mesh['chosen']} (searched "
+              f"{len(mesh.get('candidates', {}))} factorizations)", file=out)
+    print(f"\nwhy: {provenance.get('why', '(not recorded)')}", file=out)
+
+
+def _load_provenance(path: str) -> dict:
+    """Provenance from a file, a cache entry dir, or a cache root (newest
+    entry wins)."""
+    import glob
+    import json as _json
+
+    if os.path.isdir(path):
+        direct = os.path.join(path, "provenance.json")
+        if os.path.exists(direct):
+            path = direct
+        else:
+            candidates = sorted(
+                glob.glob(os.path.join(path, "*", "provenance.json")),
+                key=os.path.getmtime, reverse=True)
+            if not candidates:
+                raise FileNotFoundError(
+                    f"no provenance.json under {path!r}")
+            path = candidates[0]
+    with open(path, "r", encoding="utf-8") as f:
+        return _json.load(f)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m autodist_tpu.strategy.explain",
         description="Rank strategy builders for a model on a cluster (cost model).",
     )
-    p.add_argument("--model", required=True, help="zoo model name (e.g. bert_base, resnet, lstm_lm)")
+    p.add_argument("--model", help="zoo model name (e.g. bert_base, resnet, lstm_lm)")
     p.add_argument("--model-kwargs", default="", help='comma "k=v" factory overrides')
     p.add_argument("--resource-spec", default="", help="cluster yml (default: local devices)")
     p.add_argument("--batch-size", type=int, default=32, help="planning batch size")
@@ -183,6 +269,12 @@ def main(argv=None) -> int:
              'default location; adds a calibrated step-time column',
     )
     p.add_argument(
+        "--plan-provenance", default="",
+        help="render a plan-search provenance record instead of the slate "
+             "table: a provenance.json path, a plan-cache entry dir, or a "
+             "cache root (newest entry). See docs/planner.md.",
+    )
+    p.add_argument(
         "--platform", default="cpu",
         help="jax platform for the planning traces (default cpu: ranking is "
              "analytical and must not hang on an absent/wedged accelerator; "
@@ -190,6 +282,16 @@ def main(argv=None) -> int:
              "real local devices instead of a --resource-spec file)",
     )
     args = p.parse_args(argv)
+
+    if args.plan_provenance:
+        try:
+            provenance = _load_provenance(args.plan_provenance)
+        except (OSError, ValueError) as e:
+            p.error(f"--plan-provenance {args.plan_provenance!r}: {e}")
+        explain_provenance(provenance)
+        return 0
+    if not args.model:
+        p.error("--model is required (or pass --plan-provenance)")
 
     import jax
 

@@ -234,12 +234,17 @@ def _embed_last_accel(result: dict) -> dict:
     return result
 
 
-def measure_workload(model_name: str, on_accel: bool) -> dict:
+def measure_workload(model_name: str, on_accel: bool,
+                     plan_cache: str = "") -> dict:
     """Train-step throughput for one named workload on the visible devices.
 
     Returns raw numbers; the caller formats the JSON line. Uses the full
     AutoDist pipeline (AllReduce strategy) — the bench measures the
-    framework's production path, not a hand-written loop.
+    framework's production path, not a hand-written loop. With
+    ``plan_cache`` set, the strategy comes from the search-based planner
+    backed by that persistent cache dir instead (docs/planner.md): the
+    first queue round searches, later rounds hit the cache and skip
+    planning entirely; per-round hit/miss counts ride the JSON line.
     """
     import jax
 
@@ -299,11 +304,24 @@ def measure_workload(model_name: str, on_accel: bool) -> dict:
     # execution finishes, so a device->host fetch is the only trustworthy
     # barrier. Batch size is swept (the throughput-vs-batch curve is not
     # monotone on one chip); the best throughput wins.
+    plan_stats = {}
+
+    def _builder():
+        if not plan_cache:
+            return S.AllReduce()
+        from autodist_tpu.plan import Plan, PlanConfig
+
+        return Plan(PlanConfig(cache_dir=plan_cache))
+
     def measure(bs):
         AutoDist.reset_default()
-        ad = AutoDist(strategy_builder=S.AllReduce())
+        ad = AutoDist(strategy_builder=_builder())
         batch = spec.example_batch(bs)
         step = ad.build(spec.loss_fn, params, batch)
+        cache = getattr(ad.strategy_builder, "cache", None)
+        if cache is not None:
+            for k, v in cache.stats.items():
+                plan_stats[k] = plan_stats.get(k, 0) + v
         state = step.init(params)
         # Pin the batch in HBM (the "compute" methodology,
         # docs/performance.md): image-sized host feeds otherwise measure
@@ -344,6 +362,7 @@ def measure_workload(model_name: str, on_accel: bool) -> dict:
         peak_per_chip, peak_detected = _peak_flops(dev)
         mfu = achieved / (peak_per_chip * n_chips) if on_accel else float("nan")
         return {
+            **({"plan_cache": dict(plan_stats)} if plan_cache else {}),
             "unit_per": unit_per,
             "mfu": mfu,
             "units_per_sec": units_per_sec,
@@ -443,6 +462,14 @@ def _format_result(measured: dict, errors: dict) -> tuple:
             key = f"{name}_note"
             result[key] = "; ".join(filter(None, [result.get(key),
                                                   w["note"]]))
+    # Plan-cache accounting (--plan-cache): summed across workloads so the
+    # queue driver can see reuse per round ("hits": N on a warm round).
+    plan_totals = {}
+    for w in measured.values():
+        for k, v in (w.get("plan_cache") or {}).items():
+            plan_totals[k] = plan_totals.get(k, 0) + int(v)
+    if plan_totals:
+        result["plan_cache"] = plan_totals
     for name, err in errors.items():
         result[f"{name}_error"] = err
     return result, on_accel
@@ -462,7 +489,8 @@ def _last_json_line(out):
     return None
 
 
-def _measure_in_subprocess(name: str, cpu_smoke: bool, timeout_s: float):
+def _measure_in_subprocess(name: str, cpu_smoke: bool, timeout_s: float,
+                           plan_cache: str = ""):
     """Run one workload isolated in a child process.
 
     A wedged tunnel hangs the *process* that touched it, unrecoverably;
@@ -479,6 +507,8 @@ def _measure_in_subprocess(name: str, cpu_smoke: bool, timeout_s: float):
     cmd = [sys.executable, os.path.abspath(__file__), "--one", name]
     if cpu_smoke:
         cmd.append("--cpu-smoke")
+    if plan_cache:
+        cmd.extend(["--plan-cache", plan_cache])
     try:
         r = subprocess.run(
             cmd, timeout=timeout_s, capture_output=True, text=True,
@@ -502,14 +532,14 @@ def _measure_in_subprocess(name: str, cpu_smoke: bool, timeout_s: float):
     return None, f"workload exited rc={r.returncode} with no JSON line"
 
 
-def _run_one(name: str, cpu_smoke: bool) -> None:
+def _run_one(name: str, cpu_smoke: bool, plan_cache: str = "") -> None:
     """Child mode: measure one workload, print its raw dict as JSON."""
     import jax
 
     if cpu_smoke:
         jax.config.update("jax_platforms", "cpu")
     on_accel = jax.devices()[0].platform != "cpu"
-    out = measure_workload(name, on_accel)
+    out = measure_workload(name, on_accel, plan_cache=plan_cache)
     out["on_accel"] = on_accel
     print(json.dumps(out))
 
@@ -627,9 +657,14 @@ def main() -> None:
                     default="both")
     ap.add_argument("--one", help=argparse.SUPPRESS)          # child mode
     ap.add_argument("--cpu-smoke", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument(
+        "--plan-cache", default="", metavar="DIR",
+        help="build strategies through the search-based planner backed by "
+             "this persistent plan cache (docs/planner.md); hit/miss counts "
+             "are logged in the JSON line so queue rounds show reuse")
     args = ap.parse_args()
     if args.one:
-        _run_one(args.one, args.cpu_smoke)
+        _run_one(args.one, args.cpu_smoke, plan_cache=args.plan_cache)
         return
 
     # Safety net over the budget clamps: if anything blocks anyway, SIGALRM
@@ -718,7 +753,8 @@ def main() -> None:
                      / sum(weights.get(n, 1.0) for n in rest))
             fair_s = min(per_workload_s, BUDGET.remaining() * share)
             out, err = _measure_in_subprocess(
-                name, cpu_smoke=not accel_ok, timeout_s=fair_s)
+                name, cpu_smoke=not accel_ok, timeout_s=fair_s,
+                plan_cache=args.plan_cache)
             if err is not None:
                 errors[name] = err
                 print(f"bench[{name}] failed: {err}", file=sys.stderr)
@@ -742,7 +778,8 @@ def main() -> None:
             # budget already drained by the failed accel attempts.
             for name in base_workloads:
                 out, err = _measure_in_subprocess(
-                    name, cpu_smoke=True, timeout_s=per_workload_s)
+                    name, cpu_smoke=True, timeout_s=per_workload_s,
+                    plan_cache=args.plan_cache)
                 if err is not None:
                     errors[name] = f"{errors.get(name, '')}; cpu smoke: {err}"
                     continue

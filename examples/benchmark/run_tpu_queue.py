@@ -81,6 +81,13 @@ JOBS = [
     # number the old methodology undersold. Quick single-model headline
     # first so a brief healthy window still banks a pipelined bench line.
     ("bench_quick_pipelined", ["bench.py", "--model", "bert"], 1800),
+    # PR 4: same quick headline through the search-based planner with the
+    # round-persistent plan cache (docs/planner.md) — round 1 searches and
+    # stores, every later round's JSON line must show "plan_cache":
+    # {"hits": N, "misses": 0, ...}, i.e. strategy planning amortized to
+    # zero across queue rounds.
+    ("bench_plan_cached", ["bench.py", "--model", "bert", "--plan-cache",
+                           "docs/measured/queue/plan-cache"], 1800),
     ("resnet50_pipelined", ["examples/benchmark/train.py", "--model", "resnet50",
                             "--batch-size", "128", "--steps", "120", "--warmup", "40",
                             "--window", "20", "--pin"], 900),
@@ -121,6 +128,10 @@ JOB_ENV = {
                               "BENCH_WORKLOAD_TIMEOUT": "1200",
                               "BENCH_PREFLIGHT_TIMEOUTS": "120",
                               "BENCH_REQUIRE_ACCEL": "1"},
+    "bench_plan_cached": {"BENCH_BUDGET_S": "1700",
+                          "BENCH_WORKLOAD_TIMEOUT": "1200",
+                          "BENCH_PREFLIGHT_TIMEOUTS": "120",
+                          "BENCH_REQUIRE_ACCEL": "1"},
     "bench_final_pipelined": {"BENCH_BUDGET_S": "5100",
                               "BENCH_REQUIRE_ACCEL": "1"},
 }

@@ -124,7 +124,9 @@ def test_powersgd_exact_on_lowrank():
 
     def one(g, local, shared):
         mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("data",))
-        f = jax.shard_map(
+        from autodist_tpu.utils.compat import shard_map
+
+        f = shard_map(
             lambda g, l, s: comp.step(g, l, s, axis="data", nshards=1),
             mesh=mesh,
             in_specs=(jax.sharding.PartitionSpec(),) * 3,
@@ -293,8 +295,15 @@ def test_compressed_path_with_sparse_embedding_matches_oracle():
     )
 
 
-@pytest.mark.parametrize("name", ["HorovodCompressor", "HorovodCompressorEF",
-                                  "PowerSGDCompressor"])
+@pytest.mark.parametrize("name", [
+    "HorovodCompressor", "HorovodCompressorEF",
+    pytest.param("PowerSGDCompressor", marks=pytest.mark.skipif(
+        not hasattr(jax, "shard_map"),
+        reason="jax<0.6 partial-manual shard_map: PowerSGD's in-region "
+               "matmuls trip an XLA SPMD partitioner CHECK (process abort, "
+               "not a Python error) on the auto= bridge — see docs/parity.md "
+               "shard_map drift triage")),
+])
 def test_compression_on_data_model_mesh(name):
     """Compression must survive a mixed data×model mesh (VERDICT r1 next
     #7): the compressed sync runs partial-manual over the data axis with
@@ -557,7 +566,9 @@ def _run_topk_shardwise(comp, grads, n_shards):
             nshards=n_shards)
         return out[None], jax.tree.map(lambda x: x[None], l2)
 
-    f = jax.shard_map(
+    from autodist_tpu.utils.compat import shard_map
+
+    f = shard_map(
         shardwise, mesh=mesh,
         in_specs=(P("data"), P("data")), out_specs=(P("data"), P("data")),
         axis_names={"data"}, check_vma=False,
@@ -577,8 +588,12 @@ def test_topk_full_ratio_matches_dense_psum():
     out, local2 = _run_topk_shardwise(comp, grads, n_shards)
     expected = jnp.mean(grads, axis=0)
     for s in range(n_shards):
+        # rtol covers psum-vs-mean reassociation: old jaxlib's full-manual
+        # all-reduce sums in a different order than jnp.mean, which moves a
+        # couple of near-cancelling elements by a few ulp (observed 4.5e-6
+        # relative on jax 0.4.37; exact on newer toolchains).
         np.testing.assert_allclose(np.asarray(out[s]), np.asarray(expected),
-                                   rtol=1e-6)
+                                   rtol=1e-5)
     # Full selection leaves no residual.
     np.testing.assert_allclose(np.asarray(local2["residual"]), 0.0, atol=1e-7)
 

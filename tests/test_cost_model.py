@@ -589,3 +589,61 @@ def test_rank_near_tie_prefers_slate_order_single_chip():
     # Outside the band the cheap one wins regardless of preference.
     table = {"TensorParallel": 0.00060, "AllReduce": 0.000884}
     assert preferred_prediction(table) == "TensorParallel"
+
+
+class TestRankTieDeterminism:
+    """Regression (PR 4 satellite): rank's near-tie break must be a function
+    of the candidates alone — canonical slate preference first, then lower
+    per-chip memory, then stable name order — NEVER the caller's candidate
+    ordering, so Auto's choice can't flap between runs on near-equal
+    candidates."""
+
+    def _near_tied_candidates(self):
+        # Two structurally different strategies the single-chip tie band
+        # (NEAR_TIE_REL=5%) makes indistinguishable: on 1 chip every
+        # collective is elided, so ALL candidates predict ~identical times.
+        item = _item({"w": (64, 64), "b": (64,)})
+        spec = _single(chips=1)
+        cm = CostModel(item, spec)
+        cands = [
+            ("AllReduce", AllReduce().build(item, spec)),
+            ("PS(zero1)", PS(local_proxy_variable=True).build(item, spec)),
+            ("PSLoadBalancing", PSLoadBalancing().build(item, spec)),
+        ]
+        return cm, cands
+
+    def test_winner_is_caller_order_invariant(self):
+        cm, cands = self._near_tied_candidates()
+        winners = {
+            cm.rank(list(perm))[0][0]
+            for perm in (cands, cands[::-1],
+                         [cands[1], cands[2], cands[0]])
+        }
+        assert winners == {"AllReduce"}, (
+            f"rank winner flapped with caller order: {winners}")
+
+    def test_unknown_names_prefer_lower_memory_then_name(self):
+        # Planner-generated candidates are off-slate: within the tie band
+        # the lower-footprint one must win deterministically; equal
+        # footprints fall back to name order.
+        item = _item({"w": (64, 64), "b": (64,)}, opt="adam")
+        spec = _single(chips=8)
+        cm = CostModel(item, spec)
+        lean = PS(local_proxy_variable=False).build(item, spec)  # ZeRO-3
+        fat = PS(local_proxy_variable=True).build(item, spec)    # ZeRO-1
+        lean_cost = cm.strategy_cost(lean)
+        fat_cost = cm.strategy_cost(fat)
+        assert lean_cost.per_chip_bytes < fat_cost.per_chip_bytes
+        # Same mechanism => genuinely near-tied predictions; only the
+        # names (off-slate) and footprints differ.
+        for perm in (
+            [("plan:a", fat), ("plan:b", lean)],
+            [("plan:b", lean), ("plan:a", fat)],
+        ):
+            assert cm.rank(perm)[0][0] == "plan:b"
+        # Equal costs + equal memory: stable name order decides.
+        for perm in (
+            [("plan:x", lean), ("plan:c", lean)],
+            [("plan:c", lean), ("plan:x", lean)],
+        ):
+            assert cm.rank(perm)[0][0] == "plan:c"
