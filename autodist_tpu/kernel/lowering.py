@@ -97,6 +97,13 @@ class VarPlan:
     # entries get zero gradients and elementwise optimizers keep them at
     # zero. None = storage is the logical shape.
     storage_shape: Optional[Tuple[int, ...]] = None
+    # ZeRO-1 weight-update sharding for an AllReduce var (arXiv 2004.13336,
+    # strategy.ir.AllReduceSynchronizer.shard_update): param replicated,
+    # optimizer slots + update sharded per ``update_pspec`` over the data
+    # axis, gradient sync rendered reduce-scatter → sharded update →
+    # all-gather. True only when the rendering is ACTIVE (update_pspec is
+    # genuinely sharded) — the step keys its manual grad sync off this.
+    shard_update: bool = False
 
 
 @struct.dataclass
@@ -196,6 +203,9 @@ class GraphTransformer:
         self.host_offload = host_offload
 
     def transform(self) -> "ShardingPlan":
+        from autodist_tpu.obs import spans as _spans
+
+        t_wall, t0 = time.time(), time.perf_counter()
         plans: Dict[str, VarPlan] = {}
         for node in self.strategy.node_config:
             var = self.model_item.var(node.var_name)
@@ -206,6 +216,13 @@ class GraphTransformer:
                 plans[var.name] = VarPlan(
                     var=var, kind=SyncKind.ALL_REDUCE, pspec=P(), update_pspec=P()
                 )
+        # Retroactive span (obs timeline): how long lowering took and how
+        # many vars carry the zero1 reduce-scatter/all-gather rendering.
+        _spans.add_span(
+            "lowering.transform", t_wall, time.perf_counter() - t0,
+            n_nodes=len(self.strategy.node_config),
+            shard_update_vars=sum(1 for p in plans.values() if p.shard_update),
+        )
         return ShardingPlan(mesh=self.mesh, var_plans=plans)
 
     # ------------------------------------------------------------------ rules
@@ -291,6 +308,12 @@ class GraphTransformer:
             part_comp = uniform("compressor")
             if part_comp != "NoneCompressor":
                 folded["compressor"] = part_comp
+            # Same default-ambiguity contract for shard_update (default
+            # False): a uniform True overrides; uniform False defers to the
+            # node level. One variable = one gradient wire, so a mixed
+            # table raises in uniform().
+            if uniform("shard_update"):
+                folded["shard_update"] = True
         return folded
 
     def _lower_node(self, node: NodeConfig, var: VarItem) -> VarPlan:
@@ -303,6 +326,7 @@ class GraphTransformer:
             kind = SyncKind.ALL_REDUCE
             compressor, group = folded.get("compressor", sync.compressor), sync.group
             staleness, dest, proxy = 0, "", False
+            shard_update = folded.get("shard_update", sync.shard_update)
         else:
             assert isinstance(sync, PSSynchronizer)
             if not sync.sync:
@@ -317,6 +341,7 @@ class GraphTransformer:
             staleness = folded.get("staleness", sync.staleness)
             dest = sync.reduction_destination
             proxy = folded.get("proxy", sync.local_replication)
+            shard_update = False
 
         mesh_shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
         n_shard = mesh_shape[shard_ax]
@@ -417,9 +442,52 @@ class GraphTransformer:
             # remote-read-per-step placement.
             update_pspec = self._weight_update_spec(var)
             pspec = P() if proxy else update_pspec
+        elif kind is SyncKind.ALL_REDUCE and shard_update and rank > 0:
+            # ZeRO-1 for an AllReduce var (shard_update capability): the
+            # parameter stays replicated — its uses are untouched — but the
+            # optimizer slots and the update computation shard over the
+            # data axis. The step's manual grad sync renders the gradient
+            # reduction as reduce-scatter and the fresh values all-gather
+            # back (arXiv 2004.13336; docs/zero.md).
+            pspec = P()
+            update_pspec = self._weight_update_spec(var)
         else:
             pspec = P()
             update_pspec = P()
+
+        # shard_update is ACTIVE only where the zero1 branch fired with a
+        # genuinely sharded update spec: vars claimed by a more specific
+        # rendering (expert / explicit partition / sparse row-sharding)
+        # already shard their update, and a var with no data-axis-divisible
+        # dimension has nothing to scatter — both degrade to their usual
+        # rendering rather than erroring (cost_model prices the same rule).
+        su_active = (
+            kind is SyncKind.ALL_REDUCE and shard_update
+            and pspec == P() and update_pspec != P()
+        )
+        if su_active:
+            from autodist_tpu.kernel.compressor import is_active_compressor
+
+            if is_active_compressor(compressor):
+                # The compressed wire psums the FULL gradient inside its
+                # manual region (_manual_sync_grads) — there is no
+                # reduce-scatter to render, and a silently ineffective
+                # shard_update would desync pricing from the program. The
+                # compressor is the explicit opt-in; it wins.
+                logging.warning(
+                    "var %s: shard_update ignored — compressor %s syncs the "
+                    "full gradient (no reduce-scatter rendering); optimizer "
+                    "state stays replicated for this var",
+                    var.name, compressor,
+                )
+                su_active = False
+                update_pspec = P()
+        elif kind is SyncKind.ALL_REDUCE and shard_update:
+            logging.debug(
+                "var %s: shard_update has no effect (var is expert/"
+                "partitioned/sparse-sharded or has no data-axis-divisible "
+                "dimension)", var.name,
+            )
 
         shard_dests = folded.get("shard_destinations", ())
         # Reference parity: PS destinations are host CPUs; offload is opt-in
@@ -454,6 +522,7 @@ class GraphTransformer:
             offload=offload,
             shard_destinations=shard_dests,
             storage_shape=storage_shape,
+            shard_update=su_active,
         )
 
     @staticmethod
@@ -767,7 +836,9 @@ class ShardingPlan:
 
     def stale_shardings(self, stale_state) -> Any:
         """Gradient-delay buffers: the var's sharding behind a replicated
-        leading (delay-depth) dim."""
+        leading (delay-depth) dim. (Staleness is a PS-only capability —
+        the AR arm of ``_lower_node`` pins staleness=0 — so zero1
+        shard_update vars never appear here.)"""
         out = {}
         for name, leaf in stale_state.items():
             pspec = self.var_plans[name].pspec if name in self.var_plans else P()
@@ -788,6 +859,7 @@ class ShardingPlan:
         for name, p in self.var_plans.items():
             lines.append(
                 f"  {name}: {p.kind.value} param={p.pspec} update={p.update_pspec}"
+                + (" shard_update=zero1" if p.shard_update else "")
                 + (f" dest={p.reduction_destination}" if p.reduction_destination else "")
                 + (f" shard_dests={list(p.shard_destinations)}"
                    if p.shard_destinations else "")
@@ -851,6 +923,15 @@ class DistributedTrainStep:
         self.compile_log: List[Dict[str, Any]] = []
         self._state_shardings = None
         self._compressors = self._resolve_compressors(plan)
+        # ZeRO-1 (shard_update) vars: gradient sync rendered manually as
+        # reduce-scatter inside the shard_map region (the toolchain's GSPMD
+        # pass renders a psum + sliced consumer as all-reduce +
+        # dynamic-slice, which pays full wire AND forfeits the pinned
+        # reduce-scatter evidence), update computed on the 1/N shard,
+        # params re-gathered by the output shardings.
+        self._shard_update = {
+            name: p for name, p in plan.var_plans.items() if p.shard_update
+        }
         self._stale = {
             name: p.staleness
             for name, p in plan.var_plans.items()
@@ -1027,8 +1108,8 @@ class DistributedTrainStep:
             host_shardings = self.plan.state_shardings(shapes)
             device_shardings = self.plan.state_shardings(shapes, device_view=True)
             state = _stream(state, host_shardings, device_shardings)
-        if self._compressors:
-            loss, aux, grads, new_comp = self._compressed_grads(state, batch)
+        if self._compressors or self._shard_update:
+            loss, aux, grads, new_comp = self._manual_sync_grads(state, batch)
         elif self._accum > 1:
             loss, aux, grads = self._accumulated_grads(state.params, batch)
             new_comp = state.comp_state
@@ -1046,6 +1127,8 @@ class DistributedTrainStep:
             grads, new_stale = self._apply_staleness(grads, state.stale_state)
         updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        if self._shard_update:
+            new_params = self._gather_updated_params(new_params)
         new_state = TrainState(
             step=state.step + 1, params=new_params, opt_state=new_opt,
             comp_state=new_comp, stale_state=new_stale,
@@ -1056,6 +1139,24 @@ class DistributedTrainStep:
         if aux is not None:
             metrics["aux"] = aux
         return new_state, metrics
+
+    def _gather_updated_params(self, params):
+        """Re-gather zero1 (shard_update) parameters to their replicated
+        residency after the sharded update — the all-gather leg of
+        reduce-scatter → sharded update → all-gather (arXiv 2004.13336).
+        The explicit constraint (under a named scope, so profiles attribute
+        the collective) pins the gather HERE; without it the output
+        shardings would still force one, but anonymously at program exit."""
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
+        out = []
+        with jax.named_scope("zero1.all_gather_params"):
+            for path, leaf in leaves:
+                plan = self._shard_update.get(_path_name(path))
+                if plan is not None:
+                    leaf = lax.with_sharding_constraint(
+                        leaf, NamedSharding(self.plan.mesh, plan.pspec))
+                out.append(leaf)
+        return jax.tree_util.tree_unflatten(treedef, out)
 
     # --------------------------------------------- gradient accumulation
     def _accumulated_grads(self, params, batch):
@@ -1148,20 +1249,30 @@ class DistributedTrainStep:
         )
         return loss, aux, grads
 
-    # ------------------------------------------------- compressed grad sync
-    def _compressed_grads(self, state: TrainState, batch):
-        """Gradient sync with compression around the data-axis psum.
+    # ---------------------------------------------- manual gradient sync
+    def _manual_sync_grads(self, state: TrainState, batch):
+        """Gradient sync with an explicit per-variable wire: compression
+        and/or zero1 reduce-scatter around the data-axis psum.
 
         Runs the loss/grad computation inside a ``shard_map`` that is manual
         over the data axis only: each instance sees its local batch shard,
-        computes local-mean grads, and each var's compressor performs the
-        compress → psum → decompress sequence (so the collective itself runs
-        on compressed payloads — the reference wrapped
-        ``collective_ops.all_reduce`` the same way). Model/other mesh axes
-        stay GSPMD-auto (partial-manual mode), so tensor-parallel vars keep
-        their shardings; on a pure-DP mesh the region runs fully manual over
-        a flat data-only mesh view (identical device order), which keeps the
-        long-tested full-manual lowering on the bench path.
+        computes local-mean grads, and each var picks its wire —
+
+        - compressed vars: the compressor's compress → psum → decompress
+          sequence (the collective runs on compressed payloads — the
+          reference wrapped ``collective_ops.all_reduce`` the same way);
+        - ``shard_update`` (zero1) vars: ``lax.psum_scatter`` over the data
+          axis, so each instance exits with its 1/N reduce-scattered
+          gradient slice (arXiv 2004.13336) — the optimizer update outside
+          the region then runs sharded and the output shardings all-gather
+          the fresh params;
+        - everything else: a plain ``lax.psum``.
+
+        Model/other mesh axes stay GSPMD-auto (partial-manual mode), so
+        tensor-parallel vars keep their shardings; on a pure-DP mesh the
+        region runs fully manual over a flat data-only mesh view (identical
+        device order), which keeps the long-tested full-manual lowering on
+        the bench path.
 
         Assumes ``loss_fn`` computes a *mean* over the batch (the reference's
         merge=Add final=Div semantics, all_reduce_synchronizer.py:100-126).
@@ -1175,6 +1286,13 @@ class DistributedTrainStep:
             # Pure DP: flat full-manual view, device order unchanged.
             mesh = Mesh(mesh.devices.reshape(-1), (ax,))
         compressors = self._compressors
+        # zero1 vars: data-axis index of their scatter dimension, taken from
+        # the plan's update spec (always divisible — _weight_update_spec
+        # only picks divisible axes).
+        su_dims = {
+            name: list(p.update_pspec).index(ax)
+            for name, p in self._shard_update.items()
+        }
 
         # Every parameter enters the manual region REPLICATED over the data
         # axis (shard_map all-gathers data-sharded leaves at entry): the
@@ -1182,9 +1300,21 @@ class DistributedTrainStep:
         # feeding a data-row-sliced leaf (e.g. a row-sharded embedding, or a
         # ZeRO-sharded kernel) would silently compute garbage — jnp.take
         # clamps out-of-range ids instead of failing. Grads exit replicated
-        # too (each instance psums the full gradient); GSPMD reshards them
-        # onto the plan's update shardings at the region boundary.
+        # too (each instance psums the full gradient) EXCEPT zero1 vars,
+        # whose reduce-scattered slice exits sharded on its scatter dim;
+        # GSPMD reshards everything onto the plan's update shardings at the
+        # region boundary.
         param_specs = jax.tree_util.tree_map(lambda _: P(), state.params)
+        g_spec_leaves, g_spec_treedef = jax.tree_util.tree_flatten_with_path(
+            state.params)
+        grad_specs = jax.tree_util.tree_unflatten(
+            g_spec_treedef,
+            [
+                (self._shard_update[_path_name(path)].update_pspec
+                 if _path_name(path) in self._shard_update else P())
+                for path, _ in g_spec_leaves
+            ],
+        )
 
         def spec_for_batch(leaf):
             shape = tuple(getattr(leaf, "shape", ()))
@@ -1213,10 +1343,11 @@ class DistributedTrainStep:
                     and (shape[0] // n) % k != 0
                 ):
                     raise ValueError(
-                        f"grad_accum_steps={k} with compression requires each "
-                        f"data shard's batch slice (global {shape[0]} / "
-                        f"{n} shards) to split into {k} microbatches; got "
-                        f"shape {shape}")
+                        f"grad_accum_steps={k} with a manual gradient sync "
+                        f"(compression and/or zero1 shard_update) requires "
+                        f"each data shard's batch slice (global {shape[0]} "
+                        f"/ {n} shards) to split into {k} microbatches; "
+                        f"got shape {shape}")
 
         def local_grads(params, local_batch):
             if has_aux:
@@ -1261,6 +1392,15 @@ class DistributedTrainStep:
             synced = []
             for path, g in g_leaves:
                 name = _path_name(path)
+                if name in su_dims:
+                    # zero1: one reduce-scatter replaces the all-reduce —
+                    # this instance keeps only its 1/n gradient slice, which
+                    # is exactly what its optimizer-state shard consumes.
+                    with jax.named_scope("zero1.reduce_scatter_grads"):
+                        synced.append(lax.psum_scatter(
+                            g / n, ax, scatter_dimension=su_dims[name],
+                            tiled=True))
+                    continue
                 comp = compressors.get(name)
                 if comp is None:
                     synced.append(lax.psum(g, ax) / n)
@@ -1283,7 +1423,7 @@ class DistributedTrainStep:
             local_fn,
             mesh=mesh,
             in_specs=(param_specs, batch_specs, comp_specs),
-            out_specs=(P(), P(), param_specs, comp_specs),
+            out_specs=(P(), P(), grad_specs, comp_specs),
             axis_names={ax},
             check_vma=False,
         )

@@ -10,9 +10,11 @@ closes the loop: a set of ``(predicted components, measured seconds)``
 records fits per-component efficiency coefficients
 
     measured_s ≈ base + a·comm_s + b·update_s + c·latency_s + d·act_sync_s
+                 + e·gather_s
 
 where ``base`` absorbs the compute floor (plus fixed dispatch overhead) and
-``a..d`` the achieved fraction of each nominal peak. The fit REPORTS its
+``a..e`` the achieved fraction of each nominal peak (``gather_s`` is the
+zero1 param re-gather wire — see :data:`COMPONENTS`). The fit REPORTS its
 own ranking error (mean |rel| error before vs after), and is persisted
 per-topology — one file per (accelerator kind × chip count × mesh shape) —
 so it shrinks with use and a calibration measured on one cluster never
@@ -39,7 +41,11 @@ from autodist_tpu.resource_spec import ResourceSpec
 from autodist_tpu.strategy.cost_model import StrategyCost
 from autodist_tpu.utils import logging
 
-COMPONENTS = ("comm_s", "update_s", "latency_s", "act_sync_s")
+# gather_s (added with the zero1 shard_update capability) is the param
+# re-gather wire of weight-update-sharded vars — fitted separately from
+# comm_s because the all-gather overlaps differently with the update than
+# the gradient reduction does with the backward pass.
+COMPONENTS = ("comm_s", "update_s", "latency_s", "act_sync_s", "gather_s")
 # Below this many distinct records the per-component least squares is
 # underdetermined; fall back to the scalar base+scale fit.
 MIN_COMPONENT_POINTS = len(COMPONENTS) + 2
@@ -73,13 +79,15 @@ class CalibrationRecord:
     act_sync_s: float
     measured_s: float
     name: str = ""
+    gather_s: float = 0.0  # zero1 param re-gather wire (0 pre-zero1 records)
     dispatch_gap_s: float = 0.0
     flops_per_step: float = 0.0
     bytes_per_step: float = 0.0
 
     @property
     def predicted_s(self) -> float:
-        return self.comm_s + self.update_s + self.latency_s + self.act_sync_s
+        return (self.comm_s + self.update_s + self.latency_s
+                + self.act_sync_s + self.gather_s)
 
     @classmethod
     def from_cost(cls, cost: StrategyCost, measured_s: float,
@@ -87,6 +95,7 @@ class CalibrationRecord:
         return cls(
             comm_s=cost.comm_s, update_s=cost.update_s,
             latency_s=cost.latency_s, act_sync_s=cost.act_sync_s,
+            gather_s=getattr(cost, "gather_s", 0.0),
             measured_s=float(measured_s), name=name, **extra,
         )
 
@@ -95,6 +104,7 @@ class CalibrationRecord:
             "comm_s": self.comm_s, "update_s": self.update_s,
             "latency_s": self.latency_s, "act_sync_s": self.act_sync_s,
             "measured_s": self.measured_s,
+            **({"gather_s": self.gather_s} if self.gather_s else {}),
             **({"name": self.name} if self.name else {}),
             **({"dispatch_gap_s": self.dispatch_gap_s}
                if self.dispatch_gap_s else {}),
@@ -111,6 +121,7 @@ class CalibrationRecord:
             latency_s=float(d["latency_s"]),
             act_sync_s=float(d["act_sync_s"]),
             measured_s=float(d["measured_s"]), name=str(d.get("name", "")),
+            gather_s=float(d.get("gather_s", 0.0)),
             dispatch_gap_s=float(d.get("dispatch_gap_s", 0.0)),
             flops_per_step=float(d.get("flops_per_step", 0.0)),
             bytes_per_step=float(d.get("bytes_per_step", 0.0)),
@@ -152,17 +163,14 @@ class TopologyCalibration:
 
     # ----------------------------------------------------------------- apply
     def predict_s(self, cost: StrategyCost) -> float:
-        """Calibrated seconds for anything exposing the four component
+        """Calibrated seconds for anything exposing the component
         attributes — a :class:`~autodist_tpu.strategy.cost_model.
         StrategyCost` or a :class:`CalibrationRecord` (one formula, so the
         error grader and the search objective can never drift apart)."""
         c = self.coefficients
-        return (
-            self.base_s
-            + c.get("comm_s", 1.0) * cost.comm_s
-            + c.get("update_s", 1.0) * cost.update_s
-            + c.get("latency_s", 1.0) * cost.latency_s
-            + c.get("act_sync_s", 1.0) * cost.act_sync_s
+        return self.base_s + sum(
+            c.get(comp, 1.0) * getattr(cost, comp, 0.0)
+            for comp in COMPONENTS
         )
 
     def describe(self) -> dict:
@@ -188,16 +196,18 @@ class TopologyCalibration:
         out.error_before = prediction_error(recs, None)
 
         fitted = False
+        n_comp = len(COMPONENTS)
         if len(recs) >= MIN_COMPONENT_POINTS:
             A = np.array(
-                [[r.comm_s, r.update_s, r.latency_s, r.act_sync_s, 1.0]
+                [[getattr(r, c) for c in COMPONENTS] + [1.0]
                  for r in recs], np.float64)
             y = np.array([r.measured_s for r in recs], np.float64)
             # Columns that never vary carry no signal; zero them so lstsq
             # can't spend them on noise (their coefficient stays 1.0).
-            active = [i for i in range(4) if float(np.ptp(A[:, i])) > 1e-12]
+            active = [i for i in range(n_comp)
+                      if float(np.ptp(A[:, i])) > 1e-12]
             if active:
-                cols = active + [4]
+                cols = active + [n_comp]
                 coef, *_ = np.linalg.lstsq(A[:, cols], y, rcond=None)
                 comp_coef = {c: 1.0 for c in COMPONENTS}
                 for i, col in enumerate(active):
@@ -325,7 +335,7 @@ def _merge_records(old: Sequence[CalibrationRecord],
     merged: Dict[tuple, CalibrationRecord] = {}
     for r in list(old) + list(new):
         sig = (r.name, r.comm_s, r.update_s, r.latency_s, r.act_sync_s,
-               r.measured_s)
+               r.gather_s, r.measured_s)
         merged.pop(sig, None)  # re-insert so the newest occurrence is last
         merged[sig] = r
     return list(merged.values())[-MAX_PERSISTED_RECORDS:]

@@ -49,7 +49,13 @@ from autodist_tpu.strategy.ir import (
 )
 from autodist_tpu.utils import logging
 
-KINDS = ("ar", "ps1", "ps3")
+# "zero1" = AllReduce with weight-update sharding (shard_update capability:
+# reduce-scatter grads, 1/N-sharded optimizer update, all-gather params —
+# arXiv 2004.13336); same wire bytes as "ar", ~N× less optimizer HBM, one
+# extra collective dispatch per fusion group. The cost model prices the
+# trade per variable, so search mixes ar (tiny vars) and zero1 (big vars)
+# freely within one plan.
+KINDS = ("ar", "ps1", "ps3", "zero1")
 CHUNK_SIZES = (1, 32, 128, 512)
 
 
@@ -98,7 +104,12 @@ def genome_to_strategy(
     strategy.graph_config.replicas = replica_devices(resource_spec)
     for var, gene in zip(variables, genome):
         partitioner = ""
-        if gene.axis is not None and gene.axis < len(var.shape):
+        if (gene.axis is not None and gene.axis < len(var.shape)
+                and gene.kind != "zero1"):
+            # zero1 renders unpartitioned by definition (replicated param,
+            # sharded update); a partitioned var already shards its update,
+            # so an axis on a zero1 gene would only alias the "ar"+axis
+            # rendering under a second genome spelling.
             k = _shard_count(int(var.shape[gene.axis]), degree)
             if k > 1:
                 parts = [1] * len(var.shape)
@@ -106,6 +117,8 @@ def genome_to_strategy(
                 partitioner = ",".join(map(str, parts))
         if gene.kind == "ar":
             sync = AllReduceSynchronizer(group=gene.group)
+        elif gene.kind == "zero1":
+            sync = AllReduceSynchronizer(group=gene.group, shard_update=True)
         else:
             sync = PSSynchronizer(
                 reduction_destination=dests[gene.dest % len(dests)],
@@ -136,7 +149,8 @@ def strategy_to_genome(strategy: Strategy, model_item: ModelItem,
         except ValueError:
             axis = None  # multi-active-axis tables have no genome rendering
         if isinstance(sync, AllReduceSynchronizer):
-            genes.append(VarGene(kind="ar", axis=axis, group=sync.group))
+            kind = "zero1" if (sync.shard_update and axis is None) else "ar"
+            genes.append(VarGene(kind=kind, axis=axis, group=sync.group))
         else:
             genes.append(VarGene(
                 kind="ps1" if sync.local_replication else "ps3",
@@ -397,7 +411,11 @@ class PlanSearch:
                 "update_s": win_cost.update_s,
                 "latency_s": win_cost.latency_s,
                 "act_sync_s": win_cost.act_sync_s,
+                "gather_s": win_cost.gather_s,
                 "per_chip_gb": win_cost.per_chip_bytes / 1e9,
+                "opt_gb_per_chip": win_cost.opt_bytes / 1e9,
+                "n_shard_update": sum(
+                    1 for g in winner if g.kind == "zero1"),
                 "feasible": win_cost.feasible,
             },
             "improvement_vs_best_seed": improvement,

@@ -23,13 +23,14 @@ from autodist_tpu.strategy.ps_strategy import PS
 from autodist_tpu.strategy.random_axis_partition_all_reduce_strategy import RandomAxisPartitionAR
 from autodist_tpu.strategy.tensor_parallel_strategy import TensorParallel
 from autodist_tpu.strategy.uneven_partition_ps_strategy import UnevenPartitionedPS
+from autodist_tpu.strategy.zero1_strategy import Zero1
 
 BUILTIN_BUILDERS = {
     cls.__name__: cls
     for cls in (
         PS, PSLoadBalancing, PartitionedPS, UnevenPartitionedPS,
         AllReduce, PartitionedAR, RandomAxisPartitionAR, Parallax, Auto,
-        TensorParallel,
+        TensorParallel, Zero1,
     )
 }
 
@@ -76,4 +77,5 @@ __all__ = [
     "StrategyCompiler",
     "TensorParallel",
     "UnevenPartitionedPS",
+    "Zero1",
 ]

@@ -76,10 +76,10 @@ NEAR_TIE_REL_MULTI = 0.01    # multi-chip meshes
 # preferred_prediction so the two surfaces cannot drift. Names absent from
 # this tuple rank last, alphabetically.
 SLATE_PREFERENCE = (
-    "AllReduce", "PartitionedAR", "TensorParallel", "PSLoadBalancing",
-    "PS(zero3)", "PS(zero1)", "Parallax", "RandomAxisPartitionAR",
-    "PartitionedPS", "UnevenPartitionedPS", "AllReduce+bf16",
-    "AllReduce+topk",
+    "AllReduce", "Zero1", "PartitionedAR", "TensorParallel",
+    "PSLoadBalancing", "PS(zero3)", "PS(zero1)", "Parallax",
+    "RandomAxisPartitionAR", "PartitionedPS", "UnevenPartitionedPS",
+    "AllReduce+bf16", "AllReduce+topk",
 )
 
 
@@ -227,9 +227,16 @@ def candidate_slate(
     )
     from autodist_tpu.strategy.tensor_parallel_strategy import TensorParallel
     from autodist_tpu.strategy.uneven_partition_ps_strategy import UnevenPartitionedPS
+    from autodist_tpu.strategy.zero1_strategy import Zero1
 
     slate: List[Tuple[str, object]] = [
         ("AllReduce", AllReduce(chunk_size=chunk_size)),
+        # Weight-update sharding (ZeRO-1, Xu et al. arXiv 2004.13336):
+        # identical wire bytes to the ring all-reduce (rs + ag IS the
+        # ring), optimizer slots + update time ÷ data-axis size; wins on
+        # big dense models, ties (and then loses the tie to AllReduce's
+        # simpler mechanism) on tiny ones. docs/zero.md.
+        ("Zero1", Zero1(chunk_size=chunk_size)),
         ("PartitionedAR", PartitionedAR(chunk_size=chunk_size)),
         # Megatron axis pairing: the winner on model-axis meshes for
         # transformer-shaped models; degrades to ZeRO-style data-axis
@@ -367,10 +374,19 @@ class StrategyCost:
     per_chip_bytes: float  # resident state: params + slots + grad buffer
     hbm_bytes: float       # usable per-chip capacity (already derated)
     n_collectives: int
+    # Param re-gather wire of weight-update-sharded (zero1) vars — the
+    # all-gather leg of rs → sharded update → ag. A separate component (not
+    # folded into comm_s) so the planner's per-topology calibration can fit
+    # its achieved bandwidth independently (plan/calibrate.py COMPONENTS).
+    gather_s: float = 0.0
+    # Per-chip optimizer-slot residency (a subset of per_chip_bytes): the
+    # number zero1 divides by ~N, surfaced as explain's opt/chip column.
+    opt_bytes: float = 0.0
 
     @property
     def total_s(self) -> float:
-        return self.comm_s + self.update_s + self.latency_s + self.act_sync_s
+        return (self.comm_s + self.update_s + self.latency_s
+                + self.act_sync_s + self.gather_s)
 
     @property
     def feasible(self) -> bool:
@@ -380,8 +396,10 @@ class StrategyCost:
         return (
             f"total {self.total_s * 1e3:.3f} ms "
             f"(comm {self.comm_s * 1e3:.3f}, update {self.update_s * 1e3:.3f}, "
-            f"lat {self.latency_s * 1e3:.3f}, act {self.act_sync_s * 1e3:.3f}) "
+            f"lat {self.latency_s * 1e3:.3f}, act {self.act_sync_s * 1e3:.3f}, "
+            f"gather {self.gather_s * 1e3:.3f}) "
             f"mem {self.per_chip_bytes / 1e9:.2f}/{self.hbm_bytes / 1e9:.2f} GB "
+            f"(opt {self.opt_bytes / 1e9:.2f}) "
             f"{'ok' if self.feasible else 'OVER'}"
         )
 
@@ -517,9 +535,9 @@ class CostModel:
 
     def _sparse_cost(
         self, var: VarItem, update_traffic_factor: float
-    ) -> Tuple[float, float, float, float, int]:
-        """(comm_s, update_s, param_bytes, extra_bytes, shards) for a
-        row-sharded sparse table — the lowering's sparse branch, which
+    ) -> Tuple[float, float, float, float, float, int]:
+        """(comm_s, update_s, param_bytes, extra_bytes, opt_bytes, shards)
+        for a row-sharded sparse table — the lowering's sparse branch, which
         applies under both PS and AllReduce synchronizers.
 
         Wire: forward row gather + backward scatter-add of touched rows.
@@ -538,15 +556,18 @@ class CostModel:
             res = B
         update = update_traffic_factor * B * self.sparse_touch / shards / self.hbm_bw
         params = res / shards
-        extra = self.slot_factor * res / shards + wire
-        return comm, update, params, extra, shards
+        opt = self.slot_factor * res / shards
+        extra = opt + wire
+        return comm, update, params, extra, opt, shards
 
     # ------------------------------------------------------------ node costs
     def _node_cost(self, node: NodeConfig, var: VarItem) -> Tuple[
-        float, float, float, float, float, int, Dict[str, float]
+        float, float, float, float, float, float, float, int, bool,
+        Dict[str, float]
     ]:
-        """(comm_s, update_s, act_s, param_bytes, slot+grad bytes,
-        n_collectives, ps_host_loads) for one variable."""
+        """(comm_s, update_s, act_s, gather_s, param_bytes, slot+grad bytes,
+        opt_bytes, n_collectives, shard_update_active, ps_host_loads) for
+        one variable."""
         B = float(var.byte_size)
         sync = node.synchronizer
         update_traffic_factor = 3.0 + 2.0 * self.slot_factor  # param rw + grad r + slots rw
@@ -566,8 +587,10 @@ class CostModel:
             comm = self.allreduce_s(res)
             update = update_traffic_factor * res / self.hbm_bw
             params = res
-            extra = self.slot_factor * res + res
-            return comm, update, 0.0, params, extra, 1, ps_loads
+            opt = self.slot_factor * res
+            extra = opt + res
+            return (comm, update, 0.0, 0.0, params, extra, opt, 1, False,
+                    ps_loads)
 
         if isinstance(sync, AllReduceSynchronizer):
             part_axis = node.active_partition_axis
@@ -582,7 +605,7 @@ class CostModel:
                     # compressor routes the whole grad computation through
                     # the data-manual shard_map, which feeds every param in
                     # REPLICATED — the table all-gathers in and its dense
-                    # gradient psums at full size (_compressed_grads),
+                    # gradient psums at full size (_manual_sync_grads),
                     # erasing the sparse wire savings. Price that honestly
                     # rather than reporting tokens-scaled comm for a
                     # table-scaled program. (On non-pure-DP meshes
@@ -591,20 +614,47 @@ class CostModel:
                     comm = self._oneway_s(B) + self.allreduce_s(B)
                     update = update_traffic_factor * B / self.hbm_bw
                     params = B  # materialized replicated inside the step
-                    extra = self.slot_factor * B + B
-                    return comm, update, 0.0, params, extra, 1, ps_loads
+                    opt = self.slot_factor * B
+                    extra = opt + B
+                    return (comm, update, 0.0, 0.0, params, extra, opt, 1,
+                            False, ps_loads)
                 # Lowering parity: the sparse branch row-shards under
                 # AllReduce exactly like PS (kernel/lowering.py sparse
                 # branch), so the wire is tokens-scaled gather/scatter —
                 # never a dense full-table all-reduce.
-                comm, update, params, extra, _ = self._sparse_cost(
+                comm, update, params, extra, opt, _ = self._sparse_cost(
                     var, update_traffic_factor
                 )
-                return comm, update, 0.0, params, extra, 1, ps_loads
+                return (comm, update, 0.0, 0.0, params, extra, opt, 1,
+                        False, ps_loads)
             shards = self._sharded(var, part_axis)
             res = self._residency_bytes(var, part_axis, shards)
             act = 0.0
             if shards <= 1:
+                from autodist_tpu.kernel.compressor import is_active_compressor
+
+                upd_shards = self._update_axis_shards(var)
+                if (sync.shard_update and upd_shards > 1
+                        and not is_active_compressor(sync.compressor)):
+                    # zero1 weight-update sharding (lowering parity: the
+                    # shard_update branch of _lower_node; same degradation
+                    # rules — compressed or non-divisible vars fall through
+                    # to plain AR below). Wire bytes equal the ring
+                    # all-reduce (rs + ag IS the ring decomposition), but
+                    # split across the comm (reduce-scatter) and gather
+                    # (all-gather) components; the optimizer update and
+                    # slots shard 1/N. Two collectives per fusion group
+                    # (rs + ag) vs the plain AR's one — the latency term
+                    # that makes tiny vars lose.
+                    comm = self._oneway_s(B)
+                    gather = self._oneway_s(B)
+                    update = (update_traffic_factor * B / upd_shards
+                              / self.hbm_bw)
+                    params = B
+                    opt = self.slot_factor * B / upd_shards
+                    extra = opt + B  # sharded slots + full grad buffer
+                    return (comm, update, 0.0, gather, params, extra, opt,
+                            2, True, ps_loads)
                 # Plain DP: one gradient all-reduce over the data group,
                 # compressed at the full gradient shape.
                 comm = self.allreduce_s(
@@ -644,13 +694,15 @@ class CostModel:
                 comm = 3.0 * self._oneway_s(res)
             update = update_traffic_factor * res / shards / self.hbm_bw
             params = res / shards
-            extra = self.slot_factor * res / shards + res  # slots + grad buffer
+            opt = self.slot_factor * res / shards
+            extra = opt + res  # slots + grad buffer
             n_coll = 1
-            return comm, update, act, params, extra, n_coll, ps_loads
+            return (comm, update, act, 0.0, params, extra, opt, n_coll,
+                    False, ps_loads)
 
         assert isinstance(sync, PSSynchronizer)
         if var.sparse_update:
-            comm, update, params, extra, shards = self._sparse_cost(
+            comm, update, params, extra, opt, shards = self._sparse_cost(
                 var, update_traffic_factor
             )
         else:
@@ -676,7 +728,8 @@ class CostModel:
                 comm = 3.0 * self._oneway_s(res)
                 params = res / upd_shards
             update = update_traffic_factor * res / upd_shards / self.hbm_bw
-            extra = self.slot_factor * res / upd_shards + res
+            opt = self.slot_factor * res / upd_shards
+            extra = opt + res
         # Multi-node PS: the destination host's NIC serializes this var's
         # cross-host traffic (reference: all workers push to one PS CPU).
         # A partitioned var's shards may reduce at different hosts
@@ -715,12 +768,15 @@ class CostModel:
                 ps_loads[host] = ps_loads.get(host, 0.0) + load
         act = 0.0
         n_coll = 2  # push + pull round
-        return comm, update, act, params, extra, n_coll, ps_loads
+        return (comm, update, act, 0.0, params, extra, opt, n_coll, False,
+                ps_loads)
 
     # -------------------------------------------------------------- strategy
     def strategy_cost(self, strategy: Strategy) -> StrategyCost:
-        comm = update = act = params_bytes = extra_bytes = 0.0
+        comm = update = act = gather = params_bytes = extra_bytes = 0.0
+        opt_bytes = 0.0
         groups: set = set()
+        su_groups: set = set()
         n_ps_coll = 0
         host_loads: Dict[str, float] = {}
         for node in strategy.node_config:
@@ -728,12 +784,15 @@ class CostModel:
                 var = self.model_item.var(node.var_name)
             except KeyError:
                 continue
-            c, u, a, p, e, n_coll, loads = self._node_cost(node, var)
+            (c, u, a, g, p, e, ob, n_coll, su_active,
+             loads) = self._node_cost(node, var)
             comm += c
             update += u
             act += a
+            gather += g
             params_bytes += p
             extra_bytes += e
+            opt_bytes += ob
             for h, load in loads.items():
                 host_loads[h] = host_loads.get(h, 0.0) + load
             sync = node.synchronizer
@@ -743,14 +802,18 @@ class CostModel:
                      if isinstance(p.synchronizer, AllReduceSynchronizer)]
                     or [sync.group]
                 )
-                groups.update(leaf_groups)
+                # zero1 fusion groups dispatch TWO collectives (rs + ag)
+                # where a plain AR group dispatches one; keep them apart so
+                # the latency term reflects the extra dispatch (this is
+                # what makes shard_update lose on a model of tiny vars).
+                (su_groups if su_active else groups).update(leaf_groups)
             else:
                 n_ps_coll += n_coll
         # PS destination NIC serialization dominates the hierarchical
         # all-reduce estimate for those vars; charge the slower of the two.
         if host_loads:
             comm = max(comm, max(host_loads.values()))
-        n_collectives = len(groups) + n_ps_coll
+        n_collectives = len(groups) + 2 * len(su_groups) + n_ps_coll
         latency = n_collectives * self.latency
         per_chip = params_bytes + extra_bytes
         return StrategyCost(
@@ -758,9 +821,11 @@ class CostModel:
             update_s=update,
             latency_s=latency,
             act_sync_s=act,
+            gather_s=gather,
             per_chip_bytes=per_chip,
             hbm_bytes=self.hbm_cap,
             n_collectives=n_collectives,
+            opt_bytes=opt_bytes,
         )
 
     def rank(

@@ -98,7 +98,8 @@ def explain(
         )
     header = (
         f"{'strategy':22s} {'total':>10s} {'comm':>10s} {'update':>9s} "
-        f"{'latency':>9s} {'act':>9s} {'mem/chip':>10s} {'fits':>5s}"
+        f"{'latency':>9s} {'act':>9s} {'gather':>9s} {'mem/chip':>10s} "
+        f"{'opt/chip':>10s} {'fits':>5s}"
         + (f" {'calib':>10s}" if calibration is not None else "")
         + (f" {'measured':>10s}" if measured else "")
     )
@@ -108,7 +109,9 @@ def explain(
         row = (
             f"{name:22s} {cost.total_s * 1e3:8.3f}ms {cost.comm_s * 1e3:8.3f}ms "
             f"{cost.update_s * 1e3:7.3f}ms {cost.latency_s * 1e3:7.3f}ms "
-            f"{cost.act_sync_s * 1e3:7.3f}ms {cost.per_chip_bytes / 1e9:8.2f}GB "
+            f"{cost.act_sync_s * 1e3:7.3f}ms {cost.gather_s * 1e3:7.3f}ms "
+            f"{cost.per_chip_bytes / 1e9:8.2f}GB "
+            f"{cost.opt_bytes / 1e9:8.2f}GB "
             f"{'yes' if cost.feasible else 'NO':>5s}"
         )
         if calibration is not None:
@@ -202,11 +205,20 @@ def explain_provenance(provenance: dict, out=None) -> None:
         f"(comm {w.get('comm_s', 0.0) * 1e3:.3f}, "
         f"update {w.get('update_s', 0.0) * 1e3:.3f}, "
         f"lat {w.get('latency_s', 0.0) * 1e3:.3f}, "
-        f"act {w.get('act_sync_s', 0.0) * 1e3:.3f}), "
+        f"act {w.get('act_sync_s', 0.0) * 1e3:.3f}, "
+        f"gather {w.get('gather_s', 0.0) * 1e3:.3f}), "
         f"{w.get('per_chip_gb', 0.0):.2f} GB/chip "
+        f"(opt {w.get('opt_gb_per_chip', 0.0):.2f}) "
         f"{'ok' if w.get('feasible') else 'OVER'}",
         file=out,
     )
+    if w.get("n_shard_update"):
+        print(
+            f"zero1: {w['n_shard_update']} vars carry shard_update "
+            f"(reduce-scatter grads, 1/N-sharded optimizer update, "
+            f"all-gather params — docs/zero.md)",
+            file=out,
+        )
     calib = provenance.get("calibration")
     if calib:
         print(

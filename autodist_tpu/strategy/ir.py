@@ -98,10 +98,22 @@ class AllReduceSynchronizer:
     spec: str = AllReduceSpec.AUTO
     compressor: str = "NoneCompressor"  # see kernel/compressor.py registry
     group: int = 0                      # collective fusion group id (advisory)
+    # Weight-update sharding (ZeRO-1, arXiv 2004.13336) for an otherwise
+    # replicated all-reduce variable: the gradient sync renders as a
+    # reduce-scatter over the data axis, the optimizer slots and update
+    # computation live 1/N-sharded between steps, and fresh values
+    # all-gather back — same numerics as all-reduce + replicated update,
+    # ~N× less optimizer HBM. Lowering honors it only where it has a
+    # rendering: dense, unpartitioned, uncompressed variables with a
+    # data-axis-divisible dimension (docs/zero.md).
+    shard_update: bool = False
 
     def __post_init__(self):
         if self.spec not in AllReduceSpec.VALID:
             raise ValueError(f"invalid all-reduce spec {self.spec!r}")
+        if not isinstance(self.shard_update, bool):
+            raise ValueError(
+                f"shard_update must be a bool, got {self.shard_update!r}")
 
 
 Synchronizer = Union[PSSynchronizer, AllReduceSynchronizer]

@@ -161,17 +161,44 @@ def _preflight(timeouts=None, backoffs=None) -> bool:
     # there to catch a wedge that clears, not to spend the round probing
     # while the measurement (or at least the CPU-smoke fallback) starves.
     preflight_deadline = time.monotonic() + BUDGET.total / 2.0
+    # Smallest window in which a probe attempt is still meaningful: a probe
+    # clamped far below its intended timeout would misreport a healthy-but-
+    # slow accelerator as wedged, and (the BENCH_r05 regression) retrying
+    # with no budget left just parks the process in a sleep for the driver's
+    # SIGKILL to find.
+    min_probe_s = 30.0
     for i, t in enumerate(timeouts):
         if BUDGET.expired() or time.monotonic() > preflight_deadline:
             print("bench: preflight budget exhausted; assuming wedged",
                   file=sys.stderr)
             return False
+        if BUDGET.remaining() < min(t, min_probe_s):
+            # Budget-aware stop (BENCH_r05: rc=124, parsed null — the
+            # driver timeout fired mid-ladder): when the remaining budget
+            # cannot cover another probe, stop retrying NOW so the caller
+            # still has time to emit the cached-fallback line.
+            print(
+                f"bench: remaining budget ({BUDGET.remaining():.0f}s) cannot "
+                f"cover probe {i + 1}/{len(timeouts)}; stopping the ladder",
+                file=sys.stderr,
+            )
+            return False
         if _probe_once(t):
             return True
         if i + 1 < len(timeouts):
+            next_t = timeouts[i + 1]
             wait = backoffs[i] if i < len(backoffs) else 0.0
             wait = max(0.0, min(wait, preflight_deadline - time.monotonic(),
                                 BUDGET.remaining()))
+            if BUDGET.remaining() - wait < min(next_t, min_probe_s):
+                # Sleeping would eat the budget the NEXT probe needs —
+                # don't park the process in a sleep the driver timeout
+                # would interrupt; give up on the ladder instead.
+                print(
+                    "bench: backoff would exhaust the budget before another "
+                    "probe could run; stopping the ladder", file=sys.stderr,
+                )
+                return False
             print(
                 f"bench: accelerator probe {i + 1}/{len(timeouts)} timed out "
                 f"({t:.0f}s); retrying in {wait:.0f}s",
@@ -651,6 +678,24 @@ def _emergency_line(errors: dict, reason: str) -> dict:
 
 
 def main() -> None:
+    """Wrapper enforcing the one-JSON-line contract unconditionally:
+    whatever goes wrong inside the run — an unexpected exception, a
+    KeyboardInterrupt, a bug in a fallback path itself — the process still
+    prints a driver-parseable line (with the cached last-verified
+    accelerator number when one exists) before exiting. BENCH_r05's lesson
+    generalized: rc must never arrive with parsed: null."""
+    try:
+        _main()
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 - the line contract is absolute
+        print(json.dumps(_emergency_line(
+            {}, f"bench crashed before emitting: {type(e).__name__}: {e}")),
+            flush=True)
+        sys.exit(1)
+
+
+def _main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model",
                     choices=("bert", "resnet", "bert_large", "both"),

@@ -78,6 +78,63 @@ def test_preflight_stops_at_first_success(bench, monkeypatch):
     assert calls == [1.0, 2.0]
 
 
+def test_preflight_stops_when_budget_cannot_cover_probe(bench, monkeypatch):
+    # PR-5 satellite (BENCH_r05: rc=124, parsed null — the driver timeout
+    # fired mid-sleep between probe retries): with less budget left than a
+    # meaningful probe needs, the ladder must refuse to start/continue so
+    # the caller can still emit the cached-fallback line.
+    calls = []
+    monkeypatch.setattr(bench, "_probe_once",
+                        lambda t: (calls.append(t), False)[1])
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setenv("BENCH_PREFLIGHT_TIMEOUTS", "120,180")
+    monkeypatch.setattr(bench.BUDGET, "total", 60.0)
+    monkeypatch.setattr(bench.BUDGET, "t0", bench.time.monotonic())
+    # remaining ≈ 60 - 45 reserve = 15s < the 30s meaningful-probe floor.
+    assert bench._preflight() is False
+    assert calls == []  # never probed — no budget to probe WITH
+
+
+def test_preflight_skips_backoff_that_starves_next_probe(bench, monkeypatch):
+    # The mid-ladder variant: probing is affordable now, but the configured
+    # backoff would burn the budget the NEXT probe needs — stop instead of
+    # parking in a sleep for the driver's SIGTERM to find.
+    calls, sleeps = [], []
+    monkeypatch.setattr(bench, "_probe_once",
+                        lambda t: (calls.append(t), False)[1])
+    monkeypatch.setattr(bench.time, "sleep", lambda s: sleeps.append(s))
+    monkeypatch.setenv("BENCH_PREFLIGHT_TIMEOUTS", "10,60")
+    monkeypatch.setenv("BENCH_PREFLIGHT_BACKOFFS", "600")
+    monkeypatch.setattr(bench.BUDGET, "total", 130.0)
+    monkeypatch.setattr(bench.BUDGET, "t0", bench.time.monotonic())
+    assert bench._preflight() is False
+    assert calls == [10.0]  # first probe ran; the retry was unaffordable
+    assert sleeps == []     # and it never slept toward the deadline
+
+
+def test_main_emits_line_even_on_unexpected_crash(bench, tmp_path,
+                                                  monkeypatch, capsys):
+    # The one-JSON-line contract is unconditional: an exception escaping
+    # the run body still prints a parseable (cached-fallback) line.
+    monkeypatch.setattr(bench, "LAST_ACCEL_PATH",
+                        str(tmp_path / "bench_last_accel.json"))
+    bench._store_last_accel({"metric": "bert_base_mfu", "value": 0.69,
+                             "unit": "mfu", "vs_baseline": 1.38})
+
+    def boom():
+        raise RuntimeError("boom")
+
+    monkeypatch.setattr(bench, "_main", boom)
+    with pytest.raises(SystemExit):
+        bench.main()
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")]
+    assert lines, "no JSON line emitted on crash"
+    parsed = json.loads(lines[-1])
+    assert "boom" in parsed["error"]
+    assert parsed["cached"] is True and parsed["value"] == 0.69
+
+
 def test_last_accel_cache_round_trips(bench, tmp_path, monkeypatch):
     # A successful run's cache must come back attached to a later fallback
     # line, clearly labeled with its capture time.

@@ -267,13 +267,16 @@ class TestFeasibility:
 class TestAutoIntegration:
     def test_auto_respects_memory_pressure(self):
         # Under a tight HBM budget Auto must NOT pick plain AllReduce: the
-        # replicated optimizer state cannot fit.
+        # replicated optimizer state cannot fit. A zero1 (shard_update)
+        # choice counts as sharded — it shards exactly the optimizer state
+        # that overflowed.
         item = _item({"w": (8192, 8192), "b": (8192,)}, opt="adam")
         s = Auto().build(item, _single(chips=8, hbm_gb=1.0))
         from autodist_tpu.strategy.ir import AllReduceSynchronizer
 
         all_plain_ar = all(
-            isinstance(n.synchronizer, AllReduceSynchronizer) and not n.partitioner
+            isinstance(n.synchronizer, AllReduceSynchronizer)
+            and not n.partitioner and not n.synchronizer.shard_update
             for n in s.node_config
         )
         assert not all_plain_ar
@@ -564,6 +567,75 @@ def test_shard_destinations_spread_ps_nic_load():
     assert loads_spread["10.0.0.2"] == pytest.approx(total / 2)
     # Both shards on one host re-accumulate to the full load there.
     assert loads_packed["10.0.0.1"] == pytest.approx(total)
+
+
+class TestWeightUpdateSpecParity:
+    """PR-5 satellite: the ``cost_model._update_axis_shards`` docstring
+    claims parity with lowering's ``_weight_update_spec`` — now that AR
+    (zero1) vars shard their update through the same pair, drift between
+    the two would silently desync pricing from the program. Executable
+    form: for a sweep of shapes × data-axis sizes, the shard count the
+    lowering realizes equals the one the cost model divides by."""
+
+    SHAPES = [
+        (), (3,), (8,), (64,), (7, 3), (8, 3), (64, 64), (3, 64),
+        (5, 7, 11), (16, 24, 2), (1, 8), (2, 2, 2),
+    ]
+
+    def _lowering_shards(self, mesh, shape):
+        from autodist_tpu.kernel.lowering import GraphTransformer
+        from autodist_tpu.model_item import VarItem
+        from autodist_tpu.strategy.ir import Strategy
+
+        item = _item({"w": (4, 4)})
+        gt = GraphTransformer(Strategy(), item, mesh)
+        var = VarItem(name="w", shape=tuple(shape), dtype="float32")
+        spec = gt._weight_update_spec(var)
+        entries = tuple(spec)
+        if not any(e is not None for e in entries):
+            return 1
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        (axis_name,) = [e for e in entries if e is not None]
+        return sizes[axis_name]
+
+    @pytest.mark.parametrize("ndev", [1, 2, 4, 8])
+    def test_shard_counts_agree(self, ndev):
+        import jax
+        from jax.sharding import Mesh
+        from autodist_tpu.model_item import VarItem
+
+        mesh = Mesh(np.array(jax.devices()[:ndev]).reshape(ndev), ("data",))
+        spec = _single(chips=ndev)
+        cm = CostModel(_item({"w": (4, 4)}), spec)
+        assert cm.n_data == ndev
+        for shape in self.SHAPES:
+            var = VarItem(name="w", shape=tuple(shape), dtype="float32")
+            assert (self._lowering_shards(mesh, shape)
+                    == cm._update_axis_shards(var)), (
+                f"shape {shape} on {ndev} devices: lowering and cost model "
+                f"disagree on update-shard count")
+
+    def test_zero1_full_pipeline_parity(self):
+        # End-to-end: lower a Zero1 strategy and check every var's PRICED
+        # opt residency divisor equals the REALIZED update-spec divisor.
+        import jax
+        from autodist_tpu.kernel import GraphTransformer, build_mesh
+        from autodist_tpu.strategy import Zero1
+        from autodist_tpu.strategy.base import StrategyCompiler
+
+        item = _item({"big": (64, 64), "odd": (7, 3), "vec": (64,)},
+                     opt="adam")
+        spec = _single(chips=8)
+        strategy = StrategyCompiler(item).compile(Zero1().build(item, spec))
+        plan = GraphTransformer(
+            strategy, item, build_mesh(spec)).transform()
+        cm = CostModel(item, spec)
+        for name in ("big", "odd", "vec"):
+            p = plan.plan_for(name)
+            realized = 8 if any(
+                e is not None for e in tuple(p.update_pspec)) else 1
+            assert realized == cm._update_axis_shards(item.var(name)), name
+            assert p.shard_update == (realized > 1), name
 
 
 def test_slate_preference_matches_candidate_slate_order():
