@@ -1,0 +1,141 @@
+"""shardlint: static sharding/collective/memory analysis of lowered programs.
+
+AutoDist's premise is that the strategy compiler — not the user — is
+accountable for what the transformed graph actually does; GSPMD
+(arXiv 2105.04663) inserts resharding collectives silently wherever
+annotations are inconsistent, so "the strategy said reduce-scatter" and
+"the program carries reduce-scatter" are different claims. This subsystem
+checks the second claim statically: take (Strategy, ShardingPlan,
+ResourceSpec, compiled HLO text) and produce a structured findings report
+with no device execution — it runs on CPU under ``JAX_PLATFORMS=cpu``.
+
+Surfaces:
+
+- :func:`analyze_plan` — plan-only passes (degradation drift, static HBM
+  budget, optional strategy screen): what ``plan/cache.py`` runs before
+  trusting a cached winner;
+- :func:`analyze_program` — the above plus wire conformance and alias
+  hazards against a compiled program's
+  :class:`~autodist_tpu.analysis.inventory.CollectiveInventory`: what
+  ``strategy/explain.py --lint``, ``bench.py --lint`` and the tier-1 wire
+  pins ride;
+- ``python -m autodist_tpu.analysis --selftest`` — the CPU proof: every
+  dryrun family's pinned wire re-derived with zero findings, plus seeded
+  defects that MUST trip each pass (docs/analysis.md).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from autodist_tpu.analysis.inventory import (
+    COLLECTIVE_KINDS,
+    COLLECTIVE_OPS,
+    Collective,
+    CollectiveInventory,
+    assert_hlo_wire,
+    collective_sizes,
+    compiled_hlo,
+    hlo_contains,
+)
+from autodist_tpu.analysis.report import (
+    FINDING_CODES,
+    AnalysisError,
+    AnalysisReport,
+    Finding,
+    report_to_text,
+)
+from autodist_tpu.analysis.passes import (
+    DEFAULT_HEADROOM,
+    alias_hazards,
+    batch_element_count,
+    degradation_check,
+    hbm_budget,
+    rendezvous_hazards,
+    screen_strategy,
+    wire_conformance,
+)
+
+
+def analyze_plan(
+    plan,
+    strategy=None,
+    resource_spec=None,
+    optimizer: str = "",
+    headroom: float = DEFAULT_HEADROOM,
+    temp_bytes: float = 0.0,
+    program: str = "",
+) -> AnalysisReport:
+    """Static passes over a lowered :class:`ShardingPlan` (no program text
+    needed): degradation drift vs the shared predicate, and — when a
+    ``resource_spec`` is given — the per-chip HBM budget. This is the
+    validation the plan cache runs on every hit."""
+    report = AnalysisReport(program=program)
+    report.extend(degradation_check(plan, strategy))
+    mem_findings, mem_summary = hbm_budget(
+        plan, resource_spec=resource_spec, optimizer=optimizer,
+        headroom=headroom, temp_bytes=temp_bytes)
+    report.extend(mem_findings)
+    report.tables["memory"] = mem_summary
+    return report
+
+
+def analyze_program(
+    plan,
+    hlo_text: str,
+    strategy=None,
+    resource_spec=None,
+    optimizer: str = "",
+    headroom: float = DEFAULT_HEADROOM,
+    temp_bytes: float = 0.0,
+    batch=None,
+    batch_elements: Optional[int] = None,
+    program: str = "",
+) -> AnalysisReport:
+    """Full analysis of one compiled program: everything
+    :func:`analyze_plan` checks plus wire conformance (the program's
+    collective inventory diffed against the plan's promised wire) and
+    donated-buffer alias hazards. ``batch`` (or ``batch_elements``)
+    supplies the activation allowance — pass the training batch whenever
+    you have one, or token-scale collectives on tiny models read as
+    unplanned."""
+    report = analyze_plan(
+        plan, strategy=strategy, resource_spec=resource_spec,
+        optimizer=optimizer, headroom=headroom, temp_bytes=temp_bytes,
+        program=program)
+    if batch_elements is None and batch is not None:
+        batch_elements = batch_element_count(batch)
+    inventory = CollectiveInventory.from_hlo(hlo_text, program=program)
+    wire_findings, wire_table = wire_conformance(
+        plan, inventory, batch_elements=batch_elements)
+    report.extend(wire_findings)
+    report.extend(alias_hazards(hlo_text))
+    report.tables["wire"] = wire_table
+    report.tables["inventory"] = inventory.to_json()
+    return report
+
+
+__all__ = [
+    "COLLECTIVE_KINDS",
+    "COLLECTIVE_OPS",
+    "AnalysisError",
+    "AnalysisReport",
+    "Collective",
+    "CollectiveInventory",
+    "DEFAULT_HEADROOM",
+    "FINDING_CODES",
+    "Finding",
+    "alias_hazards",
+    "analyze_plan",
+    "analyze_program",
+    "assert_hlo_wire",
+    "batch_element_count",
+    "collective_sizes",
+    "compiled_hlo",
+    "degradation_check",
+    "hbm_budget",
+    "hlo_contains",
+    "rendezvous_hazards",
+    "report_to_text",
+    "screen_strategy",
+    "wire_conformance",
+]

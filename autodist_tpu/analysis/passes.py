@@ -1,0 +1,562 @@
+"""shardlint passes: diff a lowered program against what its plan promises.
+
+Three pass families, all static (CPU-only, nothing executes):
+
+- **wire conformance** (:func:`wire_conformance`) — the program's
+  :class:`~autodist_tpu.analysis.inventory.CollectiveInventory` against the
+  plan's :meth:`~autodist_tpu.kernel.lowering.ShardingPlan.promised_wire`:
+  planned op kinds must be present (SLW002), and no collective may carry a
+  payload only an UNPLANNED wire explains (SLW001) — the GSPMD resharding
+  leak (a full-table collective for a row-sharded sparse var) and the
+  zero1 re-fusion regression (a full-gradient all-reduce for a
+  shard_update var) both land here. Payload thresholds are deliberately
+  conservative: activation-scale traffic (token gathers, TP partial sums,
+  expert dispatch) is inherently data-dependent, so the pass only flags
+  payloads that exceed EVERY planned source including the activation
+  allowance derived from ``batch_elements``.
+- **static HBM budget** (:func:`hbm_budget`) — per-chip params + optimizer
+  slots (sharded per the plan's update specs — the ``_weight_update_spec``
+  accounting) + a full-gradient transient, plus the compiled program's
+  temp/peak when given, against the ResourceSpec's per-chip HBM with a
+  configurable headroom (SLM001/SLM002): overcommit is a lint error, not
+  an OOM at step 1.
+- **hazards** — degradation drift between plan flags and the shared
+  ``kernel/degrade.py`` predicate (SLH003), replica-group ordering
+  mismatches across programs that will rendezvous (SLH001, the
+  pipeline/MPMD deadlock mode), and donated-buffer alias size mismatches
+  (SLH002). :func:`screen_strategy` is the pre-lowering subset the
+  planner's search runs before pricing a candidate (SLS001).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from autodist_tpu.analysis.inventory import CollectiveInventory
+from autodist_tpu.analysis.report import (
+    ERROR,
+    INFO,
+    WARNING,
+    Finding,
+)
+
+# Default fraction of per-chip HBM the static state may use; matches the
+# cost model's HBM_USABLE_FRACTION so lint and pricing agree on "fits".
+DEFAULT_HEADROOM = 0.75
+
+
+def batch_element_count(batch) -> int:
+    """Total elements across a batch pytree's leaves — the activation
+    allowance input for :func:`wire_conformance` (shapes only; nothing is
+    read or transferred)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(batch):
+        shape = np.shape(leaf)
+        total += int(np.prod(shape)) if shape else 1
+    return total
+
+
+# ---------------------------------------------------------------------- wire
+def wire_conformance(
+    plan,
+    inventory: CollectiveInventory,
+    batch_elements: Optional[int] = None,
+) -> Tuple[List[Finding], List[Dict]]:
+    """Diff the program's collectives against the plan's promised wire.
+
+    Returns ``(findings, table)`` where ``table`` is the per-variable
+    planned-vs-actual rows ``explain --lint`` renders.
+    """
+    findings: List[Finding] = []
+    wires = plan.promised_wire()
+    mesh_sizes = dict(zip(plan.mesh.axis_names, plan.mesh.devices.shape))
+    n_total = int(np.prod(list(mesh_sizes.values()))) if mesh_sizes else 1
+    from autodist_tpu.kernel.mesh import data_axis
+
+    n_data = int(mesh_sizes.get(data_axis(plan.mesh), 1))
+    if n_total <= 1:
+        # One chip emits no collectives at all (XLA elides them): nothing
+        # to conform.
+        return findings, []
+
+    trainable = {n: w for n, w in wires.items()
+                 if w.rendering != "nontrainable"}
+
+    # Activation allowance: collectives whose payload scales with the batch
+    # (token gathers, TP partial sums, ring K/V chunks, expert dispatch)
+    # are planned wire too, but their size is data- not plan-dependent.
+    # bound = batch elements x the widest TRAILING dim any sharded var can
+    # fan a token into (a gather/matmul fans each token into shape[-1]
+    # features — never into the row count, which is what a leak moves).
+    # Without a batch hint the allowance is zero and the caller accepts a
+    # stricter (possibly over-eager on tiny models) check.
+    sharded = [w for w in trainable.values()
+               if w.rendering in ("sparse", "expert", "partitioned", "zero3")]
+    max_fan = 1
+    for w in sharded:
+        shape = tuple(plan.var_plans[w.var].var.shape) or (1,)
+        max_fan = max(max_fan, int(shape[-1]))
+    act_allow = int(batch_elements or 0) * int(max_fan)
+
+    # ----------------------------------------------- missing collectives
+    for w in trainable.values():
+        for op in w.require:
+            if not inventory.has(op):
+                findings.append(Finding(
+                    code="SLW002", severity=ERROR, var=w.var,
+                    pass_name="wire",
+                    message=(
+                        f"plan promises {op!r} for var {w.var!r} "
+                        f"({w.rendering} rendering) but the compiled "
+                        f"program carries none"),
+                    details={"op": op, "rendering": w.rendering},
+                ))
+    if trainable and n_data > 1 and not (
+            inventory.has("all-reduce") or inventory.has("reduce-scatter")):
+        findings.append(Finding(
+            code="SLW002", severity=ERROR, pass_name="wire",
+            message=(
+                f"data-parallel degree {n_data} with trainable variables "
+                f"but the program carries no gradient-reduction collective "
+                f"(no all-reduce, no reduce-scatter)"),
+        ))
+
+    # ------------------------------------------------ unplanned payloads
+    def allow_sum(op: str, exclude: str = "") -> int:
+        return sum(w.storage_elements for w in trainable.values()
+                   if op in w.allow and w.var != exclude)
+
+    su = [w for w in trainable.values() if w.shard_update]
+    if su:
+        min_su = min(w.storage_elements for w in su)
+        ar_allow = allow_sum("all-reduce") + act_allow
+        for c in inventory.by_op("all-reduce"):
+            p = c.max_payload_elements
+            if p >= min_su and p > ar_allow:
+                findings.append(Finding(
+                    code="SLW001", severity=ERROR, pass_name="wire",
+                    var=min(
+                        (w.var for w in su if w.storage_elements <= p),
+                        key=lambda v: wires[v].storage_elements, default=""),
+                    message=(
+                        f"all-reduce carries a shard_update-sized payload "
+                        f"({p} elems >= smallest zero1 var {min_su}): the "
+                        f"planned reduce-scatter wire re-fused into "
+                        f"all-reduce (docs/zero.md regression)"),
+                    details={"payload_elements": p, "min_su": min_su,
+                             "allowance": ar_allow},
+                ))
+    for w in trainable.values():
+        if not w.sparse_row_sharded:
+            continue
+        for c in inventory.collectives:
+            p = c.max_payload_elements
+            other = allow_sum(c.op, exclude=w.var) + act_allow
+            if p >= w.storage_elements and p > other:
+                findings.append(Finding(
+                    code="SLW001", severity=ERROR, var=w.var,
+                    pass_name="wire",
+                    message=(
+                        f"{c.op} moves a full-table payload ({p} elems >= "
+                        f"table {w.storage_elements}) for row-sharded "
+                        f"sparse var {w.var!r}: sync wire must scale with "
+                        f"touched rows, never the table (GSPMD resharding "
+                        f"leak)"),
+                    details={"op": c.op, "payload_elements": p,
+                             "table_elements": w.storage_elements,
+                             "allowance": other},
+                ))
+
+    # Informational: payloads no planned source (incl. the activation
+    # allowance) accounts for — GSPMD resharding worth a look, below the
+    # error bar because attribution under op fusion is heuristic.
+    for op in inventory.ops():
+        bound = allow_sum(op) + act_allow
+        p = inventory.max_payload(op)
+        if p > bound:
+            findings.append(Finding(
+                code="SLW003", severity=INFO, pass_name="wire",
+                message=(
+                    f"{op} payload of {p} elems exceeds the summed planned "
+                    f"{op} wire ({bound} elems incl. activation allowance) "
+                    f"— possible GSPMD resharding"),
+                details={"op": op, "payload_elements": p, "allowance": bound},
+            ))
+
+    # --------------------------------------------- planned-vs-actual table
+    table: List[Dict] = []
+    for name, w in sorted(wires.items()):
+        if w.rendering == "nontrainable":
+            continue
+        planned_ops = tuple(w.require) or tuple(w.allow)
+        matched = []
+        for c in inventory.collectives:
+            for _dt, dims in c.results:
+                elems = int(np.prod(dims)) if dims else 1
+                candidates = {w.storage_elements}
+                for k in mesh_sizes.values():
+                    if k > 1:
+                        candidates.add(-(-w.storage_elements // int(k)))
+                if elems in candidates and (
+                        c.op in w.allow or c.op in w.require):
+                    matched.append(c)
+                    break
+        table.append({
+            "var": name,
+            "rendering": w.rendering,
+            "planned_ops": list(planned_ops),
+            "planned_bytes": int(w.storage_bytes),
+            "actual_ops": sorted({c.op for c in matched}),
+            "actual_bytes": (sum(c.result_bytes for c in matched)
+                             if matched else None),
+            "degradations": list(w.degradations),
+        })
+    return findings, table
+
+
+# -------------------------------------------------------------------- memory
+def hbm_budget(
+    plan,
+    resource_spec=None,
+    optimizer: str = "",
+    headroom: float = DEFAULT_HEADROOM,
+    temp_bytes: float = 0.0,
+) -> Tuple[List[Finding], Dict]:
+    """Static per-chip HBM budget from the lowered plan.
+
+    State = params (sharded per ``pspec``, padded storage shapes) +
+    optimizer slots (sharded per ``update_pspec`` — the
+    ``_weight_update_spec`` accounting the cost model prices) + one
+    full-gradient transient per trainable var; ``temp_bytes`` adds the
+    compiled program's own temp/peak figure when the caller has one
+    (``DistributedTrainStep.window_cost``). Host-offloaded vars live in
+    pinned host memory and are excluded from the HBM sum.
+    """
+    from autodist_tpu.strategy.cost_model import OPTIMIZER_SLOT_FACTOR
+
+    findings: List[Finding] = []
+    mesh_sizes = dict(zip(plan.mesh.axis_names, plan.mesh.devices.shape))
+    slot_factor = OPTIMIZER_SLOT_FACTOR.get(optimizer, 2.0)
+
+    def shards_of(pspec) -> int:
+        k = 1
+        for e in tuple(pspec):
+            if e is None:
+                continue
+            for name in (e if isinstance(e, tuple) else (e,)):
+                k *= int(mesh_sizes.get(name, 1))
+        return max(k, 1)
+
+    state = 0.0
+    per_var: Dict[str, float] = {}
+    for name, p in plan.var_plans.items():
+        elems = int(np.prod(p.storage_shape or tuple(p.var.shape) or (1,)))
+        b = float(elems) * np.dtype(p.var.dtype).itemsize
+        if p.offload:
+            continue  # pinned-host residency: not an HBM tenant
+        contrib = b / shards_of(p.pspec)
+        if p.var.trainable:
+            contrib += slot_factor * b / shards_of(p.update_pspec)
+            contrib += b  # transient full-gradient buffer
+        state += contrib
+        per_var[name] = contrib
+    capacity = float(resource_spec.tpu.hbm_bytes) if resource_spec else 0.0
+    usable = capacity * headroom
+    n_chips = max(int(resource_spec.num_chips), 1) if resource_spec else 1
+    summary = {
+        "state_gb_per_chip": state / 1e9,
+        "temp_gb_per_chip": float(temp_bytes) / 1e9,
+        "capacity_gb_per_chip": capacity / 1e9,
+        "usable_gb_per_chip": usable / 1e9,
+        "headroom": headroom,
+        "n_chips": n_chips,
+        "top_vars": sorted(per_var, key=per_var.get, reverse=True)[:5],
+    }
+    if resource_spec is None:
+        return findings, summary
+    if state > usable:
+        findings.append(Finding(
+            code="SLM001", severity=ERROR, pass_name="memory",
+            message=(
+                f"static state {state / 1e9:.3f} GB/chip overcommits "
+                f"{usable / 1e9:.3f} GB usable "
+                f"({headroom:.0%} headroom of {capacity / 1e9:.2f} GB "
+                f"HBM): OOM at step 1, re-shard or offload"),
+            details=summary,
+        ))
+    elif temp_bytes and state + float(temp_bytes) > usable:
+        findings.append(Finding(
+            code="SLM002", severity=ERROR, pass_name="memory",
+            message=(
+                f"state {state / 1e9:.3f} GB + compiled temp "
+                f"{float(temp_bytes) / 1e9:.3f} GB/chip overcommits "
+                f"{usable / 1e9:.3f} GB usable"),
+            details=summary,
+        ))
+    return findings, summary
+
+
+# ------------------------------------------------------------------- hazards
+def degradation_check(plan, strategy=None) -> List[Finding]:
+    """Plan flags vs the ONE shared degradation predicate (SLH003).
+
+    With ``strategy`` given, each node's shard_update REQUEST is replayed
+    through ``kernel.degrade.zero1_degradation_reasons`` on this mesh and
+    compared against what the plan actually flags — the check that catches
+    a lowering rule drifting away from pricing/analysis within one package
+    version. Degradations themselves are declared (info), never errors.
+    """
+    from autodist_tpu import const
+    from autodist_tpu.kernel.degrade import (
+        DEGRADATION_REASONS,
+        zero1_degradation_reasons,
+    )
+    from autodist_tpu.kernel.mesh import data_axis
+    from autodist_tpu.strategy.ir import AllReduceSynchronizer
+
+    findings: List[Finding] = []
+    mesh_sizes = dict(zip(plan.mesh.axis_names, plan.mesh.devices.shape))
+    n_data = int(mesh_sizes.get(data_axis(plan.mesh), 1))
+    n_model = int(mesh_sizes.get(const.MESH_AXIS_MODEL, 1))
+    n_expert = int(mesh_sizes.get(const.MESH_AXIS_EXPERT, 1))
+
+    nodes = {}
+    if strategy is not None:
+        nodes = {n.var_name: n for n in strategy.node_config}
+
+    for name, p in plan.var_plans.items():
+        unknown = [r for r in p.degradations if r not in DEGRADATION_REASONS]
+        if unknown:
+            findings.append(Finding(
+                code="SLH003", severity=ERROR, var=name, pass_name="hazard",
+                message=(f"plan declares unknown degradation reason(s) "
+                         f"{unknown}: not in the shared predicate's "
+                         f"vocabulary"),
+            ))
+        if p.shard_update and p.degradations:
+            findings.append(Finding(
+                code="SLH003", severity=ERROR, var=name, pass_name="hazard",
+                message=("plan flags shard_update ACTIVE while declaring "
+                         f"degradations {list(p.degradations)}"),
+            ))
+        node = nodes.get(name)
+        if node is None or not isinstance(
+                node.synchronizer, AllReduceSynchronizer):
+            continue
+        requested = bool(node.synchronizer.shard_update)
+        if not requested and not p.shard_update:
+            continue
+        try:
+            part_axis = node.active_partition_axis
+        except ValueError:
+            part_axis = None
+        reasons = zero1_degradation_reasons(
+            p.var.shape,
+            sparse_update=p.var.sparse_update,
+            expert=p.var.expert,
+            part_axis=part_axis,
+            compressor=p.compressor,
+            n_data=n_data, n_model=n_model, n_expert=n_expert,
+        )
+        expect_active = requested and not reasons
+        if p.shard_update != expect_active:
+            findings.append(Finding(
+                code="SLH003", severity=ERROR, var=name, pass_name="hazard",
+                message=(
+                    f"strategy requests shard_update={requested} and the "
+                    f"shared predicate says "
+                    f"{'active' if expect_active else 'degrade'}"
+                    f"{' (' + ', '.join(reasons) + ')' if reasons else ''}, "
+                    f"but the plan rendered "
+                    f"shard_update={p.shard_update} — lowering has drifted "
+                    f"from kernel/degrade.py"),
+                details={"reasons": list(reasons)},
+            ))
+        elif requested and reasons and tuple(p.degradations) != reasons:
+            findings.append(Finding(
+                code="SLH003", severity=WARNING, var=name,
+                pass_name="hazard",
+                message=(
+                    f"quiet degradation is undeclared: predicate says "
+                    f"{list(reasons)}, plan declares "
+                    f"{list(p.degradations)}"),
+            ))
+    return findings
+
+
+def rendezvous_hazards(
+    inventories: Dict[str, CollectiveInventory]) -> List[Finding]:
+    """Cross-program collective-ordering check (SLH001) for programs that
+    will rendezvous (pipeline/MPMD stages lowered separately): each pair
+    must issue the same collectives, over the same replica groups in the
+    same device order, in the same sequence — anything else deadlocks or
+    silently mis-reduces at runtime."""
+    findings: List[Finding] = []
+    names = sorted(inventories)
+
+    def seq(inv: CollectiveInventory, exact: bool):
+        out = []
+        for c in inv.collectives:
+            if not c.replica_groups:
+                continue
+            groups = (tuple(c.replica_groups) if exact else
+                      tuple(sorted(tuple(sorted(g))
+                                   for g in c.replica_groups)))
+            out.append((c.op, groups))
+        return out
+
+    for i, a in enumerate(names):
+        for b in names[i + 1:]:
+            norm_a, norm_b = (seq(inventories[a], False),
+                              seq(inventories[b], False))
+            exact_a, exact_b = (seq(inventories[a], True),
+                                seq(inventories[b], True))
+            if sorted(norm_a) != sorted(norm_b):
+                findings.append(Finding(
+                    code="SLH001", severity=ERROR, pass_name="hazard",
+                    message=(
+                        f"programs {a!r} and {b!r} issue different "
+                        f"collective sets ({len(norm_a)} vs {len(norm_b)} "
+                        f"group-carrying collectives): they cannot "
+                        f"rendezvous"),
+                    details={"a": a, "b": b},
+                ))
+            elif norm_a != norm_b:
+                findings.append(Finding(
+                    code="SLH001", severity=ERROR, pass_name="hazard",
+                    message=(
+                        f"programs {a!r} and {b!r} issue matching "
+                        f"collectives in DIFFERENT ORDER: rendezvous "
+                        f"deadlock hazard"),
+                    details={"a": a, "b": b},
+                ))
+            elif exact_a != exact_b:
+                findings.append(Finding(
+                    code="SLH001", severity=ERROR, pass_name="hazard",
+                    message=(
+                        f"programs {a!r} and {b!r} order replica groups "
+                        f"differently for matching collectives: "
+                        f"mis-rendezvous (wrong pairing) hazard"),
+                    details={"a": a, "b": b},
+                ))
+    return findings
+
+
+_ALIAS_PAIR_RE = re.compile(
+    r"\{([0-9, ]*)\}:\s*\((\d+),\s*\{([0-9, ]*)\}")
+
+
+def alias_hazards(hlo_text: str) -> List[Finding]:
+    """Donated-buffer aliasing check (SLH002): every input/output alias
+    pair declared by the module must connect equal-sized buffers. A
+    mismatched pair is a program XLA will reject at runtime (or worse,
+    silently mis-donate) — statically checkable from the dump's ENTRY
+    signature."""
+    findings: List[Finding] = []
+    alias_line = next(
+        (ln for ln in hlo_text.splitlines() if "input_output_alias=" in ln),
+        "")
+    if not alias_line:
+        return findings
+    alias_blob = alias_line.split("input_output_alias=", 1)[1]
+    entry = next(
+        (ln for ln in hlo_text.splitlines() if ln.startswith("ENTRY ")), "")
+    if "->" not in entry:
+        return findings
+    params_part, result_part = entry.split("->", 1)
+    param_shapes = re.findall(
+        r"[\w.]+:\s*([a-z][0-9a-z]*\[[0-9,]*\])", params_part)
+    result_shapes = re.findall(r"([a-z][0-9a-z]*\[[0-9,]*\])", result_part)
+
+    def nbytes(shape: str) -> int:
+        from autodist_tpu.analysis.inventory import dtype_bytes
+
+        dt, dims = shape.split("[", 1)
+        dims = dims.rstrip("]")
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        return n * dtype_bytes(dt)
+
+    for pair in _ALIAS_PAIR_RE.finditer(alias_blob):
+        out_ix = [int(x) for x in pair.group(1).split(",") if x.strip()]
+        param_no = int(pair.group(2))
+        if param_no >= len(param_shapes):
+            continue
+        oi = out_ix[0] if out_ix else 0
+        if oi >= len(result_shapes):
+            continue
+        pb, ob = nbytes(param_shapes[param_no]), nbytes(result_shapes[oi])
+        if pb != ob:
+            findings.append(Finding(
+                code="SLH002", severity=ERROR, pass_name="hazard",
+                message=(
+                    f"input_output_alias pairs parameter {param_no} "
+                    f"({param_shapes[param_no]}, {pb} B) with output "
+                    f"{oi} ({result_shapes[oi]}, {ob} B): donated buffer "
+                    f"sizes differ"),
+                details={"param": param_no, "output": oi,
+                         "param_bytes": pb, "output_bytes": ob},
+            ))
+    return findings
+
+
+# -------------------------------------------------------------------- screen
+def screen_strategy(strategy, model_item, resource_spec) -> List[Finding]:
+    """Pre-lowering strategy screen (SLS001): defects that make a candidate
+    unlowerable or meaningless, cheap enough to run on every search seed
+    before any pricing. Mirrors the hard errors ``_fold_part_config`` /
+    ``StrategyCompiler`` raise, as findings instead of exceptions."""
+    from autodist_tpu.kernel.lowering import GraphTransformer
+    from autodist_tpu.strategy.ir import PSSynchronizer
+
+    findings: List[Finding] = []
+    for node in strategy.node_config:
+        try:
+            var = model_item.var(node.var_name)
+        except KeyError:
+            findings.append(Finding(
+                code="SLS001", severity=ERROR, var=node.var_name,
+                pass_name="screen",
+                message=f"strategy names unknown variable "
+                        f"{node.var_name!r}"))
+            continue
+        try:
+            axis = node.active_partition_axis
+        except ValueError as e:
+            findings.append(Finding(
+                code="SLS001", severity=ERROR, var=node.var_name,
+                pass_name="screen",
+                message=f"invalid partitioner: {e}"))
+            continue
+        if axis is not None:
+            if axis >= len(var.shape):
+                findings.append(Finding(
+                    code="SLS001", severity=ERROR, var=node.var_name,
+                    pass_name="screen",
+                    message=(f"partition axis {axis} out of range for "
+                             f"shape {tuple(var.shape)}")))
+            elif node.num_shards > max(int(var.shape[axis]), 1):
+                findings.append(Finding(
+                    code="SLS001", severity=ERROR, var=node.var_name,
+                    pass_name="screen",
+                    message=(f"{node.num_shards} shards exceed axis "
+                             f"{axis} size {var.shape[axis]}")))
+        sync = node.synchronizer
+        if isinstance(sync, PSSynchronizer) and not sync.sync:
+            findings.append(Finding(
+                code="SLS001", severity=ERROR, var=node.var_name,
+                pass_name="screen",
+                message="async PS (sync=False) has no SPMD rendering"))
+        try:
+            GraphTransformer._fold_part_config(node)
+        except ValueError as e:
+            findings.append(Finding(
+                code="SLS001", severity=ERROR, var=node.var_name,
+                pass_name="screen", message=str(e)))
+    return findings

@@ -1,0 +1,103 @@
+"""The ONE quiet-degradation predicate for zero1 weight-update sharding.
+
+``shard_update`` (ZeRO-1, arXiv 2004.13336) is a *capability request*: a
+variable claimed by a more specific rendering (expert sharding, explicit
+partitioning, sparse row-sharding), carried by a compressed wire, or with
+no data-axis-divisible dimension keeps its usual rendering instead of
+erroring. Three subsystems must agree on that list exactly:
+
+- ``kernel/lowering.py`` decides whether the reduce-scatter → sharded
+  update → all-gather rendering is ACTIVE for a variable;
+- ``strategy/cost_model.py`` prices zero1 only where the lowering would
+  actually render it (a priced-but-not-rendered var would desync the
+  ranking from the program);
+- ``analysis/passes.py`` treats exactly these reasons as *declared*
+  degradations — anything else that silently differs from the strategy's
+  request is a finding.
+
+Before this module each side mirrored the list by hand (PR 5); the parity
+regression lives in ``tests/test_cost_model.py`` next to
+``TestWeightUpdateSpecParity``. Pure arithmetic on shapes and mesh
+degrees — no jax imports — so the chief-side cost model stays light.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+#: Every reason this predicate can emit, in emission order. The analyzer
+#: treats exactly this vocabulary as "declared"; an unknown reason string
+#: anywhere in a plan is itself a finding (docs/analysis.md, SLH003).
+DEGRADATION_REASONS = (
+    "scalar",          # rank-0 var: nothing to scatter
+    "compressed",      # active compressor owns the wire (full-grad psum)
+    "expert",          # expert-axis sharding claims the var first
+    "partitioned",     # explicit partition request lands (incl. fallback/pad)
+    "sparse",          # sparse-update row-sharding claims the var first
+    "non_divisible",   # no dimension divides the data axis: nothing shards
+)
+
+
+def _compressor_active(compressor: Optional[str]) -> bool:
+    from autodist_tpu.kernel.compressor import is_active_compressor
+
+    return is_active_compressor(compressor or "")
+
+
+def zero1_degradation_reasons(
+    shape: Sequence[int],
+    *,
+    sparse_update: bool = False,
+    expert: bool = False,
+    part_axis: Optional[int] = None,
+    compressor: str = "NoneCompressor",
+    n_data: int = 1,
+    n_model: int = 1,
+    n_expert: int = 1,
+) -> Tuple[str, ...]:
+    """Why a ``shard_update`` request would NOT actively render for a var.
+
+    Returns every applicable reason (ordered as
+    :data:`DEGRADATION_REASONS`); empty tuple = the zero1 rendering is
+    active. Mirrors ``kernel/lowering.py::GraphTransformer._lower_node``'s
+    branch precedence: expert > explicit partition (divisible, largest
+    divisible fallback, or pad-and-mask) > sparse row-sharding > zero1.
+    """
+    shape = tuple(int(d) for d in (shape or ()))
+    n_data = max(int(n_data), 1)
+    n_model = max(int(n_model), 1)
+    n_expert = max(int(n_expert), 1)
+    # The shard axis variable partitioning rides (lowering _shard_axis_name):
+    # the model axis when non-trivial, else ZeRO-style over the data axis.
+    n_shard = n_model if n_model > 1 else n_data
+
+    reasons = []
+    if not shape:
+        reasons.append("scalar")
+    if _compressor_active(compressor):
+        reasons.append("compressed")
+    if shape and expert and n_expert > 1 and shape[0] % n_expert == 0:
+        reasons.append("expert")
+    if shape and part_axis is not None and part_axis < len(shape):
+        # Does the partition request LAND (exact divide, largest-divisible
+        # fallback axis, or pad-and-mask on an over-degree axis)? A landed
+        # partition already shards the update; a request that cannot land
+        # at all falls through to the zero1 branch in the lowering.
+        d = shape[part_axis]
+        divisible = d % n_shard == 0 and d >= n_shard
+        fallback = any(x % n_shard == 0 and x >= n_shard for x in shape)
+        if divisible or fallback or d > n_shard:
+            reasons.append("partitioned")
+    if shape and sparse_update and "partitioned" not in reasons:
+        # Sparse row-sharding (axis 0, padding when rows don't divide)
+        # claims the var under both PS and AllReduce whenever the table has
+        # enough rows; n_shard == 1 row-"shards" trivially.
+        if (shape[0] % n_shard == 0 and shape[0] >= n_shard) or shape[0] > n_shard:
+            reasons.append("sparse")
+    if shape and (
+        n_data <= 1
+        or not any(d % n_data == 0 and d >= n_data for d in shape)
+    ):
+        # _weight_update_spec parity: nothing to scatter over the data axis
+        # (a single-chip data axis renders no wire at all).
+        reasons.append("non_divisible")
+    return tuple(r for r in DEGRADATION_REASONS if r in reasons)

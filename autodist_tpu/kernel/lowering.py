@@ -34,7 +34,7 @@ insert the collectives:
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from enum import Enum
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -104,6 +104,12 @@ class VarPlan:
     # all-gather. True only when the rendering is ACTIVE (update_pspec is
     # genuinely sharded) — the step keys its manual grad sync off this.
     shard_update: bool = False
+    # Declared quiet degradations: why a requested capability (today:
+    # shard_update) did NOT render for this var, in the shared
+    # ``kernel.degrade.zero1_degradation_reasons`` vocabulary. The static
+    # analyzer (autodist_tpu.analysis) treats exactly these as declared;
+    # a plan whose flags disagree with the predicate is a finding.
+    degradations: Tuple[str, ...] = ()
 
 
 @struct.dataclass
@@ -455,20 +461,37 @@ class GraphTransformer:
             pspec = P()
             update_pspec = P()
 
-        # shard_update is ACTIVE only where the zero1 branch fired with a
-        # genuinely sharded update spec: vars claimed by a more specific
-        # rendering (expert / explicit partition / sparse row-sharding)
-        # already shard their update, and a var with no data-axis-divisible
-        # dimension has nothing to scatter — both degrade to their usual
-        # rendering rather than erroring (cost_model prices the same rule).
-        su_active = (
-            kind is SyncKind.ALL_REDUCE and shard_update
-            and pspec == P() and update_pspec != P()
-        )
-        if su_active:
-            from autodist_tpu.kernel.compressor import is_active_compressor
+        # shard_update activation: the ONE shared degradation predicate
+        # (kernel/degrade.py) decides whether the request renders — the same
+        # predicate the cost model prices by and the static analyzer
+        # (autodist_tpu.analysis) treats as the declared-degradation list.
+        # The structural rendering above must agree with it; divergence is a
+        # lowering bug and fails loudly rather than desyncing the three.
+        su_active = False
+        degradations: Tuple[str, ...] = ()
+        if kind is SyncKind.ALL_REDUCE and shard_update:
+            from autodist_tpu.kernel.degrade import zero1_degradation_reasons
 
-            if is_active_compressor(compressor):
+            degradations = zero1_degradation_reasons(
+                var.shape,
+                sparse_update=var.sparse_update,
+                expert=var.expert,
+                part_axis=part_axis,
+                compressor=compressor,
+                n_data=mesh_shape.get(data_axis(self.mesh), 1),
+                n_model=mesh_shape.get(const.MESH_AXIS_MODEL, 1),
+                n_expert=mesh_shape.get(expert_ax, 1),
+            )
+            su_active = not degradations
+            structural = pspec == P() and update_pspec != P()
+            if su_active != (structural and "compressed" not in degradations):
+                raise RuntimeError(
+                    f"var {var.name!r}: zero1 rendering "
+                    f"(pspec={pspec}, update={update_pspec}) disagrees with "
+                    f"degradation_reasons={degradations!r} — "
+                    f"kernel/degrade.py and _lower_node have drifted"
+                )
+            if structural and "compressed" in degradations:
                 # The compressed wire psums the FULL gradient inside its
                 # manual region (_manual_sync_grads) — there is no
                 # reduce-scatter to render, and a silently ineffective
@@ -480,14 +503,12 @@ class GraphTransformer:
                     "state stays replicated for this var",
                     var.name, compressor,
                 )
-                su_active = False
                 update_pspec = P()
-        elif kind is SyncKind.ALL_REDUCE and shard_update:
-            logging.debug(
-                "var %s: shard_update has no effect (var is expert/"
-                "partitioned/sparse-sharded or has no data-axis-divisible "
-                "dimension)", var.name,
-            )
+            elif degradations:
+                logging.debug(
+                    "var %s: shard_update has no effect (%s)",
+                    var.name, ", ".join(degradations),
+                )
 
         shard_dests = folded.get("shard_destinations", ())
         # Reference parity: PS destinations are host CPUs; offload is opt-in
@@ -523,6 +544,7 @@ class GraphTransformer:
             shard_destinations=shard_dests,
             storage_shape=storage_shape,
             shard_update=su_active,
+            degradations=degradations,
         )
 
     @staticmethod
@@ -544,6 +566,25 @@ class GraphTransformer:
             return P()
         best = max(candidates, key=lambda i: var.shape[i])
         return _spec_with_axis(len(var.shape), best, ax_name)
+
+
+@dataclass(frozen=True)
+class VarWire:
+    """One variable's slice of the plan's promised collective wire — what
+    the lowering COMMITS the compiled program to carrying for this var (see
+    :meth:`ShardingPlan.promised_wire`). Consumed by the static analyzer's
+    wire-conformance pass (``autodist_tpu.analysis.passes``)."""
+
+    var: str
+    rendering: str                      # zero1|sparse|expert|partitioned|...
+    require: Tuple[str, ...] = ()       # op kinds that MUST appear
+    allow: Tuple[str, ...] = ()         # kinds allowed at up-to-full payload
+    storage_elements: int = 0
+    storage_bytes: int = 0
+    shard_update: bool = False
+    sparse_row_sharded: bool = False
+    compressor: str = "NoneCompressor"
+    degradations: Tuple[str, ...] = ()
 
 
 @dataclass
@@ -853,6 +894,87 @@ class ShardingPlan:
             comp_state=self.comp_shardings(state_shapes.comp_state),
             stale_state=self.stale_shardings(state_shapes.stale_state),
         )
+
+    # -------------------------------------------------------- promised wire
+    def promised_wire(self) -> Dict[str, "VarWire"]:
+        """The collective wire this plan PROMISES, per variable — the
+        contract the static analyzer (``autodist_tpu.analysis``) checks the
+        compiled program against. Exported from the lowering (not re-derived
+        in the analyzer) so the promise and the rendering can never drift:
+        each :class:`VarWire` names the op kinds that must appear
+        (``require``), the kinds this var's sync can legitimately emit at up
+        to its full payload (``allow``), and the declared degradations.
+
+        Renderings (mirroring ``_lower_node`` precedence):
+
+        - ``zero1`` (shard_update active): reduce-scatter + all-gather are
+          REQUIRED; an all-reduce carrying this var's full gradient is the
+          regression GSPMD re-fusion produces (docs/zero.md);
+        - ``sparse``: row-sharded table — wire must stay tokens-scale, so
+          NOTHING is allowed at full-table payload;
+        - ``expert`` / ``partitioned``: sharded param; gathers/reduces up to
+          the storage size are the planned TP/EP wire (activation-scale
+          all-to-all / collective-permute ride the activation allowance);
+        - ``zero3`` (data-axis-sharded param): all-gather on use is
+          required; this toolchain's GSPMD renders the grad reduce-scatter
+          as all-reduce + slice, so full-size all-reduce is allowed;
+        - ``ps1`` / ``replicated``: dense all-reduce wire at full payload.
+        """
+        ax_d = data_axis(self.mesh)
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+
+        def axes_of(pspec: P):
+            out = set()
+            for e in tuple(pspec):
+                if e is None:
+                    continue
+                for name in (e if isinstance(e, tuple) else (e,)):
+                    out.add(name)
+            return out
+
+        wires: Dict[str, VarWire] = {}
+        for name, p in self.var_plans.items():
+            elems = 1
+            for d in (p.storage_shape or tuple(p.var.shape) or (1,)):
+                elems *= int(d)
+            axes = {a for a in axes_of(p.pspec) if sizes.get(a, 1) > 1}
+            if not p.var.trainable:
+                rendering, require, allow = "nontrainable", (), ()
+            elif p.shard_update:
+                rendering = "zero1"
+                require = ("reduce-scatter", "all-gather")
+                allow = ("reduce-scatter", "all-gather")
+            elif p.var.sparse_update and axes:
+                rendering, require, allow = "sparse", (), ()
+            elif const.MESH_AXIS_EXPERT in axes:
+                rendering, require = "expert", ()
+                allow = ("all-reduce", "all-gather", "all-to-all")
+            elif ax_d in axes:
+                rendering = "zero3"
+                require = ("all-gather",) if sizes.get(ax_d, 1) > 1 else ()
+                allow = ("all-gather", "reduce-scatter", "all-reduce")
+            elif axes:
+                rendering, require = "partitioned", ()
+                allow = ("all-gather", "reduce-scatter", "all-reduce")
+            elif p.kind is SyncKind.PS:
+                rendering, require = "ps1", ()
+                allow = ("all-reduce", "all-gather")
+            else:
+                rendering, require = "replicated", ()
+                allow = ("all-reduce", "all-gather")
+            wires[name] = VarWire(
+                var=name,
+                rendering=rendering,
+                require=require,
+                allow=allow,
+                storage_elements=elems,
+                storage_bytes=elems * int(np.dtype(p.var.dtype).itemsize),
+                shard_update=p.shard_update,
+                sparse_row_sharded=(p.var.sparse_update and bool(axes)),
+                compressor=p.compressor,
+                degradations=p.degradations,
+            )
+        return wires
 
     def describe(self) -> str:
         lines = [f"ShardingPlan(mesh={dict(zip(self.mesh.axis_names, self.mesh.devices.shape))})"]

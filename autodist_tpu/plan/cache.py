@@ -14,11 +14,14 @@ Trust model: a cached plan is VALIDATED before it is believed —
 - integrity: ``meta.json`` carries a sha256 over the strategy bytes; any
   mismatch (torn write, hand-edit, bitrot) is a loud warning + fresh
   search, never a crash;
-- liveness: the plan is compiled against the current model
-  (``StrategyCompiler``) and dry-run lowered to a ShardingPlan over the
-  live mesh (``kernel/lowering.py`` dryrun machinery) when the runtime has
-  the spec's device count — a plan that no longer lowers (shape drift the
-  key missed, lowering rule changes inside one package version) is evicted.
+- liveness + conformance: the plan is compiled against the current model
+  (``StrategyCompiler``), dry-run lowered to a ShardingPlan over the live
+  mesh, and then STATICALLY ANALYZED (``autodist_tpu.analysis``: shared
+  degradation predicate, per-chip HBM budget — docs/analysis.md) when the
+  runtime has the spec's device count — a plan that no longer lowers, or
+  that lowers but trips the analyzer (shape drift the key missed, lowering
+  rule changes inside one package version, HBM overcommit), is evicted
+  with the finding attached to the warning.
 
 Layout: ``<dir>/<key>/{strategy.json, provenance.json, meta.json}``, one
 directory per key, writes staged in a temp dir and atomically renamed.
@@ -79,11 +82,16 @@ def plan_key(model_item: ModelItem, resource_spec: ResourceSpec,
 
 def dryrun_lowers(strategy: Strategy, model_item: ModelItem,
                   resource_spec: ResourceSpec) -> bool:
-    """True when the strategy still lowers against the current model on a
-    mesh of the spec's shape — the no-execution slice of the driver's
-    ``dryrun_multichip`` contract: StrategyCompiler validation + a full
-    ``GraphTransformer.transform()`` into a ShardingPlan (sharding
-    assignment only; nothing jits, nothing executes).
+    """True when the strategy still lowers AND analyzes clean against the
+    current model on a mesh of the spec's shape — the no-execution slice
+    of the driver's ``dryrun_multichip`` contract: StrategyCompiler
+    validation + a full ``GraphTransformer.transform()`` into a
+    ShardingPlan, then the static analyzer (``autodist_tpu.analysis``)
+    over the lowered plan — degradation drift vs the shared predicate and
+    the per-chip HBM budget (docs/analysis.md). A cached winner that
+    lowers but overcommits memory or whose flags disagree with the
+    lowering rules is evicted WITH the finding attached, not trusted into
+    an OOM at step 1.
 
     Skips (returns True with a debug log) when the live runtime doesn't
     have the spec's device count — validation needs a real mesh, and a
@@ -92,6 +100,7 @@ def dryrun_lowers(strategy: Strategy, model_item: ModelItem,
 
     import jax
 
+    from autodist_tpu.analysis import AnalysisError, analyze_plan
     from autodist_tpu.kernel import GraphTransformer, build_mesh
     from autodist_tpu.strategy.base import StrategyCompiler
 
@@ -110,7 +119,12 @@ def dryrun_lowers(strategy: Strategy, model_item: ModelItem,
     candidate = copy.deepcopy(strategy)
     compiled = StrategyCompiler(model_item).compile(candidate)
     mesh = build_mesh(resource_spec)
-    GraphTransformer(compiled, model_item, mesh).transform()
+    plan = GraphTransformer(compiled, model_item, mesh).transform()
+    report = analyze_plan(
+        plan, strategy=compiled, resource_spec=resource_spec,
+        optimizer=model_item.optimizer_spec.name, program="plan-cache")
+    if not report.ok:
+        raise AnalysisError(report)
     return True
 
 

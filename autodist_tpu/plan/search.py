@@ -220,6 +220,8 @@ class PlanSearch:
             for v in self.model_item.trainable_variables
         ]
         self._n_dests = max(len(reduction_devices(resource_spec)), 1)
+        # Seeds the static screen rejected before pricing: {name: [codes]}.
+        self._screen_rejected: Dict[str, List[str]] = {}
 
     # ------------------------------------------------------------------ seeds
     def _seed_slate(self) -> Tuple[Dict[str, Strategy], Dict[str, Genome]]:
@@ -245,6 +247,8 @@ class PlanSearch:
                 for s in iter_synchronizers(node)
             )
 
+        from autodist_tpu.analysis import screen_strategy
+
         built: Dict[str, Strategy] = {}
         genomes: Dict[str, Genome] = {}
         slate = candidate_slate(
@@ -255,6 +259,20 @@ class PlanSearch:
             except Exception as e:  # noqa: BLE001 - skip unbuildable seeds
                 logging.debug("plan search: seed %s failed to build (%s)",
                               name, e)
+                continue
+            # Static screen BEFORE pricing (docs/analysis.md SLS001): a
+            # candidate that cannot lower (bad part tables, over-sharded
+            # axes, async PS) must never enter the pool — pricing it would
+            # let an unlowerable plan win the search and fail at build.
+            findings = [f for f in screen_strategy(
+                strategy, self.model_item, self.spec)
+                if f.severity == "error"]
+            if findings:
+                self._screen_rejected[name] = [f.code for f in findings]
+                logging.warning(
+                    "plan search: seed %s rejected by the static screen "
+                    "(%s)", name,
+                    "; ".join(f.render() for f in findings))
                 continue
             if not lossy(strategy):
                 built[name] = strategy
@@ -420,6 +438,7 @@ class PlanSearch:
             },
             "improvement_vs_best_seed": improvement,
             "trajectory": trajectory,
+            "screen_rejected": dict(self._screen_rejected),
             "why": why,
         }
         if self.calibration is not None:

@@ -533,6 +533,23 @@ class CostModel:
         cands = [d for d in var.shape if d % k == 0 and d >= k]
         return k if cands else 1
 
+    def _zero1_degradations(self, var: VarItem, part_axis, compressor):
+        """The shared quiet-degradation predicate (kernel/degrade.py) on
+        this model's mesh degrees — ONE list for lowering, pricing, and the
+        static analyzer; ``tests/test_cost_model.py`` pins the parity."""
+        from autodist_tpu.kernel.degrade import zero1_degradation_reasons
+
+        return zero1_degradation_reasons(
+            var.shape,
+            sparse_update=var.sparse_update,
+            expert=var.expert,
+            part_axis=part_axis,
+            compressor=compressor,
+            n_data=self.n_data,
+            n_model=self.n_model,
+            n_expert=self.n_expert,
+        )
+
     def _sparse_cost(
         self, var: VarItem, update_traffic_factor: float
     ) -> Tuple[float, float, float, float, float, int]:
@@ -631,14 +648,12 @@ class CostModel:
             res = self._residency_bytes(var, part_axis, shards)
             act = 0.0
             if shards <= 1:
-                from autodist_tpu.kernel.compressor import is_active_compressor
-
                 upd_shards = self._update_axis_shards(var)
-                if (sync.shard_update and upd_shards > 1
-                        and not is_active_compressor(sync.compressor)):
-                    # zero1 weight-update sharding (lowering parity: the
-                    # shard_update branch of _lower_node; same degradation
-                    # rules — compressed or non-divisible vars fall through
+                if sync.shard_update and not self._zero1_degradations(
+                        var, part_axis, sync.compressor):
+                    # zero1 weight-update sharding (lowering parity via the
+                    # ONE shared kernel/degrade.py predicate; compressed,
+                    # claimed-elsewhere or non-divisible vars fall through
                     # to plain AR below). Wire bytes equal the ring
                     # all-reduce (rs + ag IS the ring decomposition), but
                     # split across the comm (reduce-scatter) and gather

@@ -239,6 +239,94 @@ def explain_provenance(provenance: dict, out=None) -> None:
     print(f"\nwhy: {provenance.get('why', '(not recorded)')}", file=out)
 
 
+def lint(
+    model_spec,
+    model_item: ModelItem,
+    resource_spec: ResourceSpec,
+    builder_name: str = "AllReduce",
+    batch=None,
+    out=None,
+) -> int:
+    """``--lint``: lower + compile the (model × builder × cluster) step on
+    this process's devices and run the static analyzer (shardlint,
+    ``autodist_tpu.analysis``) over the compiled program — findings table
+    plus the per-variable planned-vs-actual wire bytes. Falls back to the
+    plan-only passes (degradation drift + HBM budget, no wire conformance)
+    when the runtime doesn't have the spec's device count, since wire
+    conformance needs the real compiled program.
+
+    Returns a process exit code: 0 clean, 1 when any error-severity
+    finding survives (CI-friendly)."""
+    import jax
+
+    from autodist_tpu.analysis import (
+        analyze_plan,
+        analyze_program,
+        report_to_text,
+    )
+    from autodist_tpu.kernel import (
+        DistributedTrainStep,
+        GraphTransformer,
+        build_mesh,
+    )
+    from autodist_tpu.strategy import from_name
+    from autodist_tpu.strategy.base import StrategyCompiler
+
+    out = out if out is not None else sys.stdout
+    builder = from_name(builder_name)
+    strategy = StrategyCompiler(model_item).compile(
+        builder.build(model_item, resource_spec))
+    if jax.device_count() != resource_spec.num_chips:
+        print(
+            f"lint: runtime has {jax.device_count()} devices, spec wants "
+            f"{resource_spec.num_chips} — running plan-only passes (no "
+            f"wire conformance, and no HBM budget: shardings realized on "
+            f"the local mesh would misprice the spec's per-chip residency; "
+            f"run under a matching mesh for the full check)", file=out)
+        mesh = build_mesh(ResourceSpec(resource_dict={
+            "nodes": [{"address": "localhost",
+                       "chips": jax.device_count(), "chief": True}]}))
+        plan = GraphTransformer(strategy, model_item, mesh).transform()
+        # resource_spec=None: the plan was lowered over the LOCAL mesh, so
+        # its shard counts say nothing about the spec's chips — judging
+        # un-sharded residency against the remote HBM would emit false
+        # SLM001 errors (and a false exit 1) for plans that fit fine.
+        report = analyze_plan(
+            plan, strategy=strategy, resource_spec=None,
+            optimizer=model_item.optimizer_spec.name,
+            program=f"{builder_name} (plan-only)")
+    else:
+        mesh = build_mesh(resource_spec)
+        plan = GraphTransformer(strategy, model_item, mesh).transform()
+        try:
+            optimizer = model_item.optimizer_spec.make()
+        except TypeError:
+            # Default spec with no hyperparameters (lint only needs the
+            # program's SHAPE; the learning rate is irrelevant to the wire).
+            import optax
+
+            optimizer = optax.sgd(0.1)
+        step = DistributedTrainStep(plan, model_spec.loss_fn, optimizer)
+        params = model_spec.init(jax.random.PRNGKey(0))
+        state = step.init(params)
+        # ONE compile serves both the HLO text and the memory analysis —
+        # the XLA compile is the dominant cost of lint.
+        compiled = step._compile(state, batch).lower(state, batch).compile()
+        hlo = compiled.as_text()
+        temp = 0.0
+        try:
+            mem = compiled.memory_analysis()
+            temp = float(getattr(mem, "temp_size_in_bytes", 0))
+        except Exception:  # noqa: BLE001 - optional backend API
+            pass
+        report = analyze_program(
+            plan, hlo, strategy=strategy, resource_spec=resource_spec,
+            optimizer=model_item.optimizer_spec.name, batch=batch,
+            temp_bytes=temp, program=builder_name)
+    print(report_to_text(report), file=out)
+    return 0 if report.ok else 1
+
+
 def _load_provenance(path: str) -> dict:
     """Provenance from a file, a cache entry dir, or a cache root (newest
     entry wins)."""
@@ -293,6 +381,19 @@ def main(argv=None) -> int:
              "pass e.g. 'tpu' to derive the default ResourceSpec from the "
              "real local devices instead of a --resource-spec file)",
     )
+    p.add_argument(
+        "--lint", action="store_true",
+        help="run the static sharding analyzer (shardlint, docs/analysis.md) "
+             "over the builder's compiled program instead of the ranking "
+             "table: findings + per-variable planned-vs-actual wire bytes; "
+             "exits 1 on any error finding. Provisions a CPU mesh matching "
+             "the spec's chip count when no backend exists yet.",
+    )
+    p.add_argument(
+        "--builder", default="AllReduce",
+        help="--lint: strategy builder to lower and analyze "
+             "(default AllReduce; any strategy.from_name name)",
+    )
     args = p.parse_args(argv)
 
     if args.plan_provenance:
@@ -311,6 +412,23 @@ def main(argv=None) -> int:
         # Before any backend use: shape-only planning runs anywhere, and the
         # default accelerator may be absent or wedged (axon tunnel).
         jax.config.update("jax_platforms", args.platform)
+    if args.lint and args.resource_spec and args.platform == "cpu":
+        # Wire conformance needs a mesh of the spec's shape; provision the
+        # CPU host platform with that many devices while the backend is
+        # still uninitialized (the __graft_entry__ recipe). A live backend
+        # is used as-is — lint degrades to plan-only passes on mismatch.
+        try:
+            from jax._src import xla_bridge
+
+            backend_up = bool(xla_bridge._backends)
+        except Exception:  # noqa: BLE001 - internal moved: assume up
+            backend_up = True
+        if not backend_up:
+            n = ResourceSpec(args.resource_spec).num_chips
+            flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                     if "xla_force_host_platform_device_count" not in f]
+            flags.append(f"--xla_force_host_platform_device_count={n}")
+            os.environ["XLA_FLAGS"] = " ".join(flags)
 
     from autodist_tpu.models import get_model
 
@@ -341,6 +459,8 @@ def main(argv=None) -> int:
                 "from the local accelerator",
                 file=sys.stderr,
             )
+    if args.lint:
+        return lint(spec, item, rs, builder_name=args.builder, batch=batch)
     measured = None
     if args.measured_file:
         import json

@@ -332,6 +332,33 @@ def measure_workload(model_name: str, on_accel: bool,
     # barrier. Batch size is swept (the throughput-vs-batch curve is not
     # monotone on one chip); the best throughput wins.
     plan_stats = {}
+    lint_info = {}
+
+    def _lint(ad, step, state, batch):
+        """``--lint`` mode: run the static analyzer (shardlint) on the
+        compiled program BEFORE any timed window and emit its own JSON
+        line immediately — device-queue rounds that wedge (rc=124) still
+        yield static signal even when timing is lost. Opt-in: costs one
+        extra compile of the per-step program."""
+        if os.environ.get("AUTODIST_BENCH_LINT", "") != "1" or lint_info:
+            return
+        try:
+            from autodist_tpu.analysis import analyze_program, compiled_hlo
+
+            rep = analyze_program(
+                step.plan, compiled_hlo(step, state, batch),
+                resource_spec=ad.resource_spec, batch=batch,
+                program=f"bench:{model_name}")
+            lint_info.update({
+                "lint_findings": len(rep.findings),
+                "lint_errors": len(rep.errors),
+                "lint_codes": sorted(set(rep.codes())),
+            })
+        except Exception as e:  # noqa: BLE001 - lint must never eat a bench
+            lint_info.update({"lint_findings": -1,
+                              "lint_failed": str(e)[:200]})
+        print(json.dumps({"bench_lint": dict(lint_info),
+                          "model": model_name}), flush=True)
 
     def _builder():
         if not plan_cache:
@@ -350,6 +377,7 @@ def measure_workload(model_name: str, on_accel: bool,
             for k, v in cache.stats.items():
                 plan_stats[k] = plan_stats.get(k, 0) + v
         state = step.init(params)
+        _lint(ad, step, state, batch)
         # Pin the batch in HBM (the "compute" methodology,
         # docs/performance.md): image-sized host feeds otherwise measure
         # the tunnel, not the chip. Token feeds are tiny but pinning is
@@ -390,6 +418,7 @@ def measure_workload(model_name: str, on_accel: bool,
         mfu = achieved / (peak_per_chip * n_chips) if on_accel else float("nan")
         return {
             **({"plan_cache": dict(plan_stats)} if plan_cache else {}),
+            **lint_info,
             "unit_per": unit_per,
             "mfu": mfu,
             "units_per_sec": units_per_sec,
@@ -707,7 +736,18 @@ def _main() -> None:
         help="build strategies through the search-based planner backed by "
              "this persistent plan cache (docs/planner.md); hit/miss counts "
              "are logged in the JSON line so queue rounds show reuse")
+    ap.add_argument(
+        "--lint", action="store_true",
+        help="run the static sharding analyzer (shardlint, docs/analysis.md) "
+             "on each workload's compiled program BEFORE any timed window "
+             "and put lint_findings counts in the JSON result line — static "
+             "signal survives even when timing is lost to a wedged queue "
+             "driver (rc=124)")
     args = ap.parse_args()
+    if args.lint:
+        # Env, not a flag, so watchdogged child processes
+        # (_measure_in_subprocess) inherit the mode without plumbing.
+        os.environ["AUTODIST_BENCH_LINT"] = "1"
     if args.one:
         _run_one(args.one, args.cpu_smoke, plan_cache=args.plan_cache)
         return

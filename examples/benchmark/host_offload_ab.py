@@ -54,10 +54,10 @@ def run_variant(tag, step, state, batch, n_windows: int):
     print(f"[{tag}] warm window done (loss {losses[-1]:.4f})", flush=True)
     times = []
     for i in range(n_windows):
-        t0 = time.time()
+        t0 = time.perf_counter()
         state, metrics = step.run(state, batch, WINDOW)
         losses.extend(float(x) for x in np.asarray(metrics["loss"]))
-        times.append(time.time() - t0)
+        times.append(time.perf_counter() - t0)
         print(f"[{tag}] window {i + 1}/{n_windows}: {times[-1]:.2f}s", flush=True)
     return losses, float(np.mean(times))
 

@@ -1,99 +1,20 @@
-"""Shared test helpers.
+"""Shared test helpers — thin re-exports of the package's ONE collective
+parser (:mod:`autodist_tpu.analysis.inventory`).
 
-``hlo_contains``/``assert_hlo_wire`` consolidate the pinned-HLO-wire greps
-that used to be hand-rolled per test (the ring-attention family's
-collective-permute pin, the bf16-operand pin, the zero1 reduce-scatter/
-all-gather pin): HLO spells collectives with hyphens (``all-reduce(``),
-StableHLO with underscores (``stablehlo.all_reduce``), and a grep that
-checks only one spelling silently passes when the dump format changes.
-One normalizing matcher, used by the tests AND the driver-gate dryrun
-families (``__graft_entry__``), so every wire pin means the same thing.
+``hlo_contains``/``assert_hlo_wire``/``collective_sizes`` started here as
+consolidated pinned-HLO-wire greps; the static-analysis subsystem promoted
+them into the package proper so tests and the analyzer can never disagree
+on how a collective is parsed. This module stays as the import surface the
+tests (and the driver-gate dryrun families in ``__graft_entry__``) use.
 """
 from __future__ import annotations
 
-import re
-from typing import Iterable, List, Tuple
-
-
-def _variants(op: str) -> Tuple[str, str]:
-    """Both spellings of a collective name: hyphenated (post-optimization
-    HLO) and underscored (StableHLO / traced jaxpr)."""
-    base = op.strip().rstrip("(")
-    return base.replace("_", "-"), base.replace("-", "_")
-
-
-# jax.named_scope labels ride along as HLO metadata={op_name="..."} and
-# StableHLO loc("...") attachments — a scope named "zero1.reduce_scatter"
-# puts the op's NAME on every op it wraps, including whatever op a
-# regression replaced the real collective with. Strip both before
-# matching so a present-pin can only be satisfied by an actual op call.
-_METADATA_RE = re.compile(r'metadata=\{[^}]*\}|loc\("[^"]*"[^)]*\)')
-
-
-def hlo_contains(text: str, op: str) -> bool:
-    """True when ``op`` (a collective like ``"reduce-scatter"``) appears AS
-    AN OP CALL in a lowered/compiled program dump — post-optimization HLO
-    (``all-gather(``), StableHLO (``stablehlo.all_gather``), or a traced
-    jaxpr (``all_gather(``). Named-scope metadata mentioning the op does
-    not count."""
-    hyphen, underscore = _variants(op)
-    needles = (f"{hyphen}(", f"stablehlo.{underscore}", f"{underscore}(")
-    for line in text.splitlines():
-        line = _METADATA_RE.sub("", line)
-        if any(n in line for n in needles):
-            return True
-    return False
-
-
-def assert_hlo_wire(text: str, present: Iterable[str] = (),
-                    absent: Iterable[str] = (), label: str = "") -> None:
-    """Pin a program's collective wire: every op in ``present`` must appear,
-    none in ``absent`` may. Raises AssertionError naming the offender."""
-    where = f" [{label}]" if label else ""
-    for op in present:
-        assert hlo_contains(text, op), (
-            f"lowered program{where} carries no {op!r} wire")
-    for op in absent:
-        assert not hlo_contains(text, op), (
-            f"lowered program{where} unexpectedly carries a {op!r} wire")
-
-
-# The payload-size half of wire pinning (the classifier
-# tests/test_sparse_wire.py pioneered; it and test_compressor import it
-# from here now): result-side element counts of every collective line.
-COLLECTIVE_OPS = (
-    "all-reduce(",
-    "all-gather(",
-    "reduce-scatter(",
-    "all-to-all(",
-    "collective-permute(",
+from autodist_tpu.analysis.inventory import (  # noqa: F401 - re-exports
+    COLLECTIVE_OPS,
+    Collective,
+    CollectiveInventory,
+    assert_hlo_wire,
+    collective_sizes,
+    compiled_hlo,
+    hlo_contains,
 )
-
-
-def collective_sizes(hlo_text: str, ops: Iterable[str] = COLLECTIVE_OPS,
-                     ) -> List[int]:
-    """Element count of every collective's result array(s) in a
-    post-optimization HLO dump."""
-    sizes = []
-    for line in hlo_text.splitlines():
-        if "=" not in line or not any(op in line for op in ops):
-            continue
-        # Result shapes sit between '=' and the op name, e.g.
-        #   %all-reduce.3 = (f32[4096,16]{1,0}, f32[]) all-reduce(...)
-        lhs = line.split("=", 1)[1]
-        shapes = re.findall(r"[a-z][0-9a-z]*\[([0-9,]*)\]", lhs)
-        for s in shapes:
-            dims = [int(d) for d in s.split(",") if d]
-            n = 1
-            for d in dims:
-                n *= d
-            sizes.append(n)
-    return sizes
-
-
-def compiled_hlo(step, state, batch) -> str:
-    """Post-optimization HLO of a DistributedTrainStep's single-step
-    program — the text every wire pin greps. (StableHLO from
-    ``lower_text`` shows collectives only when they are explicit in the
-    traced program; GSPMD-inserted ones exist only post-compile.)"""
-    return step._compile(state, batch).lower(state, batch).compile().as_text()

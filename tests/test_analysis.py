@@ -1,0 +1,420 @@
+"""shardlint (autodist_tpu.analysis): inventory parsing, wire-pin
+migration onto the inventory API, seeded-defect findings, strategy screen,
+and analyzer-backed plan-cache validation.
+
+The historical wire pins (tests/test_sparse_wire.py payload greps, the
+zero1 family's rs/ag pin) now ride the SAME parser the analyzer uses —
+``tests/helpers`` is a thin re-export of ``analysis.inventory`` — so this
+module pins both directions: the analyzer re-derives the proven wire with
+zero findings on a correct program, and each deliberately broken program
+trips its intended finding code with a stable, greppable message.
+"""
+import json
+import logging as pylogging
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from helpers import collective_sizes, compiled_hlo
+from autodist_tpu.analysis import (
+    AnalysisError,
+    CollectiveInventory,
+    alias_hazards,
+    analyze_plan,
+    analyze_program,
+    rendezvous_hazards,
+    screen_strategy,
+)
+from autodist_tpu.analysis.report import FINDING_CODES, Finding
+from autodist_tpu.kernel.lowering import DistributedTrainStep, GraphTransformer
+from autodist_tpu.kernel.mesh import build_mesh
+from autodist_tpu.model_item import ModelItem, OptimizerSpec
+from autodist_tpu.models import get_model
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+from autodist_tpu.strategy.base import StrategyCompiler
+from autodist_tpu.strategy.ir import (
+    AllReduceSynchronizer,
+    NodeConfig,
+    PSSynchronizer,
+    Strategy,
+)
+from autodist_tpu.strategy.zero1_strategy import Zero1
+
+N = 8  # conftest pins the 8-device CPU mesh
+
+
+def _spec(**extra):
+    return ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": N, "chief": True}],
+        **extra,
+    })
+
+
+# ----------------------------------------------------------- shared fixtures
+@pytest.fixture(scope="module")
+def zero1_setup():
+    """(plan, strategy, item, step, state, batch) for the zero1 mlp — one
+    compile shared by the wire-pin and defect tests."""
+    model = get_model("mlp", in_dim=8 * N, hidden=(8 * N,), num_classes=4)
+    params = model.init(jax.random.PRNGKey(0))
+    batch = model.example_batch(2 * N)
+    adam = OptimizerSpec("adam", {"learning_rate": 1e-3})
+    item = ModelItem.from_params(
+        params, optimizer_spec=adam, loss_fn=model.loss_fn,
+        example_batch=batch)
+    strategy = StrategyCompiler(item).compile(Zero1().build(item, _spec()))
+    plan = GraphTransformer(strategy, item, build_mesh(_spec())).transform()
+    step = DistributedTrainStep(plan, model.loss_fn, adam.make())
+    state = step.init(params)
+    return plan, strategy, item, step, state, batch, params, model
+
+
+def _embed_loss(params, batch):
+    ids, y = batch
+    x = jnp.take(params["embedding"], ids, axis=0)
+    return jnp.mean(((x @ params["w"]).squeeze(-1) - y) ** 2)
+
+
+@pytest.fixture(scope="module")
+def sparse_setup():
+    """Row-sharded embedding model: good plan + a leaked (replicated-table)
+    program compiled from a mutated plan."""
+    k = jax.random.PRNGKey(0)
+    params = {"embedding": jax.random.normal(k, (4096, 16)),
+              "w": jax.random.normal(k, (16, 1))}
+    batch = (jax.random.randint(k, (64,), 0, 4096),
+             jax.random.normal(k, (64,)))
+    sgd = OptimizerSpec("sgd", {"learning_rate": 0.1})
+    item = ModelItem.from_params(
+        params, optimizer_spec=sgd, loss_fn=_embed_loss,
+        example_batch=batch)
+    strategy = StrategyCompiler(item).compile(AllReduce().build(item, _spec()))
+    mesh = build_mesh(_spec())
+    good_plan = GraphTransformer(strategy, item, mesh).transform()
+    bad_plan = GraphTransformer(strategy, item, mesh).transform()
+    bad_plan.plan_for("embedding").pspec = P()
+    bad_plan.plan_for("embedding").update_pspec = P()
+    leaky = DistributedTrainStep(bad_plan, _embed_loss, sgd.make())
+    leaked_hlo = compiled_hlo(leaky, leaky.init(params), batch)
+    return good_plan, strategy, item, batch, leaked_hlo, params, sgd
+
+
+# --------------------------------------------------------------- inventory
+class TestInventory:
+    AR_LINE = (
+        '  %all-reduce.3 = f32[4096,16]{1,0} all-reduce(f32[4096,16]{1,0} '
+        '%fusion.1), channel_id=1, replica_groups={{0,1,2,3},{4,5,6,7}}, '
+        'use_global_device_ids=true, to_apply=%add, '
+        'metadata={op_name="jit(_step)/psum" source_file="x.py"}')
+
+    def test_parses_explicit_groups_dtype_and_scope(self):
+        inv = CollectiveInventory.from_hlo(self.AR_LINE)
+        assert len(inv.collectives) == 1
+        c = inv.collectives[0]
+        assert c.op == "all-reduce"
+        assert c.result_elements == 4096 * 16
+        assert c.result_bytes == 4096 * 16 * 4
+        assert c.replica_groups == ((0, 1, 2, 3), (4, 5, 6, 7))
+        assert c.channel_id == 1
+        assert c.op_name == "jit(_step)/psum"
+
+    def test_parses_iota_groups(self):
+        line = ('  %all-gather.1 = f32[64,64]{1,0} all-gather(f32[8,64]{1,0} '
+                '%fusion), channel_id=7, replica_groups=[1,8]<=[8], '
+                'dimensions={0}, use_global_device_ids=true')
+        c = CollectiveInventory.from_hlo(line).collectives[0]
+        assert c.replica_groups == ((0, 1, 2, 3, 4, 5, 6, 7),)
+        assert c.op == "all-gather"
+        # operand payload is visible too (the leak detectors use max of
+        # result and operand arrays)
+        assert c.operand_elements == 8 * 64
+        assert c.max_payload_elements == 64 * 64
+
+    def test_iota_transpose_expands(self):
+        line = ('  %all-gather.2 = f32[16]{0} all-gather(f32[8]{0} %x), '
+                'replica_groups=[2,4]<=[2,2,2]T(2,1,0), dimensions={0}')
+        c = CollectiveInventory.from_hlo(line).collectives[0]
+        assert c.replica_groups == ((0, 4, 2, 6), (1, 5, 3, 7))
+
+    def test_metadata_scope_never_creates_an_entry(self):
+        # A named scope mentioning reduce_scatter on a non-collective op
+        # must not be inventoried (the regression hlo_contains defends).
+        line = ('  %add.1 = f32[64]{0} add(f32[64]{0} %a, f32[64]{0} %b), '
+                'metadata={op_name="zero1.reduce_scatter_grads/reduce_scatter"}')
+        assert CollectiveInventory.from_hlo(line).collectives == []
+
+    def test_sizes_matches_legacy_collective_sizes(self):
+        text = self.AR_LINE + "\n%x = f32[8]{0} add(f32[8]{0} %a, f32[8]{0} %b)"
+        inv = CollectiveInventory.from_hlo(text)
+        assert sorted(inv.sizes()) == sorted(collective_sizes(text))
+
+    def test_helpers_are_the_analyzer_parsers(self):
+        # Satellite contract: tests and the analyzer can never disagree on
+        # how a collective is parsed — the helper IS the analyzer's parser.
+        import helpers
+        from autodist_tpu.analysis import inventory as inv_mod
+
+        assert helpers.collective_sizes is inv_mod.collective_sizes
+        assert helpers.hlo_contains is inv_mod.hlo_contains
+        assert helpers.assert_hlo_wire is inv_mod.assert_hlo_wire
+        assert helpers.CollectiveInventory is inv_mod.CollectiveInventory
+
+
+# ------------------------------------------------- wire pins via the analyzer
+class TestWirePinsOnInventoryAPI:
+    def test_zero1_wire_rederived_clean(self, zero1_setup):
+        plan, strategy, item, step, state, batch, *_ = zero1_setup
+        hlo = compiled_hlo(step, state, batch)
+        report = analyze_program(
+            plan, hlo, strategy=strategy, resource_spec=_spec(),
+            optimizer="adam", batch=batch, program="zero1")
+        assert report.ok, report.render()
+        assert not report.warnings, report.render()
+        inv = CollectiveInventory.from_hlo(hlo)
+        assert inv.has("reduce-scatter") and inv.has("all-gather")
+        # the historical payload pin, now through the inventory API: no
+        # all-reduce at or above the smallest shard_update var
+        min_su = min(
+            int(np.prod(p.var.shape))
+            for p in plan.var_plans.values() if p.shard_update)
+        assert inv.max_payload("all-reduce") < min_su
+
+    def test_promised_wire_names_the_renderings(self, zero1_setup):
+        plan, *_ = zero1_setup
+        wires = plan.promised_wire()
+        su = [w for w in wires.values() if w.rendering == "zero1"]
+        assert su and all(
+            w.require == ("reduce-scatter", "all-gather") for w in su)
+        degraded = [w for w in wires.values() if w.degradations]
+        # the 4-class head bias can't scatter over 8 shards: its quiet
+        # degradation is DECLARED on the promise
+        assert any("non_divisible" in w.degradations for w in degraded)
+
+    def test_sparse_wire_rederived_clean(self, sparse_setup):
+        good_plan, strategy, item, batch, _leaked, params, sgd = sparse_setup
+        good = DistributedTrainStep(good_plan, _embed_loss, sgd.make())
+        hlo = compiled_hlo(good, good.init(params), batch)
+        report = analyze_program(
+            good_plan, hlo, strategy=strategy, resource_spec=_spec(),
+            batch=batch, program="sparse")
+        assert report.ok and not report.warnings, report.render()
+        assert any(w.rendering == "sparse"
+                   for w in good_plan.promised_wire().values())
+
+
+# ------------------------------------------------------------ seeded defects
+class TestSeededDefects:
+    def test_leaked_full_table_collective_is_slw001(self, sparse_setup):
+        good_plan, _s, _i, batch, leaked_hlo, *_ = sparse_setup
+        report = analyze_program(
+            good_plan, leaked_hlo, resource_spec=_spec(), batch=batch,
+            program="leak")
+        codes = report.codes()
+        assert "SLW001" in codes, report.render()
+        msg = next(f for f in report.findings if f.code == "SLW001").message
+        assert "full-table payload" in msg  # stable, greppable
+
+    def test_zero1_refused_wire_is_slw002_and_slw001(self, zero1_setup):
+        plan, _s, item, _step, _state, batch, params, model = zero1_setup
+        adam = OptimizerSpec("adam", {"learning_rate": 1e-3})
+        astrategy = StrategyCompiler(item).compile(
+            AllReduce().build(item, _spec()))
+        aplan = GraphTransformer(
+            astrategy, item, build_mesh(_spec())).transform()
+        astep = DistributedTrainStep(aplan, model.loss_fn, adam.make())
+        ahlo = compiled_hlo(astep, astep.init(params), batch)
+        report = analyze_program(plan, ahlo, resource_spec=_spec(),
+                                 batch=batch, program="refused")
+        codes = report.codes()
+        assert "SLW002" in codes and "SLW001" in codes, report.render()
+        messages = " | ".join(f.message for f in report.findings)
+        assert "carries none" in messages
+        assert "re-fused" in messages
+
+    def test_hbm_overcommit_is_slm001(self, zero1_setup):
+        plan, *_ = zero1_setup
+        tiny = _spec(tpu={"hbm_gb": 1e-5})
+        report = analyze_plan(plan, resource_spec=tiny, optimizer="adam")
+        assert report.codes() == ("SLM001",), report.render()
+        assert "overcommits" in report.findings[0].message
+        # and a sane spec is clean
+        assert analyze_plan(plan, resource_spec=_spec(),
+                            optimizer="adam").ok
+
+    def test_degradation_drift_is_slh003(self, zero1_setup):
+        _plan, strategy, item, *_ = zero1_setup
+        drifted = GraphTransformer(
+            strategy, item, build_mesh(_spec())).transform()
+        flipped = next(vp for vp in drifted.var_plans.values()
+                       if vp.degradations)
+        flipped.shard_update = True
+        report = analyze_plan(drifted, strategy=strategy)
+        assert "SLH003" in report.codes(), report.render()
+        messages = " | ".join(f.message for f in report.findings)
+        assert "drifted" in messages or "declaring degradations" in messages
+
+    def test_rendezvous_order_and_group_permutation_are_slh001(self):
+        a = ("%all-reduce.1 = f32[64]{0} all-reduce(f32[64]{0} %x), "
+             "channel_id=1, replica_groups={{0,1},{2,3}}, to_apply=%add\n"
+             "%all-gather.1 = f32[64]{0} all-gather(f32[8]{0} %y), "
+             "channel_id=2, replica_groups={{0,1,2,3}}, dimensions={0}\n")
+        reordered = "\n".join(reversed(a.strip().splitlines()))
+        permuted = a.replace("{{0,1},{2,3}}", "{{1,0},{2,3}}")
+
+        def codes(b_text):
+            return [f.code for f in rendezvous_hazards({
+                "s0": CollectiveInventory.from_hlo(a, "s0"),
+                "s1": CollectiveInventory.from_hlo(b_text, "s1")})]
+
+        assert codes(reordered) == ["SLH001"]
+        assert codes(permuted) == ["SLH001"]
+        assert codes(a) == []  # identical programs rendezvous fine
+
+    def test_alias_size_mismatch_is_slh002(self):
+        bad = ("HloModule jit__step, is_scheduled=true, "
+               "input_output_alias={ {0}: (0, {}, may-alias) }, x=y\n"
+               "ENTRY %main.1 (p0: f32[64,64], p1: f32[32]) -> "
+               "(f32[32,64], f32[]) {\n")
+        findings = alias_hazards(bad)
+        assert [f.code for f in findings] == ["SLH002"]
+        assert "donated buffer sizes differ" in findings[0].message
+        good = bad.replace("(f32[32,64]", "(f32[64,64]")
+        assert alias_hazards(good) == []
+
+    def test_finding_codes_are_stable_and_closed(self):
+        # Codes are append-only API: a Finding with an unknown code or
+        # severity must be unconstructable.
+        assert set(FINDING_CODES) >= {
+            "SLW001", "SLW002", "SLW003", "SLM001", "SLM002",
+            "SLH001", "SLH002", "SLH003", "SLS001"}
+        with pytest.raises(ValueError):
+            Finding(code="SLX999", severity="error", message="x")
+        with pytest.raises(ValueError):
+            Finding(code="SLW001", severity="fatal", message="x")
+
+
+# ------------------------------------------------------------------- screen
+class TestScreenStrategy:
+    def _item(self):
+        return ModelItem.from_params({"w": np.zeros((64, 64), np.float32)})
+
+    def test_unknown_var_and_part_table_mismatch(self):
+        item = self._item()
+        s = Strategy(node_config=[
+            NodeConfig("ghost", AllReduceSynchronizer()),
+            NodeConfig("w", AllReduceSynchronizer(), partitioner="4,1",
+                       part_config=[
+                           NodeConfig("w/p0", AllReduceSynchronizer())]),
+        ])
+        codes = [f.code for f in screen_strategy(s, item, _spec())]
+        assert codes == ["SLS001", "SLS001"]
+
+    def test_async_ps_and_oversharded_axis(self):
+        item = self._item()
+        s = Strategy(node_config=[
+            NodeConfig("w", PSSynchronizer(sync=False)),
+        ])
+        findings = screen_strategy(s, item, _spec())
+        assert [f.code for f in findings] == ["SLS001"]
+        assert "async PS" in findings[0].message
+        s2 = Strategy(node_config=[
+            NodeConfig("w", AllReduceSynchronizer(), partitioner="128,1"),
+        ])
+        findings2 = screen_strategy(s2, item, _spec())
+        assert [f.code for f in findings2] == ["SLS001"]
+
+    def test_clean_strategy_screens_clean(self):
+        item = self._item()
+        s = AllReduce().build(item, _spec())
+        assert screen_strategy(s, item, _spec()) == []
+
+    def test_search_rejects_screened_seeds_before_pricing(self, monkeypatch):
+        # A slate seed the screen rejects never enters the candidate pool;
+        # provenance records the rejection.
+        import importlib
+
+        # NB: `import autodist_tpu.plan.search as m` resolves to the
+        # `search()` FUNCTION (plan/__init__ rebinds the name); go through
+        # sys.modules for the module object.
+        search_mod = importlib.import_module("autodist_tpu.plan.search")
+        import autodist_tpu.strategy.cost_model as cm
+
+        item = ModelItem.from_params({"w": np.zeros((64, 64), np.float32)})
+        real_slate = cm.candidate_slate
+
+        class BadBuilder:
+            def build(self, mi, rs):
+                return Strategy(node_config=[
+                    NodeConfig("w", PSSynchronizer(sync=False))])
+
+        def slate_with_bad(*a, **kw):
+            return real_slate(*a, **kw) + [("BadSeed", BadBuilder())]
+
+        monkeypatch.setattr(search_mod, "candidate_slate", slate_with_bad)
+        result = search_mod.PlanSearch(
+            item, _spec(),
+            search_mod.SearchConfig(generations=1)).run()
+        rejected = result.provenance.get("screen_rejected", {})
+        assert rejected.get("BadSeed") == ["SLS001"]
+        assert "BadSeed" not in result.provenance["seeds"]
+
+
+# ------------------------------------------------- cache analyzer validation
+class TestCacheAnalyzerValidation:
+    def test_overcommitted_entry_evicted_with_finding(
+            self, zero1_setup, tmp_path):
+        _plan, strategy, item, *_ = zero1_setup
+        from autodist_tpu.plan.cache import PlanCache
+
+        cache = PlanCache(cache_dir=str(tmp_path / "cache"), validate=True)
+        cache.put(item, _spec(), strategy)
+        assert cache.get(item, _spec()) is not None  # clean entry validates
+
+        tiny = _spec(tpu={"hbm_gb": 1e-5})
+        cache.put(item, tiny, strategy)
+        # The package logger doesn't propagate to root (caplog can't see
+        # it); attach a capture handler directly.
+        import io
+
+        buf = io.StringIO()
+        handler = pylogging.StreamHandler(buf)
+        logger = pylogging.getLogger("autodist_tpu")
+        logger.addHandler(handler)
+        try:
+            entry = cache.get(item, tiny)
+        finally:
+            logger.removeHandler(handler)
+        assert entry is None
+        assert cache.stats["invalidated"] == 1
+        assert "SLM001" in buf.getvalue()  # the finding rides the eviction
+
+    def test_dryrun_lowers_raises_analysis_error(self, zero1_setup):
+        _plan, strategy, item, *_ = zero1_setup
+        from autodist_tpu.plan.cache import dryrun_lowers
+
+        tiny = _spec(tpu={"hbm_gb": 1e-5})
+        with pytest.raises(AnalysisError) as ei:
+            dryrun_lowers(strategy, item, tiny)
+        assert "SLM001" in str(ei.value)
+        assert dryrun_lowers(strategy, item, _spec()) is True
+
+
+# ----------------------------------------------------------------- selftest
+def test_selftest_cli():
+    """The fast-lane wiring of ``python -m autodist_tpu.analysis
+    --selftest`` — the same convention as tests/test_plan.py's planner
+    selftest pin (compiles every dryrun family in a subprocess, ~15 s)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "autodist_tpu.analysis", "--selftest"],
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["ok"] is True
+    assert line["n_families_clean"] >= 9
+    assert line["seeded_defects"]["hbm_overcommit"] == ["SLM001"]

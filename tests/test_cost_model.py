@@ -638,6 +638,88 @@ class TestWeightUpdateSpecParity:
             assert p.shard_update == (realized > 1), name
 
 
+class TestDegradationParity:
+    """PR-6 satellite: lowering, pricing, and the static analyzer share
+    ONE quiet-degradation predicate (``kernel/degrade.py``). Executable
+    form: for a sweep of var kinds × shapes × mesh sizes, the lowering's
+    realized ``shard_update`` flag equals ``not degradation_reasons`` AND
+    equals the cost model's zero1 pricing gate — three-way parity, so the
+    PR-5-era hand-mirrored lists can never silently diverge again."""
+
+    # (shape, sparse, expert, part_axis, compressor)
+    CASES = [
+        ((64, 64), False, False, None, "NoneCompressor"),   # clean zero1
+        ((7, 3), False, False, None, "NoneCompressor"),     # non-divisible
+        ((), False, False, None, "NoneCompressor"),         # scalar
+        ((64, 64), False, False, None, "bf16"),             # compressed
+        ((64, 64), False, False, 0, "NoneCompressor"),      # partitioned
+        ((7, 64), False, False, 0, "NoneCompressor"),       # fallback axis
+        ((4096, 16), True, False, None, "NoneCompressor"),  # sparse rows
+        ((8, 16, 32), False, True, None, "NoneCompressor"),  # expert var
+        ((6,), False, False, 0, "NoneCompressor"),          # nothing lands
+    ]
+
+    @pytest.mark.parametrize("ndev", [2, 8])
+    def test_three_way_parity(self, ndev):
+        from autodist_tpu.kernel import GraphTransformer, build_mesh
+        from autodist_tpu.kernel.degrade import zero1_degradation_reasons
+        from autodist_tpu.model_item import ModelItem
+        from autodist_tpu.strategy.base import StrategyCompiler
+        from autodist_tpu.strategy.ir import (
+            AllReduceSynchronizer,
+            NodeConfig,
+            Strategy,
+        )
+
+        import jax
+
+        spec = _single(chips=ndev)
+        mesh = build_mesh(spec, devices=jax.devices()[:ndev])
+        for shape, sparse, expert, axis, comp in self.CASES:
+            if not shape and axis is not None:
+                continue
+            params = {"w": np.zeros(shape or (), np.float32)}
+            item = ModelItem.from_params(
+                params, optimizer_spec=OptimizerSpec("adam"),
+                sparse_names=["w"] if sparse else (),
+                expert_names=["w"] if expert else ())
+            partitioner = ""
+            if axis is not None and shape:
+                parts = [1] * len(shape)
+                parts[axis] = min(int(shape[axis]), ndev) or 1
+                partitioner = ",".join(map(str, parts))
+            s = Strategy(node_config=[NodeConfig(
+                "w", AllReduceSynchronizer(
+                    compressor=comp, shard_update=True),
+                partitioner=partitioner)])
+            s.graph_config.replicas = ["localhost:TPU:0"]
+            compiled = StrategyCompiler(item).compile(s)
+            node = compiled.node_config[0]
+            plan = GraphTransformer(compiled, item, mesh).transform()
+            var = item.var("w")
+            reasons = zero1_degradation_reasons(
+                var.shape, sparse_update=var.sparse_update,
+                expert=var.expert, part_axis=node.active_partition_axis,
+                compressor=comp, n_data=ndev, n_model=1, n_expert=1)
+            realized = plan.plan_for("w").shard_update
+            label = (f"shape={shape} sparse={sparse} expert={expert} "
+                     f"axis={axis} comp={comp} ndev={ndev}")
+            # lowering == predicate
+            assert realized == (not reasons), (
+                f"{label}: lowering rendered shard_update={realized} but "
+                f"the shared predicate says {reasons}")
+            # degradations are DECLARED on the plan when inactive
+            if not realized:
+                assert tuple(plan.plan_for("w").degradations) == reasons, (
+                    label)
+            # pricing == predicate (the cost model's zero1 gate)
+            cm = CostModel(item, spec)
+            priced = not cm._zero1_degradations(
+                var, node.active_partition_axis, comp)
+            assert priced == (not reasons), (
+                f"{label}: cost model gate {priced} vs predicate {reasons}")
+
+
 def test_slate_preference_matches_candidate_slate_order():
     """SLATE_PREFERENCE is the tie-break order preferred_prediction uses;
     it must list candidate_slate's names in the slate's own order or the

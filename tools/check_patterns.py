@@ -1,0 +1,90 @@
+#!/usr/bin/env python3
+"""Banned-pattern lint: codebase-specific rules ruff can't express.
+
+Runs in CI's lint job (``.github/workflows/ci.yml``) before any test tier;
+exits 1 listing ``file:line`` offenders. Rules:
+
+1. **shard_map drift shield** — ``jax.experimental.shard_map`` may be
+   imported ONLY inside ``autodist_tpu/utils/compat.py``: every other call
+   site must go through the compat shim, which maps the new
+   ``jax.shard_map`` surface onto 0.4.x's experimental one (docs/parity.md
+   drift triage). A bare import reintroduces exactly the toolchain-drift
+   class PR 4 spent 15 test failures fixing.
+
+2. **no wall-clock in timed bench windows** — ``time.time()`` is banned in
+   ``bench.py`` and ``examples/benchmark/``: it steps with NTP/suspend, so
+   a timed window that uses it can silently mis-measure. Timed windows use
+   ``time.perf_counter()``; wall stamps for traces belong to ``obs/``.
+
+Pure stdlib, no third-party deps — runs anywhere Python runs.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SHARD_MAP_RE = re.compile(
+    r"^\s*(from\s+jax\.experimental(\.shard_map)?\s+import\s+.*shard_map"
+    r"|.*\bjax\.experimental\.shard_map\b(?!`))")
+TIME_TIME_RE = re.compile(r"\btime\.time\(\)")
+
+
+def _py_files(*roots):
+    for root in roots:
+        full = os.path.join(REPO, root)
+        if os.path.isfile(full):
+            yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for f in filenames:
+                if f.endswith(".py"):
+                    yield os.path.relpath(
+                        os.path.join(dirpath, f), REPO)
+
+
+def main() -> int:
+    errors = []
+
+    shard_map_allowed = {os.path.join("autodist_tpu", "utils", "compat.py")}
+    for rel in _py_files("autodist_tpu", "tests", "examples", "bench.py"):
+        if rel in shard_map_allowed:
+            continue
+        with open(os.path.join(REPO, rel), "r", encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                code = line.split("#", 1)[0]
+                if SHARD_MAP_RE.search(code):
+                    errors.append(
+                        f"{rel}:{i}: bare jax.experimental.shard_map import"
+                        f" — use autodist_tpu.utils.compat.shard_map (the "
+                        f"version shim; docs/parity.md)")
+
+    # The queue DRIVER (run_tpu_queue.py) legitimately uses wall-clock for
+    # subprocess deadlines/grace periods — the rule targets measurement
+    # windows, not timeouts.
+    time_exempt = {os.path.join("examples", "benchmark", "run_tpu_queue.py")}
+    for rel in _py_files("bench.py", os.path.join("examples", "benchmark")):
+        if rel in time_exempt:
+            continue
+        with open(os.path.join(REPO, rel), "r", encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                code = line.split("#", 1)[0]
+                if TIME_TIME_RE.search(code):
+                    errors.append(
+                        f"{rel}:{i}: time.time() in a bench file — timed "
+                        f"windows must use time.perf_counter()")
+
+    if errors:
+        print("banned-pattern lint FAILED:", file=sys.stderr)
+        for e in errors:
+            print("  " + e, file=sys.stderr)
+        return 1
+    print("banned-pattern lint ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
