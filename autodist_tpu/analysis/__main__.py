@@ -6,7 +6,8 @@ acceptance claim fails**:
 
 1. **family conformance** — every dryrun family the driver gate runs
    (``__graft_entry__``: tensor-parallel, Parallax sparse, PS/ZeRO-3,
-   zero1, expert, ring, pipeline, PowerSGD, TopK+bf16, host offload,
+   zero1, bucketed backward-overlap, expert, ring, pipeline, PowerSGD,
+   TopK+bf16, host offload,
    hybrid DCN) lowers, compiles, and the analyzer re-derives its pinned
    wire from the plan's promise with ZERO error/warning findings — the
    analyzer agrees with every existing wire pin on every family;
@@ -63,6 +64,7 @@ def _families():
         "parallax_sparse": g._dryrun_parallax_sparse,
         "ps_zero3": g._dryrun_ps_zero3,
         "zero1": g._dryrun_zero1,
+        "bucketed_overlap": g._dryrun_bucketed_overlap,
         "expert_parallel": g._dryrun_expert_parallel,
         "ring_attention": g._dryrun_ring_attention,
         "pipeline_parallel": g._dryrun_pipeline_parallel,
@@ -134,7 +136,18 @@ def selftest() -> int:  # noqa: C901 - one linear proof, mirrors plan's
                           for row in report.tables.get("wire", [])}
             expect = {"zero1": "zero1", "parallax_sparse": "sparse",
                       "ps_zero3": "zero3", "tensor_parallel": "partitioned",
-                      "expert_parallel": "expert"}.get(tag)
+                      "expert_parallel": "expert",
+                      "bucketed_overlap": "zero1"}.get(tag)
+            # Family #12: the analyzer's promised-wire table must carry
+            # the bucket attribution (per-bucket allowances in VarWire).
+            if tag == "bucketed_overlap":
+                bucket_ids = {row.get("bucket")
+                              for row in report.tables.get("wire", [])
+                              if row.get("bucket") is not None}
+                if len(bucket_ids) < 2:
+                    failures.append(
+                        f"family {tag}: wire table attributes "
+                        f"{len(bucket_ids)} bucket(s); expected >= 2")
             if expect and expect not in renderings:
                 failures.append(
                     f"family {tag}: promised wire lost the {expect!r} "

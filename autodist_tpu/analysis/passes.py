@@ -197,15 +197,25 @@ def wire_conformance(
         for c in inventory.collectives:
             for _dt, dims in c.results:
                 elems = int(np.prod(dims)) if dims else 1
-                candidates = {w.storage_elements}
+                # Backward-overlap bucketing (VarWire.bucket): a combined
+                # collective for this var's bucket legitimately carries the
+                # bucket's SUMMED payload — the per-bucket allowance. Each
+                # base size is divided by ONE mesh axis at a time (shard
+                # view), never compounded across axes — compounding would
+                # loosen the match for every multi-axis family.
+                bases = {w.storage_elements}
+                if w.bucket is not None and w.bucket_elements:
+                    bases.add(int(w.bucket_elements))
+                candidates = set(bases)
                 for k in mesh_sizes.values():
                     if k > 1:
-                        candidates.add(-(-w.storage_elements // int(k)))
+                        for base in bases:
+                            candidates.add(-(-base // int(k)))
                 if elems in candidates and (
                         c.op in w.allow or c.op in w.require):
                     matched.append(c)
                     break
-        table.append({
+        row = {
             "var": name,
             "rendering": w.rendering,
             "planned_ops": list(planned_ops),
@@ -214,7 +224,10 @@ def wire_conformance(
             "actual_bytes": (sum(c.result_bytes for c in matched)
                              if matched else None),
             "degradations": list(w.degradations),
-        })
+        }
+        if w.bucket is not None:
+            row["bucket"] = int(w.bucket)
+        table.append(row)
     return findings, table
 
 

@@ -1,0 +1,265 @@
+"""Bucketed backward-overlap gradient synchronization (the "hide the wire"
+mechanism, GSPMD §latency-hiding / arXiv 2105.04663; ZeRO weight-update
+sharding assumes exactly this overlap, arXiv 2004.13336).
+
+Without bucketing, ``DistributedTrainStep`` emits every gradient collective
+(``psum`` for plain AllReduce vars, ``psum_scatter`` for zero1
+``shard_update`` vars) *after* the full backward pass — communication and
+compute are serialized on the hot path, and ``obs.StepProfiler`` shows the
+wire as exposed step time. This module makes the sync overlap the backward:
+
+- **assignment** (:func:`assign_buckets`): eligible variables are grouped
+  into size-targeted buckets in REVERSE model order — the backward pass
+  produces gradients for the last layers first, so the bucket holding the
+  last layers' variables closes earliest and has the most remaining
+  backward compute to hide under;
+- **emission** (:func:`make_bucket_hook`): each bucket is an identity
+  ``jax.custom_vjp`` applied to the bucket's parameters inside the
+  differentiated function. Autodiff calls the hook's backward rule exactly
+  when ALL of the bucket's cotangents are available — i.e. at the bucket's
+  layer-group boundary in the backward — and the rule emits the bucket's
+  collectives there, under a ``gradsync.bucket_{i}`` named scope, so XLA's
+  latency-hiding scheduler can run bucket k's reduce-scatter concurrently
+  with layer k-1's backward compute.
+
+Eligibility mirrors the quiet-degradation discipline of
+``kernel/degrade.py``: variables claimed by a more specific wire
+(compressed, sparse row-sharded, expert-sharded, explicitly partitioned)
+keep their rendering and sync after the backward as before. THREE
+subsystems must agree on that list exactly — the lowering (which vars get
+hooks), the cost model (which wire seconds count as overlappable), and the
+static analyzer (which collectives attribute to which bucket) — so the
+predicate lives here, once, as pure shape/mesh arithmetic
+(:func:`bucket_exclusion_reasons`; ``tests/test_bucketing.py`` pins the
+three-way parity).
+
+The collective-emission helpers at the bottom are the ONE place the
+gradient-sync ``lax.psum`` / ``lax.psum_scatter`` calls live
+(``tools/check_patterns.py`` bans them elsewhere in ``kernel/lowering.py``
+so a future change cannot silently reintroduce the monolithic post-backward
+sync path). jax imports stay inside the emission functions so the
+chief-side cost model can import the pure half without pulling jax
+(the ``kernel/degrade.py`` convention).
+
+Zero1 shape note: a ``custom_vjp`` backward rule must return cotangents
+shaped like its primals, but ``psum_scatter`` produces the 1/N shard. The
+hook therefore re-embeds the shard into a zero-filled full-shape buffer at
+this instance's offset (``dynamic_update_slice``), and
+:func:`slice_update_shard` extracts exactly that slice again after the
+gradient exits autodiff — values round-trip bit-exactly, and XLA folds the
+update/slice pair away.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+#: Every reason this predicate can emit, in emission order. Mirrors the
+#: ``kernel/degrade.py`` vocabulary where the same mechanism excludes a var
+#: from the zero1 rendering; ``nontrainable``/``ps`` are bucketing-specific
+#: (PS vars sync through their own push/pull wire, never the AR psum path).
+EXCLUSION_REASONS = (
+    "nontrainable",    # no gradient, nothing to sync
+    "ps",              # PS synchronizer: reduction rides the PS wire
+    "compressed",      # active compressor owns the wire (full-grad psum)
+    "expert",          # expert-axis sharding claims the var first
+    "partitioned",     # explicit partition request lands (sharded param)
+    "sparse",          # sparse-update row-sharding claims the var first
+)
+
+#: Default bucket size target (bytes) when a caller enables bucketing
+#: without picking a size; the planner searches the gene instead
+#: (plan/search.py BUCKET_GENE_CHOICES).
+DEFAULT_BUCKET_BYTES = 4 << 20
+
+
+def bucket_exclusion_reasons(
+    shape: Sequence[int],
+    *,
+    trainable: bool = True,
+    is_ps: bool = False,
+    sparse_update: bool = False,
+    expert: bool = False,
+    part_axis: Optional[int] = None,
+    compressor: str = "NoneCompressor",
+    n_data: int = 1,
+    n_model: int = 1,
+    n_expert: int = 1,
+) -> Tuple[str, ...]:
+    """Why a variable would NOT enter a gradient bucket, as pure shape/mesh
+    arithmetic (the cost model's entry point — no jax, no VarPlan).
+
+    Empty tuple = the var is bucket-eligible: its gradient sync is a plain
+    data-axis ``psum`` (replicated AR var, including scalars and vars whose
+    zero1 request quietly degraded on divisibility) or a zero1
+    ``psum_scatter`` — both of which the bucketed emission renders
+    identically to the monolithic path. Mirrors the branch precedence of
+    ``kernel/lowering.py::GraphTransformer._lower_node``.
+    """
+    from autodist_tpu.kernel.degrade import zero1_degradation_reasons
+
+    shape = tuple(int(d) for d in (shape or ()))
+    reasons = []
+    if not trainable:
+        reasons.append("nontrainable")
+    if is_ps:
+        reasons.append("ps")
+    # Reuse the ONE shared degradation predicate for the renderings that
+    # claim a var away from the plain-AR/zero1 psum path; its scalar /
+    # non_divisible reasons do NOT exclude from bucketing (those vars still
+    # sync via a plain psum, which buckets fine).
+    shared = zero1_degradation_reasons(
+        shape, sparse_update=sparse_update, expert=expert,
+        part_axis=part_axis, compressor=compressor,
+        n_data=n_data, n_model=n_model, n_expert=n_expert,
+    )
+    for r in ("compressed", "expert", "partitioned", "sparse"):
+        if r in shared:
+            reasons.append(r)
+    return tuple(r for r in EXCLUSION_REASONS if r in reasons)
+
+
+def plan_exclusion_reasons(var_plan) -> Tuple[str, ...]:
+    """:func:`bucket_exclusion_reasons` read off a lowered
+    :class:`~autodist_tpu.kernel.lowering.VarPlan` — the lowering/analyzer
+    entry point. Derives the same answer from the plan's resolved facts
+    (no mesh arithmetic: the plan already folded it) so the two entry
+    points cannot disagree on a rendered plan."""
+    from autodist_tpu.kernel.compressor import is_active_compressor
+    from autodist_tpu.kernel.lowering import SyncKind
+
+    reasons = []
+    if not var_plan.var.trainable:
+        reasons.append("nontrainable")
+    if var_plan.kind is SyncKind.PS:
+        reasons.append("ps")
+    if is_active_compressor(var_plan.compressor):
+        reasons.append("compressed")
+    # A sharded parameter (expert / partitioned / sparse row-sharded) syncs
+    # through its sharded wire, not the plain data-axis psum — EXCEPT the
+    # zero1 rendering, whose param stays replicated (update_pspec shards).
+    if not var_plan.shard_update and tuple(var_plan.pspec):
+        sharded = any(e is not None for e in tuple(var_plan.pspec))
+        if sharded:
+            if var_plan.var.expert:
+                reasons.append("expert")
+            elif var_plan.var.sparse_update:
+                reasons.append("sparse")
+            else:
+                reasons.append("partitioned")
+    return tuple(r for r in EXCLUSION_REASONS if r in reasons)
+
+
+def assign_buckets(
+    sized_names: Sequence[Tuple[str, int]],
+    bucket_bytes: int,
+) -> Tuple[Tuple[str, ...], ...]:
+    """Partition eligible variables into size-targeted buckets.
+
+    ``sized_names`` is ``(name, byte_size)`` in MODEL order (the plan's
+    variable order); the assignment walks it in REVERSE so bucket 0 holds
+    the last variables — whose gradients the backward pass produces first —
+    and closes early. Greedy fill: a bucket closes once its accumulated
+    bytes reach ``bucket_bytes`` (an oversized single variable gets its own
+    bucket). Deterministic and order-stable: the same input always yields
+    the same partition, every input name lands in exactly one bucket.
+    """
+    if bucket_bytes <= 0 or not sized_names:
+        return ()
+    buckets = []
+    current: list = []
+    acc = 0
+    for name, nbytes in reversed(list(sized_names)):
+        current.append(name)
+        acc += max(int(nbytes), 0)
+        if acc >= bucket_bytes:
+            buckets.append(tuple(current))
+            current, acc = [], 0
+    if current:
+        buckets.append(tuple(current))
+    return tuple(buckets)
+
+
+# --------------------------------------------------------------- emission
+# The ONE home of the gradient-sync collectives. tools/check_patterns.py
+# bans lax.psum / lax.psum_scatter in kernel/lowering.py so the monolithic
+# sync path cannot silently come back outside this helper.
+
+def psum_mean(x, axis_name: str, n: int):
+    """Data-axis mean reduction: the plain AllReduce gradient (and loss /
+    aux) wire — ``psum(x) / n``."""
+    from jax import lax
+
+    return lax.psum(x, axis_name) / n
+
+
+def reduce_scatter_grad(g, axis_name: str, n: int, dim: int):
+    """The zero1 gradient wire: reduce-scatter of the mean gradient over
+    the data axis; this instance keeps its 1/n slice along ``dim``
+    (arXiv 2004.13336)."""
+    from jax import lax
+
+    return lax.psum_scatter(g / n, axis_name, scatter_dimension=dim,
+                            tiled=True)
+
+
+def slice_update_shard(g, axis_name: str, n: int, dim: int):
+    """Extract this instance's 1/n shard of a full-shape gradient along
+    ``dim`` — the inverse of the bucket hook's zero-embed, so a bucketed
+    zero1 gradient exits the manual region shaped exactly like the
+    unbucketed ``psum_scatter`` result (bit-equal values)."""
+    from jax import lax
+
+    idx = lax.axis_index(axis_name)
+    size = g.shape[dim] // n
+    return lax.dynamic_slice_in_dim(g, idx * size, size, dim)
+
+
+def make_bucket_hook(
+    bucket_index: int,
+    names: Sequence[str],
+    su_dims: Dict[str, int],
+    axis_name: str,
+    n: int,
+):
+    """Identity ``custom_vjp`` over one bucket's parameter leaves whose
+    backward rule emits the bucket's gradient collectives.
+
+    Autodiff invokes the rule when every cotangent in the bucket is ready —
+    the bucket's layer-group boundary in the backward — so the collectives
+    land mid-backward where XLA's latency-hiding scheduler can overlap them
+    with the remaining backward compute. Plain AR vars get
+    :func:`psum_mean`; zero1 (``shard_update``) vars get
+    :func:`reduce_scatter_grad` with the shard re-embedded full-shape (see
+    module docstring); the caller re-slices via :func:`slice_update_shard`.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    names = tuple(names)
+
+    @jax.custom_vjp
+    def hook(*leaves):
+        return leaves
+
+    def fwd(*leaves):
+        return leaves, None
+
+    def bwd(_, grads):
+        out = []
+        with jax.named_scope(f"gradsync.bucket_{bucket_index}"):
+            for name, g in zip(names, grads):
+                dim = su_dims.get(name)
+                if dim is None:
+                    out.append(psum_mean(g, axis_name, n))
+                    continue
+                shard = reduce_scatter_grad(g, axis_name, n, dim)
+                idx = lax.axis_index(axis_name)
+                size = g.shape[dim] // n
+                out.append(lax.dynamic_update_slice_in_dim(
+                    jnp.zeros(g.shape, shard.dtype), shard, idx * size,
+                    dim))
+        return tuple(out)
+
+    hook.defvjp(fwd, bwd)
+    return hook

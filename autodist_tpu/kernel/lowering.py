@@ -34,7 +34,7 @@ insert the collectives:
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as _dc_replace
 from enum import Enum
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -47,6 +47,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from autodist_tpu import const
+from autodist_tpu.kernel import bucketing
 from autodist_tpu.kernel.mesh import data_axis
 from autodist_tpu.model_item import ModelItem, VarItem, _path_to_name
 from autodist_tpu.strategy.ir import (
@@ -229,7 +230,11 @@ class GraphTransformer:
             n_nodes=len(self.strategy.node_config),
             shard_update_vars=sum(1 for p in plans.values() if p.shard_update),
         )
-        return ShardingPlan(mesh=self.mesh, var_plans=plans)
+        return ShardingPlan(
+            mesh=self.mesh, var_plans=plans,
+            bucket_bytes=int(getattr(
+                self.strategy.graph_config, "bucket_bytes", 0) or 0),
+        )
 
     # ------------------------------------------------------------------ rules
     def _shard_axis_name(self) -> str:
@@ -585,6 +590,12 @@ class VarWire:
     sparse_row_sharded: bool = False
     compressor: str = "NoneCompressor"
     degradations: Tuple[str, ...] = ()
+    # Backward-overlap bucket this var's gradient collective is emitted in
+    # (kernel/bucketing.py; None = unbucketed post-backward sync), and the
+    # bucket's summed payload — the per-bucket allowance the analyzer
+    # attributes a combined/fused collective against.
+    bucket: Optional[int] = None
+    bucket_elements: int = 0
 
 
 @dataclass
@@ -593,10 +604,39 @@ class ShardingPlan:
 
     mesh: Mesh
     var_plans: Dict[str, VarPlan]
+    # Backward-overlap gradient bucketing target (bytes, 0 = disabled):
+    # carried from Strategy.graph_config.bucket_bytes by the lowering; the
+    # step, the cost model and the analyzer all derive the SAME assignment
+    # from it via bucket_assignment().
+    bucket_bytes: int = 0
 
     # --------------------------------------------------------------- lookups
     def plan_for(self, name: str) -> VarPlan:
         return self.var_plans[name]
+
+    def bucket_assignment(self) -> Tuple[Tuple[str, ...], ...]:
+        """Deterministic backward-overlap bucket partition of this plan's
+        bucket-eligible variables (kernel/bucketing.py): reverse model
+        order, greedy fill to ``bucket_bytes``. Empty when bucketing is
+        disabled or nothing is eligible. The ONE assignment the step's
+        emission, the analyzer's attribution and the cost model's overlap
+        pricing share."""
+        from autodist_tpu.kernel.bucketing import (
+            assign_buckets,
+            plan_exclusion_reasons,
+        )
+
+        if self.bucket_bytes <= 0:
+            return ()
+        sized = []
+        for name, p in self.var_plans.items():
+            if plan_exclusion_reasons(p):
+                continue
+            elems = 1
+            for d in (p.storage_shape or tuple(p.var.shape) or (1,)):
+                elems *= int(d)
+            sized.append((name, elems * int(np.dtype(p.var.dtype).itemsize)))
+        return assign_buckets(sized, self.bucket_bytes)
 
     @property
     def has_sparse_ps(self) -> bool:
@@ -922,6 +962,13 @@ class ShardingPlan:
         """
         ax_d = data_axis(self.mesh)
         sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        # Bucket attribution: which backward-overlap bucket carries each
+        # var's gradient collective, and the bucket's summed payload (the
+        # allowance a combined per-bucket collective is checked against).
+        bucket_of: Dict[str, int] = {}
+        for bi, names in enumerate(self.bucket_assignment()):
+            for n in names:
+                bucket_of[n] = bi
 
         def axes_of(pspec: P):
             out = set()
@@ -973,7 +1020,18 @@ class ShardingPlan:
                 sparse_row_sharded=(p.var.sparse_update and bool(axes)),
                 compressor=p.compressor,
                 degradations=p.degradations,
+                bucket=bucket_of.get(name),
             )
+        if bucket_of:
+            # Per-bucket summed payload: a combined collective for bucket i
+            # may legitimately carry up to this many elements.
+            bucket_sums: Dict[int, int] = {}
+            for name, bi in bucket_of.items():
+                bucket_sums[bi] = (bucket_sums.get(bi, 0)
+                                   + wires[name].storage_elements)
+            for name, bi in bucket_of.items():
+                wires[name] = _dc_replace(
+                    wires[name], bucket_elements=bucket_sums[bi])
         return wires
 
     def describe(self) -> str:
@@ -1059,6 +1117,22 @@ class DistributedTrainStep:
             for name, p in plan.var_plans.items()
             if p.staleness > 0
         }
+        # Backward-overlap gradient bucketing (kernel/bucketing.py): the
+        # plan's deterministic assignment, emitted as per-bucket collectives
+        # INSIDE the backward via custom_vjp hooks so XLA's latency-hiding
+        # scheduler can overlap the wire with backward compute. Disabled
+        # under gradient accumulation: per-microbatch emission would
+        # multiply the wire by k and reassociate the mean.
+        self._buckets: Tuple[Tuple[str, ...], ...] = ()
+        if plan.bucket_bytes > 0:
+            if self._accum > 1:
+                logging.warning(
+                    "bucketed grad sync (bucket_bytes=%d) disabled under "
+                    "grad_accum_steps=%d: collectives must fire once per "
+                    "step, after accumulation", plan.bucket_bytes,
+                    self._accum)
+            else:
+                self._buckets = plan.bucket_assignment()
 
     @staticmethod
     def _resolve_compressors(plan: ShardingPlan):
@@ -1230,7 +1304,7 @@ class DistributedTrainStep:
             host_shardings = self.plan.state_shardings(shapes)
             device_shardings = self.plan.state_shardings(shapes, device_view=True)
             state = _stream(state, host_shardings, device_shardings)
-        if self._compressors or self._shard_update:
+        if self._compressors or self._shard_update or self._buckets:
             loss, aux, grads, new_comp = self._manual_sync_grads(state, batch)
         elif self._accum > 1:
             loss, aux, grads = self._accumulated_grads(state.params, batch)
@@ -1373,13 +1447,21 @@ class DistributedTrainStep:
 
     # ---------------------------------------------- manual gradient sync
     def _manual_sync_grads(self, state: TrainState, batch):
-        """Gradient sync with an explicit per-variable wire: compression
-        and/or zero1 reduce-scatter around the data-axis psum.
+        """Gradient sync with an explicit per-variable wire: compression,
+        zero1 reduce-scatter, and/or bucketed backward-overlap emission
+        around the data-axis psum.
 
         Runs the loss/grad computation inside a ``shard_map`` that is manual
         over the data axis only: each instance sees its local batch shard,
         computes local-mean grads, and each var picks its wire —
 
+        - bucketed vars (``plan.bucket_assignment()`` non-empty): the
+          collective is emitted INSIDE the backward pass by the bucket's
+          ``custom_vjp`` hook (kernel/bucketing.py, ``gradsync.bucket_{i}``
+          named scopes) — same per-var op (psum / psum_scatter), moved to
+          the bucket's layer-group boundary so XLA's latency-hiding
+          scheduler overlaps it with the remaining backward compute; the
+          trailing loop only re-slices zero1 shards;
         - compressed vars: the compressor's compress → psum → decompress
           sequence (the collective runs on compressed payloads — the
           reference wrapped ``collective_ops.all_reduce`` the same way);
@@ -1454,6 +1536,41 @@ class DistributedTrainStep:
         )
 
         loss_fn, has_aux, k = self.loss_fn, self.has_aux, self._accum
+
+        # Backward-overlap buckets: wrap the loss so each bucket's params
+        # pass through an identity custom_vjp whose backward rule emits the
+        # bucket's collectives mid-backward (kernel/bucketing.py). Names
+        # are filtered to leaves actually present in the params tree so a
+        # hook's arg list always zips exactly with its cotangents.
+        p_leaves, _ = jax.tree_util.tree_flatten_with_path(state.params)
+        present = {_path_name(path) for path, _ in p_leaves}
+        buckets = tuple(
+            b for b in (
+                tuple(nm for nm in names if nm in present)
+                for names in self._buckets)
+            if b)
+        bucketed = {nm for names in buckets for nm in names}
+        if buckets:
+            hooks = [
+                bucketing.make_bucket_hook(i, names, su_dims, ax, n)
+                for i, names in enumerate(buckets)
+            ]
+            inner_loss_fn = loss_fn
+
+            def loss_fn(p, b):  # noqa: F811 - deliberate hooked rebind
+                leaves, treedef = jax.tree_util.tree_flatten_with_path(p)
+                vals = [leaf for _, leaf in leaves]
+                idx_of = {
+                    _path_name(path): j for j, (path, _) in enumerate(leaves)
+                }
+                for hook, names in zip(hooks, buckets):
+                    idxs = [idx_of[nm] for nm in names]
+                    outs = hook(*[vals[j] for j in idxs])
+                    for j, o in zip(idxs, outs):
+                        vals[j] = o
+                return inner_loss_fn(
+                    jax.tree_util.tree_unflatten(treedef, vals), b)
+
         if k > 1:
             # Validate (and later microbatch) ONLY the leaves the region
             # data-shards; replicated leaves (broadcast masks, scalars —
@@ -1506,26 +1623,39 @@ class DistributedTrainStep:
                     local_grads, params, micro, k)
             else:
                 loss, aux, grads = local_grads(params, local_batch)
-            loss = lax.psum(loss, ax) / n
+            loss = bucketing.psum_mean(loss, ax, n)
             if aux is not None:
-                aux = jax.tree.map(lambda x: lax.psum(x, ax) / n, aux)
+                aux = jax.tree.map(
+                    lambda x: bucketing.psum_mean(x, ax, n), aux)
             g_leaves, g_treedef = jax.tree_util.tree_flatten_with_path(grads)
             new_comp = dict(comp_state)
             synced = []
             for path, g in g_leaves:
                 name = _path_name(path)
+                if name in bucketed:
+                    if name in su_dims:
+                        # Bucketed zero1: the reduce-scatter already fired
+                        # inside the backward (gradsync.bucket_i scope);
+                        # extract this instance's shard from the hook's
+                        # re-embedded full-shape buffer (bit-exact).
+                        with jax.named_scope("gradsync.shard_slice"):
+                            synced.append(bucketing.slice_update_shard(
+                                g, ax, n, su_dims[name]))
+                    else:
+                        # Plain AR bucketed var: already psum'd mid-backward.
+                        synced.append(g)
+                    continue
                 if name in su_dims:
                     # zero1: one reduce-scatter replaces the all-reduce —
                     # this instance keeps only its 1/n gradient slice, which
                     # is exactly what its optimizer-state shard consumes.
                     with jax.named_scope("zero1.reduce_scatter_grads"):
-                        synced.append(lax.psum_scatter(
-                            g / n, ax, scatter_dimension=su_dims[name],
-                            tiled=True))
+                        synced.append(bucketing.reduce_scatter_grad(
+                            g, ax, n, su_dims[name]))
                     continue
                 comp = compressors.get(name)
                 if comp is None:
-                    synced.append(lax.psum(g, ax) / n)
+                    synced.append(bucketing.psum_mean(g, ax, n))
                     continue
                 # Local state arrives as the (1, ...) slice of the stacked
                 # per-shard leaves; unwrap, step, rewrap.

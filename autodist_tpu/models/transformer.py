@@ -9,7 +9,10 @@ TPU-first choices:
 - compute in bfloat16 (params fp32, matmuls bf16) — MXU-native;
 - attention impl selectable: ``dot`` (XLA fused), ``flash`` (pallas kernel,
   :mod:`autodist_tpu.ops.flash_attention`), ``ring`` (sequence-parallel ring
-  attention, :mod:`autodist_tpu.parallel.ring_attention`);
+  attention, :mod:`autodist_tpu.parallel.ring_attention`), or the default
+  ``auto`` — ``flash`` at and above the measured crossover sequence length
+  (``docs/measured/flash_crossover.json`` via
+  :mod:`autodist_tpu.ops.crossover`), ``dot`` below it;
 - optional ``jax.checkpoint`` per block (remat trades FLOPs for HBM);
 - static shapes everywhere; the layer stack is a Python loop over identical
   blocks so XLA can pipeline it.
@@ -37,7 +40,10 @@ class TransformerConfig:
     max_seq_len: int = 512
     causal: bool = True                 # False => BERT-style MLM
     dtype: Any = jnp.bfloat16           # compute dtype (params stay fp32)
-    attention_impl: str = "dot"         # dot | flash | ring | ulysses
+    # auto = measured-crossover selection (dot below, flash at/above the
+    # seq length recorded in docs/measured/flash_crossover.json); explicit
+    # dot | flash | ring | ulysses always honored.
+    attention_impl: str = "auto"
     remat: bool = False
     mlm_mask_token: int = 0             # [MASK] id for the MLM objective
 
@@ -102,17 +108,25 @@ def _dot_attention(q, k, v, causal: bool):
 
 
 def _attention(q, k, v, cfg: TransformerConfig):
-    if cfg.attention_impl == "dot":
+    impl = cfg.attention_impl
+    if impl == "auto":
+        # Measured-crossover auto-selection: flash at/above the recorded
+        # breakeven seq (block-aligned), dot below — so the default hot
+        # path is the Pallas kernel exactly where the sweep shows it wins.
+        from autodist_tpu.ops.crossover import resolve_attention_impl
+
+        impl = resolve_attention_impl(impl, q.shape[1])
+    if impl == "dot":
         return _dot_attention(q, k, v, cfg.causal)
-    if cfg.attention_impl == "flash":
+    if impl == "flash":
         from autodist_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=cfg.causal)
-    if cfg.attention_impl == "ring":
+    if impl == "ring":
         from autodist_tpu.parallel.ring_attention import ring_attention
 
         return ring_attention(q, k, v, causal=cfg.causal)
-    if cfg.attention_impl == "ulysses":
+    if impl == "ulysses":
         from autodist_tpu.parallel.ring_attention import ulysses_attention
 
         return ulysses_attention(q, k, v, causal=cfg.causal)

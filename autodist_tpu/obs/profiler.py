@@ -18,7 +18,11 @@ FLOPs and HBM bytes come from the **compiled program's own cost analysis**
 an analytical model, so live MFU is measured-over-measured:
 ``mfu = flops_per_step / (device_s_per_step × peak_flops)``. Roofline
 position reuses :mod:`autodist_tpu.utils.roofline`'s time conversion with
-the compiled byte counts. Compile counts/times ride the step's
+the compiled byte counts, and the same bound yields the
+``exposed_comm_fraction`` metric — device time beyond the compute/HBM
+roofline, i.e. wire (and scheduling) time NOT hidden under compute — the
+before/after signal for bucketed backward-overlap gradient sync
+(``GraphConfig.bucket_bytes``, docs/performance.md). Compile counts/times ride the step's
 ``compile_log`` (fresh-program first-call latencies) and the HBM
 high-water mark comes from ``device.memory_stats()`` where the platform
 exposes one (TPU; None on CPU).
@@ -130,6 +134,7 @@ class StepProfiler:
         self._g_flops = reg.gauge("obs_flops_per_step")
         self._g_hbm = reg.gauge("obs_hbm_high_water_bytes")
         self._g_compiles = reg.gauge("obs_programs_compiled")
+        self._g_exposed = reg.gauge("obs_exposed_comm_fraction")
         self._c_windows = reg.counter("obs_profiled_windows_total")
 
     # ------------------------------------------------------------------ run
@@ -291,6 +296,24 @@ class StepProfiler:
                 "vs_roofline": (out["step_device_s"] / times["t_roofline_s"]
                                 if times["t_roofline_s"] else float("nan")),
             }
+            # Exposed-communication split: device step time BEYOND the
+            # compiled program's own compute/HBM roofline bound is time the
+            # chip spent neither on the MXU nor on HBM — on real meshes
+            # that residue is dominated by collectives NOT hidden under
+            # compute (plus scheduling slack), so the fraction is the
+            # measurable "did bucketed backward-overlap actually hide the
+            # wire" signal (docs/performance.md): it drops when
+            # GraphConfig.bucket_bytes moves the grad sync into the
+            # backward, and it is what the plan calibration's overlap_s
+            # coefficient is fitted against. Upper bound by construction —
+            # any non-comm overhead inflates it, never deflates.
+            if out["step_device_s"] > 0:
+                exposed = max(
+                    out["step_device_s"] - times["t_roofline_s"], 0.0)
+                out["exposed_comm_s_per_step"] = exposed
+                out["exposed_comm_fraction"] = (
+                    exposed / out["step_device_s"])
+                self._g_exposed.set(out["exposed_comm_fraction"])
         compile_log = list(getattr(self.step, "compile_log", ()))
         out["compiles"] = {
             "count": len(compile_log),

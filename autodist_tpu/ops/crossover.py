@@ -1,0 +1,79 @@
+"""Measured flash-attention crossover: when "auto" picks the Pallas kernel.
+
+``examples/benchmark/flash_crossover.py`` sweeps the transformer step with
+``attention_impl`` "dot" vs "flash" over sequence lengths on the real
+accelerator and records the table in ``docs/measured/flash_crossover.json``.
+The shape of that table (TPU v5e, bf16): XLA's fused dot-product attention
+wins at short sequences (the flash kernel's block bookkeeping costs more
+than the O(s²) logits it avoids materializing), and the Pallas kernel wins
+once the logits matrix stops fitting in VMEM — 2× step time at s=4096.
+
+This module turns the table into the ONE decision rule the transformer's
+``attention_impl="auto"`` uses: the smallest measured sequence length from
+which flash never loses to dot again. Below it, or when the sequence is not
+block-aligned (the kernel would fall back to the jnp reference anyway),
+"auto" resolves to "dot".
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+#: Fallback when no measured table is readable: the v5e-measured breakeven
+#: (flash ties dot at s=1024 and wins beyond; docs/measured/
+#: flash_crossover.json).
+DEFAULT_FLASH_CROSSOVER_SEQ = 1024
+
+#: The flash kernel's block alignment (ops/flash_attention.py falls back to
+#: the jnp reference for sequences this doesn't divide).
+_FLASH_BLOCK = 128
+
+_cache: dict = {}
+
+
+def _measured_path() -> str:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, "docs", "measured", "flash_crossover.json")
+
+
+def flash_crossover_seq(path: Optional[str] = None) -> int:
+    """Smallest measured seq length from which "flash" never loses to
+    "dot" (tokens/sec), per the recorded sweep; the packaged default when
+    the file is missing, unreadable, or records no crossover. Cached per
+    path — the resolution runs inside model tracing."""
+    key = path or "__default__"
+    if key in _cache:
+        return _cache[key]
+    out = DEFAULT_FLASH_CROSSOVER_SEQ
+    try:
+        with open(path or _measured_path(), "r", encoding="utf-8") as f:
+            rows = json.load(f).get("rows", [])
+        by_seq: dict = {}
+        for r in rows:
+            by_seq.setdefault(int(r["seq"]), {})[str(r["impl"])] = float(
+                r["tokens_per_sec"])
+        seqs = sorted(s for s, v in by_seq.items()
+                      if "dot" in v and "flash" in v)
+        for i, s in enumerate(seqs):
+            if all(by_seq[t]["flash"] >= by_seq[t]["dot"]
+                   for t in seqs[i:]):
+                out = s
+                break
+    except (OSError, ValueError, KeyError, TypeError):
+        pass  # unmeasured installs use the packaged default
+    _cache[key] = out
+    return out
+
+
+def resolve_attention_impl(impl: str, seq_len: int) -> str:
+    """The ``attention_impl="auto"`` rule: "flash" at and above the
+    measured crossover when the sequence is block-aligned (the Pallas
+    kernel's own constraint), else "dot". Explicit impls pass through
+    untouched — "auto" never overrides a caller's choice."""
+    if impl != "auto":
+        return impl
+    if seq_len >= flash_crossover_seq() and seq_len % _FLASH_BLOCK == 0:
+        return "flash"
+    return "dot"

@@ -27,6 +27,8 @@ from autodist_tpu.plan.calibrate import (
     topology_key,
 )
 from autodist_tpu.plan.search import (
+    BUCKET_GENE_CHOICES,
+    PlanGenome,
     PlanSearch,
     SearchConfig,
     SearchResult,
@@ -37,11 +39,13 @@ from autodist_tpu.plan.search import (
 )
 
 __all__ = [
+    "BUCKET_GENE_CHOICES",
     "CacheEntry",
     "CalibrationRecord",
     "Plan",
     "PlanCache",
     "PlanConfig",
+    "PlanGenome",
     "PlanSearch",
     "SearchConfig",
     "SearchResult",

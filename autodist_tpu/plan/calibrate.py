@@ -10,11 +10,13 @@ closes the loop: a set of ``(predicted components, measured seconds)``
 records fits per-component efficiency coefficients
 
     measured_s ≈ base + a·comm_s + b·update_s + c·latency_s + d·act_sync_s
-                 + e·gather_s
+                 + e·gather_s + f·overlap_s
 
 where ``base`` absorbs the compute floor (plus fixed dispatch overhead) and
-``a..e`` the achieved fraction of each nominal peak (``gather_s`` is the
-zero1 param re-gather wire — see :data:`COMPONENTS`). The fit REPORTS its
+``a..f`` the achieved fraction of each nominal peak (``gather_s`` is the
+zero1 param re-gather wire; ``overlap_s`` the bucketed backward-overlap
+wire, whose fitted coefficient is the measured exposed fraction — see
+:data:`COMPONENTS`). The fit REPORTS its
 own ranking error (mean |rel| error before vs after), and is persisted
 per-topology — one file per (accelerator kind × chip count × mesh shape) —
 so it shrinks with use and a calibration measured on one cluster never
@@ -44,11 +46,28 @@ from autodist_tpu.utils import logging
 # gather_s (added with the zero1 shard_update capability) is the param
 # re-gather wire of weight-update-sharded vars — fitted separately from
 # comm_s because the all-gather overlaps differently with the update than
-# the gradient reduction does with the backward pass.
-COMPONENTS = ("comm_s", "update_s", "latency_s", "act_sync_s", "gather_s")
+# the gradient reduction does with the backward pass. overlap_s (added
+# with bucketed backward-overlap emission, GraphConfig.bucket_bytes) is
+# the wire the latency-hiding scheduler is EXPECTED to hide under backward
+# compute: its fitted coefficient is the measured exposed fraction — near
+# 0 when overlap works, near 1 when it doesn't — replacing the analytic
+# prior (cost_model.OVERLAP_EXPOSED_FRACTION).
+COMPONENTS = ("comm_s", "update_s", "latency_s", "act_sync_s", "gather_s",
+              "overlap_s")
 # Below this many distinct records the per-component least squares is
 # underdetermined; fall back to the scalar base+scale fit.
 MIN_COMPONENT_POINTS = len(COMPONENTS) + 2
+
+
+def _default_coefficients() -> Dict[str, float]:
+    """Uncalibrated coefficients: nominal (1.0) for every component except
+    overlap_s, which starts at the cost model's analytic exposure prior so
+    an unfitted TopologyCalibration predicts exactly StrategyCost.total_s."""
+    from autodist_tpu.strategy.cost_model import OVERLAP_EXPOSED_FRACTION
+
+    coef = {c: 1.0 for c in COMPONENTS}
+    coef["overlap_s"] = OVERLAP_EXPOSED_FRACTION
+    return coef
 
 
 def default_calibration_dir() -> str:
@@ -80,14 +99,23 @@ class CalibrationRecord:
     measured_s: float
     name: str = ""
     gather_s: float = 0.0  # zero1 param re-gather wire (0 pre-zero1 records)
+    # Bucketed backward-overlap wire (0 for pre-bucketing / unbucketed
+    # records); see COMPONENTS.
+    overlap_s: float = 0.0
     dispatch_gap_s: float = 0.0
     flops_per_step: float = 0.0
     bytes_per_step: float = 0.0
 
     @property
     def predicted_s(self) -> float:
+        """Mirrors StrategyCost.total_s (incl. the analytic overlap-exposure
+        prior) so the uncalibrated error column grades the same number the
+        search objective uses."""
+        from autodist_tpu.strategy.cost_model import OVERLAP_EXPOSED_FRACTION
+
         return (self.comm_s + self.update_s + self.latency_s
-                + self.act_sync_s + self.gather_s)
+                + self.act_sync_s + self.gather_s
+                + OVERLAP_EXPOSED_FRACTION * self.overlap_s)
 
     @classmethod
     def from_cost(cls, cost: StrategyCost, measured_s: float,
@@ -96,6 +124,7 @@ class CalibrationRecord:
             comm_s=cost.comm_s, update_s=cost.update_s,
             latency_s=cost.latency_s, act_sync_s=cost.act_sync_s,
             gather_s=getattr(cost, "gather_s", 0.0),
+            overlap_s=getattr(cost, "overlap_s", 0.0),
             measured_s=float(measured_s), name=name, **extra,
         )
 
@@ -105,6 +134,7 @@ class CalibrationRecord:
             "latency_s": self.latency_s, "act_sync_s": self.act_sync_s,
             "measured_s": self.measured_s,
             **({"gather_s": self.gather_s} if self.gather_s else {}),
+            **({"overlap_s": self.overlap_s} if self.overlap_s else {}),
             **({"name": self.name} if self.name else {}),
             **({"dispatch_gap_s": self.dispatch_gap_s}
                if self.dispatch_gap_s else {}),
@@ -122,6 +152,7 @@ class CalibrationRecord:
             act_sync_s=float(d["act_sync_s"]),
             measured_s=float(d["measured_s"]), name=str(d.get("name", "")),
             gather_s=float(d.get("gather_s", 0.0)),
+            overlap_s=float(d.get("overlap_s", 0.0)),
             dispatch_gap_s=float(d.get("dispatch_gap_s", 0.0)),
             flops_per_step=float(d.get("flops_per_step", 0.0)),
             bytes_per_step=float(d.get("bytes_per_step", 0.0)),
@@ -151,7 +182,7 @@ class TopologyCalibration:
     """Fitted per-component correction for one topology."""
 
     coefficients: Dict[str, float] = field(
-        default_factory=lambda: {c: 1.0 for c in COMPONENTS})
+        default_factory=_default_coefficients)
     base_s: float = 0.0
     device: str = ""
     topology: str = ""
@@ -209,7 +240,7 @@ class TopologyCalibration:
             if active:
                 cols = active + [n_comp]
                 coef, *_ = np.linalg.lstsq(A[:, cols], y, rcond=None)
-                comp_coef = {c: 1.0 for c in COMPONENTS}
+                comp_coef = _default_coefficients()
                 for i, col in enumerate(active):
                     comp_coef[COMPONENTS[col]] = float(coef[i])
                 base = float(coef[-1])
@@ -231,7 +262,12 @@ class TopologyCalibration:
                 scale, base = np.polyfit(pred, meas, 1)
                 if scale <= 0:
                     scale, base = 1.0, float(np.mean(meas - pred))
-            out.coefficients = {c: float(scale) for c in COMPONENTS}
+            # Scalar form scales predicted_s, which already charges the
+            # overlap-exposure prior — so the overlap coefficient carries
+            # scale x prior to keep predict_s == base + scale·predicted_s.
+            out.coefficients = {
+                c: float(scale) * v for c, v in _default_coefficients().items()
+            }
             out.base_s = max(float(base), 0.0)
         out.error_after = prediction_error(recs, out)
         return out
@@ -268,7 +304,10 @@ class TopologyCalibration:
         try:
             with open(path, "r", encoding="utf-8") as f:
                 d = json.load(f)
-            coef = {c: float(d["coefficients"].get(c, 1.0))
+            # Components absent from an older file (pre-overlap_s
+            # calibrations) keep their uncalibrated default.
+            defaults = _default_coefficients()
+            coef = {c: float(d["coefficients"].get(c, defaults[c]))
                     for c in COMPONENTS}
             return cls(
                 coefficients=coef,
@@ -335,7 +374,7 @@ def _merge_records(old: Sequence[CalibrationRecord],
     merged: Dict[tuple, CalibrationRecord] = {}
     for r in list(old) + list(new):
         sig = (r.name, r.comm_s, r.update_s, r.latency_s, r.act_sync_s,
-               r.gather_s, r.measured_s)
+               r.gather_s, r.overlap_s, r.measured_s)
         merged.pop(sig, None)  # re-insert so the newest occurrence is last
         merged[sig] = r
     return list(merged.values())[-MAX_PERSISTED_RECORDS:]

@@ -27,6 +27,12 @@ Genome encoding (one :class:`VarGene` per trainable variable, model order):
 - ``group``: collective fusion group id (AllReduce only, advisory on TPU);
 - ``dest``: PS reduction-destination index into ``reduction_devices``.
 
+Plus ONE genome-wide gene: ``bucket_bytes`` (``PlanGenome.bucket_bytes``,
+choices in :data:`BUCKET_GENE_CHOICES`) — the backward-overlap gradient
+bucketing target the lowering renders via ``kernel/bucketing.py``; the
+cost model prices its hidden wire as ``overlap_s`` and the per-topology
+calibration fits how much of it the hardware actually hides.
+
 Seeds come from the live ``candidate_slate()`` builders, so search starts
 from every policy ``Auto`` already knows and can only improve on the best
 of them (the ``--selftest`` acceptance bound).
@@ -58,6 +64,16 @@ from autodist_tpu.utils import logging
 KINDS = ("ar", "ps1", "ps3", "zero1")
 CHUNK_SIZES = (1, 32, 128, 512)
 
+# Backward-overlap bucket-size gene (GraphConfig.bucket_bytes): 0 keeps the
+# monolithic post-backward sync; non-zero targets bucket the grad
+# collectives inside the backward (kernel/bucketing.py). Genome-wide, not
+# per-var — the assignment is a partition of the whole gradient set. The
+# cost model prices the trade (overlap_s hides wire, per-bucket dispatch
+# latency punishes confetti-sized buckets), and the per-topology
+# calibration's overlap_s coefficient makes the gene's value measured, not
+# assumed.
+BUCKET_GENE_CHOICES = (0, 1 << 20, 4 << 20, 16 << 20, 64 << 20)
+
 
 @dataclass(frozen=True)
 class VarGene:
@@ -69,7 +85,47 @@ class VarGene:
     dest: int = 0
 
 
-Genome = Tuple[VarGene, ...]
+@dataclass(frozen=True, eq=False)
+class PlanGenome:
+    """A full candidate plan: per-variable genes + the genome-wide
+    backward-overlap bucket-size gene. Hashable (beam/dedup key).
+
+    Pre-bucket-gene code treated a genome as a bare tuple of VarGenes;
+    iteration, length, equality and hashing preserve that view (an
+    unbucketed PlanGenome equals — and hashes like — its genes tuple)."""
+
+    genes: Tuple[VarGene, ...]
+    bucket_bytes: int = 0
+
+    def __len__(self) -> int:
+        return len(self.genes)
+
+    def __iter__(self):
+        return iter(self.genes)
+
+    def __eq__(self, other):
+        if isinstance(other, PlanGenome):
+            return (self.genes == other.genes
+                    and self.bucket_bytes == other.bucket_bytes)
+        if isinstance(other, tuple):
+            return self.bucket_bytes == 0 and self.genes == other
+        return NotImplemented
+
+    def __hash__(self):
+        if self.bucket_bytes == 0:
+            return hash(self.genes)  # hash-consistent with the tuple view
+        return hash((self.genes, self.bucket_bytes))
+
+
+# One candidate in genome space. A bare tuple of VarGenes is accepted
+# everywhere a Genome is (bucket_bytes = 0) for backward compatibility.
+Genome = PlanGenome
+
+
+def _as_genome(genome) -> PlanGenome:
+    if isinstance(genome, PlanGenome):
+        return genome
+    return PlanGenome(genes=tuple(genome))
 
 
 def _shard_count(dim: int, degree: int) -> int:
@@ -87,13 +143,16 @@ def genome_to_strategy(
 ) -> Strategy:
     """Render a genome as ordinary Strategy IR (node-level configs only —
     no per-shard ``part_config`` tables, which exist for reference-format
-    parity and fold back to node-level settings at lowering anyway)."""
+    parity and fold back to node-level settings at lowering anyway). The
+    bucket-size gene lands on ``graph_config.bucket_bytes``."""
     from autodist_tpu.strategy.base import replica_devices
 
+    genome = _as_genome(genome)
     variables = model_item.trainable_variables
-    if len(genome) != len(variables):
+    if len(genome.genes) != len(variables):
         raise ValueError(
-            f"genome length {len(genome)} != {len(variables)} trainable vars")
+            f"genome length {len(genome.genes)} != {len(variables)} "
+            f"trainable vars")
     dests = reduction_devices(resource_spec)
     mesh_shape = resource_spec.mesh_shape(("data", "model"))
     n_model = max(int(mesh_shape.get("model", 1)), 1)
@@ -102,7 +161,8 @@ def genome_to_strategy(
 
     strategy = Strategy(id=Strategy.new_id(resource_spec.fingerprint()))
     strategy.graph_config.replicas = replica_devices(resource_spec)
-    for var, gene in zip(variables, genome):
+    strategy.graph_config.bucket_bytes = int(genome.bucket_bytes)
+    for var, gene in zip(variables, genome.genes):
         partitioner = ""
         if (gene.axis is not None and gene.axis < len(var.shape)
                 and gene.kind != "zero1"):
@@ -135,7 +195,7 @@ def strategy_to_genome(strategy: Strategy, model_item: ModelItem,
                        resource_spec: ResourceSpec) -> Genome:
     """Project a built Strategy onto the genome space (seeding). Per-shard
     tables collapse to their node-level settings; unknown destinations map
-    to index 0."""
+    to index 0; the graph-wide bucket_bytes projects onto the bucket gene."""
     dests = {d: i for i, d in enumerate(reduction_devices(resource_spec))}
     genes: List[VarGene] = []
     for var in model_item.trainable_variables:
@@ -157,7 +217,11 @@ def strategy_to_genome(strategy: Strategy, model_item: ModelItem,
                 axis=axis,
                 dest=dests.get(sync.reduction_destination, 0),
             ))
-    return tuple(genes)
+    return PlanGenome(
+        genes=tuple(genes),
+        bucket_bytes=int(getattr(
+            strategy.graph_config, "bucket_bytes", 0) or 0),
+    )
 
 
 def _objective(cost: StrategyCost, calibration=None) -> Tuple[bool, float]:
@@ -280,15 +344,24 @@ class PlanSearch:
                 strategy, self.model_item, self.spec)
         if not genomes:
             # Degenerate fallback: all-AllReduce (always buildable).
-            genomes["AllReduce"] = tuple(
-                VarGene() for _ in self.model_item.trainable_variables)
+            genomes["AllReduce"] = PlanGenome(genes=tuple(
+                VarGene() for _ in self.model_item.trainable_variables))
         return built, genomes
 
     # -------------------------------------------------------------- mutation
     def _mutate(self, genome: Genome) -> Genome:
-        genes = list(genome)
+        genome = _as_genome(genome)
+        genes = list(genome.genes)
+        bucket = genome.bucket_bytes
         if not genes:  # model with no trainable variables: nothing to move
             return genome
+        move = self._rng.random()
+        if move < 0.12:
+            # Genome-wide bucket-size gene: re-pick the backward-overlap
+            # bucketing target (0 = monolithic post-backward sync).
+            return PlanGenome(
+                genes=tuple(genes),
+                bucket_bytes=self._rng.choice(BUCKET_GENE_CHOICES))
         i = self._rng.randrange(len(genes))
         g = genes[i]
         move = self._rng.random()
@@ -311,9 +384,9 @@ class PlanSearch:
                         dest=x.dest)
                 for j, x in enumerate(genes)
             ]
-            return tuple(genes)
+            return PlanGenome(genes=tuple(genes), bucket_bytes=bucket)
         genes[i] = g
-        return tuple(genes)
+        return PlanGenome(genes=tuple(genes), bucket_bytes=bucket)
 
     # ----------------------------------------------------------------- score
     def _score(self, genome: Genome) -> Tuple[Tuple[bool, float], StrategyCost]:
@@ -430,14 +503,21 @@ class PlanSearch:
                 "latency_s": win_cost.latency_s,
                 "act_sync_s": win_cost.act_sync_s,
                 "gather_s": win_cost.gather_s,
+                "overlap_s": win_cost.overlap_s,
                 "per_chip_gb": win_cost.per_chip_bytes / 1e9,
                 "opt_gb_per_chip": win_cost.opt_bytes / 1e9,
                 "n_shard_update": sum(
-                    1 for g in winner if g.kind == "zero1"),
+                    1 for g in _as_genome(winner).genes if g.kind == "zero1"),
+                "bucket_bytes": _as_genome(winner).bucket_bytes,
                 "feasible": win_cost.feasible,
             },
             "improvement_vs_best_seed": improvement,
             "trajectory": trajectory,
+            # The bucket-size gene values the search actually visited —
+            # the end-to-end evidence that the gene is searchable, pinned
+            # by the plan selftest.
+            "bucket_sizes_visited": sorted(
+                {_as_genome(g).bucket_bytes for g in scored}),
             "screen_rejected": dict(self._screen_rejected),
             "why": why,
         }

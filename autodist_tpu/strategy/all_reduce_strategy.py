@@ -26,15 +26,21 @@ class AllReduce(StrategyBuilder):
     """Gradient all-reduce over the ICI mesh for every trainable variable."""
 
     def __init__(self, chunk_size: int = 128, all_reduce_spec: str = "AUTO",
-                 compressor: str = "NoneCompressor"):
+                 compressor: str = "NoneCompressor", bucket_bytes: int = 0):
         if chunk_size < 1:
             raise ValueError("The chunk_size must be greater than zero.")
+        if bucket_bytes < 0:
+            raise ValueError("bucket_bytes must be >= 0.")
         self.chunk_size = chunk_size
         self.all_reduce_spec = all_reduce_spec
         self.compressor = compressor
+        # Backward-overlap gradient bucketing target (0 = one post-backward
+        # sync); see strategy.ir.GraphConfig.bucket_bytes / docs/zero.md.
+        self.bucket_bytes = bucket_bytes
 
     def build(self, model_item: ModelItem, resource_spec: ResourceSpec) -> Strategy:
         expr = self._new_strategy(resource_spec)
+        expr.graph_config.bucket_bytes = self.bucket_bytes
         expr.node_config = [
             NodeConfig(
                 var_name=v.name,

@@ -56,6 +56,17 @@ from autodist_tpu.strategy.ir import (
 ICI_LATENCY_S = 5e-6
 DCN_LATENCY_S = 100e-6
 
+# Analytic prior for bucketed backward-overlap collectives
+# (GraphConfig.bucket_bytes > 0): the fraction of an *overlappable* bucket
+# collective's wire time still expected to show up on the critical path
+# (scheduler imperfections, VMEM pressure, ICI contention with the matmuls
+# it hides under). StrategyCost.overlap_s carries the overlappable seconds
+# raw; total_s charges this fraction of them. The per-topology calibration
+# (plan/calibrate.py "overlap_s" component) replaces the prior with a
+# measured coefficient — near 0 when XLA's latency-hiding scheduler truly
+# hides the wire, near 1 when it doesn't.
+OVERLAP_EXPOSED_FRACTION = 0.25
+
 # Predictions closer than this are a tie, not a ranking: the analytical
 # model's per-family deltas (collective-count latency, chunking constants)
 # sit well below both its own fidelity and measured run-to-run variance
@@ -382,11 +393,19 @@ class StrategyCost:
     # Per-chip optimizer-slot residency (a subset of per_chip_bytes): the
     # number zero1 divides by ~N, surfaced as explain's opt/chip column.
     opt_bytes: float = 0.0
+    # Wire seconds moved OUT of comm_s because bucketed backward-overlap
+    # emission (GraphConfig.bucket_bytes) lets the latency-hiding scheduler
+    # run them under backward compute: every bucket's grad collective
+    # except the last-closing one. total_s charges only
+    # OVERLAP_EXPOSED_FRACTION of it (analytic prior); calibration fits
+    # the real coefficient per topology.
+    overlap_s: float = 0.0
 
     @property
     def total_s(self) -> float:
         return (self.comm_s + self.update_s + self.latency_s
-                + self.act_sync_s + self.gather_s)
+                + self.act_sync_s + self.gather_s
+                + OVERLAP_EXPOSED_FRACTION * self.overlap_s)
 
     @property
     def feasible(self) -> bool:
@@ -397,7 +416,8 @@ class StrategyCost:
             f"total {self.total_s * 1e3:.3f} ms "
             f"(comm {self.comm_s * 1e3:.3f}, update {self.update_s * 1e3:.3f}, "
             f"lat {self.latency_s * 1e3:.3f}, act {self.act_sync_s * 1e3:.3f}, "
-            f"gather {self.gather_s * 1e3:.3f}) "
+            f"gather {self.gather_s * 1e3:.3f}, "
+            f"overlap {self.overlap_s * 1e3:.3f}) "
             f"mem {self.per_chip_bytes / 1e9:.2f}/{self.hbm_bytes / 1e9:.2f} GB "
             f"(opt {self.opt_bytes / 1e9:.2f}) "
             f"{'ok' if self.feasible else 'OVER'}"
@@ -786,6 +806,31 @@ class CostModel:
         return (comm, update, act, 0.0, params, extra, opt, n_coll, False,
                 ps_loads)
 
+    def _bucketable(self, node: NodeConfig, var: VarItem) -> bool:
+        """Backward-overlap bucket eligibility for one AR node — the ONE
+        shared predicate (kernel/bucketing.py), on this model's mesh
+        degrees, so pricing can never bucket a var the lowering would not
+        (``tests/test_bucketing.py`` pins the three-way parity)."""
+        from autodist_tpu.kernel.bucketing import bucket_exclusion_reasons
+
+        try:
+            part_axis = node.active_partition_axis
+        except ValueError:
+            part_axis = None
+        return not bucket_exclusion_reasons(
+            var.shape,
+            trainable=var.trainable,
+            is_ps=not isinstance(node.synchronizer, AllReduceSynchronizer),
+            sparse_update=var.sparse_update,
+            expert=var.expert,
+            part_axis=part_axis,
+            compressor=getattr(node.synchronizer, "compressor",
+                               "NoneCompressor"),
+            n_data=self.n_data,
+            n_model=self.n_model,
+            n_expert=self.n_expert,
+        )
+
     # -------------------------------------------------------------- strategy
     def strategy_cost(self, strategy: Strategy) -> StrategyCost:
         comm = update = act = gather = params_bytes = extra_bytes = 0.0
@@ -794,6 +839,12 @@ class CostModel:
         su_groups: set = set()
         n_ps_coll = 0
         host_loads: Dict[str, float] = {}
+        bucket_bytes = int(getattr(
+            strategy.graph_config, "bucket_bytes", 0) or 0)
+        # (name, var bytes, comm contribution, shard_update) per bucketed
+        # var, in node (model) order — mirrors the lowering's assignment
+        # input exactly.
+        bucket_rows: List[Tuple[str, float, float, bool]] = []
         for node in strategy.node_config:
             try:
                 var = self.model_item.var(node.var_name)
@@ -812,6 +863,12 @@ class CostModel:
                 host_loads[h] = host_loads.get(h, 0.0) + load
             sync = node.synchronizer
             if isinstance(sync, AllReduceSynchronizer):
+                if bucket_bytes > 0 and self._bucketable(node, var):
+                    # Bucketed vars leave the fusion-group accounting: the
+                    # bucket partition decides their dispatch count below.
+                    bucket_rows.append(
+                        (var.name, float(var.byte_size), c, su_active))
+                    continue
                 leaf_groups = (
                     [p.synchronizer.group for p in node.part_config
                      if isinstance(p.synchronizer, AllReduceSynchronizer)]
@@ -824,11 +881,40 @@ class CostModel:
                 (su_groups if su_active else groups).update(leaf_groups)
             else:
                 n_ps_coll += n_coll
+        # Bucketed backward-overlap emission (kernel/bucketing.py): the SAME
+        # reverse-order greedy assignment the lowering renders. Every
+        # bucket's grad collective except the LAST-closing one (the first
+        # model variables, whose grads the backward produces at its very
+        # end) overlaps remaining backward compute — its wire moves from
+        # comm_s to overlap_s (total_s charges OVERLAP_EXPOSED_FRACTION of
+        # it; calibration fits the real coefficient). The zero1 param
+        # all-gather (gather_s) happens after the update and stays exposed.
+        overlap = 0.0
+        n_bucket_coll = 0
+        if bucket_rows:
+            from autodist_tpu.kernel.bucketing import assign_buckets
+
+            buckets = assign_buckets(
+                [(nm, b) for nm, b, _, _ in bucket_rows], bucket_bytes)
+            comm_of = {nm: c for nm, _, c, _ in bucket_rows}
+            per_bucket = [sum(comm_of[nm] for nm in names)
+                          for names in buckets]
+            overlap = sum(per_bucket[:-1])
+            # One grad collective dispatch per bucket, plus one param
+            # all-gather when any bucketed var shards its update.
+            n_bucket_coll = len(buckets) + (
+                1 if any(su for *_, su in bucket_rows) else 0)
         # PS destination NIC serialization dominates the hierarchical
-        # all-reduce estimate for those vars; charge the slower of the two.
+        # all-reduce estimate for those vars; charge the slower of the two
+        # — against the PRE-overlap comm, then move the overlappable wire
+        # out (subtracting after the max would let a dominating host load
+        # void the subtraction while total_s still charges the overlap
+        # prior, double-counting the bucketed wire on mixed AR+PS plans).
         if host_loads:
             comm = max(comm, max(host_loads.values()))
-        n_collectives = len(groups) + 2 * len(su_groups) + n_ps_coll
+        comm = max(comm - overlap, 0.0)
+        n_collectives = (len(groups) + 2 * len(su_groups) + n_ps_coll
+                         + n_bucket_coll)
         latency = n_collectives * self.latency
         per_chip = params_bytes + extra_bytes
         return StrategyCost(
@@ -837,6 +923,7 @@ class CostModel:
             latency_s=latency,
             act_sync_s=act,
             gather_s=gather,
+            overlap_s=overlap,
             per_chip_bytes=per_chip,
             hbm_bytes=self.hbm_cap,
             n_collectives=n_collectives,

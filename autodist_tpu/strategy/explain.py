@@ -206,7 +206,8 @@ def explain_provenance(provenance: dict, out=None) -> None:
         f"update {w.get('update_s', 0.0) * 1e3:.3f}, "
         f"lat {w.get('latency_s', 0.0) * 1e3:.3f}, "
         f"act {w.get('act_sync_s', 0.0) * 1e3:.3f}, "
-        f"gather {w.get('gather_s', 0.0) * 1e3:.3f}), "
+        f"gather {w.get('gather_s', 0.0) * 1e3:.3f}, "
+        f"overlap {w.get('overlap_s', 0.0) * 1e3:.3f}), "
         f"{w.get('per_chip_gb', 0.0):.2f} GB/chip "
         f"(opt {w.get('opt_gb_per_chip', 0.0):.2f}) "
         f"{'ok' if w.get('feasible') else 'OVER'}",
@@ -217,6 +218,14 @@ def explain_provenance(provenance: dict, out=None) -> None:
             f"zero1: {w['n_shard_update']} vars carry shard_update "
             f"(reduce-scatter grads, 1/N-sharded optimizer update, "
             f"all-gather params — docs/zero.md)",
+            file=out,
+        )
+    if w.get("bucket_bytes"):
+        print(
+            f"bucketed overlap: bucket_bytes={w['bucket_bytes']} — grad "
+            f"collectives emitted per bucket inside the backward "
+            f"({w.get('overlap_s', 0.0) * 1e3:.3f} ms of wire priced as "
+            f"overlappable; kernel/bucketing.py, docs/performance.md)",
             file=out,
         )
     calib = provenance.get("calibration")

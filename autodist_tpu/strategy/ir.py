@@ -184,9 +184,27 @@ class NodeConfig:
 
 @dataclass
 class GraphConfig:
-    """Graph-wide config: the replica set (strategy.proto:62-68)."""
+    """Graph-wide config: the replica set (strategy.proto:62-68) plus the
+    backward-overlap gradient-bucketing target.
+
+    ``bucket_bytes`` (0 = disabled) asks the lowering to emit gradient
+    collectives in size-targeted buckets INSIDE the backward pass
+    (``kernel/bucketing.py``): eligible AR/zero1 variables partition into
+    buckets of ~this many bytes in reverse model order, each bucket's
+    psum/psum-scatter fires at its layer-group boundary so XLA's
+    latency-hiding scheduler overlaps the wire with backward compute.
+    Graph-wide (not per-node) because the assignment is a partition of the
+    whole gradient set; the planner searches it as a gene
+    (``plan/search.py`` BUCKET_GENE_CHOICES).
+    """
 
     replicas: List[str] = field(default_factory=list)
+    bucket_bytes: int = 0
+
+    def __post_init__(self):
+        if self.bucket_bytes < 0:
+            raise ValueError(
+                f"bucket_bytes must be >= 0, got {self.bucket_bytes}")
 
 
 # --------------------------------------------------------------------------- #
@@ -260,16 +278,23 @@ class Strategy:
             "id": self.id,
             "path": self.path,
             "node_config": [_node_to_json(n) for n in self.node_config],
-            "graph_config": {"replicas": list(self.graph_config.replicas)},
+            "graph_config": {
+                "replicas": list(self.graph_config.replicas),
+                "bucket_bytes": int(self.graph_config.bucket_bytes),
+            },
         }
 
     @classmethod
     def from_json(cls, d: dict) -> "Strategy":
+        gc = d.get("graph_config", {})
         return cls(
             id=d.get("id", ""),
             path=d.get("path", ""),
             node_config=[_node_from_json(n) for n in d.get("node_config", [])],
-            graph_config=GraphConfig(replicas=list(d.get("graph_config", {}).get("replicas", []))),
+            graph_config=GraphConfig(
+                replicas=list(gc.get("replicas", [])),
+                bucket_bytes=int(gc.get("bucket_bytes", 0)),
+            ),
         )
 
     def serialize(self, path: Optional[str] = None) -> str:

@@ -31,17 +31,24 @@ class Zero1(StrategyBuilder):
     """AllReduce with reduce-scatter/sharded-update/all-gather weight sync."""
 
     def __init__(self, chunk_size: int = 128, all_reduce_spec: str = "AUTO",
-                 min_bytes: int = 0):
+                 min_bytes: int = 0, bucket_bytes: int = 0):
         if chunk_size < 1:
             raise ValueError("The chunk_size must be greater than zero.")
         if min_bytes < 0:
             raise ValueError("min_bytes must be >= 0.")
+        if bucket_bytes < 0:
+            raise ValueError("bucket_bytes must be >= 0.")
         self.chunk_size = chunk_size
         self.all_reduce_spec = all_reduce_spec
         self.min_bytes = min_bytes
+        # Backward-overlap bucketing: emit the reduce-scatters per bucket
+        # inside the backward (kernel/bucketing.py) instead of one
+        # monolithic post-backward sync; 0 keeps the monolithic rendering.
+        self.bucket_bytes = bucket_bytes
 
     def build(self, model_item: ModelItem, resource_spec: ResourceSpec) -> Strategy:
         expr = self._new_strategy(resource_spec)
+        expr.graph_config.bucket_bytes = self.bucket_bytes
         expr.node_config = [
             NodeConfig(
                 var_name=v.name,

@@ -488,6 +488,10 @@ def _format_result(measured: dict, errors: dict) -> tuple:
         "batch_size": head["batch_size"],
         "loss": round(head["loss"], 4),
     }
+    if os.environ.get("AUTODIST_BENCH_XLA_FLAG_SET"):
+        # Which measured compiler-flag set (docs/measured/xla_flags.json)
+        # was active — so rounds before/after a flag change stay comparable.
+        result["xla_flag_set"] = os.environ["AUTODIST_BENCH_XLA_FLAG_SET"]
     if head_name != "resnet":
         result["seq_len"] = head["seq"]
     # The non-head workload rides along as extras in BOTH directions —
@@ -724,6 +728,39 @@ def main() -> None:
         sys.exit(1)
 
 
+def _apply_measured_xla_flags() -> str:
+    """Apply the flag set ``xla_flag_ab.py --emit-json`` recorded in
+    docs/measured/xla_flags.json (the latency-hiding / async-collective
+    set the bucketed backward-overlap grad sync depends on) to the
+    environment BEFORE any jax backend initializes — child measurement
+    processes inherit it. Returns the applied config name ('' when none).
+    Opt out by deleting the file or setting
+    ``AUTODIST_NO_MEASURED_XLA_FLAGS=1``."""
+    if os.environ.get("AUTODIST_NO_MEASURED_XLA_FLAGS"):
+        return ""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "docs", "measured", "xla_flags.json")
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            chosen = json.load(f).get("chosen", {})
+    except (OSError, ValueError):
+        return ""
+    name = str(chosen.get("name", ""))
+    for env_key, doc_key in (("XLA_FLAGS", "xla_flags"),
+                             ("LIBTPU_INIT_ARGS", "libtpu_init_args")):
+        extra = str(chosen.get(doc_key, "") or "").strip()
+        # Operator-set flags win: only append flags whose NAME is absent
+        # (exact name match — a substring test would drop a flag whose
+        # name prefixes a longer operator-set flag).
+        have = os.environ.get(env_key, "")
+        have_names = {t.split("=", 1)[0] for t in have.split()}
+        add = " ".join(tok for tok in extra.split()
+                       if tok.split("=", 1)[0] not in have_names)
+        if add:
+            os.environ[env_key] = (have + " " + add).strip()
+    return name
+
+
 def _main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model",
@@ -744,6 +781,16 @@ def _main() -> None:
              "signal survives even when timing is lost to a wedged queue "
              "driver (rc=124)")
     args = ap.parse_args()
+    # Measured compiler-flag set (docs/measured/xla_flags.json) goes into
+    # the env before ANY jax import in this process or its children —
+    # compiler flags only exist at backend init.
+    _applied_flags = _apply_measured_xla_flags()
+    if _applied_flags:
+        # Env (not a local) so watchdogged child processes inherit the
+        # label the JSON line reports.
+        os.environ["AUTODIST_BENCH_XLA_FLAG_SET"] = _applied_flags
+        print(f"bench: applying measured XLA flag set {_applied_flags!r} "
+              f"(docs/measured/xla_flags.json)", file=sys.stderr)
     if args.lint:
         # Env, not a flag, so watchdogged child processes
         # (_measure_in_subprocess) inherit the mode without plumbing.

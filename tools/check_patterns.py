@@ -16,6 +16,16 @@ exits 1 listing ``file:line`` offenders. Rules:
    a timed window that uses it can silently mis-measure. Timed windows use
    ``time.perf_counter()``; wall stamps for traces belong to ``obs/``.
 
+3. **grad-sync collectives live in the bucketing helper** — emitting
+   ``lax.psum(`` / ``lax.psum_scatter(`` in ``autodist_tpu/kernel/``
+   outside ``kernel/bucketing.py`` (and the compressor wire,
+   ``kernel/compressor.py``) is banned: the bucketed backward-overlap
+   emission (dryrun family #12) is only sound if EVERY gradient collective
+   goes through the one helper the bucket assignment, the cost model's
+   overlap pricing and the analyzer's attribution share — a direct psum in
+   the lowering would silently reintroduce the monolithic post-backward
+   sync path this rule exists to keep dead.
+
 Pure stdlib, no third-party deps — runs anywhere Python runs.
 """
 from __future__ import annotations
@@ -30,6 +40,7 @@ SHARD_MAP_RE = re.compile(
     r"^\s*(from\s+jax\.experimental(\.shard_map)?\s+import\s+.*shard_map"
     r"|.*\bjax\.experimental\.shard_map\b(?!`))")
 TIME_TIME_RE = re.compile(r"\btime\.time\(\)")
+PSUM_CALL_RE = re.compile(r"\blax\.psum(_scatter)?\s*\(")
 
 
 def _py_files(*roots):
@@ -76,6 +87,22 @@ def main() -> int:
                     errors.append(
                         f"{rel}:{i}: time.time() in a bench file — timed "
                         f"windows must use time.perf_counter()")
+
+    psum_allowed = {
+        os.path.join("autodist_tpu", "kernel", "bucketing.py"),
+        os.path.join("autodist_tpu", "kernel", "compressor.py"),
+    }
+    for rel in _py_files(os.path.join("autodist_tpu", "kernel")):
+        if rel in psum_allowed:
+            continue
+        with open(os.path.join(REPO, rel), "r", encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                code = line.split("#", 1)[0]
+                if PSUM_CALL_RE.search(code):
+                    errors.append(
+                        f"{rel}:{i}: direct lax.psum/psum_scatter for grad "
+                        f"sync — emit through kernel/bucketing.py (the one "
+                        f"bucketed-emission helper; docs/zero.md)")
 
     if errors:
         print("banned-pattern lint FAILED:", file=sys.stderr)
