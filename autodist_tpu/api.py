@@ -337,6 +337,7 @@ class AutoDist:
         grad_accum_steps: int = 1,
         remat: Union[bool, str] = False,
         compute_dtype: Union[str, None] = None,
+        record_norms: bool = False,
     ) -> "Union[DistributedTrainStep, AsyncPSTrainer]":
         """Capture → strategy → compile → lower (autodist.py:139-150).
 
@@ -362,6 +363,10 @@ class AutoDist:
         ~+1/3 FLOPs), or pass a ``jax.checkpoint_policies`` name (e.g.
         ``"dots_saveable"``) to keep MXU outputs and recompute the rest —
         the HBM-vs-FLOPs trade the TPU guide recommends.
+        ``record_norms=True`` adds global gradient/update L2 norms to the
+        step metrics (two cheap reductions) — the flight recorder persists
+        them and the obs sentry's SNT002 non-finite-norm check watches
+        them (docs/observability.md).
         ``compute_dtype="bfloat16"`` is the mixed-precision master-weight
         policy: floating-point parameters are cast to the compute dtype on
         entry to the loss (XLA fuses the casts into the consuming
@@ -411,7 +416,7 @@ class AutoDist:
             loss_fn = jax.checkpoint(loss_fn, policy=_remat_policy(remat))
         step = DistributedTrainStep(
             plan, loss_fn, tx, has_aux=has_aux, donate_state=donate_state,
-            grad_accum_steps=grad_accum_steps,
+            grad_accum_steps=grad_accum_steps, record_norms=record_norms,
         )
         self._built, self._strategy, self._model_item = step, compiled, model_item
         return step

@@ -106,6 +106,11 @@ class ENV:
     # Plan-cache base dir for the search-based planner (docs/planner.md);
     # empty = DEFAULT_PLAN_DIR/cache.
     AUTODIST_PLAN_CACHE = _EnvVar("")
+    # Flight recorder (docs/observability.md): explicit dir for the
+    # always-on black-box step/event log. Empty = derive <AUTODIST_FT_DIR>/
+    # flight when an ft base is exported, disabled otherwise;
+    # AUTODIST_NO_FLIGHT=1 (read raw, not via this enum) opts out entirely.
+    AUTODIST_FLIGHT_DIR = _EnvVar("")
     SYS_DATA_PATH = _EnvVar("")
     SYS_RESOURCE_PATH = _EnvVar("")
 

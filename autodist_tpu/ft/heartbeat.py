@@ -45,6 +45,7 @@ from typing import Callable, Dict, List, Optional
 
 from autodist_tpu import metrics as M
 from autodist_tpu.ft.config import FTConfig
+from autodist_tpu.obs import recorder as obs_recorder
 from autodist_tpu.utils import logging
 
 
@@ -363,6 +364,12 @@ class HealthMonitor:
             self._c_trans.inc()
             logging.info("peer %d: %s -> %s%s", pid, old.value, new.value,
                          f" ({reason})" if reason else "")
+            # Classification changes are rare and load-bearing for a
+            # postmortem ("host 3 went suspect 40s before the wedge") —
+            # flight-record each with the immediate-fsync discipline.
+            obs_recorder.record_event(
+                "peer_transition", peer=pid, old=old.value, new=new.value,
+                reason=reason or "")
             for fn in self._transitions:
                 try:
                     fn(pid, old, new)

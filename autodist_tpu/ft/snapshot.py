@@ -50,6 +50,7 @@ import jax
 from autodist_tpu import metrics as M
 from autodist_tpu.checkpoint.saver import Saver, _to_host
 from autodist_tpu.ft.config import FTConfig
+from autodist_tpu.obs import recorder as obs_recorder
 from autodist_tpu.obs import spans as obs_spans
 from autodist_tpu.utils import logging
 
@@ -252,8 +253,16 @@ class SnapshotManager:
                     self._prune()
             self._c_taken.inc()
             self._g_step.set(step)
+            # Black-box the landed snapshot: the doctor's progress marker
+            # ("last good state at step N") and the restart supervisor's
+            # progress evidence in one flight event.
+            obs_recorder.record_event("snapshot", critical=False,
+                                      step=step, path=path)
         except BaseException as e:  # noqa: BLE001 - surfaced via wait()
             self._worker_error = e
+            obs_recorder.record_event(
+                "error", error=f"snapshot write failed: "
+                               f"{type(e).__name__}: {e}"[:500])
             logging.warning("snapshot write to %s failed", path, exc_info=True)
 
     def _write_manifest(self, path: str, step: int) -> None:
@@ -344,6 +353,11 @@ class SnapshotManager:
         def handler(sig, frame):
             self.preempted = True
             saved = True
+            # First thing, before any snapshot IO that may itself fail: the
+            # preemption event is the doctor's DOC004 evidence, fsync'd
+            # immediately (critical) so even a botched exit leaves it.
+            obs_recorder.record_event("preempt", signal=int(sig),
+                                      step=self._last_step)
             with self._hook_lock:
                 if self._state_provider is not None:
                     try:

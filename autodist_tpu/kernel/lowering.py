@@ -49,6 +49,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from autodist_tpu import const
 from autodist_tpu.kernel import bucketing
 from autodist_tpu.kernel.mesh import data_axis
+from autodist_tpu.obs import recorder as flight
 from autodist_tpu.model_item import ModelItem, VarItem, _path_to_name
 from autodist_tpu.strategy.ir import (
     AllReduceSynchronizer,
@@ -1077,6 +1078,7 @@ class DistributedTrainStep:
         has_aux: bool = False,
         donate_state: bool = True,
         grad_accum_steps: int = 1,
+        record_norms: bool = False,
     ):
         self.plan = plan
         # Under pad-and-mask sharding the step's param tree is the padded
@@ -1092,6 +1094,11 @@ class DistributedTrainStep:
         self.tx = optimizer
         self.has_aux = has_aux
         self._donate = donate_state
+        # Flight-recorder telemetry (docs/observability.md): global grad /
+        # update norms in the step metrics — two extra reductions per step
+        # (cheap next to the backward), opt-in because they change the
+        # metrics pytree shape callers may have pinned.
+        self._record_norms = bool(record_norms)
         if grad_accum_steps < 1:
             raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
         self._accum = grad_accum_steps
@@ -1334,6 +1341,13 @@ class DistributedTrainStep:
         metrics = {"loss": loss}
         if aux is not None:
             metrics["aux"] = aux
+        if self._record_norms:
+            # Global (all-leaf) L2 norms: the NaN/explosion signal the obs
+            # sentry watches (SNT002). optax.global_norm handles ragged
+            # pytrees; sharded leaves are fine — the norm is computed under
+            # the same shardings as the update itself.
+            metrics["grad_norm"] = optax.global_norm(grads)
+            metrics["update_norm"] = optax.global_norm(updates)
         return new_state, metrics
 
     def _gather_updated_params(self, params):
@@ -1731,20 +1745,35 @@ class DistributedTrainStep:
                         f"{getattr(leaf, 'shape', ())}")
         key = (int(num_steps), stacked, _force_unroll)
         fresh = key not in self._compiled_runs
-        fn = self._window_program(state, batch, num_steps, stacked,
-                                  _force_unroll)
-        if fresh:
-            # The first call of a fresh program compiles synchronously
-            # before dispatching; its latency is the compile-time signal
-            # the obs StepProfiler reports.
-            t0 = time.perf_counter()
-            out = fn(state, batch)
-            self.compile_log.append({
-                "program": f"run[{num_steps}{'/stacked' if stacked else ''}]",
-                "first_call_s": time.perf_counter() - t0,
-            })
-            return out
-        return fn(state, batch)
+        program = f"run[{num_steps}{'/stacked' if stacked else ''}]"
+        try:
+            fn = self._window_program(state, batch, num_steps, stacked,
+                                      _force_unroll)
+            if fresh:
+                # The first call of a fresh program compiles synchronously
+                # before dispatching; its latency is the compile-time signal
+                # the obs StepProfiler reports.
+                t0 = time.perf_counter()
+                out = fn(state, batch)
+                entry = {
+                    "program": program,
+                    "first_call_s": time.perf_counter() - t0,
+                }
+                self.compile_log.append(entry)
+                # Flight-record the compile (no-op without a recorder): a
+                # run that dies mid-compile leaves "compiling X" as its
+                # last event — exactly what the postmortem doctor needs.
+                flight.record_event("compile", critical=False, **entry)
+                return out
+            return fn(state, batch)
+        except Exception as e:
+            # Black-box the failure before re-raising: an XLA OOM
+            # (RESOURCE_EXHAUSTED) or runtime error recorded here is the
+            # doctor's primary oom/crash evidence (docs/observability.md).
+            flight.record_event(
+                "error", program=program,
+                error=f"{type(e).__name__}: {e}"[:500])
+            raise
 
     def _window_program(self, state: TrainState, batch, num_steps: int,
                         stacked: bool, _force_unroll: bool):

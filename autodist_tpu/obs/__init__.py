@@ -20,21 +20,36 @@ text in serve, and the unexported roofline/metrics plumbing:
 - :mod:`~autodist_tpu.obs.aggregate` — per-host step-time quantiles over
   the ft coordination transports; straggler scores feed the
   HealthMonitor's suspect escalation.
+- :mod:`~autodist_tpu.obs.recorder` — the always-on **flight recorder**:
+  one compact JSONL record per train/serve step plus sparse events, in a
+  crash-safe fsync'd segment ring under ``<ft base>/flight`` — the black
+  box every death leaves behind.
+- :mod:`~autodist_tpu.obs.sentry` — online anomaly sentry over that
+  stream: NaN/Inf, loss spikes, step-time regressions, HBM creep,
+  stragglers — stable ``SNT###`` verdict codes, escalated into the ft
+  HealthMonitor.
+- :mod:`~autodist_tpu.obs.doctor` — the postmortem: stitch flight
+  records, heartbeats, snapshot manifests, hang bundles and span parts
+  into one timeline and classify the death (``DOC###`` verdicts).
 
 Entry points: ``AutoDist(observability=ObsConfig(...))`` → ``autodist.obs``
-(:class:`ObsRuntime`), and ``python -m autodist_tpu.obs --selftest`` — the
-zero-hardware CPU proof. See docs/observability.md.
+(:class:`ObsRuntime`), ``python -m autodist_tpu.obs doctor <ft-dir>``, and
+``python -m autodist_tpu.obs --selftest`` — the zero-hardware CPU proof.
+See docs/observability.md.
 """
 from __future__ import annotations
 
 from autodist_tpu.obs.aggregate import HostAggregator
 from autodist_tpu.obs.config import ObsConfig, ObsRuntime
+from autodist_tpu.obs.doctor import Diagnosis, diagnose
 from autodist_tpu.obs.exporter import (
     FileExporter,
     parse_openmetrics,
     render_openmetrics,
 )
 from autodist_tpu.obs.profiler import StepProfiler, StepTimer, detect_peak_flops
+from autodist_tpu.obs.recorder import FlightRecorder, read_records
+from autodist_tpu.obs.sentry import Finding, Sentry, SentryConfig
 from autodist_tpu.obs.spans import (
     Span,
     SpanTracer,
@@ -48,10 +63,15 @@ from autodist_tpu.obs.spans import (
 )
 
 __all__ = [
+    "Diagnosis",
     "FileExporter",
+    "Finding",
+    "FlightRecorder",
     "HostAggregator",
     "ObsConfig",
     "ObsRuntime",
+    "Sentry",
+    "SentryConfig",
     "Span",
     "SpanTracer",
     "StepProfiler",
@@ -59,9 +79,11 @@ __all__ = [
     "add_span",
     "current_trace_id",
     "detect_peak_flops",
+    "diagnose",
     "enable_trace_out",
     "get_tracer",
     "parse_openmetrics",
+    "read_records",
     "render_openmetrics",
     "span",
     "stitch",

@@ -14,10 +14,12 @@ from typing import Optional
 
 from autodist_tpu import const, metrics as M
 from autodist_tpu.const import ENV
+from autodist_tpu.obs import recorder as _flight
 from autodist_tpu.obs import spans as _spans
 from autodist_tpu.obs.aggregate import HostAggregator
 from autodist_tpu.obs.exporter import FileExporter
 from autodist_tpu.obs.profiler import StepProfiler
+from autodist_tpu.obs.sentry import Sentry, SentryConfig
 
 __all__ = ["ObsConfig", "ObsRuntime"]
 
@@ -39,6 +41,18 @@ class ObsConfig:
       p50 exceeds ``threshold ×`` the fleet median for ``escalate_after``
       consecutive aggregation ticks is escalated to the HealthMonitor's
       SUSPECT state (no-op when no monitor is attached).
+    - ``flight`` / ``flight_dir``: the always-on black-box flight recorder
+      (docs/observability.md): one compact JSONL record per profiled step
+      window plus sparse events, in a crash-safe fsync'd ring under
+      ``flight_dir`` (default ``<ft base>/flight``). The recorder is
+      installed as the **process default**, so every built-in
+      instrumentation point (train step compiles/errors, serve admits,
+      snapshots, heartbeat transitions) writes to the same box.
+    - ``sentry`` / ``sentry_config``: the online anomaly sentry over that
+      stream (``obs/sentry.py``): NaN/Inf loss or grads, loss spikes,
+      step-time regressions, HBM creep, stragglers — each a stable
+      ``SNT###`` verdict, escalated into the ft HealthMonitor when one is
+      attached.
     """
 
     trace_out: str = ""
@@ -50,15 +64,27 @@ class ObsConfig:
     aggregate_interval_s: float = 5.0
     straggler_threshold: float = 1.5
     escalate_after: int = 3
+    flight: bool = True
+    flight_dir: str = ""
+    sentry: bool = True
+    sentry_config: Optional[SentryConfig] = None
 
     def resolved(self) -> "ObsConfig":
         """Fill env/derived defaults (same pattern as ``FTConfig.resolved``)."""
         out = ObsConfig(**self.__dict__)
         if not out.trace_out:
             out.trace_out = ENV.AUTODIST_TRACE_OUT.val
+        base = ENV.AUTODIST_FT_DIR.val or const.DEFAULT_FT_DIR
         if out.aggregate and not out.aggregate_dir:
-            base = ENV.AUTODIST_FT_DIR.val or const.DEFAULT_FT_DIR
             out.aggregate_dir = os.path.join(base, "obs")
+        if os.environ.get("AUTODIST_NO_FLIGHT") == "1":
+            # The operator's opt-out (slow/read-only filesystem) beats the
+            # default-on contract AND an explicit ObsConfig — one switch
+            # that stops every flight write in the process.
+            out.flight = False
+        if out.flight and not out.flight_dir:
+            out.flight_dir = (ENV.AUTODIST_FLIGHT_DIR.val
+                              or _flight.flight_dir(base))
         return out
 
 
@@ -87,6 +113,19 @@ class ObsRuntime:
             self.exporter = FileExporter(
                 self.config.metrics_path, registry=self.registry,
                 interval_s=self.config.metrics_interval_s).start()
+        # Flight recorder + sentry (the black-box pair): the recorder is
+        # installed as the process default so library instrumentation
+        # points (train-step compiles/errors, serve admits, ft snapshot
+        # and heartbeat events) write into the same ring this runtime
+        # owns; the sentry watches the per-step stream online.
+        self.recorder = None
+        if self.config.flight and self.config.flight_dir:
+            self.recorder = _flight.enable(self.config.flight_dir)
+        self.sentry: Optional[Sentry] = None
+        if self.config.sentry:
+            self.sentry = Sentry(
+                config=self.config.sentry_config, registry=self.registry,
+                monitor=monitor, recorder=self.recorder)
         self.aggregator: Optional[HostAggregator] = None
         if self.config.aggregate:
             from autodist_tpu.ft.heartbeat import FileTransport
@@ -103,9 +142,11 @@ class ObsRuntime:
 
     def profiler(self, step, **kwargs) -> StepProfiler:
         """A :class:`StepProfiler` over ``step`` wired into this runtime's
-        registry and tracer."""
+        registry, tracer, flight recorder, and sentry."""
         kwargs.setdefault("registry", self.registry)
         kwargs.setdefault("tracer", self.tracer)
+        kwargs.setdefault("recorder", self.recorder)
+        kwargs.setdefault("sentry", self.sentry)
         return StepProfiler(step, **kwargs)
 
     def observe_step(self, seconds: float) -> None:
@@ -116,12 +157,16 @@ class ObsRuntime:
         """Late-bind a HealthMonitor (ft starts after obs in AutoDist)."""
         if self.aggregator is not None:
             self.aggregator.monitor = monitor
+        if self.sentry is not None:
+            self.sentry.monitor = monitor
 
     def close(self) -> None:
         if self.aggregator is not None:
             self.aggregator.stop()
         if self.exporter is not None:
             self.exporter.stop()
+        if self.recorder is not None:
+            self.recorder.close()  # writes the clean run_end marker
         if self.config.trace_out and self.tracer.spans():
             try:
                 self.tracer.flush_part(self.config.trace_out)

@@ -41,6 +41,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from autodist_tpu import metrics as M
+from autodist_tpu.obs import recorder as _flight
 from autodist_tpu.obs import spans as _spans
 from autodist_tpu.utils import logging
 
@@ -107,11 +108,37 @@ class StepProfiler:
         tracer: Optional[_spans.SpanTracer] = None,
         peak_flops_per_chip: Optional[float] = None,
         hbm_bw_bytes_per_s: Optional[float] = None,
+        recorder=None,
+        sentry=None,
     ):
         import jax
 
         self.step = step
         self.tracer = tracer or _spans.get_tracer()
+        # Black-box feed (docs/observability.md § flight recorder): every
+        # profiled window appends one step record, and the sentry watches
+        # the same stream online. Defaults follow the always-on contract —
+        # the env-gated process recorder, plus a monitor-less sentry so
+        # NaN/regression verdicts exist wherever the recorder does.
+        self.recorder = (_flight.get_recorder() if recorder is None
+                         else recorder)
+        if sentry is None and self.recorder is not None:
+            from autodist_tpu.obs.sentry import Sentry
+
+            sentry = Sentry(registry=registry, recorder=self.recorder)
+        self.sentry = sentry
+        # Planned per-step collective payload (sum of the plan's promised
+        # wire, docs/analysis.md): a constant of the compiled program,
+        # computed once and stamped on every flight record so postmortems
+        # can relate wall-time anomalies to wire pressure. None for steps
+        # without a plan (foreign step objects).
+        self._collective_bytes: Optional[float] = None
+        try:
+            wire = self.step.plan.promised_wire()
+            self._collective_bytes = float(
+                sum(w.storage_bytes for w in wire.values()))
+        except Exception:  # noqa: BLE001 - telemetry only
+            pass
         self._n_devices = jax.device_count()
         self.peak_flops_per_chip = (
             peak_flops_per_chip
@@ -119,6 +146,10 @@ class StepProfiler:
             else detect_peak_flops(jax.devices()[0]))
         self.hbm_bw_bytes_per_s = hbm_bw_bytes_per_s
         self.windows: List[Dict[str, float]] = []
+        # Cumulative profiled-step counter: stamps flight records and
+        # sentry findings with WHICH step an anomaly hit (a proxy for the
+        # training step — exact when profiling starts at step 0).
+        self._steps_total = 0
         self._cost: Dict[int, Dict[str, float]] = {}
         # Cost analysis runs OFF the training thread: it AOT-compiles the
         # single-step program, which on a big TPU model takes minutes — a
@@ -148,18 +179,27 @@ class StepProfiler:
         # ONE end barrier per window (bench.py discipline): a device→host
         # scalar fetch of the final loss.
         loss = metrics.get("loss") if isinstance(metrics, dict) else None
+        loss_val = None
         if loss is not None:
-            float(np.asarray(loss).ravel()[-1])
+            loss_val = float(np.asarray(loss).ravel()[-1])
         else:
             import jax
 
             jax.block_until_ready(metrics)
         wall = time.perf_counter() - t0
-        self._record(num_steps, stacked, dispatch, wall, t_wall, state, batch)
+        # Norm scalars (present when the step was built with
+        # record_norms=True) ride the same already-barriered metrics tree.
+        norms = {}
+        if isinstance(metrics, dict):
+            for key in ("grad_norm", "update_norm"):
+                if key in metrics:
+                    norms[key] = float(np.asarray(metrics[key]).ravel()[-1])
+        self._record(num_steps, stacked, dispatch, wall, t_wall, state,
+                     batch, loss_val, norms)
         return state, metrics
 
     def _record(self, num_steps, stacked, dispatch, wall, t_wall,
-                state, batch) -> None:
+                state, batch, loss_val=None, norms=None) -> None:
         device_s = max(wall - dispatch, 0.0)
         cost = self._step_cost(state, batch, stacked)
         flops_step = cost.get("flops", 0.0)
@@ -187,6 +227,61 @@ class StepProfiler:
             "profiler.window", t_wall, wall, steps=num_steps,
             dispatch_gap_ms=round(dispatch * 1e3, 3),
         )
+        # Flight-record + sentry feed: one compact record per window, with
+        # per-step derived values (the exposed-comm fraction joins once the
+        # background cost analysis lands AND a bandwidth was configured).
+        n = max(int(num_steps), 1)
+        self._steps_total += n
+        exposed = self._window_exposed_fraction(device_s / n, cost)
+        if self.recorder is not None:
+            rec = {
+                "step": self._steps_total,
+                "steps": int(num_steps),
+                "step_wall_s": wall / n,
+                "dispatch_gap_s": dispatch,
+                "device_s": device_s,
+            }
+            if loss_val is not None:
+                rec["loss"] = loss_val
+            if norms:
+                rec.update(norms)
+            if hbm is not None:
+                rec["hbm_high_water"] = hbm
+            if exposed is not None:
+                rec["exposed_comm_fraction"] = exposed
+            if flops_step:
+                rec["flops_per_step"] = flops_step
+            if self._collective_bytes:
+                rec["collective_bytes_planned"] = self._collective_bytes
+            self.recorder.record_step(**rec)
+        if self.sentry is not None:
+            norms = norms or {}
+            self.sentry.observe_step(
+                step=self._steps_total, loss=loss_val,
+                step_time_s=wall / n, hbm_bytes=hbm,
+                grad_norm=norms.get("grad_norm"),
+                update_norm=norms.get("update_norm"))
+
+    def _window_exposed_fraction(self, step_device_s: float,
+                                 cost) -> Optional[float]:
+        """Per-window exposed-comm fraction (same formula as report();
+        None until the cost analysis and a bandwidth are both known)."""
+        if (not cost or not self.hbm_bw_bytes_per_s
+                or not self.peak_flops_per_chip or step_device_s <= 0):
+            return None
+        from autodist_tpu.utils import roofline
+
+        bounds = {
+            "flops": cost.get("flops", 0.0),
+            "lower_bytes": cost.get("bytes_accessed", 0.0),
+            "upper_bytes": cost.get("bytes_accessed", 0.0),
+        }
+        times = roofline.roofline_times(
+            bounds, self.peak_flops_per_chip, self.hbm_bw_bytes_per_s)
+        if not times.get("t_roofline_s"):
+            return None
+        exposed = max(step_device_s - times["t_roofline_s"], 0.0)
+        return exposed / step_device_s
 
     def _step_cost(self, state, batch, stacked: bool) -> Dict[str, float]:
         """Per-step FLOPs/bytes = the SINGLE-STEP compiled program's cost

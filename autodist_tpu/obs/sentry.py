@@ -1,0 +1,313 @@
+"""Online anomaly sentry over the flight-record stream.
+
+The flight recorder (:mod:`autodist_tpu.obs.recorder`) answers "what
+happened"; the sentry answers "is something going wrong *right now*". It
+watches the same per-step telemetry the recorder persists — loss,
+grad/update norms, step wall time, HBM high-water, per-host straggler
+scores — and emits a stable, greppable **verdict code** the moment a
+stream turns anomalous (mirroring shardlint's SLW/SLM codes,
+docs/analysis.md):
+
+======== ==============================================================
+Code     Condition
+======== ==============================================================
+SNT001   non-finite loss (NaN/Inf)
+SNT002   non-finite gradient / update norm
+SNT003   loss spike: z-score vs the rolling window exceeds threshold
+SNT004   step-time regression: consecutive steps above ratio x rolling
+         median
+SNT005   HBM high-water creep above the post-warmup baseline
+SNT006   straggler host: step-time p50 diverges from the fleet median
+         (scores from :class:`~autodist_tpu.obs.aggregate.HostAggregator`)
+======== ==============================================================
+
+Each finding fires **once per episode** (a NaN'ing loss is one incident,
+not one per step; the episode re-arms when the stream recovers), is
+logged with its code, appended to the flight record as a ``sentry`` event
+(so the postmortem doctor sees it), counted in ``obs_sentry_*`` metrics
+through the shared :class:`~autodist_tpu.metrics.MetricsRegistry`, and —
+when a :class:`~autodist_tpu.ft.heartbeat.HealthMonitor` is attached —
+**escalated**: the offending host is promoted to SUSPECT scrutiny the
+same way a silent one is (``HealthMonitor.escalate``), closing the gap
+between "beating its heart" and "training correctly".
+
+Wired automatically by :class:`~autodist_tpu.obs.config.ObsRuntime` and
+by :class:`~autodist_tpu.obs.profiler.StepProfiler` whenever a flight
+recorder is active; ``python -m autodist_tpu.obs --selftest`` proves each
+seeded anomaly class trips exactly its code and a clean run trips none.
+"""
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from autodist_tpu import metrics as M
+from autodist_tpu.const import ENV
+from autodist_tpu.utils import logging
+
+__all__ = ["CODES", "Finding", "Sentry", "SentryConfig"]
+
+#: code -> one-line description (the docs/observability.md table renders
+#: from the same source of truth).
+CODES: Dict[str, str] = {
+    "SNT001": "non-finite loss (NaN/Inf)",
+    "SNT002": "non-finite gradient/update norm",
+    "SNT003": "loss spike vs rolling window (z-score)",
+    "SNT004": "step-time regression vs rolling median",
+    "SNT005": "HBM high-water creep above baseline",
+    "SNT006": "straggler host: step-time diverges from fleet median",
+}
+
+
+@dataclass
+class SentryConfig:
+    """Detection thresholds. Defaults are deliberately conservative —
+    the selftest's clean-run bar ("zero findings on a healthy dryrun")
+    is as load-bearing as the seeded-anomaly bar."""
+
+    window: int = 64              # rolling history length (steps)
+    min_history: int = 8          # observations before spike checks arm
+    loss_z_threshold: float = 8.0     # SNT003: z vs rolling mean/std
+    # SNT003 absolute-change floor: a spike must ALSO exceed this fraction
+    # of |rolling mean| (min 1e-6) — a flat window's std collapses toward
+    # zero and a pure z-score would turn float noise into a verdict.
+    loss_spike_min_fraction: float = 0.05
+    step_time_ratio: float = 2.0      # SNT004: step > ratio x rolling median
+    step_time_consecutive: int = 3    # SNT004: consecutive regressed steps
+    hbm_growth_fraction: float = 0.05  # SNT005: growth over baseline
+    hbm_min_history: int = 8           # SNT005: baseline sample size
+    straggler_threshold: float = 1.5   # SNT006: score bar (aggregate's)
+
+
+@dataclass
+class Finding:
+    """One tripped verdict."""
+
+    code: str
+    message: str
+    value: float = 0.0
+    step: Optional[int] = None
+    process_id: Optional[int] = None
+    t: float = field(default_factory=time.time)
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code, "message": self.message, "value": self.value,
+            "step": self.step, "process_id": self.process_id, "t": self.t,
+        }
+
+
+def _finite(x: Optional[float]) -> bool:
+    return x is not None and math.isfinite(float(x))
+
+
+class Sentry:
+    """Streaming detector: call :meth:`observe_step` with whatever subset
+    of signals a step produced; call :meth:`observe_scores` with the
+    aggregator's straggler scores. Thread-compat (single producer per
+    instance, as with StepProfiler)."""
+
+    def __init__(
+        self,
+        config: Optional[SentryConfig] = None,
+        registry: Optional[M.MetricsRegistry] = None,
+        monitor=None,
+        recorder=None,
+        process_id: Optional[int] = None,
+    ):
+        self.config = config or SentryConfig()
+        self.monitor = monitor
+        self.recorder = recorder
+        self.process_id = (ENV.AUTODIST_PROCESS_ID.val
+                           if process_id is None else int(process_id))
+        self.findings: List[Finding] = []
+        w = max(4, int(self.config.window))
+        self._loss: deque = deque(maxlen=w)
+        self._times: deque = deque(maxlen=w)
+        self._hbm_baseline: List[float] = []
+        self._slow_streak = 0
+        self._episodes: set = set()   # active (code[, pid]) incidents
+        self._n = 0
+
+        reg = registry or M.registry
+        self._reg = reg
+        self._c_findings = reg.counter("obs_sentry_findings_total")
+        self._g_loss_z = reg.gauge("obs_sentry_loss_z")
+        self._g_time_ratio = reg.gauge("obs_sentry_step_time_ratio")
+        self._g_hbm_growth = reg.gauge("obs_sentry_hbm_growth")
+        self._g_last = reg.gauge("obs_sentry_last_finding_t")
+
+    # ------------------------------------------------------------- emission
+    def _emit(self, code: str, message: str, value: float = 0.0,
+              step: Optional[int] = None,
+              process_id: Optional[int] = None) -> Finding:
+        pid = self.process_id if process_id is None else int(process_id)
+        f = Finding(code=code, message=message, value=float(value),
+                    step=step, process_id=pid)
+        self.findings.append(f)
+        self._c_findings.inc()
+        self._reg.counter(f"obs_sentry_{code.lower()}_total").inc()
+        self._g_last.set(f.t)
+        # The greppable line: `grep SNT0 <log>` finds every verdict.
+        logging.warning("%s: %s (value=%.4g, step=%s, host=%d)",
+                        code, message, f.value, step, pid)
+        if self.recorder is not None:
+            try:
+                self.recorder.record_event(
+                    "sentry", code=code, message=message, value=f.value,
+                    step=step, process_id=pid)
+            except Exception:  # noqa: BLE001 - telemetry never fatal
+                pass
+        if self.monitor is not None:
+            try:
+                self.monitor.escalate(pid, reason=f"{code}: {message}")
+            except Exception:  # noqa: BLE001 - monitor may be stopping
+                logging.warning("sentry escalation failed", exc_info=True)
+        return f
+
+    def _fire_once(self, key, code: str, message: str, **kw) -> bool:
+        """Once-per-episode gate; :meth:`_clear` re-arms on recovery."""
+        if key in self._episodes:
+            return False
+        self._episodes.add(key)
+        self._emit(code, message, **kw)
+        return True
+
+    def _clear(self, key) -> None:
+        self._episodes.discard(key)
+
+    # ------------------------------------------------------------- observing
+    def observe_step(
+        self,
+        step: Optional[int] = None,
+        loss: Optional[float] = None,
+        step_time_s: Optional[float] = None,
+        hbm_bytes: Optional[float] = None,
+        grad_norm: Optional[float] = None,
+        update_norm: Optional[float] = None,
+    ) -> List[Finding]:
+        """Feed one step's signals (any subset); returns the findings this
+        observation tripped (possibly empty)."""
+        cfg = self.config
+        before = len(self.findings)
+        self._n += 1
+
+        # ---- SNT001 / SNT003: loss stream
+        if loss is not None:
+            loss = float(loss)
+            if not math.isfinite(loss):
+                # value keeps the raw non-finite loss (sign included);
+                # JSONL round-trips NaN/Infinity through python json.
+                self._fire_once("SNT001", "SNT001",
+                                f"non-finite loss {loss!r}", value=loss,
+                                step=step)
+            else:
+                self._clear("SNT001")
+                if len(self._loss) >= cfg.min_history:
+                    hist = np.asarray(self._loss, np.float64)
+                    mean, std = float(hist.mean()), float(hist.std())
+                    delta = loss - mean
+                    floor = max(1e-6,
+                                cfg.loss_spike_min_fraction * abs(mean))
+                    # Zero-std window (flat/deterministic loss): only a
+                    # change past the absolute floor counts as a spike —
+                    # never a bare float-noise uptick.
+                    z = (delta / std if std > 1e-12
+                         else (float("inf") if delta > floor else 0.0))
+                    self._g_loss_z.set(min(z, 1e9))
+                    if z > cfg.loss_z_threshold and delta > floor:
+                        self._fire_once(
+                            "SNT003", "SNT003",
+                            f"loss spike: {loss:.4g} is z={min(z, 1e9):.1f} "
+                            f"above the rolling window (threshold "
+                            f"{cfg.loss_z_threshold})",
+                            value=min(z, 1e9), step=step)
+                    elif z < cfg.loss_z_threshold / 2:
+                        self._clear("SNT003")
+                self._loss.append(loss)
+
+        # ---- SNT002: gradient / update norms
+        norms_seen = [("grad_norm", grad_norm), ("update_norm", update_norm)]
+        bad = [(k, v) for k, v in norms_seen
+               if v is not None and not math.isfinite(float(v))]
+        if bad:
+            k, v = bad[0]
+            self._fire_once("SNT002", "SNT002",
+                            f"non-finite {k} {float(v)!r}", step=step)
+        elif any(v is not None for _, v in norms_seen):
+            self._clear("SNT002")
+
+        # ---- SNT004: step-time regression
+        if step_time_s is not None and step_time_s > 0:
+            step_time_s = float(step_time_s)
+            if len(self._times) >= cfg.min_history:
+                med = float(np.median(np.asarray(self._times, np.float64)))
+                ratio = step_time_s / med if med > 0 else 0.0
+                self._g_time_ratio.set(ratio)
+                if ratio > cfg.step_time_ratio:
+                    self._slow_streak += 1
+                    if self._slow_streak >= cfg.step_time_consecutive:
+                        self._fire_once(
+                            "SNT004", "SNT004",
+                            f"step time regressed: {step_time_s * 1e3:.1f}ms is "
+                            f"{ratio:.2f}x the rolling median "
+                            f"({med * 1e3:.1f}ms) for {self._slow_streak} "
+                            f"consecutive steps", value=ratio, step=step)
+                else:
+                    self._slow_streak = 0
+                    self._clear("SNT004")
+            self._times.append(step_time_s)
+
+        # ---- SNT005: HBM high-water creep
+        if hbm_bytes is not None and hbm_bytes > 0:
+            hbm_bytes = float(hbm_bytes)
+            if len(self._hbm_baseline) < cfg.hbm_min_history:
+                self._hbm_baseline.append(hbm_bytes)
+            else:
+                base = float(np.median(self._hbm_baseline))
+                growth = (hbm_bytes - base) / base if base > 0 else 0.0
+                self._g_hbm_growth.set(growth)
+                if growth > cfg.hbm_growth_fraction:
+                    self._fire_once(
+                        "SNT005", "SNT005",
+                        f"HBM high-water creep: {hbm_bytes / 2**30:.2f} GiB is "
+                        f"{growth * 100:.1f}% above the post-warmup baseline "
+                        f"({base / 2**30:.2f} GiB)", value=growth, step=step)
+                elif growth < cfg.hbm_growth_fraction / 2:
+                    self._clear("SNT005")
+
+        return self.findings[before:]
+
+    def observe_scores(self, scores: Dict[int, float],
+                       step: Optional[int] = None) -> List[Finding]:
+        """Feed the aggregator's per-host straggler scores
+        (``HostAggregator.straggler_scores()``); SNT006 fires once per
+        host per straggle episode."""
+        before = len(self.findings)
+        for pid, score in scores.items():
+            key = ("SNT006", int(pid))
+            if score > self.config.straggler_threshold:
+                self._fire_once(
+                    key, "SNT006",
+                    f"host {pid} is a straggler: step-time p50 is "
+                    f"{score:.2f}x the fleet median", value=score, step=step,
+                    process_id=pid)
+            else:
+                self._clear(key)
+        return self.findings[before:]
+
+    # --------------------------------------------------------------- queries
+    def codes(self) -> List[str]:
+        return sorted({f.code for f in self.findings})
+
+    def summary(self) -> dict:
+        return {
+            "findings": len(self.findings),
+            "codes": self.codes(),
+            "observed_steps": self._n,
+        }

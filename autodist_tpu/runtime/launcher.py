@@ -100,6 +100,55 @@ class _FleetWatch:
         base dir the watchdog sweeps."""
         return {ENV.AUTODIST_FT_DIR.name: self.config.base_dir}
 
+    def write_bundle(self, reason: str = "fleet_hung") -> Optional[str]:
+        """Persist a doctor bundle — last heartbeats (per-peer state +
+        payload), fleet verdict, and this launcher's open spans — under
+        ``<ft base>/doctor/`` BEFORE the kill, so a supervised termination
+        is attributable: ``python -m autodist_tpu.obs doctor <ft base>``
+        reads it as the primary wedge evidence (docs/observability.md).
+        Best-effort, atomic, fsync'd; never blocks the kill on IO."""
+        import json
+
+        try:
+            from autodist_tpu.obs.spans import get_tracer
+
+            peers = {}
+            for pid, p in self.monitor.peers().items():
+                peers[str(pid)] = {
+                    "state": p.state.value,
+                    "last_seen": p.last_seen,
+                    "misses": p.misses,
+                    "last_payload": p.last_payload,
+                }
+            bundle = {
+                "written_at": time.time(),
+                "reason": reason,
+                "verdict": self.monitor.verdict().value,
+                "hang_after_misses": self.config.hang_after_misses,
+                "heartbeat_interval_s": self.config.heartbeat_interval_s,
+                "heartbeats": peers,
+                "launcher_spans": [
+                    {"name": s.name, "t_start_s": s.t_start_s,
+                     "dur_s": s.dur_s, "attrs": s.attrs}
+                    for s in get_tracer().spans()[-64:]
+                ],
+            }
+            bundle_dir = os.path.join(self.config.base_dir, "doctor")
+            os.makedirs(bundle_dir, exist_ok=True)
+            path = os.path.join(
+                bundle_dir, f"hang-bundle-{int(time.time())}.json")
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(bundle, f, indent=2, default=str)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            logging.info("wrote doctor bundle -> %s", path)
+            return path
+        except Exception:  # noqa: BLE001 - the kill must proceed regardless
+            logging.warning("doctor bundle write failed", exc_info=True)
+            return None
+
     def start(self, chief: subprocess.Popen) -> None:
         def watch():
             while not self._stop.is_set():
@@ -113,6 +162,9 @@ class _FleetWatch:
                             self.config.hang_after_misses,
                             self.monitor.verdict().value,
                         )
+                        # Attribution before termination: the bundle is the
+                        # context SIGTERM would otherwise discard.
+                        self.write_bundle()
                         chief.terminate()
                         return
                 except Exception:  # noqa: BLE001 - watchdog must not die

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -34,6 +35,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from autodist_tpu.kernel import GraphTransformer, ShardingPlan, build_mesh, data_axis
 from autodist_tpu.model_item import ModelItem
+from autodist_tpu.obs import recorder as obs_recorder
 from autodist_tpu.obs import spans as obs_spans
 from autodist_tpu.utils import logging
 
@@ -350,12 +352,20 @@ class InferenceEngine:
                 self._compile_bucket(bucket)
             padded = np.zeros((1, length), np.int32)
             padded[0, : len(prompt)] = prompt
+            t_prefill = time.perf_counter()
             with obs_spans.span("serve.prefill", bucket=length,
                                 prompt_len=len(prompt)):
                 first, bucket.cache = bucket.prefill_fn(
                     self.params, jnp.asarray(padded),
                     jnp.int32(len(prompt)), bucket.cache, jnp.int32(idx))
                 first = int(jax.device_get(first)[0])
+            # Flight-record the admit (non-critical: batched fsync — serve
+            # load must not turn into an fsync storm). Rate is bounded by
+            # request admission, not token emission.
+            obs_recorder.record_step(
+                surface="serve", event="admit", bucket=length,
+                prompt_len=len(prompt),
+                prefill_s=round(time.perf_counter() - t_prefill, 6))
             bucket.active[idx] = True
             bucket.lengths[idx] = len(prompt)
             bucket.last_token[idx] = first
@@ -389,6 +399,14 @@ class InferenceEngine:
                 bucket.lengths[idx] += 1
                 bucket.last_token[idx] = tokens[idx]
                 out[Slot(length, idx)] = int(tokens[idx])
+        # Sampled flight record (1 per 64 decode rounds): enough black-box
+        # trail to show "serving was alive and at depth N" in a postmortem
+        # without a per-token write amplifying the hot loop.
+        self._decode_step_count = getattr(self, "_decode_step_count", 0) + 1
+        if self._decode_step_count % 64 == 1:
+            obs_recorder.record_step(
+                surface="serve", event="decode",
+                decode_steps=self._decode_step_count, active_slots=len(out))
         return out
 
     def slot_len(self, slot: Slot) -> int:

@@ -691,6 +691,50 @@ def _promote_cached_headline(result: dict) -> dict:
     return result
 
 
+def _emit_postmortem(reason: str, timeout_s: float = 20.0) -> None:
+    """On ANY abnormal exit (rc=124 wedge, SIGTERM, probe-ladder
+    exhaustion, crash) run the postmortem doctor over the round's ft
+    artifacts and emit a ``bench_postmortem`` JSON line with the verdict
+    code — a BENCH_rNN can never again end ``parsed: null`` with no
+    classification (docs/observability.md § doctor).
+
+    Runs ``python -m autodist_tpu.obs doctor`` in a watchdogged subprocess
+    (the bench parent stays jax-free, and a wedged filesystem cannot hang
+    the emit). Always prints exactly one line, BEFORE the final result
+    line so the driver's last-line parse still lands on the result.
+    """
+    import subprocess
+
+    line = {"verdict": "unavailable", "code": "DOC999", "reason": reason}
+    try:
+        # The launcher exports AUTODIST_FT_DIR to every fleet process;
+        # standalone bench runs fall back to the const.py default base
+        # (literal here: the parent never imports the package).
+        ft_dir = os.environ.get("AUTODIST_FT_DIR") or "/tmp/autodist-tpu/ft"
+        line["ft_dir"] = ft_dir
+        timeout_s = max(3.0, min(timeout_s, BUDGET.remaining(reserve=10.0)))
+        r = subprocess.run(
+            [sys.executable, "-m", "autodist_tpu.obs", "doctor", ft_dir,
+             "--json"],
+            timeout=timeout_s, capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        doc = _last_json_line(r.stdout)
+        if doc is not None:
+            line.update({
+                "verdict": doc.get("verdict", "unknown"),
+                "code": doc.get("code", "DOC999"),
+                "evidence": [e.get("detail", "")
+                             for e in (doc.get("evidence") or [])[:5]],
+                "stats": doc.get("stats", {}),
+            })
+        else:
+            line["error"] = f"doctor exited rc={r.returncode} with no JSON"
+    except Exception as e:  # noqa: BLE001 - the postmortem must not crash bench
+        line["error"] = f"{type(e).__name__}: {e}"[:200]
+    print(json.dumps({"bench_postmortem": line}), flush=True)
+
+
 def _emergency_line(errors: dict, reason: str) -> dict:
     """The line of last resort: nothing measured, but the driver-parseable
     contract ('bench always emits ONE JSON line') still holds. Carries the
@@ -722,6 +766,7 @@ def main() -> None:
     except SystemExit:
         raise
     except BaseException as e:  # noqa: BLE001 - the line contract is absolute
+        _emit_postmortem(f"bench crashed: {type(e).__name__}", timeout_s=15.0)
         print(json.dumps(_emergency_line(
             {}, f"bench crashed before emitting: {type(e).__name__}: {e}")),
             flush=True)
@@ -824,6 +869,10 @@ def _main() -> None:
         # os._exit because this interrupts arbitrary frames (a blocking
         # subprocess.run wait): normal unwinding could re-enter them.
         try:
+            # Classification first (short leash: `timeout -k 10` sends
+            # SIGKILL ~10s after this SIGTERM), then the result line LAST
+            # so the driver's last-line parse lands on the result.
+            _emit_postmortem("driver timeout (SIGTERM)", timeout_s=5.0)
             if measured:
                 res, on_acc = _format_result(measured, errors)
                 res["error"] = "driver timeout (SIGTERM) cut the run short"
@@ -923,6 +972,7 @@ def _main() -> None:
         signal.alarm(0)
 
     if not measured:
+        _emit_postmortem("no workload completed within the bench budget")
         print(json.dumps(_emergency_line(
             errors, "no workload completed within the bench budget")))
         sys.exit(1)
@@ -956,6 +1006,12 @@ def _main() -> None:
         # never regress the official record to a CPU-smoke headline
         # (VERDICT r5 top_next).
         result = _promote_cached_headline(_embed_last_accel(result))
+        # Wedge/probe-ladder-exhaustion rounds get a classification too:
+        # what the fleet's black box says happened (emitted before the
+        # result line, which must stay last for the driver's parse).
+        _emit_postmortem(
+            "tunnel busy through wait budget" if tunnel_busy
+            else "accelerator preflight exhausted (wedge)")
     print(json.dumps(result))
     if wedged_fallback and os.environ.get("BENCH_REQUIRE_ACCEL"):
         # Queue mode: a wedge fallback is not success — exit 4 (the

@@ -26,6 +26,15 @@ exits 1 listing ``file:line`` offenders. Rules:
    the lowering would silently reintroduce the monolithic post-backward
    sync path this rule exists to keep dead.
 
+4. **ONE flight-record writer** — touching the flight-record dir
+   (``open(`` on a flight path, or the ``flight-`` segment-name prefix)
+   anywhere in ``autodist_tpu/`` outside ``obs/recorder.py`` is banned:
+   the crash-safety story (fsync cadence, segment rotation, torn-line
+   tolerance) only holds because every writer AND reader goes through the
+   recorder module (docs/observability.md § flight recorder). Components
+   record via ``obs.recorder.record_event/record_step``; postmortems read
+   via ``obs.recorder.read_records``.
+
 Pure stdlib, no third-party deps — runs anywhere Python runs.
 """
 from __future__ import annotations
@@ -41,6 +50,9 @@ SHARD_MAP_RE = re.compile(
     r"|.*\bjax\.experimental\.shard_map\b(?!`))")
 TIME_TIME_RE = re.compile(r"\btime\.time\(\)")
 PSUM_CALL_RE = re.compile(r"\blax\.psum(_scatter)?\s*\(")
+# Rule 4: an open() whose argument expression mentions a flight path, or
+# any use of the segment-name prefix literal, outside obs/recorder.py.
+FLIGHT_WRITE_RE = re.compile(r"open\([^)\n]*flight|['\"]flight-")
 
 
 def _py_files(*roots):
@@ -103,6 +115,20 @@ def main() -> int:
                         f"{rel}:{i}: direct lax.psum/psum_scatter for grad "
                         f"sync — emit through kernel/bucketing.py (the one "
                         f"bucketed-emission helper; docs/zero.md)")
+
+    flight_allowed = {os.path.join("autodist_tpu", "obs", "recorder.py")}
+    for rel in _py_files("autodist_tpu"):
+        if rel in flight_allowed:
+            continue
+        with open(os.path.join(REPO, rel), "r", encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                code = line.split("#", 1)[0]
+                if FLIGHT_WRITE_RE.search(code):
+                    errors.append(
+                        f"{rel}:{i}: direct flight-record dir access — go "
+                        f"through autodist_tpu/obs/recorder.py (the ONE "
+                        f"writer with the fsync/rotation discipline; "
+                        f"docs/observability.md)")
 
     if errors:
         print("banned-pattern lint FAILED:", file=sys.stderr)
