@@ -50,6 +50,8 @@ from autodist_tpu.analysis.passes import (
     batch_element_count,
     degradation_check,
     hbm_budget,
+    measured_wire_check,
+    payload_candidates,
     rendezvous_hazards,
     screen_strategy,
     wire_conformance,
@@ -134,6 +136,8 @@ __all__ = [
     "degradation_check",
     "hbm_budget",
     "hlo_contains",
+    "measured_wire_check",
+    "payload_candidates",
     "rendezvous_hazards",
     "report_to_text",
     "screen_strategy",

@@ -162,6 +162,10 @@ class Collective:
     channel_id: Optional[int] = None
     op_name: str = ""                         # metadata op_name scope path
     line: str = ""
+    # HLO instruction name from the def line ("reduce-scatter.48", no %):
+    # the key device profiles carry per event, so measured-wire attribution
+    # (obs/attrib.py) can join a traced op to this inventory entry.
+    name: str = ""
 
     @staticmethod
     def _elems(shapes) -> int:
@@ -261,6 +265,8 @@ class CollectiveInventory:
                     groups = _expand_iota_groups(
                         int(im.group(1)), int(im.group(2)), dims, perm)
             cm = _CHANNEL_RE.search(line)
+            nm = re.match(r"(?:ROOT\s+)?%?([A-Za-z0-9_.-]+)\s*$",
+                          line[:eq].strip())
             out.append(Collective(
                 op=kind,
                 results=results,
@@ -270,6 +276,7 @@ class CollectiveInventory:
                 channel_id=int(cm.group(1)) if cm else None,
                 op_name=op_name_m.group(1) if op_name_m else "",
                 line=line,
+                name=nm.group(1) if nm else "",
             ))
         return cls(collectives=out, program=program)
 
@@ -304,6 +311,7 @@ class CollectiveInventory:
         return [
             {
                 "op": c.op,
+                "name": c.name,
                 "result_elements": c.result_elements,
                 "result_bytes": c.result_bytes,
                 "max_payload_elements": c.max_payload_elements,

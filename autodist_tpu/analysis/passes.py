@@ -60,6 +60,25 @@ def batch_element_count(batch) -> int:
     return total
 
 
+def payload_candidates(w, mesh_sizes: Dict[str, int]) -> set:
+    """Payload element counts a collective for VarWire ``w`` may
+    legitimately carry: the var's storage (or its bucket's summed payload
+    for backward-overlap buckets), each optionally divided by ONE mesh
+    axis at a time (the shard view) — never compounded across axes, which
+    would loosen the match for every multi-axis family. Shared by the
+    static wire-conformance table and the measured-wire attribution join
+    (obs/attrib.py) so "what counts as this var's collective" is one rule."""
+    bases = {int(w.storage_elements)}
+    if w.bucket is not None and w.bucket_elements:
+        bases.add(int(w.bucket_elements))
+    candidates = set(bases)
+    for k in mesh_sizes.values():
+        if k > 1:
+            for base in bases:
+                candidates.add(-(-base // int(k)))
+    return candidates
+
+
 # ---------------------------------------------------------------------- wire
 def wire_conformance(
     plan,
@@ -199,19 +218,11 @@ def wire_conformance(
                 elems = int(np.prod(dims)) if dims else 1
                 # Backward-overlap bucketing (VarWire.bucket): a combined
                 # collective for this var's bucket legitimately carries the
-                # bucket's SUMMED payload — the per-bucket allowance. Each
-                # base size is divided by ONE mesh axis at a time (shard
-                # view), never compounded across axes — compounding would
-                # loosen the match for every multi-axis family.
-                bases = {w.storage_elements}
-                if w.bucket is not None and w.bucket_elements:
-                    bases.add(int(w.bucket_elements))
-                candidates = set(bases)
-                for k in mesh_sizes.values():
-                    if k > 1:
-                        for base in bases:
-                            candidates.add(-(-base // int(k)))
-                if elems in candidates and (
+                # bucket's SUMMED payload — the per-bucket allowance. The
+                # candidate rule (one mesh-axis shard division at a time)
+                # lives in payload_candidates, shared with the measured-
+                # wire attribution join.
+                if elems in payload_candidates(w, mesh_sizes) and (
                         c.op in w.allow or c.op in w.require):
                     matched.append(c)
                     break
@@ -516,6 +527,85 @@ def alias_hazards(hlo_text: str) -> List[Finding]:
                 details={"param": param_no, "output": oi,
                          "param_bytes": pb, "output_bytes": ob},
             ))
+    return findings
+
+
+# ------------------------------------------------------------- measured wire
+def measured_wire_check(
+    plan,
+    measured,
+    priced_exposed_fraction: Optional[float] = None,
+    overlap_tolerance: float = 0.10,
+) -> List[Finding]:
+    """Diff a **measured** wire (an ``obs.attrib.MeasuredWire``) against
+    the plan's promise — the trace-side sibling of :func:`wire_conformance`.
+
+    All findings are WARNINGS, never errors: traces are optional, capture
+    windows are short, and a fused/renamed op is a heuristic miss, not
+    proof of a broken program. Codes:
+
+    - **SLT001** — a measured collective joined to nothing the plan
+      promises (above the aux-reduction allowance): either a GSPMD
+      resharding leak actually executing, or the join losing an op;
+    - **SLT002** — a promised (``require``'d) collective kind never
+      observed for its variable in the trace;
+    - **SLT003** — a backward-overlap bucket whose measured hidden
+      fraction falls short of what pricing assumed
+      (``1 - priced_exposed_fraction``, default the cost model's
+      OVERLAP_EXPOSED_FRACTION prior): the wire was priced as hidden but
+      measured exposed. Emitted only when the runtime can overlap at all
+      (``measured.overlap_measurable``) — a serialized executor reads 0
+      overlap for a reason the program didn't choose.
+    """
+    findings: List[Finding] = []
+    if priced_exposed_fraction is None:
+        from autodist_tpu.strategy.cost_model import OVERLAP_EXPOSED_FRACTION
+
+        priced_exposed_fraction = OVERLAP_EXPOSED_FRACTION
+
+    from autodist_tpu.obs.attrib import AUX_REDUCTION_MAX_ELEMENTS
+
+    for op in measured.collectives:
+        if op.matched or op.payload_elements <= AUX_REDUCTION_MAX_ELEMENTS:
+            continue
+        findings.append(Finding(
+            code="SLT001", severity=WARNING, pass_name="measured",
+            message=(
+                f"measured {op.kind} {op.name!r} "
+                f"({op.payload_elements} elems, "
+                f"{op.seconds_per_step * 1e3:.4f} ms/step) joins to no "
+                f"promised wire entry — unplanned collective actually "
+                f"executing, or an attribution miss"),
+            details={"name": op.name, "kind": op.kind,
+                     "payload_elements": op.payload_elements,
+                     "seconds_per_step": op.seconds_per_step},
+        ))
+    for var, rendering, kind in measured.unobserved:
+        findings.append(Finding(
+            code="SLT002", severity=WARNING, var=var, pass_name="measured",
+            message=(
+                f"plan promises {kind!r} for var {var!r} ({rendering} "
+                f"rendering) but no measured op in the trace joined to it"),
+            details={"op": kind, "rendering": rendering},
+        ))
+    if measured.overlap_measurable:
+        want_hidden = 1.0 - float(priced_exposed_fraction)
+        for b in measured.buckets:
+            if b.overlap_fraction + overlap_tolerance < want_hidden:
+                findings.append(Finding(
+                    code="SLT003", severity=WARNING, pass_name="measured",
+                    message=(
+                        f"bucket {b.bucket}: measured overlap "
+                        f"{b.overlap_fraction:.0%} is below the priced "
+                        f"{want_hidden:.0%} hidden fraction "
+                        f"({b.exposed_s_per_step * 1e3:.4f} ms/step of "
+                        f"supposedly-hidden wire exposed) — recalibrate "
+                        f"overlap_s or revisit bucket_bytes"),
+                    details={"bucket": b.bucket,
+                             "overlap_fraction": b.overlap_fraction,
+                             "priced_hidden": want_hidden,
+                             "exposed_s_per_step": b.exposed_s_per_step},
+                ))
     return findings
 
 

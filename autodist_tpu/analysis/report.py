@@ -29,6 +29,11 @@ FINDING_CODES: Dict[str, str] = {
     "SLH003": "degradation drift: plan flags disagree with the shared predicate",
     # strategy screening (pre-lowering)
     "SLS001": "strategy node cannot lower (screen reject)",
+    # measured wire (trace attribution vs the promise — obs/attrib.py;
+    # warnings only: traces are optional and the join is heuristic)
+    "SLT001": "measured collective with no planned counterpart",
+    "SLT002": "promised collective never observed in the trace",
+    "SLT003": "per-bucket measured overlap below the priced exposure",
 }
 
 ERROR, WARNING, INFO = "error", "warning", "info"

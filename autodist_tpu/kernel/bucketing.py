@@ -71,6 +71,28 @@ EXCLUSION_REASONS = (
 #: (plan/search.py BUCKET_GENE_CHOICES).
 DEFAULT_BUCKET_BYTES = 4 << 20
 
+# ----------------------------------------------------- named-scope join keys
+# The gradient-sync named scopes are the JOIN KEY between a device profile
+# and the plan: measured-wire attribution (obs/attrib.py) resolves a traced
+# collective to its bucket/vars through the compiled program's op_name
+# metadata, which carries exactly these strings. They are pinned here —
+# next to the emission that stamps them — and tests/test_attrib.py pins
+# the literals, so renaming one is a deliberate, test-visible act.
+#: Prefix of the per-bucket backward-overlap scope; bucket i's collectives
+#: fire under :func:`bucket_scope`\ ``(i)``.
+GRADSYNC_BUCKET_SCOPE = "gradsync.bucket_"
+#: Scope of the post-hook shard extraction (bit-exact re-slice).
+GRADSYNC_SHARD_SLICE_SCOPE = "gradsync.shard_slice"
+#: Scope of the unbucketed zero1 gradient reduce-scatter.
+ZERO1_REDUCE_SCATTER_SCOPE = "zero1.reduce_scatter_grads"
+#: Scope of the zero1 param re-gather after the sharded update.
+ZERO1_ALL_GATHER_SCOPE = "zero1.all_gather_params"
+
+
+def bucket_scope(bucket_index: int) -> str:
+    """Named scope bucket ``bucket_index``'s collectives are emitted under."""
+    return f"{GRADSYNC_BUCKET_SCOPE}{bucket_index}"
+
 
 def bucket_exclusion_reasons(
     shape: Sequence[int],
@@ -247,7 +269,7 @@ def make_bucket_hook(
 
     def bwd(_, grads):
         out = []
-        with jax.named_scope(f"gradsync.bucket_{bucket_index}"):
+        with jax.named_scope(bucket_scope(bucket_index)):
             for name, g in zip(names, grads):
                 dim = su_dims.get(name)
                 if dim is None:

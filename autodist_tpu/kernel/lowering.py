@@ -1359,7 +1359,7 @@ class DistributedTrainStep:
         shardings would still force one, but anonymously at program exit."""
         leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
         out = []
-        with jax.named_scope("zero1.all_gather_params"):
+        with jax.named_scope(bucketing.ZERO1_ALL_GATHER_SCOPE):
             for path, leaf in leaves:
                 plan = self._shard_update.get(_path_name(path))
                 if plan is not None:
@@ -1652,7 +1652,8 @@ class DistributedTrainStep:
                         # inside the backward (gradsync.bucket_i scope);
                         # extract this instance's shard from the hook's
                         # re-embedded full-shape buffer (bit-exact).
-                        with jax.named_scope("gradsync.shard_slice"):
+                        with jax.named_scope(
+                                bucketing.GRADSYNC_SHARD_SLICE_SCOPE):
                             synced.append(bucketing.slice_update_shard(
                                 g, ax, n, su_dims[name]))
                     else:
@@ -1663,7 +1664,8 @@ class DistributedTrainStep:
                     # zero1: one reduce-scatter replaces the all-reduce —
                     # this instance keeps only its 1/n gradient slice, which
                     # is exactly what its optimizer-state shard consumes.
-                    with jax.named_scope("zero1.reduce_scatter_grads"):
+                    with jax.named_scope(
+                            bucketing.ZERO1_REDUCE_SCATTER_SCOPE):
                         synced.append(bucketing.reduce_scatter_grad(
                             g, ax, n, su_dims[name]))
                     continue

@@ -31,6 +31,12 @@ text in serve, and the unexported roofline/metrics plumbing:
 - :mod:`~autodist_tpu.obs.doctor` — the postmortem: stitch flight
   records, heartbeats, snapshot manifests, hang bundles and span parts
   into one timeline and classify the death (``DOC###`` verdicts).
+- :mod:`~autodist_tpu.obs.attrib` — measured-wire attribution: the ONE
+  xplane reader parses a ``jax.profiler`` capture of a windowed step and
+  joins every device op back to the plan's promised wire (per-bucket
+  measured overlap, measured-vs-promised payloads, ``SLT###`` conformance
+  findings, trace-fed calibration records) — the measured leg of the
+  planned → priced → measured loop.
 
 Entry points: ``AutoDist(observability=ObsConfig(...))`` → ``autodist.obs``
 (:class:`ObsRuntime`), ``python -m autodist_tpu.obs doctor <ft-dir>``, and
@@ -40,6 +46,7 @@ See docs/observability.md.
 from __future__ import annotations
 
 from autodist_tpu.obs.aggregate import HostAggregator
+from autodist_tpu.obs.attrib import MeasuredWire, attribute
 from autodist_tpu.obs.config import ObsConfig, ObsRuntime
 from autodist_tpu.obs.doctor import Diagnosis, diagnose
 from autodist_tpu.obs.exporter import (
@@ -68,6 +75,7 @@ __all__ = [
     "Finding",
     "FlightRecorder",
     "HostAggregator",
+    "MeasuredWire",
     "ObsConfig",
     "ObsRuntime",
     "Sentry",
@@ -77,6 +85,7 @@ __all__ = [
     "StepProfiler",
     "StepTimer",
     "add_span",
+    "attribute",
     "current_trace_id",
     "detect_peak_flops",
     "diagnose",

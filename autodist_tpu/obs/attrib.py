@@ -1,0 +1,948 @@
+"""Measured-wire attribution: per-op device time joined back to the plan.
+
+The repo holds three views of a program's collective wire that, before this
+module, never met at op granularity: shardlint diffs the **planned** wire
+statically (``analysis/passes.py`` against
+:meth:`~autodist_tpu.kernel.lowering.ShardingPlan.promised_wire`),
+``plan/calibrate.py`` fits **priced** components from whole-step
+regressions, and :class:`~autodist_tpu.obs.profiler.StepProfiler` measures
+a single step-level ``exposed_comm_fraction`` from the roofline residue.
+This module closes the loop with the **measured** view: capture a
+``jax.profiler`` trace of a windowed ``DistributedTrainStep.run``, parse
+the device timeline's leaf op events out of the ``xplane.pb``, and join
+each measured op back to the plan —
+
+- collectives are recognized through the analysis
+  :class:`~autodist_tpu.analysis.inventory.CollectiveInventory` (the ONE
+  collective parser) and matched to
+  :class:`~autodist_tpu.kernel.lowering.VarWire` entries with the same
+  shard-view payload candidates the wire-conformance pass uses;
+- ``gradsync.bucket_{i}`` / ``zero1.*`` named scopes (pinned in
+  ``kernel/bucketing.py`` — they are the join key) resolve collectives to
+  backward-overlap buckets and their variables via the compiled program's
+  ``op_name`` metadata;
+- the remainder is bucketed into compute categories (the
+  ``examples/benchmark/profile_ops.py`` taxonomy, which now delegates
+  here).
+
+The result is a :class:`MeasuredWire` report: per-collective and
+per-bucket measured seconds, measured-vs-promised payloads, and a
+*per-bucket* measured overlap fraction — how much of each bucket's
+reduce-scatter interval was actually covered by concurrent compute on the
+same device timeline — replacing the single step-level roofline number.
+``overlap_measurable`` is False on runtimes that serialize every thunk on
+one stream (the CPU thunk executor): a 0.0 overlap there means "cannot
+overlap", not "failed to overlap", and the SLT003 lint check stays quiet.
+
+Parsing notes (the ``profile_ops.py`` guards, preserved):
+
+- TPU/GPU device planes (``/device:TPU:*``): ONLY the leaf ``"XLA Ops"``
+  line is read — container events (the while loop, the jit region) and the
+  async-copy line double-count wall time;
+- CPU host plane (``/host:CPU``): the ``tf_XLA*`` client-thread lines are
+  the per-device timelines; executor/listener frames
+  (``ThunkExecutor::Execute`` …) and container ops (``while.8``) are
+  skipped the same way.
+
+This file is the ONE xplane reader in the repo
+(``tools/check_patterns.py`` rule 5) — the example CLI and every consumer
+delegate here so a dump-format change can never split "what the example
+prints" from "what the framework joins".
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from autodist_tpu.analysis.inventory import (
+    COLLECTIVE_KINDS,
+    CollectiveInventory,
+)
+from autodist_tpu.kernel.bucketing import (
+    GRADSYNC_BUCKET_SCOPE,
+    ZERO1_ALL_GATHER_SCOPE,
+    ZERO1_REDUCE_SCATTER_SCOPE,
+)
+from autodist_tpu.utils import logging
+
+__all__ = [
+    "MeasuredOp",
+    "BucketWire",
+    "MeasuredWire",
+    "ParsedTrace",
+    "attribute",
+    "capture_trace",
+    "category_table",
+    "find_xplane",
+    "parse_trace",
+    "read_capture_meta",
+    "write_capture_meta",
+]
+
+#: A measured op (collective or compute) whose per-step share of device
+#: time exceeds this fraction counts as "large" — an unattributed large
+#: row is the attribution failing its job (the selftest pins zero).
+LARGE_FRACTION = 0.01
+
+#: Collectives at or below this payload (elements) with no planned
+#: counterpart are metric/loss reductions (the scalar loss psum, aux
+#: means) — planned in spirit, too small to matter, never flagged.
+AUX_REDUCTION_MAX_ELEMENTS = 4096
+
+# Frame/bookkeeping events on the CPU client-thread lines: runtime
+# scaffolding around the thunks, not ops.
+_FRAME_PREFIXES = (
+    "ThunkExecutor", "TfrtCpuExecutable", "ThreadpoolListener",
+    "XlaComputation", "BufferAllocations",
+)
+# Container ops double-count their body's wall time (the profile_ops
+# guard): the scanned while loop, conditionals, the jit region.
+_CONTAINER_RE = re.compile(r"^%?(while|conditional)(\.\d+)?$|^%?jit[_(]|^0$")
+
+#: Compute categories, checked in order (first match wins). The TPU fusion
+#: taxonomy from profile_ops.py rides first; the generic tail covers the
+#: CPU thunk names. ``None`` label = container, skip entirely.
+CATEGORIES: Tuple[Tuple[str, Optional[str]], ...] = (
+    (r"%?convert_reduce_fusion|%?reduce_fusion",
+     "stats/grad reductions (+fused producer conv)"),
+    (r"%?multiply_add_fusion", "wgrad conv + optimizer update"),
+    (r"%?select_and_scatter", "maxpool backward (SelectAndScatter)"),
+    (r"%?reduce_window", "pooling forward"),
+    (r"%?copy", "layout/loop-boundary copies"),
+    (r"%?slice-start|%?slice-done|%?dynamic-slice", "async activation slices"),
+    (r"%?dynamic-update-slice", "async activation slices"),
+    (r"%?while|^jit_|^0$", None),      # containers: skip, they double-count
+    (r"%?dot(\.|$)|%?convolution", "matmul/conv"),
+    (r"%?[\w-]*fusion", "conv/elementwise fusions"),
+    (r"%?reduce(\.|$)", "reductions"),
+    (r"%?(broadcast|transpose|reshape|concatenate|iota|constant|"
+     r"convert|select|compare|add|subtract|multiply|divide|maximum|"
+     r"minimum|exponential|tanh|rsqrt|sqrt|log|negate|sign|and|or|not|"
+     r"xor|clamp|pad|slice|gather|scatter|tuple|get-tuple-element|"
+     r"bitcast|rng|sort|abs|power|floor|ceil|round|remainder|is-finite)",
+     "elementwise/data movement"),
+)
+
+
+def _category_of(name: str) -> Optional[str]:
+    """Category label for a leaf op name; None = container (skip),
+    ``"other"`` = nothing matched."""
+    for pat, label in CATEGORIES:
+        if re.match(pat, name) or re.search(pat, name[:40]):
+            return label
+    return "other"
+
+
+def _collective_kind(name: str) -> str:
+    """Collective kind a leaf op name spells, '' for compute. Async pair
+    halves (``all-reduce-start.3``) fold onto the base kind."""
+    stem = name.lstrip("%")
+    for kind in COLLECTIVE_KINDS:
+        if stem == kind or stem.startswith(kind + ".") or \
+                stem.startswith(kind + "-start") or \
+                stem.startswith(kind + "-done"):
+            return kind
+    return ""
+
+
+# ---------------------------------------------------------------- xplane IO
+def find_xplane(trace_dir: str) -> str:
+    """Newest ``xplane.pb`` under a ``jax.profiler`` trace dir."""
+    paths = glob.glob(
+        os.path.join(trace_dir, "plugins", "profile", "*", "*.xplane.pb"))
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
+    return sorted(paths)[-1]
+
+
+def write_capture_meta(trace_dir: str, **meta: Any) -> str:
+    """Sidecar next to the trace so a later parse normalizes by the window
+    the capture actually used (the profile_ops contract)."""
+    path = os.path.join(trace_dir, "capture_meta.json")
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(meta, fh)
+    return path
+
+
+def read_capture_meta(trace_dir: str) -> Dict[str, Any]:
+    path = os.path.join(trace_dir, "capture_meta.json")
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return {}
+
+
+@dataclass
+class _Event:
+    """One leaf op occurrence on one device timeline (absolute ps)."""
+
+    name: str
+    t0: int
+    t1: int
+
+
+@dataclass
+class ParsedTrace:
+    """Leaf device-op events from one xplane, per device timeline.
+
+    ``timelines`` maps a device key (plane name, or plane:line for the CPU
+    client threads) to its time-sorted leaf events. ``totals``/``counts``
+    aggregate durations (seconds) and occurrence counts per op name across
+    all timelines. ``overlap_measurable`` is True when any two leaf events
+    on the SAME timeline overlap in time — i.e. the runtime can actually
+    run a collective under compute; on a serialized executor the measured
+    overlap fraction would read 0.0 for a reason the runtime, not the
+    program, chose.
+    """
+
+    timelines: Dict[str, List[_Event]] = field(default_factory=dict)
+    totals: Dict[str, float] = field(default_factory=dict)
+    counts: Dict[str, int] = field(default_factory=dict)
+    plane: str = ""
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def n_timelines(self) -> int:
+        return max(len(self.timelines), 1)
+
+    @property
+    def overlap_measurable(self) -> bool:
+        for evs in self.timelines.values():
+            last_end = 0
+            for e in evs:
+                if e.t0 < last_end:
+                    return True
+                last_end = max(last_end, e.t1)
+        return False
+
+    def total_device_s(self) -> float:
+        return sum(self.totals.values())
+
+
+def parse_trace(trace_dir: str) -> ParsedTrace:
+    """Parse a ``jax.profiler`` trace dir into per-device leaf op events.
+
+    Accelerator traces read the ``/device:*`` planes' leaf ``"XLA Ops"``
+    line (containers and the async-copy line are skipped — they
+    double-count); CPU traces read the ``/host:CPU`` plane's ``tf_XLA*``
+    client-thread lines with the executor frames skipped. Every event is
+    keyed by its HLO instruction name (leading ``%`` stripped) — the join
+    key into the compiled program's text.
+    """
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(find_xplane(trace_dir), "rb") as fh:
+        xs.ParseFromString(fh.read())
+
+    out = ParsedTrace(meta=read_capture_meta(trace_dir))
+
+    def add_line(key: str, line, ev_md) -> None:
+        evs: List[_Event] = []
+        for ev in line.events:
+            raw = ev_md[ev.metadata_id].name
+            if any(raw.startswith(p) for p in _FRAME_PREFIXES):
+                continue
+            if _CONTAINER_RE.match(raw):
+                continue
+            name = raw.lstrip("%")
+            t0 = line.timestamp_ns * 1000 + ev.offset_ps
+            evs.append(_Event(name=name, t0=t0, t1=t0 + ev.duration_ps))
+            out.totals[name] = (out.totals.get(name, 0.0)
+                                + ev.duration_ps / 1e12)
+            out.counts[name] = out.counts.get(name, 0) + 1
+        if evs:
+            evs.sort(key=lambda e: (e.t0, e.t1))
+            out.timelines[key] = evs
+
+    device_planes = [p for p in xs.planes if p.name.startswith("/device:")]
+    if device_planes:
+        out.plane = device_planes[0].name
+        for plane in device_planes:
+            # Leaf op line ONLY: the step/module containers and the async
+            # copy line double-count wall time (profile_ops guard).
+            for line in plane.lines:
+                if line.name == "XLA Ops":
+                    add_line(plane.name, line, plane.event_metadata)
+        if not out.timelines:
+            raise RuntimeError(
+                f"no 'XLA Ops' line in device planes "
+                f"({[ln.name for p in device_planes for ln in p.lines]})")
+        return out
+
+    host = [p for p in xs.planes if p.name == "/host:CPU"]
+    if not host:
+        raise RuntimeError(
+            f"no device plane and no /host:CPU plane in trace "
+            f"({[p.name for p in xs.planes]})")
+    out.plane = host[0].name
+    for line in host[0].lines:
+        if line.name.startswith("tf_XLA"):
+            add_line(f"{host[0].name}:{line.name}", line,
+                     host[0].event_metadata)
+    if not out.timelines:
+        raise RuntimeError(
+            "CPU trace carries no tf_XLA* client-thread lines — was a "
+            "program actually executed inside the capture?")
+    return out
+
+
+# ----------------------------------------------------- category table (CLI)
+def category_table(parsed: ParsedTrace, window: int,
+                   top: int = 0) -> Dict[str, Any]:
+    """The profile_ops.py per-kernel-category table, computed from a parsed
+    trace: per-step ms by compute category (collectives get their kind as
+    the category) plus optionally the N largest individual kernels."""
+    agg: Dict[str, float] = {}
+    cnt: Dict[str, int] = {}
+    for name, secs in parsed.totals.items():
+        kind = _collective_kind(name)
+        label = kind if kind else _category_of(name)
+        if label is None:
+            continue
+        agg[label] = agg.get(label, 0.0) + secs
+        cnt[label] = cnt.get(label, 0) + parsed.counts[name]
+    total = sum(agg.values())
+    denom = max(window, 1) * parsed.n_timelines
+    rows = [
+        {
+            "category": label,
+            "ms_per_step": round(agg[label] * 1e3 / denom, 3),
+            "pct": round(100 * agg[label] / max(total, 1e-12), 1),
+            "kernels": cnt[label],
+        }
+        for label in sorted(agg, key=agg.get, reverse=True)
+    ]
+    out = {
+        "total_ms_per_step": round(total * 1e3 / denom, 2),
+        "rows": rows,
+        "n_timelines": parsed.n_timelines,
+    }
+    if top:
+        out["top_ops"] = [
+            {"name": n[:140],
+             "ms_per_step": round(parsed.totals[n] * 1e3 / denom, 4)}
+            for n in sorted(parsed.totals, key=parsed.totals.get,
+                            reverse=True)[:top]
+        ]
+    return out
+
+
+# ------------------------------------------------------- HLO scope joining
+_DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([A-Za-z0-9_.-]+)\s*=")
+_OP_NAME_RE = re.compile(r'op_name="([^"]*)"')
+_BUCKET_SCOPE_RE = re.compile(
+    re.escape(GRADSYNC_BUCKET_SCOPE) + r"(\d+)")
+
+
+def hlo_scope_index(hlo_text: str) -> Dict[str, str]:
+    """Instruction name → ``op_name`` metadata scope path, for every def
+    line of a compiled program dump. The named scopes the lowering pins
+    (``gradsync.bucket_{i}``, ``zero1.*`` — kernel/bucketing.py) ride this
+    metadata; the measured events join through it."""
+    index: Dict[str, str] = {}
+    for line in hlo_text.splitlines():
+        m = _DEF_RE.match(line)
+        if not m:
+            continue
+        om = _OP_NAME_RE.search(line)
+        index[m.group(1)] = om.group(1) if om else ""
+    return index
+
+
+# ------------------------------------------------------------- the report
+@dataclass
+class MeasuredOp:
+    """One measured op, joined (or not) to the plan."""
+
+    name: str                       # HLO instruction name
+    kind: str = ""                  # collective kind, "" for compute
+    category: str = ""              # compute category / aux label
+    scope: str = ""                 # op_name metadata scope path
+    seconds_per_step: float = 0.0   # per device timeline
+    count: int = 0
+    payload_elements: int = 0       # largest array touched (collectives)
+    payload_bytes: int = 0
+    bucket: Optional[int] = None    # gradsync bucket (scope join)
+    vars: Tuple[str, ...] = ()      # plan vars this op syncs
+    overlap_fraction: Optional[float] = None   # measured hidden fraction
+    matched: bool = False
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "name": self.name, "kind": self.kind,
+            "category": self.category, "scope": self.scope,
+            "seconds_per_step": self.seconds_per_step, "count": self.count,
+            "payload_elements": self.payload_elements,
+            "payload_bytes": self.payload_bytes,
+            "bucket": self.bucket, "vars": list(self.vars),
+            "overlap_fraction": self.overlap_fraction,
+            "matched": self.matched,
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "MeasuredOp":
+        return cls(
+            name=d["name"], kind=d.get("kind", ""),
+            category=d.get("category", ""), scope=d.get("scope", ""),
+            seconds_per_step=float(d.get("seconds_per_step", 0.0)),
+            count=int(d.get("count", 0)),
+            payload_elements=int(d.get("payload_elements", 0)),
+            payload_bytes=int(d.get("payload_bytes", 0)),
+            bucket=d.get("bucket"), vars=tuple(d.get("vars", ())),
+            overlap_fraction=d.get("overlap_fraction"),
+            matched=bool(d.get("matched", False)),
+        )
+
+
+@dataclass
+class BucketWire:
+    """One backward-overlap bucket's measured wire."""
+
+    bucket: int
+    vars: Tuple[str, ...] = ()
+    measured_s_per_step: float = 0.0
+    promised_bytes: int = 0         # full-payload sum of the bucket's vars
+    measured_payload_bytes: int = 0  # shard-view payload the ops carried
+    overlap_fraction: float = 0.0   # measured hidden fraction [0, 1]
+    exposed_s_per_step: float = 0.0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "bucket": self.bucket, "vars": list(self.vars),
+            "measured_s_per_step": self.measured_s_per_step,
+            "promised_bytes": self.promised_bytes,
+            "measured_payload_bytes": self.measured_payload_bytes,
+            "overlap_fraction": self.overlap_fraction,
+            "exposed_s_per_step": self.exposed_s_per_step,
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "BucketWire":
+        return cls(
+            bucket=int(d["bucket"]), vars=tuple(d.get("vars", ())),
+            measured_s_per_step=float(d.get("measured_s_per_step", 0.0)),
+            promised_bytes=int(d.get("promised_bytes", 0)),
+            measured_payload_bytes=int(d.get("measured_payload_bytes", 0)),
+            overlap_fraction=float(d.get("overlap_fraction", 0.0)),
+            exposed_s_per_step=float(d.get("exposed_s_per_step", 0.0)),
+        )
+
+
+@dataclass
+class MeasuredWire:
+    """The measured side of the planned → priced → measured loop.
+
+    Per-collective measured seconds joined to the plan's promised wire,
+    per-bucket overlap fractions, compute-category remainder, and the
+    roll-ups every consumer reads: ``wire_s_per_step`` (all collective
+    time), ``exposed_wire_s_per_step`` (the part NOT covered by concurrent
+    same-device compute) and ``exposed_comm_fraction`` (exposed wire over
+    total device step time) — the measured replacement for the
+    StepProfiler's roofline-residue estimate.
+    """
+
+    program: str = ""
+    window: int = 1
+    n_devices: int = 1
+    overlap_measurable: bool = False
+    device_total_s_per_step: float = 0.0
+    wire_s_per_step: float = 0.0
+    exposed_wire_s_per_step: float = 0.0
+    ops: List[MeasuredOp] = field(default_factory=list)
+    buckets: List[BucketWire] = field(default_factory=list)
+    categories: Dict[str, float] = field(default_factory=dict)
+    # Promised-wire kinds (per var) with no matching measured op — the
+    # SLT002 input; [(var, rendering, op_kind), ...].
+    unobserved: List[Tuple[str, str, str]] = field(default_factory=list)
+    # Per-var measured-vs-promised payload rows the explain table renders.
+    var_table: List[Dict[str, Any]] = field(default_factory=list)
+
+    # ------------------------------------------------------------- queries
+    @property
+    def collectives(self) -> List[MeasuredOp]:
+        return [o for o in self.ops if o.kind]
+
+    @property
+    def exposed_comm_fraction(self) -> Optional[float]:
+        if self.device_total_s_per_step <= 0:
+            return None
+        return self.exposed_wire_s_per_step / self.device_total_s_per_step
+
+    @property
+    def unattributed_large(self) -> List[MeasuredOp]:
+        """Measured rows attribution failed on that are too big to wave
+        away: unmatched collectives above the aux-reduction allowance, and
+        uncategorized compute, each above LARGE_FRACTION of device time."""
+        floor = LARGE_FRACTION * max(self.device_total_s_per_step, 1e-12)
+        out = []
+        for o in self.ops:
+            if o.seconds_per_step < floor:
+                continue
+            if o.kind and not o.matched and \
+                    o.payload_elements > AUX_REDUCTION_MAX_ELEMENTS:
+                out.append(o)
+            elif not o.kind and o.category == "other":
+                out.append(o)
+        return out
+
+    def bucket_summed_exposed_fraction(self) -> Optional[float]:
+        """Step-level exposed-comm fraction re-derived from the per-bucket
+        rows plus the unbucketed collectives — must agree with
+        :attr:`exposed_comm_fraction` (the consistency the tests pin)."""
+        if self.device_total_s_per_step <= 0:
+            return None
+        exposed = sum(b.exposed_s_per_step for b in self.buckets)
+        for o in self.collectives:
+            if o.bucket is None:
+                exposed += o.seconds_per_step * (
+                    1.0 - (o.overlap_fraction or 0.0))
+        return exposed / self.device_total_s_per_step
+
+    def calibration_components(self) -> Dict[str, float]:
+        """Measured seconds per plan/calibrate.py component, from the join:
+        ``overlap_s`` ← bucketed grad collectives (their full measured
+        time — the component the cost model prices as overlappable),
+        ``gather_s`` ← zero1 param re-gathers, ``comm_s`` ← every other
+        matched grad collective. Components a trace cannot attribute
+        (update/latency/act) are absent, not zero."""
+        comm = gather = overlap = 0.0
+        for o in self.collectives:
+            if not o.matched:
+                continue
+            if o.bucket is not None:
+                overlap += o.seconds_per_step
+            elif o.kind == "all-gather" and (
+                    ZERO1_ALL_GATHER_SCOPE in o.scope or o.vars):
+                gather += o.seconds_per_step
+            else:
+                comm += o.seconds_per_step
+        out: Dict[str, float] = {}
+        if overlap:
+            # The overlap_s coefficient is the measured EXPOSED fraction:
+            # report the exposed seconds so Σmeasured/Σpredicted fits it.
+            exposed = sum(b.exposed_s_per_step for b in self.buckets)
+            out["overlap_s"] = exposed if self.overlap_measurable else overlap
+        if gather:
+            out["gather_s"] = gather
+        if comm:
+            out["comm_s"] = comm
+        return out
+
+    # -------------------------------------------------------------- serde
+    def summary(self) -> Dict[str, Any]:
+        """Compact roll-up for JSON lines / recorder events."""
+        return {
+            "program": self.program,
+            "window": self.window,
+            "n_devices": self.n_devices,
+            "device_ms_per_step": round(
+                self.device_total_s_per_step * 1e3, 4),
+            "wire_ms_per_step": round(self.wire_s_per_step * 1e3, 4),
+            "exposed_comm_fraction": self.exposed_comm_fraction,
+            "overlap_measurable": self.overlap_measurable,
+            "n_collectives": len(self.collectives),
+            "n_matched": sum(1 for o in self.collectives if o.matched),
+            "n_buckets": len(self.buckets),
+            "bucket_overlap": {
+                str(b.bucket): round(b.overlap_fraction, 4)
+                for b in self.buckets},
+            "unattributed_large": len(self.unattributed_large),
+            "unobserved": len(self.unobserved),
+        }
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "program": self.program,
+            "window": self.window,
+            "n_devices": self.n_devices,
+            "overlap_measurable": self.overlap_measurable,
+            "device_total_s_per_step": self.device_total_s_per_step,
+            "wire_s_per_step": self.wire_s_per_step,
+            "exposed_wire_s_per_step": self.exposed_wire_s_per_step,
+            "ops": [o.to_json() for o in self.ops],
+            "buckets": [b.to_json() for b in self.buckets],
+            "categories": dict(self.categories),
+            "unobserved": [list(u) for u in self.unobserved],
+            "var_table": list(self.var_table),
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "MeasuredWire":
+        return cls(
+            program=d.get("program", ""),
+            window=int(d.get("window", 1)),
+            n_devices=int(d.get("n_devices", 1)),
+            overlap_measurable=bool(d.get("overlap_measurable", False)),
+            device_total_s_per_step=float(
+                d.get("device_total_s_per_step", 0.0)),
+            wire_s_per_step=float(d.get("wire_s_per_step", 0.0)),
+            exposed_wire_s_per_step=float(
+                d.get("exposed_wire_s_per_step", 0.0)),
+            ops=[MeasuredOp.from_json(o) for o in d.get("ops", [])],
+            buckets=[BucketWire.from_json(b) for b in d.get("buckets", [])],
+            categories=dict(d.get("categories", {})),
+            unobserved=[tuple(u) for u in d.get("unobserved", [])],
+            var_table=list(d.get("var_table", [])),
+        )
+
+    def save(self, path: str) -> str:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(self.to_json(), fh, indent=2, sort_keys=True,
+                      default=float)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "MeasuredWire":
+        with open(path, "r", encoding="utf-8") as fh:
+            return cls.from_json(json.load(fh))
+
+    def describe(self) -> str:
+        lines = [
+            f"MeasuredWire({self.program or 'program'}: window "
+            f"{self.window} x {self.n_devices} device timeline(s), "
+            f"{self.device_total_s_per_step * 1e3:.3f} ms/step device, "
+            f"wire {self.wire_s_per_step * 1e3:.3f} ms/step, exposed "
+            f"{(self.exposed_comm_fraction or 0.0) * 100:.1f}%"
+            + ("" if self.overlap_measurable
+               else " [overlap not measurable on this runtime]") + ")"
+        ]
+        for b in self.buckets:
+            lines.append(
+                f"  bucket {b.bucket}: {b.measured_s_per_step * 1e3:8.4f} "
+                f"ms/step  hidden {b.overlap_fraction * 100:5.1f}%  "
+                f"promised {b.promised_bytes / 1e6:.3f} MB  "
+                f"vars={','.join(b.vars)[:60]}")
+        for o in self.collectives:
+            tag = "matched" if o.matched else "UNMATCHED"
+            lines.append(
+                f"  {o.kind:<19s} {o.name:<24s} "
+                f"{o.seconds_per_step * 1e3:8.4f} ms/step  {tag}"
+                + (f"  bucket={o.bucket}" if o.bucket is not None else "")
+                + (f"  vars={','.join(o.vars)[:48]}" if o.vars else ""))
+        return "\n".join(lines)
+
+
+# ------------------------------------------------------------------ overlap
+def _merge_intervals(ivs: List[Tuple[int, int]]) -> List[Tuple[int, int]]:
+    out: List[Tuple[int, int]] = []
+    for a, b in sorted(ivs):
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def _coverage(t0: int, t1: int, merged: List[Tuple[int, int]]) -> float:
+    """Fraction of [t0, t1] covered by the merged interval union."""
+    if t1 <= t0:
+        return 0.0
+    covered = 0
+    for a, b in merged:
+        lo, hi = max(a, t0), min(b, t1)
+        if hi > lo:
+            covered += hi - lo
+        if a >= t1:
+            break
+    return covered / (t1 - t0)
+
+
+def _overlap_fractions(parsed: ParsedTrace) -> Dict[str, float]:
+    """Duration-weighted hidden fraction per collective op name: how much
+    of its occurrences' intervals concurrent NON-collective work on the
+    same device timeline covered. 0.0 everywhere on serialized runtimes
+    (see :attr:`ParsedTrace.overlap_measurable`)."""
+    hidden_ps: Dict[str, float] = {}
+    total_ps: Dict[str, float] = {}
+    for evs in parsed.timelines.values():
+        compute = _merge_intervals(
+            [(e.t0, e.t1) for e in evs if not _collective_kind(e.name)])
+        for e in evs:
+            if not _collective_kind(e.name):
+                continue
+            dur = e.t1 - e.t0
+            total_ps[e.name] = total_ps.get(e.name, 0.0) + dur
+            hidden_ps[e.name] = (hidden_ps.get(e.name, 0.0)
+                                 + _coverage(e.t0, e.t1, compute) * dur)
+    return {n: hidden_ps.get(n, 0.0) / t
+            for n, t in total_ps.items() if t > 0}
+
+
+# --------------------------------------------------------------------- join
+def join_to_plan(parsed: ParsedTrace, hlo_text: str, plan,
+                 window: int, program: str = "") -> MeasuredWire:
+    """Join measured leaf ops to a :class:`ShardingPlan`'s promised wire.
+
+    Three join paths, in precedence order:
+
+    1. **scope**: the compiled program's ``op_name`` metadata carries the
+       pinned named scopes — ``gradsync.bucket_{i}`` resolves an op to a
+       backward-overlap bucket (and the bucket's variables),
+       ``zero1.reduce_scatter_grads`` / ``zero1.all_gather_params`` to the
+       shard_update vars;
+    2. **payload**: a collective whose payload equals a VarWire's
+       storage/bucket elements under one mesh-axis shard division (the
+       wire-conformance candidate rule, shared via
+       ``analysis.passes.payload_candidates``) joins to that var;
+    3. **category**: everything else is compute, bucketed by
+       :data:`CATEGORIES`; unmatched small collectives are aux/loss
+       reductions.
+    """
+    from autodist_tpu.analysis.passes import payload_candidates
+
+    inventory = CollectiveInventory.from_hlo(hlo_text, program=program)
+    inv_by_name = {c.name: c for c in inventory.collectives if c.name}
+    scopes = hlo_scope_index(hlo_text)
+    wires = plan.promised_wire()
+    trainable = {n: w for n, w in wires.items()
+                 if w.rendering != "nontrainable"}
+    mesh_sizes = dict(zip(plan.mesh.axis_names, plan.mesh.devices.shape))
+    assignment = plan.bucket_assignment()
+    bucket_vars = {i: tuple(names) for i, names in enumerate(assignment)}
+    su_vars = tuple(n for n, w in trainable.items() if w.shard_update)
+    overlap = _overlap_fractions(parsed)
+    denom = max(window, 1) * parsed.n_timelines
+
+    report = MeasuredWire(
+        program=program, window=max(window, 1),
+        n_devices=parsed.n_timelines,
+        overlap_measurable=parsed.overlap_measurable,
+        device_total_s_per_step=parsed.total_device_s() / denom,
+    )
+
+    matched_var_kinds: set = set()
+    for name in sorted(parsed.totals, key=parsed.totals.get, reverse=True):
+        secs = parsed.totals[name] / denom
+        count = parsed.counts[name]
+        kind = _collective_kind(name)
+        scope = scopes.get(name, "")
+        if not kind:
+            label = _category_of(name)
+            if label is None:
+                continue
+            report.categories[label] = (
+                report.categories.get(label, 0.0) + secs)
+            # Only large compute ops get their own row; the category table
+            # carries the rest (keeps the report O(categories), not O(ops)).
+            if label == "other" or secs >= LARGE_FRACTION * max(
+                    report.device_total_s_per_step, 1e-12):
+                report.ops.append(MeasuredOp(
+                    name=name, category=label, scope=scope,
+                    seconds_per_step=secs, count=count, matched=True))
+            continue
+
+        inv = inv_by_name.get(name)
+        payload = inv.max_payload_elements if inv is not None else 0
+        payload_bytes = inv.result_bytes if inv is not None else 0
+        op = MeasuredOp(
+            name=name, kind=kind, scope=scope, seconds_per_step=secs,
+            count=count, payload_elements=payload,
+            payload_bytes=payload_bytes,
+            overlap_fraction=overlap.get(name),
+        )
+        # Path 1: named-scope join (the bucket / zero1 keys).
+        bm = _BUCKET_SCOPE_RE.search(scope)
+        if bm is not None:
+            op.bucket = int(bm.group(1))
+            op.vars = bucket_vars.get(op.bucket, ())
+            op.matched = op.bucket in bucket_vars
+        elif ZERO1_REDUCE_SCATTER_SCOPE in scope or \
+                ZERO1_ALL_GATHER_SCOPE in scope:
+            op.vars = su_vars
+            op.matched = bool(su_vars)
+        # Path 2: payload match against the promised wire.
+        if not op.matched and payload:
+            hits = []
+            for vn, w in trainable.items():
+                if kind not in w.allow and kind not in w.require:
+                    continue
+                if payload in payload_candidates(w, mesh_sizes):
+                    hits.append(vn)
+            if hits:
+                op.vars = tuple(hits)
+                op.matched = True
+        # Small unmatched collectives: metric/aux reductions (scalar loss
+        # psum, aux means) — attributed as such, never flagged.
+        if not op.matched and payload <= AUX_REDUCTION_MAX_ELEMENTS:
+            op.category = "aux/loss reductions"
+        report.ops.append(op)
+        for vn in op.vars:
+            matched_var_kinds.add((vn, kind))
+
+    # ------------------------------------------------------------ roll-ups
+    report.wire_s_per_step = sum(
+        o.seconds_per_step for o in report.collectives)
+    report.exposed_wire_s_per_step = sum(
+        o.seconds_per_step * (1.0 - (o.overlap_fraction or 0.0))
+        for o in report.collectives)
+
+    per_bucket: Dict[int, List[MeasuredOp]] = {}
+    for o in report.collectives:
+        if o.bucket is not None:
+            per_bucket.setdefault(o.bucket, []).append(o)
+    for bi in sorted(per_bucket):
+        ops = per_bucket[bi]
+        total = sum(o.seconds_per_step for o in ops)
+        hidden = sum(
+            o.seconds_per_step * (o.overlap_fraction or 0.0) for o in ops)
+        promised = sum(
+            trainable[v].storage_bytes for v in bucket_vars.get(bi, ())
+            if v in trainable)
+        report.buckets.append(BucketWire(
+            bucket=bi, vars=bucket_vars.get(bi, ()),
+            measured_s_per_step=total,
+            promised_bytes=int(promised),
+            measured_payload_bytes=sum(o.payload_bytes for o in ops),
+            overlap_fraction=hidden / total if total > 0 else 0.0,
+            exposed_s_per_step=total - hidden,
+        ))
+
+    # Promised-but-unobserved kinds (the SLT002 input): every require'd op
+    # kind of every trainable var must have a measured op joined to it.
+    for vn, w in sorted(trainable.items()):
+        for kind in w.require:
+            if (vn, kind) not in matched_var_kinds:
+                report.unobserved.append((vn, w.rendering, kind))
+
+    # Per-var measured-vs-promised table (explain --wire-measured rows).
+    per_var_s: Dict[str, float] = {}
+    per_var_bytes: Dict[str, int] = {}
+    for o in report.collectives:
+        if not o.vars:
+            continue
+        share = o.seconds_per_step / len(o.vars)
+        for vn in o.vars:
+            per_var_s[vn] = per_var_s.get(vn, 0.0) + share
+            per_var_bytes[vn] = (per_var_bytes.get(vn, 0)
+                                 + o.payload_bytes // len(o.vars))
+    bucket_of: Dict[str, int] = {}
+    for bi, names in bucket_vars.items():
+        for vn in names:
+            bucket_of[vn] = bi
+    for vn, w in sorted(trainable.items()):
+        elems = int(w.storage_elements)
+        row = {
+            "var": vn,
+            "rendering": w.rendering,
+            "promised_bytes": int(w.storage_bytes),
+            "measured_s_per_step": per_var_s.get(vn),
+            "measured_payload_bytes": per_var_bytes.get(vn),
+            "bucket": bucket_of.get(vn),
+            "storage_elements": elems,
+        }
+        report.var_table.append(row)
+    return report
+
+
+# ------------------------------------------------------------ capture + run
+def capture_trace(step, state, batch, num_steps: int,
+                  trace_dir: Optional[str] = None, stacked: bool = False):
+    """Capture a ``jax.profiler`` trace of one windowed ``step.run``.
+
+    Warms the window program first (compile outside the capture), then
+    traces exactly one window with the one-end-barrier discipline. Returns
+    ``(trace_dir, new_state, metrics)`` — ``run`` may donate ``state``.
+    """
+    import numpy as np
+
+    from autodist_tpu.utils import tracing
+
+    def barrier(metrics):
+        loss = metrics.get("loss") if isinstance(metrics, dict) else None
+        if loss is not None:
+            float(np.asarray(loss).ravel()[-1])
+        else:
+            import jax
+
+            jax.block_until_ready(metrics)
+
+    state, metrics = step.run(state, batch, num_steps, stacked=stacked)
+    barrier(metrics)
+    with tracing.trace("attrib", trace_dir=trace_dir) as td:
+        state, metrics = step.run(state, batch, num_steps, stacked=stacked)
+        barrier(metrics)
+    write_capture_meta(td, window=int(num_steps), stacked=bool(stacked))
+    return td, state, metrics
+
+
+def windowed_hlo(step, state, batch, num_steps: int,
+                 stacked: bool = False) -> str:
+    """Post-optimization HLO text of the SAME window program a capture
+    runs — the text whose instruction names the trace events carry.
+    Shapes only (eval_shape): nothing executes, donated buffers untouched."""
+    import jax
+
+    fn = step._window_program(state, batch, num_steps, stacked, False)
+    state_shapes = jax.eval_shape(lambda: state)
+    batch_shapes = jax.eval_shape(lambda: batch)
+    return fn.lower(state_shapes, batch_shapes).compile().as_text()
+
+
+def attribute(step, state, batch, num_steps: int = 4,
+              trace_dir: Optional[str] = None, stacked: bool = False,
+              program: str = "train_window"):
+    """Capture + parse + join, end to end, for a
+    :class:`~autodist_tpu.kernel.lowering.DistributedTrainStep`.
+
+    Returns ``(MeasuredWire, new_state)`` (the window program may donate
+    ``state``). ONE XLA compile serves both halves: the AOT-compiled
+    window program yields the post-optimization text (the instruction-name
+    → scope map, so the join can never drift from what actually ran) AND
+    executes the warmup + captured windows directly — on a big TPU model
+    a second compile would eat minutes of the watchdog budget
+    ``bench.py --attrib`` exists to survive. If this toolchain's AOT
+    callable rejects the live arguments, execution falls back to
+    ``step.run`` (a second, jit-cached compile) and the text stays from
+    the AOT object — same program key, same instruction names.
+    """
+    import jax
+    import numpy as np
+
+    from autodist_tpu.utils import tracing
+
+    fn = step._window_program(state, batch, num_steps, stacked, False)
+    compiled = fn.lower(jax.eval_shape(lambda: state),
+                        jax.eval_shape(lambda: batch)).compile()
+    hlo = compiled.as_text()
+
+    def barrier(metrics):
+        loss = metrics.get("loss") if isinstance(metrics, dict) else None
+        if loss is not None:
+            float(np.asarray(loss).ravel()[-1])
+        else:
+            jax.block_until_ready(metrics)
+
+    def via_run(st):
+        return step.run(st, batch, num_steps, stacked=stacked)
+
+    def via_compiled(st):
+        return compiled(st, batch)
+
+    runner = via_compiled
+    try:
+        state, metrics = runner(state)  # warmup: page in, settle caches
+    except (TypeError, ValueError) as e:
+        # AOT arg validation rejected the live layout (raises before any
+        # donation): run through the jit path instead.
+        logging.debug("AOT window call rejected (%s); using step.run", e)
+        runner = via_run
+        state, metrics = runner(state)
+    barrier(metrics)
+    with tracing.trace("attrib", trace_dir=trace_dir) as td:
+        state, metrics = runner(state)
+        barrier(metrics)
+    write_capture_meta(td, window=int(num_steps), stacked=bool(stacked))
+    parsed = parse_trace(td)
+    report = join_to_plan(parsed, hlo, step.plan, num_steps, program=program)
+    logging.info("measured-wire attribution: %s",
+                 json.dumps(report.summary(), default=float))
+    return report, state

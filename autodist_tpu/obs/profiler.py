@@ -146,6 +146,10 @@ class StepProfiler:
             else detect_peak_flops(jax.devices()[0]))
         self.hbm_bw_bytes_per_s = hbm_bw_bytes_per_s
         self.windows: List[Dict[str, float]] = []
+        # Last measured-wire attribution (obs/attrib.py): set by
+        # attribute(); when present its trace-measured exposed-comm
+        # fraction replaces the roofline-residue estimate in report().
+        self.last_attribution = None
         # Cumulative profiled-step counter: stamps flight records and
         # sentry findings with WHICH step an anomaly hit (a proxy for the
         # training step — exact when profiling starts at step 0).
@@ -409,6 +413,17 @@ class StepProfiler:
                 out["exposed_comm_fraction"] = (
                     exposed / out["step_device_s"])
                 self._g_exposed.set(out["exposed_comm_fraction"])
+        if self.last_attribution is not None:
+            # Trace-measured wire beats the roofline residue: the residue
+            # is an upper bound (any non-comm overhead inflates it), the
+            # attribution measured the collectives themselves.
+            wire = self.last_attribution
+            out["measured_wire"] = wire.summary()
+            frac = wire.exposed_comm_fraction
+            if frac is not None:
+                out["exposed_comm_s_per_step"] = wire.exposed_wire_s_per_step
+                out["exposed_comm_fraction"] = frac
+                self._g_exposed.set(frac)
         compile_log = list(getattr(self.step, "compile_log", ()))
         out["compiles"] = {
             "count": len(compile_log),
@@ -425,6 +440,47 @@ class StepProfiler:
         logging.info("%s: %s", prefix, json.dumps(rep, sort_keys=True,
                                                   default=float))
         return rep
+
+    # ---------------------------------------------------------- attribution
+    def attribute(self, state, batch, num_steps: int = 4,
+                  trace_dir: Optional[str] = None, stacked: bool = False):
+        """Measured-wire attribution of one windowed run (obs/attrib.py):
+        capture a ``jax.profiler`` trace, join every device op back to the
+        plan's promised wire, and return ``(MeasuredWire, new_state)``
+        (``run`` donates ``state``).
+
+        Side effects: the report lands on :attr:`last_attribution`; the
+        trace-measured exposed-comm fraction (a direct measurement, unlike
+        the roofline residue) updates the ``obs_exposed_comm_fraction``
+        gauge and subsequent :meth:`report` calls; an ``attrib`` event
+        goes to the flight recorder when one is active."""
+        from autodist_tpu.obs import attrib as _attrib
+
+        wire, state = _attrib.attribute(
+            self.step, state, batch, num_steps=num_steps,
+            trace_dir=trace_dir, stacked=stacked)
+        self.last_attribution = wire
+        frac = wire.exposed_comm_fraction
+        if frac is not None:
+            self._g_exposed.set(frac)
+        if self.recorder is not None:
+            self.recorder.record_event("attrib", critical=False,
+                                       **wire.summary())
+        return wire, state
+
+    @property
+    def exposed_comm_fraction(self) -> Optional[float]:
+        """The step-level exposed-communication fraction, best evidence
+        first: the trace-measured number when :meth:`attribute` ran (wire
+        time not covered by concurrent same-device compute), else the
+        roofline-residue estimate from :meth:`report` (device time beyond
+        the compiled program's compute/HBM bound), else None."""
+        if self.last_attribution is not None:
+            frac = self.last_attribution.exposed_comm_fraction
+            if frac is not None:
+                return frac
+        rep = self.report()
+        return rep.get("exposed_comm_fraction")
 
     def calibration_record(self, cost, name: str = ""):
         """This profile as a planner calibration point: pair the measured

@@ -28,6 +28,14 @@ displays; this is its per-component superset for the planner. When fewer
 than :data:`MIN_COMPONENT_POINTS` records exist (or the component matrix
 is degenerate), the fit degrades to exactly the scalar form, so sparse
 profiles never produce wild extrapolations.
+
+Trace-fed precedence (docs/planner.md): records carrying
+``measured_components`` — per-component device seconds attributed by
+``obs/attrib.py``'s measured-wire join (:func:`record_from_attribution`)
+— pin those components' coefficients DIRECTLY (Σmeasured/Σpredicted);
+the regression only fits what the trace cannot see. One attributed record
+already calibrates the wire components; the whole-step regression stays
+the fallback when no trace exists.
 """
 from __future__ import annotations
 
@@ -105,6 +113,13 @@ class CalibrationRecord:
     dispatch_gap_s: float = 0.0
     flops_per_step: float = 0.0
     bytes_per_step: float = 0.0
+    # Trace-derived MEASURED seconds per component (obs/attrib.py
+    # MeasuredWire.calibration_components): when present, the fit pins that
+    # component's coefficient by direct attribution (Σmeasured/Σpredicted)
+    # instead of asking the whole-step regression to disentangle it —
+    # direct evidence beats a 6-coefficient least squares on few points.
+    # Components a trace cannot attribute are simply absent.
+    measured_components: Dict[str, float] = field(default_factory=dict)
 
     @property
     def predicted_s(self) -> float:
@@ -142,6 +157,8 @@ class CalibrationRecord:
                if self.flops_per_step else {}),
             **({"bytes_per_step": self.bytes_per_step}
                if self.bytes_per_step else {}),
+            **({"measured_components": dict(self.measured_components)}
+               if self.measured_components else {}),
         }
 
     @classmethod
@@ -156,6 +173,10 @@ class CalibrationRecord:
             dispatch_gap_s=float(d.get("dispatch_gap_s", 0.0)),
             flops_per_step=float(d.get("flops_per_step", 0.0)),
             bytes_per_step=float(d.get("bytes_per_step", 0.0)),
+            measured_components={
+                str(k): float(v)
+                for k, v in (d.get("measured_components") or {}).items()
+                if k in COMPONENTS},
         )
 
 
@@ -175,6 +196,21 @@ def record_from_profiler(report: Dict, cost: StrategyCost,
         flops_per_step=float(report.get("flops_per_step", 0.0)),
         bytes_per_step=float(report.get("bytes_per_step", 0.0)),
     )
+
+
+def record_from_attribution(report: Dict, cost: StrategyCost, measured_wire,
+                            name: str = "") -> CalibrationRecord:
+    """:func:`record_from_profiler` plus the trace-derived per-component
+    seconds an ``obs.attrib.MeasuredWire`` attributes (wire components
+    only — comm/gather/overlap; compute-side components stay with the
+    regression). The fit pins the attributed components directly and
+    spends the regression's degrees of freedom on the rest."""
+    rec = record_from_profiler(report, cost, name=name)
+    rec.measured_components = {
+        k: float(v)
+        for k, v in measured_wire.calibration_components().items()
+        if k in COMPONENTS}
+    return rec
 
 
 @dataclass
@@ -226,31 +262,67 @@ class TopologyCalibration:
             return out
         out.error_before = prediction_error(recs, None)
 
+        # Direct attribution first: a component measured by trace
+        # attribution (obs/attrib.py) gets its coefficient pinned as
+        # Σmeasured / Σpredicted over the records carrying evidence —
+        # per-op device time is stronger than anything a whole-step
+        # regression can infer, and it frees the regression's degrees of
+        # freedom for the components a trace cannot see. A 0.0 is
+        # legitimate (fully-hidden overlap wire costs nothing).
+        direct: Dict[str, float] = {}
+        for comp in COMPONENTS:
+            num = den = 0.0
+            for r in recs:
+                if comp in getattr(r, "measured_components", {}):
+                    num += float(r.measured_components[comp])
+                    den += float(getattr(r, comp))
+            if den > 1e-12 and num >= 0:
+                direct[comp] = num / den
+
+        def residual(r) -> float:
+            return r.measured_s - sum(
+                direct[c] * getattr(r, c) for c in direct)
+
         fitted = False
-        n_comp = len(COMPONENTS)
-        if len(recs) >= MIN_COMPONENT_POINTS:
+        free = [c for c in COMPONENTS if c not in direct]
+        if len(recs) >= MIN_COMPONENT_POINTS and free:
             A = np.array(
-                [[getattr(r, c) for c in COMPONENTS] + [1.0]
+                [[getattr(r, c) for c in free] + [1.0]
                  for r in recs], np.float64)
-            y = np.array([r.measured_s for r in recs], np.float64)
+            y = np.array([residual(r) for r in recs], np.float64)
             # Columns that never vary carry no signal; zero them so lstsq
             # can't spend them on noise (their coefficient stays 1.0).
-            active = [i for i in range(n_comp)
+            active = [i for i in range(len(free))
                       if float(np.ptp(A[:, i])) > 1e-12]
             if active:
-                cols = active + [n_comp]
+                cols = active + [len(free)]
                 coef, *_ = np.linalg.lstsq(A[:, cols], y, rcond=None)
                 comp_coef = _default_coefficients()
+                comp_coef.update(direct)
+                free_coef = {}
                 for i, col in enumerate(active):
-                    comp_coef[COMPONENTS[col]] = float(coef[i])
+                    free_coef[free[col]] = float(coef[i])
                 base = float(coef[-1])
                 # Negative efficiency coefficients mean the fit is chasing
                 # noise (a "speedup" from sending more bytes); reject the
                 # component fit rather than let it invert rankings.
-                if base >= 0 and all(v > 0 for v in comp_coef.values()):
+                if base >= 0 and all(v > 0 for v in free_coef.values()):
+                    comp_coef.update(free_coef)
                     out.coefficients = comp_coef
                     out.base_s = base
                     fitted = True
+        if not fitted and direct:
+            # Directly-attributed components pinned; the remainder keeps
+            # its uncalibrated default and base_s absorbs the mean
+            # residual (the compute floor) — no regression at all, so a
+            # single trace-attributed record already calibrates.
+            comp_coef = _default_coefficients()
+            comp_coef.update(direct)
+            rest = [residual(r) - sum(comp_coef[c] * getattr(r, c)
+                                      for c in free) for r in recs]
+            out.coefficients = comp_coef
+            out.base_s = max(float(np.mean(rest)), 0.0)
+            fitted = True
         if not fitted:
             # Scalar fallback: measured ≈ base + scale × predicted_total
             # (the tune()-era fit; see module docstring).
@@ -374,7 +446,8 @@ def _merge_records(old: Sequence[CalibrationRecord],
     merged: Dict[tuple, CalibrationRecord] = {}
     for r in list(old) + list(new):
         sig = (r.name, r.comm_s, r.update_s, r.latency_s, r.act_sync_s,
-               r.gather_s, r.overlap_s, r.measured_s)
+               r.gather_s, r.overlap_s, r.measured_s,
+               tuple(sorted(r.measured_components.items())))
         merged.pop(sig, None)  # re-insert so the newest occurrence is last
         merged[sig] = r
     return list(merged.values())[-MAX_PERSISTED_RECORDS:]

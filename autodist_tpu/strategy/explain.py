@@ -336,6 +336,110 @@ def lint(
     return 0 if report.ok else 1
 
 
+def wire_measured(
+    model_spec,
+    model_item: ModelItem,
+    resource_spec: ResourceSpec,
+    measured_path: str,
+    builder_name: str = "AllReduce",
+    out=None,
+) -> int:
+    """``--wire-measured``: the planned → priced → measured table, side by
+    side, for one (model × builder × cluster) against a saved
+    ``MeasuredWire`` JSON (``obs/attrib.py`` — produced by
+    ``StepProfiler.attribute`` / ``bench.py --attrib``). Planned comes
+    from the lowered plan's promised wire, priced from the cost model's
+    components, measured from the trace attribution; the SLT measured-wire
+    findings print below the table (warnings — exit stays 0)."""
+    import jax
+
+    from autodist_tpu.analysis.passes import measured_wire_check
+    from autodist_tpu.kernel import GraphTransformer, build_mesh
+    from autodist_tpu.obs.attrib import MeasuredWire
+    from autodist_tpu.strategy import from_name
+    from autodist_tpu.strategy.base import StrategyCompiler
+    from autodist_tpu.strategy.cost_model import OVERLAP_EXPOSED_FRACTION
+
+    out = out if out is not None else sys.stdout
+    builder = from_name(builder_name)
+    strategy = StrategyCompiler(model_item).compile(
+        builder.build(model_item, resource_spec))
+    if jax.device_count() == resource_spec.num_chips:
+        mesh = build_mesh(resource_spec)
+    else:
+        print(
+            f"wire-measured: runtime has {jax.device_count()} devices, "
+            f"spec wants {resource_spec.num_chips} — lowering the plan on "
+            f"the local mesh (promised payloads reflect the local shard "
+            f"counts)", file=out)
+        mesh = build_mesh(ResourceSpec(resource_dict={
+            "nodes": [{"address": "localhost",
+                       "chips": jax.device_count(), "chief": True}]}))
+    plan = GraphTransformer(strategy, model_item, mesh).transform()
+    cost = CostModel(model_item, resource_spec).strategy_cost(strategy)
+    measured = MeasuredWire.load(measured_path)
+    components = measured.calibration_components()
+
+    print(f"\nmeasured wire: {measured.program or measured_path} "
+          f"(window {measured.window}, {measured.n_devices} device "
+          f"timeline(s), {measured.device_total_s_per_step * 1e3:.3f} "
+          f"ms/step device time"
+          + ("" if measured.overlap_measurable
+             else ", overlap not measurable on this runtime") + ")",
+          file=out)
+    print(f"\n{'component':18s} {'priced':>12s} {'measured':>12s}",
+          file=out)
+    print("-" * 44, file=out)
+    rows = [
+        ("comm (grad sync)", cost.comm_s, components.get("comm_s")),
+        ("gather (zero1 ag)", cost.gather_s, components.get("gather_s")),
+        ("overlap (exposed)", OVERLAP_EXPOSED_FRACTION * cost.overlap_s,
+         components.get("overlap_s")),
+    ]
+    for label, priced, meas in rows:
+        print(f"{label:18s} {priced * 1e3:10.4f}ms "
+              + (f"{meas * 1e3:10.4f}ms" if meas is not None
+                 else f"{'—':>12s}"), file=out)
+
+    if measured.buckets:
+        print(f"\n{'bucket':>6s} {'measured':>11s} {'overlap':>8s} "
+              f"{'promised':>10s}  vars", file=out)
+        print("-" * 72, file=out)
+        for b in measured.buckets:
+            print(f"{b.bucket:6d} {b.measured_s_per_step * 1e3:9.4f}ms "
+                  f"{b.overlap_fraction * 100:7.1f}% "
+                  f"{b.promised_bytes / 1e6:8.3f}MB  "
+                  f"{','.join(b.vars)[:40]}", file=out)
+
+    wires = plan.promised_wire()
+    measured_by_var = {r["var"]: r for r in measured.var_table}
+    print(f"\n{'variable':28s} {'rendering':11s} {'planned ops':24s} "
+          f"{'promised':>10s} {'measured':>10s} {'bucket':>6s}", file=out)
+    print("-" * 96, file=out)
+    for name, w in sorted(wires.items()):
+        if w.rendering == "nontrainable":
+            continue
+        m = measured_by_var.get(name, {})
+        ms = m.get("measured_s_per_step")
+        print(
+            f"{name[:28]:28s} {w.rendering:11s} "
+            f"{','.join(w.require or w.allow)[:24]:24s} "
+            f"{w.storage_bytes / 1e6:8.3f}MB "
+            + (f"{ms * 1e3:8.4f}ms" if ms is not None else f"{'—':>10s}")
+            + (f" {m['bucket']:>6d}" if m.get("bucket") is not None
+               else f" {'—':>6s}"),
+            file=out)
+
+    findings = measured_wire_check(plan, measured)
+    if findings:
+        print("", file=out)
+        for f in findings:
+            print(f.render(), file=out)
+    else:
+        print("\nmeasured wire conforms: no SLT findings", file=out)
+    return 0
+
+
 def _load_provenance(path: str) -> dict:
     """Provenance from a file, a cache entry dir, or a cache root (newest
     entry wins)."""
@@ -400,8 +504,15 @@ def main(argv=None) -> int:
     )
     p.add_argument(
         "--builder", default="AllReduce",
-        help="--lint: strategy builder to lower and analyze "
+        help="--lint/--wire-measured: strategy builder to lower "
              "(default AllReduce; any strategy.from_name name)",
+    )
+    p.add_argument(
+        "--wire-measured", default="",
+        help="render the planned/priced/measured wire table side by side "
+             "against a saved MeasuredWire JSON (obs/attrib.py — from "
+             "StepProfiler.attribute or bench.py --attrib); SLT findings "
+             "print below (docs/observability.md § attribution)",
     )
     args = p.parse_args(argv)
 
@@ -421,7 +532,8 @@ def main(argv=None) -> int:
         # Before any backend use: shape-only planning runs anywhere, and the
         # default accelerator may be absent or wedged (axon tunnel).
         jax.config.update("jax_platforms", args.platform)
-    if args.lint and args.resource_spec and args.platform == "cpu":
+    if (args.lint or args.wire_measured) and args.resource_spec \
+            and args.platform == "cpu":
         # Wire conformance needs a mesh of the spec's shape; provision the
         # CPU host platform with that many devices while the backend is
         # still uninitialized (the __graft_entry__ recipe). A live backend
@@ -470,6 +582,9 @@ def main(argv=None) -> int:
             )
     if args.lint:
         return lint(spec, item, rs, builder_name=args.builder, batch=batch)
+    if args.wire_measured:
+        return wire_measured(spec, item, rs, args.wire_measured,
+                             builder_name=args.builder)
     measured = None
     if args.measured_file:
         import json

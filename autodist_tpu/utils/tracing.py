@@ -32,11 +32,18 @@ from autodist_tpu.utils import logging
 def trace(name: str = "trace", trace_dir: Optional[str] = None):
     """Profile everything inside the block; writes a TensorBoard trace.
 
+    Creates ``trace_dir`` (including parents) when missing and yields the
+    resolved path, so callers — ``train.py --profile-dir``, the
+    measured-wire capture (``obs/attrib.py``) — get the directory the
+    device profile actually landed in regardless of whether they named
+    one.
+
     Usage::
 
-        with tracing.trace("step-100"):
+        with tracing.trace("step-100") as td:
             state, metrics = step(state, batch)
             jax.block_until_ready(state.params)
+        # td -> parse with obs attrib / profile_ops.py --parse
     """
     import jax
 

@@ -21,6 +21,7 @@ import datetime
 import json
 import os
 import sys
+import tempfile
 import time
 
 # Successful accelerator runs cache their JSON line here; the CPU-smoke
@@ -333,6 +334,47 @@ def measure_workload(model_name: str, on_accel: bool,
     # monotone on one chip); the best throughput wins.
     plan_stats = {}
     lint_info = {}
+    attrib_info = {}
+
+    def _attrib(ad, step, state, batch):
+        """``--attrib`` mode: measured-wire attribution (obs/attrib.py) of
+        one short captured window BEFORE any timed window, with its own
+        JSON line emitted immediately — same rc=124 discipline as
+        ``--lint``: a wedged round still leaves the joined device profile.
+        Returns the (possibly donated-and-replaced) state."""
+        if os.environ.get("AUTODIST_BENCH_ATTRIB", "") != "1" or attrib_info:
+            return state
+        try:
+            from autodist_tpu.obs import attrib as obs_attrib
+            from autodist_tpu.obs import recorder as obs_recorder
+
+            wire, state = obs_attrib.attribute(
+                step, state, batch, num_steps=min(steps, 4),
+                program=f"bench:{model_name}")
+            summary = wire.summary()
+            report_path = wire.save(os.path.join(
+                tempfile.mkdtemp(prefix=f"{model_name}_attrib_"),
+                "measured_wire.json"))
+            summary["report"] = report_path
+            attrib_info.update({
+                "attrib_exposed_comm_fraction": wire.exposed_comm_fraction,
+                "attrib_wire_ms_per_step": round(
+                    wire.wire_s_per_step * 1e3, 4),
+                "attrib_unattributed_large": len(wire.unattributed_large),
+                "attrib_buckets": summary["bucket_overlap"],
+            })
+            obs_recorder.record_event("attrib", critical=False, **summary)
+            print(json.dumps({"bench_attrib": summary,
+                              "model": model_name}), flush=True)
+        except Exception as e:  # noqa: BLE001 - attribution never eats a bench
+            attrib_info.update({"attrib_failed": str(e)[:200]})
+            print(json.dumps({"bench_attrib": {"failed": str(e)[:200]},
+                              "model": model_name}), flush=True)
+            # A failure after the capture window ran leaves `state` donated
+            # (deleted buffers) — hand the timed windows a fresh state
+            # rather than letting the attribution eat the bench after all.
+            state = step.init(params)
+        return state
 
     def _lint(ad, step, state, batch):
         """``--lint`` mode: run the static analyzer (shardlint) on the
@@ -378,6 +420,7 @@ def measure_workload(model_name: str, on_accel: bool,
                 plan_stats[k] = plan_stats.get(k, 0) + v
         state = step.init(params)
         _lint(ad, step, state, batch)
+        state = _attrib(ad, step, state, batch)
         # Pin the batch in HBM (the "compute" methodology,
         # docs/performance.md): image-sized host feeds otherwise measure
         # the tunnel, not the chip. Token feeds are tiny but pinning is
@@ -419,6 +462,7 @@ def measure_workload(model_name: str, on_accel: bool,
         return {
             **({"plan_cache": dict(plan_stats)} if plan_cache else {}),
             **lint_info,
+            **attrib_info,
             "unit_per": unit_per,
             "mfu": mfu,
             "units_per_sec": units_per_sec,
@@ -825,6 +869,15 @@ def _main() -> None:
              "and put lint_findings counts in the JSON result line — static "
              "signal survives even when timing is lost to a wedged queue "
              "driver (rc=124)")
+    ap.add_argument(
+        "--attrib", action="store_true",
+        help="capture + join a measured-wire attribution "
+             "(docs/observability.md § attribution) of one short window "
+             "BEFORE any timed window, emit a bench_attrib JSON line "
+             "immediately (rc=124-proof, same discipline as --lint) and "
+             "put attrib_* fields in the result line; the full "
+             "MeasuredWire JSON lands in a temp dir for "
+             "`explain --wire-measured`")
     args = ap.parse_args()
     # Measured compiler-flag set (docs/measured/xla_flags.json) goes into
     # the env before ANY jax import in this process or its children —
@@ -840,6 +893,8 @@ def _main() -> None:
         # Env, not a flag, so watchdogged child processes
         # (_measure_in_subprocess) inherit the mode without plumbing.
         os.environ["AUTODIST_BENCH_LINT"] = "1"
+    if args.attrib:
+        os.environ["AUTODIST_BENCH_ATTRIB"] = "1"
     if args.one:
         _run_one(args.one, args.cpu_smoke, plan_cache=args.plan_cache)
         return

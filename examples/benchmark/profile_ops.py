@@ -1,33 +1,28 @@
 """Device op-time attribution for a windowed train step (bench chip).
 
-Captures a ``jax.profiler`` trace of one windowed ``DistributedTrainStep.run``
-and aggregates the TPU plane's leaf "XLA Ops" line into a per-kernel-category
-table — the op-by-op evidence behind the conv-net ceiling discussion in
-docs/performance.md (VERDICT r2 #2 asked the remaining non-MXU time to be
-attributed; this is the attribution tool).
-
-The xplane.pb is parsed directly with the tensorflow-bundled proto (the
-tensorboard_plugin_profile converters in this image are version-skewed
-against TF), counting only the leaf op line: container events (the while
-loop, the jit region) and the async-copy line double-count wall time and
-are skipped. Categories follow the fusion names XLA emits on TPU —
-convolutions fuse into ``*_fusion`` kernels with their epilogues, so a
-"conv" category would be misleading; kernels are grouped by what their
-name says they compute.
+Thin CLI over :mod:`autodist_tpu.obs.attrib` — the framework's ONE
+xplane reader (``tools/check_patterns.py`` rule 5 bans parsing the trace
+anywhere else, so this example can never drift from what the measured-wire
+attribution joins). Captures a ``jax.profiler`` trace of one windowed
+``DistributedTrainStep.run`` and prints the per-kernel-category table —
+the op-by-op evidence behind the conv-net ceiling discussion in
+docs/performance.md. The container/async-copy double-count guard and the
+TPU fusion taxonomy live in the library (``attrib.CATEGORIES``).
 
 Usage::
 
     python examples/benchmark/profile_ops.py --model resnet --batch 128 --window 20
     python examples/benchmark/profile_ops.py --parse /tmp/trace_dir   # parse only
+
+For the full plan join (per-bucket overlap, measured-vs-promised wire) use
+``python -m autodist_tpu.obs attrib --selftest`` /
+``StepProfiler.attribute`` — this CLI is the category view only.
 """
 from __future__ import annotations
 
 import argparse
-import collections
-import glob
 import json
 import os
-import re
 import sys
 import tempfile
 
@@ -36,11 +31,14 @@ sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..",
 
 def capture(model: str, batch: int, window: int, trace_dir: str) -> None:
     """Same production build path as bench.py/flash_crossover.py — a
-    hand-rolled pipeline here would silently drift from what users run."""
+    hand-rolled pipeline here would silently drift from what users run.
+    Capture itself (warmup, one traced window, the capture_meta.json
+    sidecar) delegates to the library."""
     import jax
 
     from autodist_tpu.api import AutoDist
     from autodist_tpu.models import get_model
+    from autodist_tpu.obs import attrib
     import autodist_tpu.strategy as S
 
     spec = get_model(model)
@@ -52,81 +50,28 @@ def capture(model: str, batch: int, window: int, trace_dir: str) -> None:
     state = step.init(params)
     batch_data = jax.device_put(batch_data, step.plan.batch_shardings(batch_data))
     jax.block_until_ready(batch_data)
-    state, m = step.run(state, batch_data, window)   # warmup + compile
-    float(m["loss"][-1])
-    with jax.profiler.trace(trace_dir):
-        state, m = step.run(state, batch_data, window)
-        float(m["loss"][-1])
-    # Sidecar so --parse later normalizes by the window this trace actually
-    # used instead of whatever --window defaults to in that invocation.
-    with open(os.path.join(trace_dir, "capture_meta.json"), "w") as fh:
-        json.dump({"model": model, "batch": batch, "window": window}, fh)
-
-
-_CATEGORIES = (
-    # (regex on the HLO op name, category label)
-    (r"%convert_reduce_fusion|%reduce_fusion", "stats/grad reductions (+fused producer conv)"),
-    (r"%multiply_add_fusion", "wgrad conv + optimizer update"),
-    (r"%select_and_scatter", "maxpool backward (SelectAndScatter)"),
-    (r"%reduce_window", "pooling forward"),
-    (r"%copy", "layout/loop-boundary copies"),
-    (r"%slice-start|%slice-done|%dynamic-slice", "async activation slices"),
-    (r"%fusion", "conv/elementwise fusions"),
-    (r"%while|^jit_|^0$", None),      # containers: skip, they double-count
-)
+    attrib.capture_trace(step, state, batch_data, window, trace_dir=trace_dir)
+    attrib.write_capture_meta(trace_dir, model=model, batch=batch,
+                              window=window)
 
 
 def parse(trace_dir: str, window: int, top: int = 0):
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    """Parse + print the category table (the historical output shape)."""
+    from autodist_tpu.obs import attrib
 
-    paths = glob.glob(os.path.join(trace_dir, "plugins/profile/*/*.xplane.pb"))
-    if not paths:
-        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
-    xs = xplane_pb2.XSpace()
-    with open(sorted(paths)[-1], "rb") as fh:
-        xs.ParseFromString(fh.read())
-    planes = [p for p in xs.planes if p.name.startswith("/device:TPU")]
-    if not planes:
-        raise RuntimeError(f"no TPU plane in trace ({[p.name for p in xs.planes]})")
-    plane = planes[0]
-    ev_md = plane.event_metadata
-    lines = [l for l in plane.lines if l.name == "XLA Ops"]
-    if not lines:
-        raise RuntimeError(f"no 'XLA Ops' line ({[l.name for l in plane.lines]})")
-
-    agg = collections.Counter()
-    cnt = collections.Counter()
-    per_op = collections.Counter()
-    for ev in lines[0].events:
-        name = ev_md[ev.metadata_id].name
-        for pat, label in _CATEGORIES:
-            if re.match(pat, name) or re.search(pat, name[:40]):
-                break
-        else:
-            label = "other"
-        if label is None:
-            continue
-        agg[label] += ev.duration_ps
-        cnt[label] += 1
-        per_op[name] += ev.duration_ps
-    total = sum(agg.values())
-    rows = []
-    print(f"device-op total {total / 1e9:.1f} ms "
-          f"-> {total / 1e9 / window:.2f} ms/step (window {window})")
-    for label, ps in agg.most_common():
-        rows.append({
-            "category": label,
-            "ms_per_step": round(ps / 1e9 / window, 3),
-            "pct": round(100 * ps / max(total, 1), 1),
-            "kernels": cnt[label],
-        })
-        print(f"  {ps / 1e9 / window:7.2f} ms/step {100 * ps / max(total, 1):5.1f}% "
-              f" n={cnt[label]:6d}  {label}")
+    parsed = attrib.parse_trace(trace_dir)
+    table = attrib.category_table(parsed, window, top=top)
+    total_ms = table["total_ms_per_step"]
+    print(f"device-op total {total_ms * window:.1f} ms "
+          f"-> {total_ms:.2f} ms/step (window {window})")
+    for row in table["rows"]:
+        print(f"  {row['ms_per_step']:7.2f} ms/step {row['pct']:5.1f}% "
+              f" n={row['kernels']:6d}  {row['category']}")
     if top:
-        print(f"\ntop {top} individual kernels (name truncated, shapes included):")
-        for name, ps in per_op.most_common(top):
-            print(f"  {ps / 1e9 / window:7.3f} ms/step  {name[:140]}")
-    return {"total_ms_per_step": round(total / 1e9 / window, 2), "rows": rows}
+        print(f"\ntop {top} individual kernels:")
+        for op in table.get("top_ops", []):
+            print(f"  {op['ms_per_step']:7.3f} ms/step  {op['name']}")
+    return table
 
 
 def main() -> None:
@@ -143,17 +88,19 @@ def main() -> None:
                     help="also print the N largest individual kernels")
     args = ap.parse_args()
 
+    from autodist_tpu.obs.attrib import read_capture_meta
+
     if args.parse:
         trace_dir = args.parse
         window = args.window
-        meta_path = os.path.join(trace_dir, "capture_meta.json")
         if window is None:
-            if not os.path.exists(meta_path):
+            meta = read_capture_meta(trace_dir)
+            if "window" not in meta:
                 ap.error(
-                    f"--parse with no --window and no {meta_path}: the window "
-                    "the trace was captured with is needed to report ms/step")
-            with open(meta_path) as fh:
-                window = json.load(fh)["window"]
+                    f"--parse with no --window and no capture_meta.json in "
+                    f"{trace_dir}: the window the trace was captured with "
+                    f"is needed to report ms/step")
+            window = int(meta["window"])
     else:
         window = args.window if args.window is not None else 20
         trace_dir = tempfile.mkdtemp(prefix=f"{args.model}_trace_")

@@ -83,6 +83,13 @@ def parse_args():
                         "methodology in docs/performance.md) instead of "
                         "paying a host upload per window ('fed')")
     p.add_argument("--trace", action="store_true", help="profile one step to TensorBoard")
+    p.add_argument("--profile-dir", default="",
+                   help="capture a jax.profiler trace of ONE windowed run "
+                        "into this dir (created if missing, via "
+                        "utils.tracing.trace) — attributable afterwards "
+                        "with examples/benchmark/profile_ops.py --parse or "
+                        "the obs/attrib.py measured-wire join "
+                        "(docs/observability.md § attribution)")
     p.add_argument("--trace-out", default="",
                    help="write a chrome-trace/Perfetto JSON of the run's "
                         "host-side spans (warmup/timed windows, compiles) "
@@ -222,6 +229,21 @@ def main():
     if args.trace:
         (_, _), trace_dir = step.trace_step(state, next_batch())
         print(f"trace -> {trace_dir}")
+    if args.profile_dir:
+        # One more window under the profiler, into the user's dir (the
+        # window program is warm by now, so the capture sees steady-state
+        # execution, not a compile). The sidecar makes the trace
+        # self-describing for `profile_ops.py --parse` / obs attrib.
+        from autodist_tpu.obs import attrib as obs_attrib
+        from autodist_tpu.utils import tracing
+
+        with tracing.trace("train", trace_dir=args.profile_dir) as td:
+            state, metrics = step.run(state, next_batch(), window)
+            float(metrics["loss"][-1])
+        obs_attrib.write_capture_meta(td, model=args.model,
+                                      batch=batch_size, window=window)
+        print(f"profile trace -> {td} (parse: python "
+              f"examples/benchmark/profile_ops.py --parse {td})")
     if args.trace_out:
         # Host-side span timeline (chrome-trace JSON): warmup/timed windows
         # plus any library spans recorded during the run.

@@ -35,6 +35,15 @@ exits 1 listing ``file:line`` offenders. Rules:
    record via ``obs.recorder.record_event/record_step``; postmortems read
    via ``obs.recorder.read_records``.
 
+5. **ONE xplane reader** — importing the xplane proto (``xplane_pb2``) or
+   globbing ``xplane.pb`` anywhere in ``autodist_tpu/``, ``examples/``,
+   ``tests/`` or ``bench.py`` outside ``obs/attrib.py`` is banned: the
+   measured-wire attribution (docs/observability.md § attribution) is
+   only trustworthy because the example CLI, the tests and the join all
+   read a device profile through the one parser with the
+   container/async-copy double-count guard. (``tools/`` is exempt: the
+   golden-trace generator builds a synthetic xplane on purpose.)
+
 Pure stdlib, no third-party deps — runs anywhere Python runs.
 """
 from __future__ import annotations
@@ -53,6 +62,8 @@ PSUM_CALL_RE = re.compile(r"\blax\.psum(_scatter)?\s*\(")
 # Rule 4: an open() whose argument expression mentions a flight path, or
 # any use of the segment-name prefix literal, outside obs/recorder.py.
 FLIGHT_WRITE_RE = re.compile(r"open\([^)\n]*flight|['\"]flight-")
+# Rule 5: the xplane proto import / trace-file glob, outside obs/attrib.py.
+XPLANE_RE = re.compile(r"\bxplane_pb2\b|xplane\.pb\b")
 
 
 def _py_files(*roots):
@@ -129,6 +140,19 @@ def main() -> int:
                         f"through autodist_tpu/obs/recorder.py (the ONE "
                         f"writer with the fsync/rotation discipline; "
                         f"docs/observability.md)")
+
+    xplane_allowed = {os.path.join("autodist_tpu", "obs", "attrib.py")}
+    for rel in _py_files("autodist_tpu", "examples", "tests", "bench.py"):
+        if rel in xplane_allowed:
+            continue
+        with open(os.path.join(REPO, rel), "r", encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                code = line.split("#", 1)[0]
+                if XPLANE_RE.search(code):
+                    errors.append(
+                        f"{rel}:{i}: xplane parsing outside obs/attrib.py "
+                        f"— capture/parse through the attribution library "
+                        f"(the ONE trace reader; docs/observability.md)")
 
     if errors:
         print("banned-pattern lint FAILED:", file=sys.stderr)

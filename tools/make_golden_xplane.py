@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""Regenerate the checked-in golden trace for tests/test_attrib.py.
+
+Builds a tiny SYNTHETIC xplane (the tensorflow-bundled proto — the one
+place outside ``obs/attrib.py`` allowed to touch it, see
+``tools/check_patterns.py`` rule 5) that mimics the CPU thunk-executor
+layout a real ``jax.profiler`` capture produces: a ``/host:CPU`` plane
+with two ``tf_XLATfrtCpuClient`` device-thread lines carrying leaf HLO op
+events, executor frames that must be skipped, a ``while`` container that
+must not double-count, and one reduce-scatter whose interval is exactly
+half-covered by a concurrent fusion on the same line (pinning the overlap
+interval math at 0.5).
+
+The numbers are the golden contract ``tests/test_attrib.py`` asserts —
+change them here and there together. Run from the repo root::
+
+    python tools/make_golden_xplane.py
+"""
+from __future__ import annotations
+
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_DIR = os.path.join(REPO, "tests", "data", "tiny_trace")
+
+#: window the synthetic capture pretends to have run (events appear twice
+#: per line = once per step).
+WINDOW = 2
+
+# (metadata id, event name)
+NAMES = {
+    1: "ThunkExecutor::Execute",        # frame: skipped
+    2: "while.3",                       # container: skipped
+    3: "dot.7",                         # compute (matmul/conv)
+    4: "reduce-scatter.9",              # collective, 50% hidden
+    5: "all-gather.11",                 # collective, fully exposed
+    6: "add_multiply_fusion.2",         # compute (fusions)
+}
+
+US = 1_000_000  # ps per µs
+
+# Per step (offset µs, duration µs) per op, on EVERY line; step k shifts
+# by 20 µs. reduce-scatter.9 [6, 10) is covered by add_multiply_fusion.2
+# [8, 12) for exactly half its span -> overlap fraction 0.5; all-gather.11
+# [13, 15) touches nothing -> 0.0.
+STEP_EVENTS = (
+    (1, 0.0, 18.0),    # frame wrapping the step (skipped)
+    (2, 0.5, 17.0),    # while container (skipped)
+    (3, 1.0, 4.0),     # dot.7
+    (4, 6.0, 4.0),     # reduce-scatter.9
+    (6, 8.0, 4.0),     # fusion overlapping rs's second half
+    (5, 13.0, 2.0),    # all-gather.11
+)
+
+
+def build_xspace():
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    plane = xs.planes.add()
+    plane.name = "/host:CPU"
+    for mid, name in NAMES.items():
+        md = plane.event_metadata[mid]
+        md.id = mid
+        md.name = name
+    for li in range(2):
+        line = plane.lines.add()
+        line.name = f"tf_XLATfrtCpuClient/{li}"
+        line.timestamp_ns = 1_000
+        for step in range(WINDOW):
+            shift = step * 20.0
+            for mid, off, dur in STEP_EVENTS:
+                ev = line.events.add()
+                ev.metadata_id = mid
+                ev.offset_ps = int((off + shift) * US)
+                ev.duration_ps = int(dur * US)
+    return xs
+
+
+def main() -> None:
+    profile_dir = os.path.join(OUT_DIR, "plugins", "profile", "golden")
+    os.makedirs(profile_dir, exist_ok=True)
+    xs = build_xspace()
+    with open(os.path.join(profile_dir, "vm.xplane.pb"), "wb") as fh:
+        fh.write(xs.SerializeToString())
+    with open(os.path.join(OUT_DIR, "capture_meta.json"), "w") as fh:
+        json.dump({"window": WINDOW, "synthetic": True}, fh)
+    print(f"golden trace -> {OUT_DIR}")
+
+
+if __name__ == "__main__":
+    main()
