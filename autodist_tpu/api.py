@@ -309,18 +309,16 @@ class AutoDist:
         Covers concurrent multi-process starts on a shared filesystem; on
         disjoint filesystems the runtime coordinator broadcasts the strategy
         instead (runtime/coordinator.py)."""
-        import time as _time
+        from autodist_tpu.utils import retry as _retry
 
         path = os.path.join(const.DEFAULT_STRATEGY_DIR, strategy_id)
-        deadline = _time.monotonic() + timeout_s
-        while not os.path.exists(path):
-            if _time.monotonic() > deadline:
-                raise FileNotFoundError(
-                    f"strategy {strategy_id!r} not found at {path} after "
-                    f"{timeout_s:.0f}s — was the chief's strategy shipped to "
-                    f"this host? (AUTODIST_STRATEGY_ID contract)"
-                )
-            _time.sleep(0.2)
+        if not _retry.wait_until(lambda: os.path.exists(path), timeout_s,
+                                 interval_s=0.2):
+            raise FileNotFoundError(
+                f"strategy {strategy_id!r} not found at {path} after "
+                f"{timeout_s:.0f}s — was the chief's strategy shipped to "
+                f"this host? (AUTODIST_STRATEGY_ID contract)"
+            )
         return Strategy.deserialize(strategy_id)
 
     def build(

@@ -1,0 +1,37 @@
+"""Deterministic fault injection + soak harness (docs/chaos.md).
+
+The fault-tolerance and observability stack (``ft/``, ``obs/``, the serve
+drain path, the launcher supervisor) claims to survive preemptions,
+corruption, partitions, stragglers and engine death. This package proves
+it: a seeded, replayable fault-injection subsystem
+(:mod:`~autodist_tpu.chaos.schedule` + :mod:`~autodist_tpu.chaos.faults`)
+whose injectors enter the stack through explicit seams
+(:mod:`~autodist_tpu.chaos.hooks` — inert dict lookups in production),
+and a CPU-runnable soak harness (:mod:`~autodist_tpu.chaos.harness`,
+``python -m autodist_tpu.chaos --selftest``) asserting, per fault class:
+detection with exactly the promised SNT*/DOC* code, recovery within a
+step budget or a typed graceful degradation (never a hang), and a
+post-recovery loss trajectory matching the uninterrupted control run.
+
+This ``__init__`` stays import-light on purpose: production seams import
+``autodist_tpu.chaos.hooks`` from hot paths (the train-step window), so
+nothing heavier than the hooks registry may load here.
+"""
+from __future__ import annotations
+
+from autodist_tpu.chaos import hooks
+
+__all__ = ["CATALOG", "ChaosEvent", "ChaosPlant", "ChaosSchedule",
+           "FaultSpec", "hooks"]
+
+
+def __getattr__(name):
+    if name in ("ChaosEvent", "ChaosPlant", "ChaosSchedule"):
+        from autodist_tpu.chaos import schedule
+
+        return getattr(schedule, name)
+    if name in ("CATALOG", "FaultSpec"):
+        from autodist_tpu.chaos import faults
+
+        return getattr(faults, name)
+    raise AttributeError(name)

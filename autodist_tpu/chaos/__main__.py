@@ -1,0 +1,115 @@
+"""CLI: ``python -m autodist_tpu.chaos [--selftest | --list | --faults ...]``.
+
+- ``--selftest`` — the zero-hardware chaos proof (docs/chaos.md), wired
+  into CI's fast lane: provision an 8-device CPU host mesh, run the full
+  soak matrix (:mod:`autodist_tpu.chaos.harness` — every catalog fault
+  class injected against the real ft/obs/serve/runtime stack), assert
+  each was detected with exactly its promised ``SNT###``/``DOC###`` code
+  and recovered within budget (or degraded typed — never a hang), verify
+  the no-chaos control run trips nothing, and prove schedule replay
+  determinism (same seed ⇒ byte-identical injection trace). Exits
+  non-zero on any contract violation.
+
+- ``--faults nan_loss,engine_death`` — run a subset of the matrix
+  (debugging one seam without paying for the rest).
+
+- ``--list`` — print the fault catalog (kind, seam, expected detection,
+  recovery contract) as JSON.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _provision_cpu_mesh(n_devices: int = 8) -> None:
+    """Force an ``n_devices`` CPU host mesh when no backend exists yet
+    (the __graft_entry__ recipe); a live backend is used as-is."""
+    try:
+        from jax._src import xla_bridge
+
+        if xla_bridge._backends:
+            return
+    except Exception:  # noqa: BLE001 - internal moved: assume initialized
+        return
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _cmd_list() -> int:
+    from autodist_tpu.chaos.faults import CATALOG
+
+    doc = {k: {"seam": s.seam, "description": s.description,
+               "detects": s.detects, "recovery": s.recovery}
+           for k, s in sorted(CATALOG.items())}
+    print(json.dumps(doc, indent=2))
+    return 0
+
+
+def _cmd_soak(faults, selftest: bool) -> int:
+    _provision_cpu_mesh()
+    from autodist_tpu.chaos import harness
+    from autodist_tpu.chaos.faults import CATALOG
+
+    try:
+        results = harness.run_soak(faults=faults)
+    except harness.SoakFailure as e:
+        print(f"chaos soak FAILED: {e}", file=sys.stderr)
+        return 1
+
+    summary = {"results": [r.to_dict() for r in results]}
+    if selftest:
+        covered = {r.fault for r in results if r.injected > 0}
+        missing = sorted(set(CATALOG) - covered)
+        if missing:
+            print(f"chaos selftest FAILED: catalog fault class(es) never "
+                  f"injected: {missing}", file=sys.stderr)
+            return 1
+        # Replay determinism: one RNG-using scenario (the corrupt injector
+        # draws the victim file and byte offset from the seeded RNG) and
+        # one windowed transport scenario.
+        for fault in ("snapshot_corrupt", "heartbeat_drop"):
+            if not harness.replay_is_deterministic(fault):
+                print(f"chaos selftest FAILED: {fault} replay produced a "
+                      f"different injection trace (nondeterminism)",
+                      file=sys.stderr)
+                return 1
+        summary["replay_deterministic"] = ["snapshot_corrupt",
+                                           "heartbeat_drop"]
+    print(json.dumps(summary, indent=2))
+    print("chaos soak ok" if not selftest else "chaos selftest ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m autodist_tpu.chaos",
+        description="Deterministic fault injection + soak harness "
+                    "(docs/chaos.md)")
+    p.add_argument("--selftest", action="store_true",
+                   help="run the full soak matrix + determinism proof")
+    p.add_argument("--faults", default="",
+                   help="comma-separated scenario subset (see --list)")
+    p.add_argument("--list", action="store_true", dest="list_catalog",
+                   help="print the fault catalog as JSON")
+    args = p.parse_args(argv)
+
+    if args.list_catalog:
+        return _cmd_list()
+    faults = [f for f in args.faults.split(",") if f] or None
+    if args.selftest or faults:
+        return _cmd_soak(faults, selftest=args.selftest and not faults)
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

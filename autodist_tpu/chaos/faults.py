@@ -1,0 +1,305 @@
+"""Fault catalog: every injectable fault class, its seam, and what the
+ft/obs stack is expected to do about it.
+
+The catalog is the chaos subsystem's source of truth (docs/chaos.md
+renders its table): each :class:`FaultSpec` names the **seam** the fault
+enters through (:mod:`autodist_tpu.chaos.hooks`), the **detection** the
+stack must produce (a sentry ``SNT###`` code, a doctor ``DOC###``
+verdict, or a typed degradation), and the **recovery** contract the soak
+harness (:mod:`autodist_tpu.chaos.harness`) asserts. ``--selftest`` fails
+if any catalog class was never injected or detected with a different
+code than promised here.
+
+Injector implementations live here too — :func:`make_handlers` builds the
+per-seam hook closures a :class:`~autodist_tpu.chaos.schedule.ChaosPlant`
+installs. All randomness (which byte to flip, which file to truncate)
+comes from the plant's seeded RNG and lands in the injection trace, so a
+schedule replay is byte-for-byte reproducible.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Callable, Dict, List
+
+from autodist_tpu.chaos import hooks
+
+__all__ = ["CATALOG", "FaultSpec", "make_handlers"]
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One injectable fault class."""
+
+    kind: str
+    seam: str          # hooks.SEAM_* ("process" for launcher-level kills)
+    description: str
+    detects: str       # expected SNT/DOC code or typed outcome
+    recovery: str      # the graceful-degradation / recovery contract
+
+
+CATALOG: Dict[str, FaultSpec] = {s.kind: s for s in (
+    FaultSpec(
+        "nan_loss", hooks.SEAM_TRAIN_BATCH,
+        "poison the training batch with NaN at step N (NaN gradients and "
+        "loss by construction)",
+        "SNT001 + DOC001",
+        "restore the newest verified snapshot, replay clean steps; tail "
+        "matches the uninterrupted run (elastic-resume tolerance)"),
+    FaultSpec(
+        "loss_spike", hooks.SEAM_TRAIN_BATCH,
+        "scale the training batch by a large factor at step N (finite "
+        "loss spike, z-score past threshold)",
+        "SNT003 + DOC000",
+        "restore the newest verified snapshot, replay clean steps; tail "
+        "matches the uninterrupted run"),
+    FaultSpec(
+        "straggler", hooks.SEAM_AGG_SWEEP,
+        "multiply one host's published step-time quantiles while the "
+        "fault window is open",
+        "SNT006 + HealthMonitor SUSPECT escalation",
+        "score renormalizes when the window closes; the sentry episode "
+        "re-arms (exactly one finding per episode)"),
+    FaultSpec(
+        "heartbeat_drop", hooks.SEAM_HB_PUBLISH,
+        "drop one host's heartbeat publishes for the fault window "
+        "(transport loss / network delay)",
+        "peer HEALTHY -> SUSPECT -> DEAD transitions",
+        "first fresh beat after the window returns the peer to HEALTHY "
+        "(escalation backoff resets)"),
+    FaultSpec(
+        "heartbeat_partition", hooks.SEAM_HB_SWEEP,
+        "hide every peer from the sweeping side (full partition: the "
+        "observer sees a silent fleet)",
+        "fleet_hung + hang bundle -> DOC003",
+        "the launcher watchdog writes an attributable doctor bundle and "
+        "terminates the fleet for a supervised restart"),
+    FaultSpec(
+        "snapshot_corrupt", hooks.SEAM_SNAPSHOT_WRITTEN,
+        "flip one byte of a landed snapshot file after its manifest is "
+        "written (bit rot / torn storage)",
+        "verify() fails; ft_snapshots_corrupt_total increments",
+        "latest_valid() falls back to the previous ring entry; restore "
+        "succeeds from it"),
+    FaultSpec(
+        "snapshot_partial", hooks.SEAM_SNAPSHOT_WRITTEN,
+        "truncate a landed snapshot file to half (partial write / full "
+        "disk at the wrong moment)",
+        "verify() fails; ft_snapshots_corrupt_total increments",
+        "latest_valid() falls back to the previous ring entry"),
+    FaultSpec(
+        "snapshot_unwritable", hooks.SEAM_SNAPSHOT_WRITE,
+        "raise OSError from the snapshot write path for the first K "
+        "attempts (transient mount/permission loss)",
+        "utils.retry heals it within policy (write retries counted); a "
+        "permanent failure surfaces loudly via wait()",
+        "snapshot lands on a retry attempt; no skipped ring slot"),
+    FaultSpec(
+        "serve_admission", hooks.SEAM_SERVE_ADMIT,
+        "make engine admission defer (no free slot) while the window is "
+        "open, backing the admission queue up",
+        "typed REJECTED results with a reason + shed flight events "
+        "(doctor timeline shows shed-load windows)",
+        "queued work completes once the window closes; overflow is shed "
+        "at the edge, nothing hangs"),
+    FaultSpec(
+        "engine_death", hooks.SEAM_SERVE_STEP,
+        "raise EngineDeadError from the decode step mid-batch",
+        "every in-flight/queued request finished typed REJECTED with an "
+        "engine-death reason; error event -> DOC006",
+        "the batcher sheds all load with explicit rejections and stops; "
+        "no client ever blocks in wait()"),
+    FaultSpec(
+        "worker_kill", "process",
+        "SIGKILL a supervised fleet process mid-run (the harness child "
+        "kills itself; no hook — the fault is the process dying)",
+        "supervised restart with jittered exponential backoff",
+        "restart budget and backoff reset on snapshot-ring progress; the "
+        "relaunched attempt completes"),
+)}
+
+
+# ------------------------------------------------------------- injectors
+def _poison_tree(tree, fill=None, scale=None):
+    """NaN-fill or scale every floating leaf (jax or numpy)."""
+    import numpy as np
+
+    import jax
+
+    def leaf(x):
+        a = np.asarray(x)
+        if not np.issubdtype(a.dtype, np.floating):
+            return x
+        if fill is not None:
+            return np.full_like(a, fill)
+        return a * np.asarray(scale, a.dtype)
+
+    return jax.tree.map(leaf, tree)
+
+
+def make_handlers(plant) -> Dict[str, Callable]:
+    """Build the seam->hook map for ``plant``'s schedule. Only seams whose
+    faults actually appear in the schedule get handlers, so an installed
+    plant perturbs nothing it was not asked to."""
+    seams = {CATALOG[e.fault].seam for e in plant.schedule.events
+             if e.fault in CATALOG}
+    handlers: Dict[str, Callable] = {}
+
+    def events(seam: str, step=None) -> List:
+        return [e for e in plant.schedule.events
+                if CATALOG.get(e.fault) is not None
+                and CATALOG[e.fault].seam == seam
+                and e.active(plant.step if step is None else step)]
+
+    if hooks.SEAM_TRAIN_BATCH in seams:
+        def train_batch(batch, num_steps=1, **_):
+            # A window [step, step+num_steps) is poisoned when any of its
+            # steps falls inside an event window; the harness uses
+            # num_steps=1 so injection is per-step exact.
+            for e in plant.schedule.events:
+                if CATALOG[e.fault].seam != hooks.SEAM_TRAIN_BATCH:
+                    continue
+                if not any(e.active(plant.step + i)
+                           for i in range(max(1, int(num_steps)))):
+                    continue
+                if e.fault == "nan_loss":
+                    plant.record("nan_loss", detail="batch poisoned with NaN")
+                    batch = _poison_tree(batch, fill=float("nan"))
+                elif e.fault == "loss_spike":
+                    scale = float(e.param("scale", 64.0))
+                    plant.record("loss_spike", detail=f"batch scaled x{scale:g}")
+                    batch = _poison_tree(batch, scale=scale)
+            return batch
+
+        handlers[hooks.SEAM_TRAIN_BATCH] = train_batch
+
+    # The metrics seam always installs alongside train faults: it is where
+    # the plant's step counter advances (post-window), keeping batch and
+    # metrics views of "the current step" consistent.
+    if hooks.SEAM_TRAIN_BATCH in seams:
+        def train_metrics(metrics, num_steps=1, **_):
+            plant.advance(max(1, int(num_steps)))
+            return metrics
+
+        handlers[hooks.SEAM_TRAIN_METRICS] = train_metrics
+
+    if hooks.SEAM_HB_PUBLISH in seams:
+        def hb_publish(payload, process_id=0, **_):
+            for e in events(hooks.SEAM_HB_PUBLISH):
+                if e.fault == "heartbeat_drop" and int(e.host) == int(process_id):
+                    plant.record("heartbeat_drop", host=int(process_id))
+                    return None  # the beat never lands
+            return payload
+
+        handlers[hooks.SEAM_HB_PUBLISH] = hb_publish
+
+    if hooks.SEAM_HB_SWEEP in seams:
+        def hb_sweep(board, **_):
+            for e in events(hooks.SEAM_HB_SWEEP):
+                if e.fault == "heartbeat_partition":
+                    plant.record_once(("heartbeat_partition", e.at_step),
+                                      "heartbeat_partition",
+                                      detail=f"hiding {len(board)} peer(s)")
+                    return {}
+            return board
+
+        handlers[hooks.SEAM_HB_SWEEP] = hb_sweep
+
+    if hooks.SEAM_AGG_SWEEP in seams:
+        def agg_sweep(fleet, **_):
+            for e in events(hooks.SEAM_AGG_SWEEP):
+                if e.fault != "straggler":
+                    continue
+                host = int(e.host)
+                summary = fleet.get(host)
+                if isinstance(summary, dict):
+                    scale = float(e.param("scale", 3.0))
+                    slowed = dict(summary)
+                    for k in ("p50", "p90", "p99", "mean"):
+                        if k in slowed:
+                            slowed[k] = float(slowed[k]) * scale
+                    fleet = {**fleet, host: slowed}
+                    plant.record_once(("straggler", e.at_step, host),
+                                      "straggler", host=host,
+                                      detail=f"p50 x{scale:g}")
+            return fleet
+
+        handlers[hooks.SEAM_AGG_SWEEP] = agg_sweep
+
+    if hooks.SEAM_SNAPSHOT_WRITE in seams:
+        def snapshot_write(path="", step=None, **_):
+            for e in events(hooks.SEAM_SNAPSHOT_WRITE):
+                if e.fault != "snapshot_unwritable":
+                    continue
+                times = int(e.param("times", 1))
+                used = plant.state.setdefault(("unwritable", id(e)), 0)
+                if used < times:
+                    plant.state[("unwritable", id(e))] = used + 1
+                    plant.record("snapshot_unwritable", step=step,
+                                 detail=f"write attempt {used + 1} refused")
+                    raise OSError(
+                        f"chaos: snapshot dir unwritable (injected, "
+                        f"attempt {used + 1}/{times})")
+
+        handlers[hooks.SEAM_SNAPSHOT_WRITE] = snapshot_write
+
+    if hooks.SEAM_SNAPSHOT_WRITTEN in seams:
+        def snapshot_written(path="", step=None, **_):
+            for e in events(hooks.SEAM_SNAPSHOT_WRITTEN):
+                if e.fault not in ("snapshot_corrupt", "snapshot_partial"):
+                    continue
+                names = sorted(
+                    os.path.join(r, f)
+                    for r, _, fs in os.walk(path) for f in fs
+                    if f != "MANIFEST.json")
+                if not names:
+                    continue
+                victim = names[plant.rng.randrange(len(names))]
+                size = os.path.getsize(victim)
+                if size <= 0:
+                    continue
+                rel = os.path.relpath(victim, path)
+                if e.fault == "snapshot_corrupt":
+                    offset = plant.rng.randrange(size)
+                    with open(victim, "r+b") as f:
+                        f.seek(offset)
+                        byte = f.read(1)
+                        f.seek(offset)
+                        f.write(bytes([(byte[0] ^ 0xFF) if byte else 0xFF]))
+                    plant.record("snapshot_corrupt", step=step, file=rel,
+                                 detail=f"flipped byte {offset}")
+                else:
+                    with open(victim, "r+b") as f:
+                        f.truncate(size // 2)
+                    plant.record("snapshot_partial", step=step, file=rel,
+                                 detail=f"truncated {size} -> {size // 2}")
+
+        handlers[hooks.SEAM_SNAPSHOT_WRITTEN] = snapshot_written
+
+    if hooks.SEAM_SERVE_ADMIT in seams:
+        def serve_admit(**_):
+            for e in events(hooks.SEAM_SERVE_ADMIT):
+                if e.fault == "serve_admission":
+                    plant.record_once(("serve_admission", e.at_step),
+                                      "serve_admission",
+                                      detail="admission deferred")
+                    return "defer"
+            return None
+
+        handlers[hooks.SEAM_SERVE_ADMIT] = serve_admit
+
+    if hooks.SEAM_SERVE_STEP in seams:
+        def serve_step(**_):
+            for e in events(hooks.SEAM_SERVE_STEP):
+                if e.fault == "engine_death":
+                    from autodist_tpu.serve.engine import EngineDeadError
+
+                    plant.record_once(("engine_death", e.at_step),
+                                      "engine_death",
+                                      detail="decode step raised")
+                    raise EngineDeadError(
+                        "chaos: injected engine death mid-decode")
+
+        handlers[hooks.SEAM_SERVE_STEP] = serve_step
+
+    return handlers

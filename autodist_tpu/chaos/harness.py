@@ -1,0 +1,795 @@
+"""CPU-runnable chaos soak harness: one scenario per catalog fault class.
+
+Each scenario arms a seeded :class:`~autodist_tpu.chaos.schedule.ChaosPlant`
+against the REAL stack (the production ``DistributedTrainStep``, the real
+``SnapshotManager`` ring, live ``HealthMonitor``/``HostAggregator``
+instances, a compiled serve engine, real supervised subprocesses) and
+asserts the :data:`~autodist_tpu.chaos.faults.CATALOG` contract for its
+fault class:
+
+- the fault was **injected** (the plant's trace is non-empty),
+- the stack **detected** it with exactly the promised ``SNT###`` sentry
+  code / ``DOC###`` doctor verdict / typed degradation — no more, no less,
+- the run **recovered** within its step budget or degraded gracefully
+  (typed rejection, never a hang), and
+- for training faults, the committed post-recovery **loss trajectory is
+  identical to the uninterrupted control run** (the elastic-resume
+  tolerance, ``tests/test_ft.py``).
+
+Scenario step budgets and schedules are constants here, so a soak run is a
+pure function of the code under test — replaying a scenario yields a
+byte-identical injection trace (:func:`replay_is_deterministic`, pinned by
+``tests/test_chaos.py``).
+
+Run it: ``python -m autodist_tpu.chaos --selftest`` (docs/chaos.md).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from autodist_tpu import metrics as M
+from autodist_tpu.chaos.faults import CATALOG
+from autodist_tpu.chaos.schedule import ChaosEvent, ChaosPlant, ChaosSchedule
+from autodist_tpu.utils import logging, retry
+
+__all__ = ["SoakResult", "SCENARIOS", "run_soak", "replay_is_deterministic"]
+
+#: Train-scenario geometry: N total steps, injection at INJECT_AT. The
+#: sentry needs ``min_history`` clean losses before spike checks arm, so
+#: the injection sits past the warmup window.
+TRAIN_STEPS = 10
+TRAIN_INJECT_AT = 6
+
+#: Loss-trajectory match tolerance — the elastic-resume bar
+#: (tests/test_ft.py::test_kill_resume_on_smaller_mesh_matches_uninterrupted).
+LOSS_RTOL, LOSS_ATOL = 1e-5, 1e-6
+
+
+@dataclass
+class SoakResult:
+    """One scenario's verdict against its catalog contract."""
+
+    fault: str
+    ok: bool
+    injected: int                      # injection-trace entries
+    detected: List[str] = field(default_factory=list)
+    expected: str = ""                 # CATALOG[fault].detects
+    recovery_steps: int = -1           # steps from detection to recovered
+    notes: str = ""
+    trace: bytes = b""
+
+    def to_dict(self) -> dict:
+        return {"fault": self.fault, "ok": self.ok,
+                "injected": self.injected, "detected": self.detected,
+                "expected": self.expected,
+                "recovery_steps": self.recovery_steps, "notes": self.notes}
+
+
+class SoakFailure(AssertionError):
+    """A scenario's contract assertion failed (message says which)."""
+
+
+def _check(cond: bool, fault: str, what: str) -> None:
+    if not cond:
+        raise SoakFailure(f"[{fault}] {what}")
+
+
+# --------------------------------------------------------------- train rig
+def _build_train_step(n_chips: int = 8):
+    """Tiny linear-regression train step over the full production stack
+    (strategy → compile → transform → DistributedTrainStep), the same rig
+    as tests/test_ft.py — small enough that a 10-step soak run costs
+    milliseconds after compile."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.kernel import (
+        DistributedTrainStep, GraphTransformer, build_mesh)
+    from autodist_tpu.model_item import ModelItem, OptimizerSpec
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce, StrategyCompiler
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] + params["b"] - y) ** 2)
+
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(3), 4)
+    params = {"w": jax.random.normal(k1, (8, 4)),
+              "b": jax.random.normal(k2, (4,))}
+    batch = (jax.random.normal(k3, (16, 8)),
+             jax.random.normal(k4, (16, 4)))
+    spec = ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": n_chips, "chief": True}]})
+    mesh = build_mesh(spec, axes=("data",))
+    mi = ModelItem.from_params(
+        params, optimizer_spec=OptimizerSpec("sgd", {"learning_rate": 0.1}))
+    strategy = AllReduce().build(mi, spec)
+    compiled = StrategyCompiler(mi).compile(strategy)
+    plan = GraphTransformer(compiled, mi, mesh).transform()
+    step = DistributedTrainStep(plan, loss_fn, optax.sgd(0.1))
+    return step, params, batch
+
+
+def _control_losses(n_steps: int = TRAIN_STEPS) -> List[float]:
+    """The uninterrupted reference trajectory (no plant installed)."""
+    step, params, batch = _build_train_step()
+    state = step.init(params)
+    out = []
+    for _ in range(n_steps):
+        state, m = step(state, batch)
+        out.append(float(m["loss"]))
+    return out
+
+
+def _sentry_rig(base: str, registry: M.MetricsRegistry, monitor=None):
+    """A flight recorder + sentry pair rooted at ``base`` (the ft-style
+    base dir the doctor later diagnoses)."""
+    from autodist_tpu.obs import recorder as obs_recorder
+    from autodist_tpu.obs.sentry import Sentry, SentryConfig
+
+    rec = obs_recorder.FlightRecorder(obs_recorder.flight_dir(base))
+    sentry = Sentry(
+        SentryConfig(min_history=4, loss_z_threshold=4.0),
+        registry=registry, recorder=rec, monitor=monitor, process_id=0)
+    return rec, sentry
+
+
+def _train_fault_scenario(fault: str, base: str) -> SoakResult:
+    """Shared rig for ``nan_loss`` and ``loss_spike``: inject at step
+    ``TRAIN_INJECT_AT``, expect immediate sentry detection, recover by
+    restoring the newest verified snapshot and replaying clean steps, and
+    require the committed loss trajectory to equal the control run's."""
+    from autodist_tpu.ft.elastic import resume_from_snapshot
+    from autodist_tpu.ft.snapshot import SnapshotManager
+    from autodist_tpu.obs import doctor
+
+    expect_snt = "SNT001" if fault == "nan_loss" else "SNT003"
+    expect_doc = "DOC001" if fault == "nan_loss" else "DOC000"
+    ref = _control_losses()
+
+    schedule = ChaosSchedule(seed=7, events=(
+        ChaosEvent(fault, at_step=TRAIN_INJECT_AT,
+                   params=(("scale", 32.0),)),))
+    step, params, batch = _build_train_step()
+    reg = M.MetricsRegistry()
+    rec, sentry = _sentry_rig(base, reg)
+    mgr = SnapshotManager(os.path.join(base, "snapshots"), keep=3,
+                          registry=reg)
+
+    committed: List[float] = []
+    detected_at: Optional[int] = None
+    with ChaosPlant(schedule) as plant:
+        state = step.init(params)
+        mgr.snapshot(state, step_obj=step, block=True)  # step-0 baseline
+        i, calls = 0, 0
+        while i < TRAIN_STEPS:
+            calls += 1
+            _check(calls <= 2 * TRAIN_STEPS, fault, "soak loop failed to "
+                   "converge (recovery re-poisoned?)")
+            state, m = step(state, batch)
+            loss = float(m["loss"])
+            rec.record_step(step=i, loss=loss)
+            findings = sentry.observe_step(step=i, loss=loss)
+            if any(f.code in ("SNT001", "SNT003") for f in findings):
+                # Detection: roll back to the newest verified snapshot and
+                # replay. The plant's step cursor has already advanced past
+                # the injection window, so the replayed batch is clean.
+                _check(detected_at is None, fault,
+                       "sentry fired twice (episode failed to close)")
+                detected_at = i
+                state = resume_from_snapshot(step, params, mgr)
+                _check(int(state.step) == i, fault,
+                       f"restored snapshot is at step {int(state.step)}, "
+                       f"expected {i}")
+                continue
+            committed.append(loss)
+            mgr.snapshot(state, step_obj=step, block=True)
+            i += 1
+        injected = plant.injected()
+        trace = plant.trace_bytes()
+
+    _check(injected >= 1, fault, "schedule never injected")
+    _check(detected_at == TRAIN_INJECT_AT, fault,
+           f"detected at step {detected_at}, injected at {TRAIN_INJECT_AT} "
+           f"(budget: same-step detection)")
+    recovery_steps = TRAIN_STEPS - detected_at
+    _check(sorted({f.code for f in sentry.findings}) == [expect_snt], fault,
+           f"sentry codes {sorted({f.code for f in sentry.findings})}, "
+           f"expected exactly [{expect_snt!r}]")
+    ok_traj = np.allclose(committed, ref, rtol=LOSS_RTOL, atol=LOSS_ATOL)
+    _check(ok_traj, fault,
+           "post-recovery loss trajectory diverged from the control run")
+    rec.close(ok=True)
+    diag = doctor.diagnose(base)
+    _check(diag.code == expect_doc, fault,
+           f"doctor said {diag.code}, expected {expect_doc}")
+
+    return SoakResult(
+        fault=fault, ok=True, injected=injected,
+        detected=[expect_snt, diag.code],
+        expected=CATALOG[fault].detects,
+        recovery_steps=TRAIN_STEPS - detected_at,
+        notes=f"detected at step {detected_at}; trajectory matches control "
+              f"(rtol={LOSS_RTOL:g})",
+        trace=trace)
+
+
+def scenario_nan_loss(base: str) -> SoakResult:
+    return _train_fault_scenario("nan_loss", base)
+
+
+def scenario_loss_spike(base: str) -> SoakResult:
+    return _train_fault_scenario("loss_spike", base)
+
+
+# ----------------------------------------------------------- control run
+def scenario_control(base: str) -> SoakResult:
+    """No plant installed: the sentry must stay silent and the doctor must
+    call the run clean — the zero-findings bar is as load-bearing as the
+    seeded-fault bars."""
+    from autodist_tpu.obs import doctor
+
+    reg = M.MetricsRegistry()
+    rec, sentry = _sentry_rig(base, reg)
+    step, params, batch = _build_train_step()
+    state = step.init(params)
+    for i in range(TRAIN_STEPS):
+        state, m = step(state, batch)
+        loss = float(m["loss"])
+        rec.record_step(step=i, loss=loss)
+        sentry.observe_step(step=i, loss=loss)
+    rec.close(ok=True)
+    _check(not sentry.findings, "control",
+           f"clean run tripped {sorted({f.code for f in sentry.findings})}")
+    diag = doctor.diagnose(base)
+    _check(diag.code == "DOC000", "control",
+           f"doctor said {diag.code} on a clean run")
+    return SoakResult(fault="control", ok=True, injected=0,
+                      detected=["DOC000"], expected="zero findings + DOC000",
+                      recovery_steps=0, notes="no chaos, no findings")
+
+
+# ------------------------------------------------------------- straggler
+def scenario_straggler(base: str) -> SoakResult:
+    from autodist_tpu.ft.heartbeat import (
+        HealthMonitor, MemoryTransport, PeerState)
+    from autodist_tpu.obs.aggregate import HostAggregator
+    from autodist_tpu.obs.sentry import Sentry, SentryConfig
+
+    fault = "straggler"
+    reg = M.MetricsRegistry()
+    monitor = HealthMonitor(MemoryTransport(), publish=False,
+                            expected=[0, 1, 2, 3], registry=reg)
+    sentry = Sentry(SentryConfig(), registry=reg, monitor=monitor,
+                    process_id=0)
+    transport = MemoryTransport()
+    aggs = [HostAggregator(transport, process_id=p, registry=reg)
+            for p in range(4)]
+    for agg in aggs:
+        for k in range(16):
+            agg.observe_step(0.1 + 0.001 * (k % 3))
+
+    # Two windows, same victim: the second proves the episode re-armed.
+    schedule = ChaosSchedule(seed=11, events=(
+        ChaosEvent(fault, at_step=1, until_step=3, host=1,
+                   params=(("scale", 4.0),)),
+        ChaosEvent(fault, at_step=5, until_step=6, host=1,
+                   params=(("scale", 4.0),)),
+    ))
+
+    def sweep_and_observe():
+        for agg in aggs[1:]:
+            agg.tick()
+        fleet = aggs[0].tick()
+        scores = aggs[0].straggler_scores(fleet)
+        sentry.observe_scores(scores)
+        return scores
+
+    with ChaosPlant(schedule) as plant:
+        scores = sweep_and_observe()                      # step 0: clean
+        _check(not sentry.findings, fault,
+               f"clean sweep tripped {[f.code for f in sentry.findings]}")
+        plant.advance(1)                                  # window 1 opens
+        scores = sweep_and_observe()
+        _check([f.code for f in sentry.findings] == ["SNT006"], fault,
+               "SNT006 did not fire on the slowed host")
+        _check(sentry.findings[0].process_id == 1, fault,
+               f"SNT006 blamed host {sentry.findings[0].process_id}, "
+               f"victim is 1")
+        _check(monitor.peers()[1].state is PeerState.SUSPECT, fault,
+               "HealthMonitor did not escalate the straggler to SUSPECT")
+        plant.advance(1)                                  # still open
+        sweep_and_observe()
+        _check(len(sentry.findings) == 1, fault,
+               "episode fired more than once inside one window")
+        plant.advance(1)                                  # window 1 closes
+        scores = sweep_and_observe()
+        _check(abs(scores[1] - 1.0) < 0.2, fault,
+               f"score did not renormalize after the window ({scores[1]:.2f})")
+        plant.advance(2)                                  # window 2 opens
+        sweep_and_observe()
+        _check(len(sentry.findings) == 2, fault,
+               "episode did not re-arm for the second window")
+        trace = plant.trace_bytes()
+
+    return SoakResult(
+        fault=fault, ok=True, injected=2, detected=["SNT006", "SUSPECT"],
+        expected=CATALOG[fault].detects, recovery_steps=1,
+        notes="score renormalized after each window; one finding per episode",
+        trace=trace)
+
+
+# ------------------------------------------------------- heartbeat faults
+def scenario_heartbeat_drop(base: str) -> SoakResult:
+    from autodist_tpu.ft.config import FTConfig
+    from autodist_tpu.ft.heartbeat import (
+        HealthMonitor, MemoryTransport, PeerState)
+
+    fault = "heartbeat_drop"
+    reg = M.MetricsRegistry()
+    cfg = FTConfig(heartbeat_interval_s=1.0, suspect_after_misses=2,
+                   dead_after_misses=4, backoff_initial_s=1.0)
+    transport = MemoryTransport()
+    monitor = HealthMonitor(transport, process_id=0, config=cfg,
+                            publish=True, registry=reg)
+    transitions: List[tuple] = []
+    monitor.on_transition(
+        lambda pid, old, new: transitions.append((pid, old, new)))
+
+    # Synthetic clock with a nonzero base: PeerInfo.last_seen starts at 0
+    # and freshness is strictly "seen > last_seen", so a t=0 beat would
+    # never register.
+    t0 = 100.0
+    schedule = ChaosSchedule(seed=5, events=(
+        ChaosEvent(fault, at_step=1, until_step=2, host=1),))
+    with ChaosPlant(schedule) as plant:
+        transport.publish(1, {"time": t0, "step": 0})
+        monitor.tick(now=t0)
+        _check(monitor.peers()[1].state is PeerState.HEALTHY, fault,
+               "peer 1 not HEALTHY after its first beat")
+        plant.advance(1)                                  # drop window opens
+        for dt in (1.0, 2.0, 3.0):
+            transport.publish(1, {"time": t0 + dt, "step": int(dt)})
+            monitor.tick(now=t0 + dt)
+        _check(monitor.peers()[1].state is PeerState.DEAD, fault,
+               f"peer 1 is {monitor.peers()[1].state} after the drop "
+               f"window, expected DEAD")
+        plant.advance(1)                                  # window closes
+        transport.publish(1, {"time": t0 + 4.0, "step": 4})
+        monitor.tick(now=t0 + 4.0)
+        trace = plant.trace_bytes()
+
+    peer = monitor.peers()[1]
+    _check(peer.state is PeerState.HEALTHY, fault,
+           "first fresh beat did not return the peer to HEALTHY")
+    _check(peer.backoff_s == 0.0 and peer.misses == 0, fault,
+           "escalation backoff did not reset on recovery")
+    seq = [(p, o.name, n.name) for p, o, n in transitions if p == 1]
+    _check(seq == [(1, "HEALTHY", "SUSPECT"), (1, "SUSPECT", "DEAD"),
+                   (1, "DEAD", "HEALTHY")], fault,
+           f"transition sequence {seq}")
+    return SoakResult(
+        fault=fault, ok=True, injected=3,
+        detected=["HEALTHY->SUSPECT", "SUSPECT->DEAD", "DEAD->HEALTHY"],
+        expected=CATALOG[fault].detects, recovery_steps=1,
+        notes="3 dropped beats -> SUSPECT -> DEAD; first fresh beat heals",
+        trace=trace)
+
+
+def scenario_heartbeat_partition(base: str) -> SoakResult:
+    import time as _time
+
+    from autodist_tpu.ft.config import FTConfig
+    from autodist_tpu.ft.heartbeat import FileTransport
+    from autodist_tpu.obs import doctor
+    from autodist_tpu.runtime.launcher import _FleetWatch
+
+    fault = "heartbeat_partition"
+    watch = _FleetWatch(FTConfig(base_dir=base, heartbeat_interval_s=1.0,
+                                 hang_after_misses=3))
+    transport = FileTransport(watch.config.heartbeat_dir)
+    t0 = _time.time()
+
+    schedule = ChaosSchedule(seed=3, events=(
+        ChaosEvent(fault, at_step=1, until_step=2),))
+    with ChaosPlant(schedule) as plant:
+        for pid in (0, 1):
+            transport.publish(pid, {"time": t0, "step": 5})
+        watch.monitor.tick(now=t0)
+        _check(len(watch.monitor.peers()) == 2, fault,
+               "watchdog did not see the fleet before the partition")
+        _check(not watch.monitor.fleet_hung(now=t0), fault,
+               "fleet read as hung before the partition")
+        plant.advance(1)                                  # partition opens
+        for k in range(1, 5):
+            watch.monitor.tick(now=t0 + k)
+        _check(watch.monitor.fleet_hung(now=t0 + 4), fault,
+               "fleet_hung never fired under a full partition")
+        bundle = watch.write_bundle()
+        _check(bundle is not None and os.path.exists(bundle), fault,
+               "hang bundle was not written")
+        trace = plant.trace_bytes()
+
+    diag = doctor.diagnose(base)
+    _check(diag.code == "DOC003", fault,
+           f"doctor said {diag.code}, expected DOC003 (wedge)")
+    return SoakResult(
+        fault=fault, ok=True, injected=1,
+        detected=["fleet_hung", "DOC003"],
+        expected=CATALOG[fault].detects, recovery_steps=0,
+        notes=f"bundle {os.path.basename(bundle)} attributes the kill",
+        trace=trace)
+
+
+# -------------------------------------------------------- snapshot faults
+def _snapshot_state():
+    return {"w": np.arange(32, dtype=np.float32),
+            "b": np.ones((4,), np.float32)}
+
+
+def _snapshot_damage_scenario(fault: str, base: str) -> SoakResult:
+    """Shared rig for ``snapshot_corrupt`` / ``snapshot_partial``: damage
+    the SECOND ring entry after it lands; the ring must fall back to the
+    first and restore from it."""
+    from autodist_tpu.ft.snapshot import SnapshotManager
+
+    reg = M.MetricsRegistry()
+    mgr = SnapshotManager(os.path.join(base, "snapshots"), keep=3,
+                          registry=reg)
+    state = _snapshot_state()
+
+    schedule = ChaosSchedule(seed=13, events=(
+        ChaosEvent(fault, at_step=1),))
+    with ChaosPlant(schedule) as plant:
+        p1 = mgr.snapshot(state, step=1, block=True)      # clean entry
+        plant.advance(1)
+        p2 = mgr.snapshot(state, step=2, block=True)      # damaged entry
+        trace = plant.trace_bytes()
+
+    _check(mgr.verify(p1), fault, "the clean ring entry failed verify()")
+    _check(not mgr.verify(p2), fault,
+           "verify() passed on the damaged snapshot")
+    _check(mgr.latest_valid() == p1, fault,
+           "latest_valid() did not fall back to the previous ring entry")
+    _check(reg.counter("ft_snapshots_corrupt_total").value >= 1, fault,
+           "ft_snapshots_corrupt_total did not increment")
+    restored = mgr.restore_latest_valid(target=_snapshot_state())
+    _check(restored is not None
+           and np.array_equal(np.asarray(restored["w"]), state["w"]), fault,
+           "restore from the fallback entry did not round-trip")
+    return SoakResult(
+        fault=fault, ok=True, injected=1,
+        detected=["verify_failed", "ft_snapshots_corrupt_total"],
+        expected=CATALOG[fault].detects, recovery_steps=0,
+        notes="ring fell back to the previous entry and restored",
+        trace=trace)
+
+
+def scenario_snapshot_corrupt(base: str) -> SoakResult:
+    return _snapshot_damage_scenario("snapshot_corrupt", base)
+
+
+def scenario_snapshot_partial(base: str) -> SoakResult:
+    return _snapshot_damage_scenario("snapshot_partial", base)
+
+
+def scenario_snapshot_unwritable(base: str) -> SoakResult:
+    from autodist_tpu.ft.snapshot import SnapshotManager
+
+    fault = "snapshot_unwritable"
+    reg = M.MetricsRegistry()
+    mgr = SnapshotManager(os.path.join(base, "snapshots"), keep=3,
+                          registry=reg)
+    state = _snapshot_state()
+
+    schedule = ChaosSchedule(seed=17, events=(
+        ChaosEvent(fault, at_step=0, params=(("times", 2),)),))
+    with ChaosPlant(schedule) as plant:
+        path = mgr.snapshot(state, step=1, block=True)    # heals on retry
+        trace = plant.trace_bytes()
+
+    _check(mgr.verify(path), fault,
+           "snapshot did not land despite the retry budget covering the "
+           "transient failures")
+    _check(reg.counter("ft_snapshot_write_retries_total").value == 2, fault,
+           f"expected exactly 2 write retries, saw "
+           f"{reg.counter('ft_snapshot_write_retries_total').value}")
+    _check(mgr.latest_valid() == path, fault, "ring slot was skipped")
+    return SoakResult(
+        fault=fault, ok=True, injected=2,
+        detected=["retry_healed", "ft_snapshot_write_retries_total=2"],
+        expected=CATALOG[fault].detects, recovery_steps=0,
+        notes="2 refused write attempts healed by utils/retry within "
+              "policy; no ring slot skipped",
+        trace=trace)
+
+
+# ------------------------------------------------------------ serve faults
+_ENGINE = None
+
+
+def _serve_engine():
+    """One compiled CPU inference engine shared by the serve scenarios
+    (the compile dominates scenario cost; the faults are injected per-run
+    through the seams, so sharing is sound)."""
+    global _ENGINE
+    if _ENGINE is not None:
+        return _ENGINE
+    import jax
+    import jax.numpy as jnp
+
+    from autodist_tpu.api import AutoDist
+    from autodist_tpu.models.transformer import (
+        TransformerConfig, decode_model, init_params)
+    from autodist_tpu.strategy import AllReduce
+
+    cfg = TransformerConfig(
+        vocab_size=97, num_layers=1, d_model=32, num_heads=2, d_ff=64,
+        max_seq_len=32, causal=True, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    AutoDist.reset_default()
+    try:
+        autodist = AutoDist(strategy_builder=AllReduce())
+        _ENGINE = autodist.build_inference(
+            params, decode_model=decode_model(cfg),
+            n_slots=4, bucket_lens=(16,))
+    finally:
+        AutoDist.reset_default()
+    return _ENGINE
+
+
+def scenario_serve_admission(base: str) -> SoakResult:
+    from autodist_tpu.obs import doctor
+    from autodist_tpu.obs import recorder as obs_recorder
+    from autodist_tpu.serve.batcher import ContinuousBatcher, RequestState
+
+    fault = "serve_admission"
+    obs_recorder.enable(obs_recorder.flight_dir(base))
+    batcher = ContinuousBatcher(_serve_engine(), max_queue=4,
+                                registry=M.MetricsRegistry())
+    prompt = np.arange(4, dtype=np.int32)
+
+    schedule = ChaosSchedule(seed=23, events=(
+        ChaosEvent(fault, at_step=0),))
+    try:
+        with ChaosPlant(schedule) as plant:
+            queued = [batcher.submit(prompt, max_new_tokens=4)
+                      for _ in range(4)]
+            batcher.start()
+            retry.wait_until(lambda: plant.injected(fault) > 0, 5.0)
+            _check(plant.injected(fault) > 0, fault,
+                   "admission seam never fired")
+            _check(all(r.state is RequestState.QUEUED for r in queued),
+                   fault, "requests progressed during the admission stall")
+            shed = [batcher.try_submit(prompt, max_new_tokens=4)
+                    for _ in range(2)]
+            _check(all(r.state is RequestState.REJECTED for r in shed),
+                   fault, "overflow was not shed with typed REJECTED")
+            _check(all("queue full" in r.error for r in shed), fault,
+                   f"rejection reason untyped: {[r.error for r in shed]}")
+            plant.advance(1)                              # window closes
+            done = [r.wait(30.0).state for r in queued]
+            _check(all(s is RequestState.DONE for s in done), fault,
+                   f"queued work did not complete after the window: {done}")
+            trace = plant.trace_bytes()
+        batcher.stop()
+    finally:
+        obs_recorder.disable(ok=True)
+
+    records = obs_recorder.read_records(obs_recorder.flight_dir(base))
+    sheds = [r for r in records if r.get("kind") == "shed"]
+    _check(len(sheds) >= 1, fault,
+           "no shed flight event — the doctor timeline cannot show the "
+           "shed-load window")
+    diag = doctor.diagnose(base)
+    _check(diag.code == "DOC000", fault,
+           f"doctor said {diag.code} after graceful recovery")
+    return SoakResult(
+        fault=fault, ok=True, injected=1,
+        detected=["REJECTED(queue full)", "shed event", "DOC000"],
+        expected=CATALOG[fault].detects, recovery_steps=0,
+        notes="overflow shed at the edge; queued work completed after the "
+              "window; shed window on the doctor timeline",
+        trace=trace)
+
+
+def scenario_engine_death(base: str) -> SoakResult:
+    from autodist_tpu.obs import doctor
+    from autodist_tpu.obs import recorder as obs_recorder
+    from autodist_tpu.serve.batcher import (
+        Backpressure, ContinuousBatcher, RequestState)
+
+    fault = "engine_death"
+    obs_recorder.enable(obs_recorder.flight_dir(base))
+    batcher = ContinuousBatcher(_serve_engine(), max_queue=8,
+                                registry=M.MetricsRegistry())
+    prompt = np.arange(4, dtype=np.int32)
+
+    schedule = ChaosSchedule(seed=29, events=(
+        ChaosEvent(fault, at_step=0),))
+    try:
+        with ChaosPlant(schedule) as plant:
+            reqs = [batcher.submit(prompt, max_new_tokens=4)
+                    for _ in range(3)]
+            batcher.start()
+            states = [r.wait(30.0).state for r in reqs]
+            _check(all(s is RequestState.REJECTED for s in states), fault,
+                   f"in-flight/queued work not typed-REJECTED: {states}")
+            _check(all("engine died" in r.error for r in reqs), fault,
+                   f"rejection reason untyped: {[r.error for r in reqs]}")
+            # Post-death admission degrades typed, never hangs.
+            late = batcher.try_submit(prompt, max_new_tokens=4)
+            _check(late.state is RequestState.REJECTED, fault,
+                   "post-death try_submit did not return typed REJECTED")
+            try:
+                batcher.submit(prompt, max_new_tokens=4)
+                _check(False, fault, "post-death submit did not raise")
+            except Backpressure:
+                pass
+            trace = plant.trace_bytes()
+        batcher.stop()
+    finally:
+        obs_recorder.disable(ok=True)
+
+    diag = doctor.diagnose(base)
+    _check(diag.code == "DOC006", fault,
+           f"doctor said {diag.code}, expected DOC006 (crash)")
+    return SoakResult(
+        fault=fault, ok=True, injected=1,
+        detected=["REJECTED(engine died)", "DOC006"],
+        expected=CATALOG[fault].detects, recovery_steps=0,
+        notes="all load shed with explicit rejections; no client hung",
+        trace=trace)
+
+
+# -------------------------------------------------------- supervised kill
+_KILL_CHILD = """\
+import json, os, signal, sys
+base = sys.argv[1]
+if os.environ.get("AUTODIST_PROCESS_ID", "0") != "0":
+    sys.exit(0)  # worker peer: the chief is the victim
+cnt = os.path.join(base, "attempts.txt")
+n = int(open(cnt).read()) if os.path.exists(cnt) else 0
+with open(cnt, "w") as f:
+    f.write(str(n + 1))
+# Real snapshot progress every attempt: the supervisor's budget AND
+# backoff must reset on it (runtime/launcher.py).
+snap = os.path.join(base, "ft", "snapshots", f"ckpt-{n + 1}")
+os.makedirs(snap, exist_ok=True)
+with open(os.path.join(snap, "MANIFEST.json"), "w") as f:
+    json.dump({"step": n + 1, "files": {}}, f)
+if n < 2:
+    os.kill(os.getpid(), signal.SIGKILL)
+sys.exit(0)
+"""
+
+
+def scenario_worker_kill(base: str) -> SoakResult:
+    """SIGKILL a REAL supervised fleet chief twice; the supervisor must
+    restart it with jittered exponential backoff, reset both the restart
+    budget and the backoff on the snapshot progress each attempt makes,
+    and the third attempt must complete. ``max_restarts=1`` makes the
+    reset the load-bearing part: without it the second kill would exhaust
+    the budget."""
+    from autodist_tpu.ft.config import FTConfig
+    from autodist_tpu.runtime import launcher
+
+    fault = "worker_kill"
+    script = os.path.join(base, "victim.py")
+    with open(script, "w", encoding="utf-8") as f:
+        f.write(_KILL_CHILD)
+    initial_s = 0.05
+    delays: List[float] = []
+    schedule = ChaosSchedule(seed=31, events=(
+        ChaosEvent(fault, at_step=0),))
+    plant = ChaosPlant(schedule)  # no hooks: the fault IS the dying process
+    rc = launcher.launch_supervised(
+        None, [sys.executable, script, base],
+        num_local_processes=2,
+        max_restarts=1,
+        restart_backoff_s=initial_s,
+        restart_backoff_max_s=1.0,
+        backoff_seed=1234,
+        restart_sleep=delays.append,   # capture; no real sleep
+        ft_config=FTConfig(base_dir=os.path.join(base, "ft")),
+    )
+    attempts = int(open(os.path.join(base, "attempts.txt")).read())
+    for k in range(attempts - 1):
+        plant.record(fault, kill=k + 1, detail="chief SIGKILLed")
+
+    _check(rc == 0, fault, f"supervised run did not complete (rc={rc})")
+    _check(attempts == 3, fault, f"expected 3 attempts (2 kills), saw "
+           f"{attempts}")
+    _check(len(delays) == 2, fault,
+           f"expected 2 restart delays, saw {len(delays)}")
+    _check(all(0.0 < d <= initial_s + 1e-9 for d in delays), fault,
+           f"backoff did not reset on snapshot progress (delays {delays}; "
+           f"an unreset second delay would exceed {initial_s}s)")
+    _check(delays[0] != delays[1], fault,
+           "restart delays identical — jitter is not being applied")
+    return SoakResult(
+        fault=fault, ok=True, injected=attempts - 1,
+        detected=["supervised restart", "budget+backoff reset on progress"],
+        expected=CATALOG[fault].detects, recovery_steps=0,
+        notes=f"2 SIGKILLs survived with max_restarts=1 (reset proof); "
+              f"jittered delays {['%.3f' % d for d in delays]}",
+        trace=plant.trace_bytes())
+
+
+# ---------------------------------------------------------------- driver
+SCENARIOS: Dict[str, Callable[[str], SoakResult]] = {
+    "control": scenario_control,
+    "nan_loss": scenario_nan_loss,
+    "loss_spike": scenario_loss_spike,
+    "straggler": scenario_straggler,
+    "heartbeat_drop": scenario_heartbeat_drop,
+    "heartbeat_partition": scenario_heartbeat_partition,
+    "snapshot_corrupt": scenario_snapshot_corrupt,
+    "snapshot_partial": scenario_snapshot_partial,
+    "snapshot_unwritable": scenario_snapshot_unwritable,
+    "serve_admission": scenario_serve_admission,
+    "engine_death": scenario_engine_death,
+    "worker_kill": scenario_worker_kill,
+}
+
+
+def run_soak(faults: Optional[List[str]] = None,
+             workdir: Optional[str] = None,
+             verbose: bool = True) -> List[SoakResult]:
+    """Run the soak matrix (every scenario, or the named subset). Each
+    scenario gets a fresh subdirectory; a :class:`SoakFailure` from any
+    scenario propagates after the matrix is reported."""
+    names = list(faults) if faults else list(SCENARIOS)
+    unknown = sorted(set(names) - set(SCENARIOS))
+    if unknown:
+        raise ValueError(f"unknown scenario(s) {unknown}; "
+                         f"have {sorted(SCENARIOS)}")
+    own_tmp = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos-soak-")
+    results: List[SoakResult] = []
+    failures: List[str] = []
+    try:
+        for name in names:
+            base = os.path.join(workdir, name)
+            os.makedirs(base, exist_ok=True)
+            try:
+                res = SCENARIOS[name](base)
+            except SoakFailure as e:
+                res = SoakResult(fault=name, ok=False, injected=0,
+                                 expected=CATALOG.get(name).detects
+                                 if name in CATALOG else "", notes=str(e))
+                failures.append(str(e))
+            results.append(res)
+            if verbose:
+                mark = "ok " if res.ok else "FAIL"
+                logging.info("chaos soak [%s] %-22s injected=%d %s",
+                             mark, res.fault, res.injected, res.notes)
+    finally:
+        if own_tmp:
+            shutil.rmtree(workdir, ignore_errors=True)
+    if failures:
+        raise SoakFailure("; ".join(failures))
+    return results
+
+
+def replay_is_deterministic(fault: str = "nan_loss") -> bool:
+    """Run ``fault``'s scenario twice in fresh directories and compare the
+    injection traces byte-for-byte — the replay-determinism acceptance
+    bar (same seed ⇒ identical trace)."""
+    traces = []
+    for _ in range(2):
+        tmp = tempfile.mkdtemp(prefix="chaos-replay-")
+        try:
+            traces.append(SCENARIOS[fault](os.path.join(tmp, fault)).trace)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return bool(traces[0]) and traces[0] == traces[1]

@@ -44,9 +44,22 @@ from enum import Enum
 from typing import Callable, Dict, List, Optional
 
 from autodist_tpu import metrics as M
+from autodist_tpu.chaos import hooks as chaos_hooks
 from autodist_tpu.ft.config import FTConfig
 from autodist_tpu.obs import recorder as obs_recorder
-from autodist_tpu.utils import logging
+from autodist_tpu.utils import logging, retry
+
+#: Transient transport-publish retry (utils/retry.py — the ONE backoff
+#: home): a beat is worth two quick retries, never a blocking stall of
+#: the monitor loop.
+_PUBLISH_RETRY = retry.RetryPolicy(
+    initial_s=0.02, max_s=0.1, multiplier=2.0, jitter=0.5,
+    max_attempts=3, deadline_s=1.0)
+
+#: Hard cap on the shutdown join: ``5 * heartbeat_interval_s`` can be
+#: minutes with long intervals, and a daemon thread stuck in a slow
+#: transport must not block process shutdown that long.
+STOP_JOIN_CAP_S = 10.0
 
 
 class PeerState(Enum):
@@ -86,12 +99,19 @@ class MemoryTransport:
         self._lock = threading.Lock()
 
     def publish(self, process_id: int, payload: dict) -> None:
+        payload = chaos_hooks.apply(chaos_hooks.SEAM_HB_PUBLISH, payload,
+                                    process_id=int(process_id),
+                                    transport="memory")
+        if payload is None:
+            return  # injected transport drop: the beat never lands
         with self._lock:
             self._board[int(process_id)] = dict(payload)
 
     def sweep(self) -> Dict[int, dict]:
         with self._lock:
-            return {pid: dict(p) for pid, p in self._board.items()}
+            board = {pid: dict(p) for pid, p in self._board.items()}
+        return chaos_hooks.apply(chaos_hooks.SEAM_HB_SWEEP, board,
+                                 transport="memory")
 
 
 class FileTransport:
@@ -106,11 +126,24 @@ class FileTransport:
         os.makedirs(directory, exist_ok=True)
 
     def publish(self, process_id: int, payload: dict) -> None:
+        payload = chaos_hooks.apply(chaos_hooks.SEAM_HB_PUBLISH, payload,
+                                    process_id=int(process_id),
+                                    transport="file")
+        if payload is None:
+            return  # injected transport drop: the beat never lands
         path = os.path.join(self.directory, f"hb-{int(process_id)}.json")
         tmp = f"{path}.tmp-{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(payload, f)
-        os.replace(tmp, path)
+
+        def _write():
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+
+        # A transient filesystem hiccup (remount, NFS blip) costs a beat
+        # only if it outlives the retry budget; the monitor loop's own
+        # exception guard catches a final failure.
+        retry.retry_call(_write, policy=_PUBLISH_RETRY, retry_on=(OSError,),
+                         describe="heartbeat publish")
 
     def sweep(self) -> Dict[int, dict]:
         out: Dict[int, dict] = {}
@@ -128,7 +161,8 @@ class FileTransport:
                     out[pid] = json.load(f)
             except (OSError, ValueError):
                 continue  # mid-replace / foreign file: catch it next sweep
-        return out
+        return chaos_hooks.apply(chaos_hooks.SEAM_HB_SWEEP, out,
+                                 transport="file")
 
 
 class CoordinatorTransport:
@@ -161,11 +195,18 @@ class CoordinatorTransport:
         self._seq = int(time.time() * 1000)
 
     def publish(self, process_id: int, payload: dict) -> None:
+        payload = chaos_hooks.apply(chaos_hooks.SEAM_HB_PUBLISH, payload,
+                                    process_id=int(process_id),
+                                    transport="coordinator")
+        if payload is None:
+            return  # injected transport drop: the beat never lands
         self._seq += 1
+        key = f"{self.PREFIX}/{int(process_id)}/{self._seq:012d}"
         try:
-            self._client.key_value_set(
-                f"{self.PREFIX}/{int(process_id)}/{self._seq:012d}",
-                json.dumps(payload))
+            retry.retry_call(
+                lambda: self._client.key_value_set(key, json.dumps(payload)),
+                policy=_PUBLISH_RETRY, retry_on=(Exception,),
+                describe="heartbeat publish (coordination kv)")
         except Exception as e:  # noqa: BLE001 - liveness signal, never fatal
             logging.warning("heartbeat publish failed (%s)", e)
 
@@ -265,7 +306,16 @@ class HealthMonitor:
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=5 * self.config.heartbeat_interval_s)
+            # Bounded shutdown: ``5 * interval`` can be minutes with long
+            # heartbeat intervals; a wedged transport must not hold the
+            # process exit hostage. Past the cap, warn and detach — the
+            # thread is a daemon and dies with the process.
+            cap = min(5 * self.config.heartbeat_interval_s, STOP_JOIN_CAP_S)
+            self._thread.join(timeout=cap)
+            if self._thread.is_alive():
+                logging.warning(
+                    "heartbeat thread did not exit within %.1fs (transport "
+                    "wedged?); detaching without blocking shutdown", cap)
             self._thread = None
 
     def _loop(self) -> None:

@@ -48,13 +48,22 @@ from typing import Any, Callable, Optional, Tuple
 import jax
 
 from autodist_tpu import metrics as M
+from autodist_tpu.chaos import hooks as chaos_hooks
 from autodist_tpu.checkpoint.saver import Saver, _to_host
 from autodist_tpu.ft.config import FTConfig
 from autodist_tpu.obs import recorder as obs_recorder
 from autodist_tpu.obs import spans as obs_spans
-from autodist_tpu.utils import logging
+from autodist_tpu.utils import logging, retry
 
 MANIFEST = "MANIFEST.json"
+
+#: Snapshot-write retry (utils/retry.py): a transient unwritable dir
+#: (remount, permission flap — the chaos ``snapshot_unwritable`` fault)
+#: heals on a quick retry; a persistent failure still surfaces loudly
+#: through ``wait()`` within ~2s instead of silently skipping ring slots.
+_WRITE_RETRY = retry.RetryPolicy(
+    initial_s=0.05, max_s=0.5, multiplier=2.0, jitter=0.5,
+    max_attempts=3, deadline_s=2.0)
 
 
 def _chain_signal(sig, frame, prev) -> None:
@@ -129,6 +138,7 @@ class SnapshotManager:
         self._c_skipped = reg.counter("ft_snapshots_skipped_total")
         self._c_corrupt = reg.counter("ft_snapshots_corrupt_total")
         self._c_preempt = reg.counter("ft_preempt_snapshots_total")
+        self._c_write_retries = reg.counter("ft_snapshot_write_retries_total")
         self._g_step = reg.gauge("ft_snapshot_last_step")
 
     @classmethod
@@ -236,21 +246,45 @@ class SnapshotManager:
     def _write(self, host_tree: Any, path: str, step: int) -> None:
         try:
             with obs_spans.span("ft.snapshot.write", step=step):
-                if jax.process_count() > 1:
-                    # The Saver's own async path runs its stage/swap barriers
-                    # on the coordination service (pure RPC — safe
-                    # off-thread); its blocking path would enqueue device
-                    # collectives from this background thread, racing the
-                    # train step's.
-                    self.saver.save(host_tree, path=path, step=step,
-                                    block=False)
-                    self.saver.wait()
-                else:
-                    self.saver.save(host_tree, path=path, step=step,
-                                    block=True)
+                def attempt():
+                    # Chaos seam: an installed plant may refuse the write
+                    # (transient unwritable dir) — exactly what the retry
+                    # below must heal.
+                    chaos_hooks.fire(chaos_hooks.SEAM_SNAPSHOT_WRITE,
+                                     path=path, step=step)
+                    if jax.process_count() > 1:
+                        # The Saver's own async path runs its stage/swap
+                        # barriers on the coordination service (pure RPC —
+                        # safe off-thread); its blocking path would enqueue
+                        # device collectives from this background thread,
+                        # racing the train step's.
+                        self.saver.save(host_tree, path=path, step=step,
+                                        block=False)
+                        self.saver.wait()
+                    else:
+                        self.saver.save(host_tree, path=path, step=step,
+                                        block=True)
+                    if jax.process_index() == 0:
+                        self._write_manifest(path, step)
+
+                # Re-saving the same path is safe (atomic stage->swap), so
+                # a transient OSError costs a jittered retry, not the ring
+                # slot.
+                retry.retry_call(
+                    attempt, policy=_WRITE_RETRY, retry_on=(OSError,),
+                    describe=f"snapshot write {path}",
+                    on_retry=lambda e, d, a: (
+                        self._c_write_retries.inc(),
+                        logging.warning(
+                            "snapshot write attempt %d failed (%s); "
+                            "retrying in %.3fs", a, e, d)))
                 if jax.process_index() == 0:
-                    self._write_manifest(path, step)
                     self._prune()
+            # Post-landing chaos seam: corruption/truncation faults bit-rot
+            # the files AFTER the manifest recorded their true hashes —
+            # verify()/latest_valid() must catch it.
+            chaos_hooks.fire(chaos_hooks.SEAM_SNAPSHOT_WRITTEN,
+                             path=path, step=step)
             self._c_taken.inc()
             self._g_step.set(step)
             # Black-box the landed snapshot: the doctor's progress marker

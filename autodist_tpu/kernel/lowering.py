@@ -47,6 +47,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from autodist_tpu import const
+from autodist_tpu.chaos import hooks as chaos_hooks
 from autodist_tpu.kernel import bucketing
 from autodist_tpu.kernel.mesh import data_axis
 from autodist_tpu.obs import recorder as flight
@@ -1751,6 +1752,7 @@ class DistributedTrainStep:
         try:
             fn = self._window_program(state, batch, num_steps, stacked,
                                       _force_unroll)
+            batch = self._chaos_batch(batch, num_steps, stacked)
             if fresh:
                 # The first call of a fresh program compiles synchronously
                 # before dispatching; its latency is the compile-time signal
@@ -1766,8 +1768,9 @@ class DistributedTrainStep:
                 # run that dies mid-compile leaves "compiling X" as its
                 # last event — exactly what the postmortem doctor needs.
                 flight.record_event("compile", critical=False, **entry)
-                return out
-            return fn(state, batch)
+            else:
+                out = fn(state, batch)
+            return self._chaos_metrics(out, num_steps)
         except Exception as e:
             # Black-box the failure before re-raising: an XLA OOM
             # (RESOURCE_EXHAUSTED) or runtime error recorded here is the
@@ -2166,16 +2169,40 @@ class DistributedTrainStep:
             jax.block_until_ready(out)
         return out, trace_dir
 
+    @staticmethod
+    def _chaos_batch(batch, num_steps: int, stacked: bool):
+        """Chaos seam (docs/chaos.md): an installed plant may poison the
+        batch (NaN gradients, loss spikes) before dispatch. Inert — one
+        predicate call — without a plant. ONE helper for the windowed
+        (:meth:`run`) and per-step (:meth:`__call__`) paths."""
+        if chaos_hooks.active():
+            batch = chaos_hooks.apply(chaos_hooks.SEAM_TRAIN_BATCH, batch,
+                                      num_steps=num_steps, stacked=stacked)
+        return batch
+
+    @staticmethod
+    def _chaos_metrics(out, num_steps: int):
+        """Post-step chaos seam: advances the plant's step cursor (and may
+        transform metrics). Same inertness contract as _chaos_batch."""
+        if chaos_hooks.active():
+            new_state, metrics = out
+            out = (new_state, chaos_hooks.apply(
+                chaos_hooks.SEAM_TRAIN_METRICS, metrics,
+                num_steps=num_steps))
+        return out
+
     def __call__(self, state: TrainState, batch) -> Tuple[TrainState, Dict[str, Any]]:
         fresh = self._compiled is None
         fn = self._compiled or self._compile(state, batch)
+        batch = self._chaos_batch(batch, num_steps=1, stacked=False)
         if fresh:
             t0 = time.perf_counter()
             out = fn(state, batch)
             self.compile_log.append(
                 {"program": "step", "first_call_s": time.perf_counter() - t0})
-            return out
-        return fn(state, batch)
+        else:
+            out = fn(state, batch)
+        return self._chaos_metrics(out, num_steps=1)
 
     def lower_text(self, state: TrainState, batch) -> str:
         """Stable-HLO dump of the compiled step — the TPU analog of the

@@ -29,6 +29,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from autodist_tpu import metrics as M
+from autodist_tpu.chaos import hooks as chaos_hooks
 from autodist_tpu.utils import logging
 
 __all__ = ["HostAggregator"]
@@ -116,6 +117,9 @@ class HostAggregator:
             fleet = self.transport.sweep()
         except Exception:  # noqa: BLE001
             fleet = {}
+        # Chaos seam (docs/chaos.md): an installed plant may slow a host's
+        # swept quantiles (straggler injection feeding SNT006).
+        fleet = chaos_hooks.apply(chaos_hooks.SEAM_AGG_SWEEP, fleet)
         with self._lock:
             self._fleet = fleet
         self._update_scores(fleet)

@@ -58,6 +58,7 @@ from autodist_tpu.utils import logging
 __all__ = [
     "FLIGHT_SUBDIR",
     "FlightRecorder",
+    "disable",
     "enable",
     "flight_dir",
     "get_recorder",
@@ -359,6 +360,19 @@ def enable(directory: str, **kwargs: Any) -> FlightRecorder:
     if old is not None:
         old.close()
     return _default
+
+
+def disable(ok: bool = True) -> None:
+    """Close and remove the process-default recorder (the inverse of
+    :func:`enable`). The next :func:`get_recorder` re-resolves the env
+    contract, so scenario harnesses (``autodist_tpu/chaos``) can scope a
+    default recorder to one run without leaking it into the next."""
+    global _default, _resolved
+    with _default_lock:
+        old, _default = _default, None
+        _resolved = False
+    if old is not None:
+        old.close(ok=ok)
 
 
 def record_step(**fields: Any) -> None:

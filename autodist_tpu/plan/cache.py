@@ -38,7 +38,7 @@ from typing import Dict, Optional
 from autodist_tpu.model_item import ModelItem
 from autodist_tpu.resource_spec import ResourceSpec
 from autodist_tpu.strategy.ir import Strategy
-from autodist_tpu.utils import logging
+from autodist_tpu.utils import logging, retry
 
 CACHE_FORMAT = 1
 
@@ -180,22 +180,24 @@ class PlanCache:
         """The cached winner for this (model, resources, version), fully
         validated — or None (counted as a miss; corrupt entries are evicted
         with a warning and also return None, never raise)."""
-        import time
-
         key = plan_key(model_item, resource_spec, version)
         d = self._entry_dir(key)
         try:
+            # A same-key writer replacing the entry mid-read produces a
+            # mixed old/new view (strategy bytes from one generation, meta
+            # checksum from the other). One short retry (through the ONE
+            # backoff home, utils/retry.py) sees the settled files. Only
+            # the cheap file-read phase retries — dry-run validation
+            # failures below are deterministic and re-lowering would just
+            # double the miss latency.
             try:
-                entry = self._read_files(key)
-            except Exception:  # noqa: BLE001 - retry the READ once
-                # A same-key writer replacing the entry mid-read produces a
-                # mixed old/new view (strategy bytes from one generation,
-                # meta checksum from the other). One short retry sees the
-                # settled files. Only the cheap file-read phase retries —
-                # dry-run validation failures below are deterministic and
-                # re-lowering would just double the miss latency.
-                time.sleep(0.05)
-                entry = self._read_files(key)
+                entry = retry.retry_call(
+                    lambda: self._read_files(key),
+                    policy=retry.RetryPolicy(
+                        initial_s=0.05, max_s=0.05, max_attempts=2),
+                    describe=f"plan cache read {key}")
+            except retry.RetryError as e:
+                raise e.__cause__ or e
             if entry is not None and self.validate:
                 dryrun_lowers(entry.strategy, model_item, resource_spec)
         except Exception as e:  # noqa: BLE001 - ANY defect => fresh search

@@ -27,7 +27,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import List, Optional, Sequence, TYPE_CHECKING
+from typing import Callable, List, Optional, Sequence, TYPE_CHECKING
 
 from autodist_tpu import const
 from autodist_tpu.const import ENV
@@ -335,6 +335,9 @@ def launch_supervised(
     coordinator_port: Optional[int] = None,
     restart_backoff_s: float = 5.0,
     ft_config: "Optional[FTConfig]" = None,
+    restart_backoff_max_s: float = 300.0,
+    backoff_seed: Optional[int] = None,
+    restart_sleep: Optional[Callable[[float], None]] = None,
 ) -> int:
     """:func:`launch` under a restart supervisor (checkpoint-resume loop).
 
@@ -363,7 +366,22 @@ def launch_supervised(
       (``ft.snapshot.latest_snapshot_step``), the counter resets — a run
       that keeps progressing between preemptions is never "given up on"
       by an absolute cap sized for genuine crash loops.
+
+    Restart pacing is **jittered exponential backoff** through the ONE
+    retry layer (``utils/retry.py``): ``restart_backoff_s`` is the first
+    delay's base, doubling per consecutive failed attempt up to
+    ``restart_backoff_max_s``, each delay jittered down by up to 50% so a
+    crashing multi-fleet deployment cannot restart-storm in lockstep. The
+    backoff resets together with the restart budget whenever the snapshot
+    ring advances — a preempted-but-progressing run restarts promptly
+    forever; only a no-progress crash loop slows down. ``backoff_seed``
+    pins the jitter (chaos replay determinism); ``restart_sleep``
+    overrides the sleep (tests, harnesses).
     """
+    import random as _random
+
+    from autodist_tpu.utils import retry as _retry
+
     def _progress() -> Optional[int]:
         if ft_config is None:
             return None
@@ -371,6 +389,12 @@ def launch_supervised(
 
         return latest_snapshot_step(ft_config.resolved().snapshot_dir)
 
+    backoff = _retry.Backoff(
+        _retry.RetryPolicy(
+            initial_s=restart_backoff_s, max_s=restart_backoff_max_s,
+            multiplier=2.0, jitter=0.5),
+        rng=_random.Random(backoff_seed) if backoff_seed is not None else None,
+    )
     attempt = 0
     last_progress = _progress()
     while True:
@@ -395,8 +419,10 @@ def launch_supervised(
                 if attempt:
                     logging.info(
                         "fleet progressed to snapshot step %d since the last "
-                        "restart; resetting the restart budget", step_now)
+                        "restart; resetting the restart budget and backoff",
+                        step_now)
                 attempt = 0
+                backoff.reset()
                 last_progress = step_now
         if code == 0 or attempt >= max_restarts:
             if code != 0:
@@ -406,11 +432,13 @@ def launch_supervised(
                 )
             return code
         attempt += 1
+        delay = backoff.next_delay()
         logging.warning(
-            "fleet exited rc=%d; restarting (%d/%d) in %.0fs",
-            code, attempt, max_restarts, restart_backoff_s,
+            "fleet exited rc=%d; restarting (%d/%d) in %.1fs",
+            code, attempt, max_restarts, delay,
         )
-        time.sleep(restart_backoff_s)
+        if delay > 0:
+            (restart_sleep or time.sleep)(delay)
 
 
 def _launch_local_fleet(

@@ -28,12 +28,18 @@ from autodist_tpu.serve.batcher import (
     GenRequest,
     RequestState,
 )
-from autodist_tpu.serve.engine import DecodeModel, InferenceEngine, Slot
+from autodist_tpu.serve.engine import (
+    DecodeModel,
+    EngineDeadError,
+    InferenceEngine,
+    Slot,
+)
 
 __all__ = [
     "Backpressure",
     "ContinuousBatcher",
     "DecodeModel",
+    "EngineDeadError",
     "GenRequest",
     "InferenceEngine",
     "RequestState",

@@ -37,9 +37,10 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from autodist_tpu import metrics as M
+from autodist_tpu.obs import recorder as obs_recorder
 from autodist_tpu.obs import spans as obs_spans
-from autodist_tpu.serve.engine import InferenceEngine, Slot
-from autodist_tpu.utils import logging
+from autodist_tpu.serve.engine import EngineDeadError, InferenceEngine, Slot
+from autodist_tpu.utils import logging, retry
 
 
 class Backpressure(RuntimeError):
@@ -154,6 +155,10 @@ class ContinuousBatcher:
         self._draining = False  # quiesced: no new admissions, finish active
         self._thread: Optional[threading.Thread] = None
         self._tick_tokens: deque = deque(maxlen=64)  # (t, n) for tokens/sec
+        self._shed_lock = threading.Lock()
+        self._shed_last = -1e9   # monotonic stamp of the last shed
+        self._shed_count = 0
+        self._SHED_WINDOW_S = 1.0
 
         reg = registry or M.registry
         self._m_depth = reg.gauge("serve_queue_depth")
@@ -191,27 +196,90 @@ class ContinuousBatcher:
             max_new_tokens=max_new_tokens,
             deadline=(time.monotonic() + timeout_s) if timeout_s else None,
         )
+        shed_reason = None
         with self._wake:
             if self._stopped:
                 # Accepting work that will never run would hang the client
                 # in wait() forever. (Pre-start submission is fine — the
                 # queue drains once start() runs.)
-                self._m_rejected.inc()
-                raise Backpressure("batcher is stopped")
-            if self._draining:
+                shed_reason = "batcher is stopped"
+            elif self._draining:
                 # Graceful shutdown in progress: shed at the edge so the
                 # client retries against the replacement server.
-                self._m_rejected.inc()
-                raise Backpressure("batcher is draining")
-            if len(self._queue) >= self.max_queue:
-                self._m_rejected.inc()
-                raise Backpressure(
+                shed_reason = "batcher is draining"
+            elif len(self._queue) >= self.max_queue:
+                shed_reason = (
                     f"admission queue full ({self.max_queue} requests)")
-            self._queue.append(req)
-            self._m_submitted.inc()
-            self._m_depth.set(len(self._queue))
-            self._wake.notify()
+            else:
+                self._queue.append(req)
+                self._m_submitted.inc()
+                self._m_depth.set(len(self._queue))
+                self._wake.notify()
+        if shed_reason is not None:
+            self._m_rejected.inc()
+            self._shed(shed_reason)
+            raise Backpressure(shed_reason)
         return req
+
+    def try_submit(
+        self,
+        prompt,
+        max_new_tokens: int = 32,
+        timeout_s: Optional[float] = None,
+    ) -> GenRequest:
+        """Admission that degrades *typed* instead of raising: always
+        returns a :class:`GenRequest`. A shed request comes back already
+        terminal — ``state == RequestState.REJECTED`` with the reason in
+        ``.error`` — so load-shedding under chaos (engine death, admission
+        stalls, queue overflow) is a value the caller can route on, never
+        a hang and never an anonymous exception (docs/chaos.md)."""
+        try:
+            return self.submit(prompt, max_new_tokens, timeout_s=timeout_s)
+        except (Backpressure, ValueError) as e:
+            try:
+                arr = np.asarray(prompt, np.int32).ravel()
+            except (TypeError, ValueError):
+                arr = np.zeros(0, np.int32)
+            req = GenRequest(prompt=arr, max_new_tokens=max_new_tokens)
+            req._finish(RequestState.REJECTED, f"admission rejected: {e}")
+            return req
+
+    def submit_with_retry(
+        self,
+        prompt,
+        max_new_tokens: int = 32,
+        timeout_s: Optional[float] = None,
+        policy: Optional[retry.RetryPolicy] = None,
+    ) -> GenRequest:
+        """Client-side admission under backpressure through the ONE retry
+        layer (utils/retry.py): jittered-exponential re-submission until
+        admitted or the policy's deadline/attempt budget is spent (the
+        final :class:`Backpressure` then propagates)."""
+        policy = policy or retry.RetryPolicy(
+            initial_s=0.02, max_s=1.0, max_attempts=8, deadline_s=10.0)
+        try:
+            return retry.retry_call(
+                lambda: self.submit(prompt, max_new_tokens,
+                                    timeout_s=timeout_s),
+                policy=policy, retry_on=(Backpressure,),
+                describe="serve admission")
+        except retry.RetryError as e:
+            raise Backpressure(str(e)) from e.__cause__
+
+    def _shed(self, reason: str) -> None:
+        """Black-box a load-shedding decision. One flight event opens each
+        shed window (rejections less than ``_SHED_WINDOW_S`` apart share
+        it), so the postmortem doctor's timeline shows *when* the server
+        was refusing work without a per-rejection fsync storm."""
+        now = time.monotonic()
+        with self._shed_lock:
+            opens = now - self._shed_last > self._SHED_WINDOW_S
+            self._shed_last = now
+            self._shed_count += 1
+            n = self._shed_count
+        if opens:
+            obs_recorder.record_event("shed", critical=False,
+                                      reason=reason, total_shed=n)
 
     # -------------------------------------------------------------- lifecycle
     def start(self) -> "ContinuousBatcher":
@@ -233,13 +301,12 @@ class ContinuousBatcher:
         timeout, or work submitted before start() of a batcher that never
         started — is failed terminally, so no client ever blocks in
         ``wait()`` on a request nobody will run."""
-        deadline = time.monotonic() + timeout_s
         if drain and self._thread is not None:
-            while time.monotonic() < deadline:
+            def idle() -> bool:
                 with self._lock:
-                    if not self._queue and not self._active:
-                        break
-                time.sleep(0.01)
+                    return not self._queue and not self._active
+
+            retry.wait_until(idle, timeout_s, interval_s=0.01)
         with self._wake:
             self._running = False
             self._stopped = True
@@ -271,13 +338,12 @@ class ContinuousBatcher:
         """
         before = self._m_completed.value
         self.quiesce()
-        deadline = time.monotonic() + deadline_s
-        started = self._thread is not None
-        while started and time.monotonic() < deadline:
-            with self._lock:
-                if not self._active:
-                    break
-            time.sleep(0.005)
+        if self._thread is not None:
+            def no_active() -> bool:
+                with self._lock:
+                    return not self._active
+
+            retry.wait_until(no_active, deadline_s, interval_s=0.005)
         with self._wake:
             self._running = False
             self._stopped = True
@@ -318,6 +384,21 @@ class ContinuousBatcher:
                     continue
             try:
                 self._tick()
+            except EngineDeadError as e:
+                # The engine cannot decode anymore: shed ALL load with
+                # explicit typed rejections (never hang a client on a dead
+                # engine), black-box the death for the postmortem doctor,
+                # and stop admitting — the replacement server takes over.
+                logging.error("engine died mid-decode; shedding all work: %s",
+                              e)
+                obs_recorder.record_event(
+                    "error", error=f"EngineDeadError: {e}"[:500])
+                self._shed(f"engine dead: {e}")
+                with self._wake:
+                    self._running = False
+                    self._stopped = True
+                self._fail_all(f"engine died mid-decode: {e}")
+                break
             except Exception:  # noqa: BLE001 - scheduler must survive
                 # A tick failure (e.g. transient compile/OOM) fails the
                 # requests it touched via _fail_active below rather than

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from autodist_tpu.chaos import hooks as chaos_hooks
 from autodist_tpu.kernel import GraphTransformer, ShardingPlan, build_mesh, data_axis
 from autodist_tpu.model_item import ModelItem
 from autodist_tpu.obs import recorder as obs_recorder
@@ -40,6 +41,13 @@ from autodist_tpu.obs import spans as obs_spans
 from autodist_tpu.utils import logging
 
 DEFAULT_BUCKET_LENS = (32, 64, 128, 256, 512, 1024)
+
+
+class EngineDeadError(RuntimeError):
+    """The inference engine can no longer decode (device lost, fatal
+    runtime error, or an injected chaos fault). The batcher catches this
+    specifically and sheds all load with typed REJECTED results instead
+    of hanging clients (docs/chaos.md)."""
 
 
 @dataclass
@@ -338,6 +346,13 @@ class InferenceEngine:
                 f"request needs a {total}-token timeline; largest bucket is "
                 f"{self._bucket_lens[-1]} (prompt {len(prompt)} + "
                 f"max_new_tokens {max_new_tokens})")
+        # Chaos seam: "defer" emulates an admission failure (behaves as no
+        # free slot — the batcher keeps the request queued and backpressure
+        # does the shedding); the hook may also raise EngineDeadError.
+        if chaos_hooks.fire(chaos_hooks.SEAM_SERVE_ADMIT,
+                            prompt_len=len(prompt),
+                            max_new_tokens=max_new_tokens) == "defer":
+            return None
         for length in self._bucket_lens:
             if length < fit:
                 continue
@@ -381,6 +396,9 @@ class InferenceEngine:
         the advanced position next step.
         """
         out: Dict[Slot, int] = {}
+        # Chaos seam: may raise EngineDeadError (mid-decode engine death).
+        chaos_hooks.fire(chaos_hooks.SEAM_SERVE_STEP,
+                         active=self.active_slots)
         for length, bucket in self._buckets.items():
             if not bucket.active.any():
                 continue

@@ -1,0 +1,378 @@
+"""Chaos subsystem tests (docs/chaos.md).
+
+Four tiers, mirroring the ISSUE-10 acceptance bars:
+
+- **replay determinism**: the same seeded schedule over the same scenario
+  produces a byte-identical injection trace; schedules round-trip JSON.
+- **one pin per fault class**: each catalog scenario runs against the real
+  stack and is detected with exactly its promised SNT*/DOC* code (the
+  harness raises :class:`~autodist_tpu.chaos.harness.SoakFailure` on any
+  contract violation, so a bare run IS the assertion).
+- **retry layer**: deadline honored strictly, jitter bounded, no retry
+  after success, reset semantics (``utils/retry.py`` — the ONE home).
+- **control**: a no-chaos run trips zero findings and reads DOC000.
+"""
+import json
+
+import pytest
+
+from autodist_tpu.chaos import hooks
+from autodist_tpu.chaos.faults import CATALOG
+from autodist_tpu.chaos.schedule import ChaosEvent, ChaosPlant, ChaosSchedule
+from autodist_tpu.utils import retry
+
+
+# ---------------------------------------------------------------- schedule
+class TestSchedule:
+    def test_json_round_trip(self):
+        s = ChaosSchedule(seed=42, events=(
+            ChaosEvent("nan_loss", at_step=3),
+            ChaosEvent("straggler", at_step=1, until_step=4, host=2,
+                       params=(("scale", 3.0),)),
+        ))
+        assert ChaosSchedule.from_json(s.to_json()) == s
+
+    def test_unknown_fault_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault"):
+            ChaosSchedule(events=(ChaosEvent("typo_fault"),))
+
+    def test_event_window_semantics(self):
+        e = ChaosEvent("nan_loss", at_step=3)            # single step
+        assert e.active(3) and not e.active(2) and not e.active(4)
+        w = ChaosEvent("nan_loss", at_step=3, until_step=6)
+        assert w.active(5) and not w.active(6)
+
+    def test_catalog_covers_every_scheduled_seam(self):
+        # Every catalog entry names a real seam constant (or the
+        # launcher-level "process" pseudo-seam).
+        seams = {getattr(hooks, n) for n in dir(hooks)
+                 if n.startswith("SEAM_")}
+        for spec in CATALOG.values():
+            assert spec.seam in seams or spec.seam == "process", spec.kind
+
+
+# ------------------------------------------------------------------- hooks
+class TestHooks:
+    def teardown_method(self):
+        hooks.clear()
+
+    def test_inert_without_plant(self):
+        assert hooks.apply("no.such.seam", {"x": 1}) == {"x": 1}
+        assert hooks.fire("no.such.seam") is None
+        assert not hooks.active()
+
+    def test_one_plant_at_a_time(self):
+        owner_a, owner_b = object(), object()
+        hooks.install("seam.a", lambda v, **k: v, owner=owner_a)
+        with pytest.raises(RuntimeError, match="already installed"):
+            hooks.install("seam.b", lambda v, **k: v, owner=owner_b)
+        hooks.clear(owner=owner_a)
+        hooks.install("seam.b", lambda v, **k: v, owner=owner_b)
+
+    def test_plant_installs_only_scheduled_seams(self):
+        s = ChaosSchedule(seed=1, events=(
+            ChaosEvent("heartbeat_drop", at_step=0, host=1),))
+        with ChaosPlant(s):
+            installed = hooks.installed()
+        assert hooks.SEAM_HB_PUBLISH in installed
+        assert hooks.SEAM_TRAIN_BATCH not in installed
+        assert hooks.installed() == []  # context exit cleared everything
+
+
+# ----------------------------------------------------------- retry layer
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+class TestRetryLayer:
+    def test_no_retry_after_success(self):
+        clk = FakeClock()
+        calls = []
+        out = retry.retry_call(lambda: calls.append(1) or "ok",
+                               sleep=clk.sleep, clock=clk)
+        assert out == "ok" and len(calls) == 1 and clk.sleeps == []
+
+    def test_retries_then_succeeds(self):
+        clk = FakeClock()
+        state = {"n": 0}
+
+        def flaky():
+            state["n"] += 1
+            if state["n"] < 3:
+                raise OSError("transient")
+            return state["n"]
+
+        out = retry.retry_call(
+            flaky, policy=retry.RetryPolicy(initial_s=0.1, jitter=0.0),
+            retry_on=(OSError,), sleep=clk.sleep, clock=clk)
+        assert out == 3 and len(clk.sleeps) == 2
+
+    def test_attempt_budget_raises_retry_error_with_cause(self):
+        clk = FakeClock()
+
+        def always():
+            raise ValueError("boom")
+
+        with pytest.raises(retry.RetryError) as ei:
+            retry.retry_call(
+                always,
+                policy=retry.RetryPolicy(max_attempts=3, jitter=0.0,
+                                         initial_s=0.01),
+                sleep=clk.sleep, clock=clk)
+        assert isinstance(ei.value.__cause__, ValueError)
+        assert "3 attempt" in str(ei.value)
+
+    def test_deadline_honored_strictly(self):
+        """Never starts a sleep that would end past the deadline."""
+        clk = FakeClock()
+
+        def always():
+            raise OSError("down")
+
+        with pytest.raises(retry.RetryError, match="deadline"):
+            retry.retry_call(
+                always,
+                policy=retry.RetryPolicy(initial_s=0.4, multiplier=2.0,
+                                         jitter=0.0, deadline_s=1.0),
+                sleep=clk.sleep, clock=clk)
+        # First delay 0.4 fits (t=0.4); second would be 0.8 -> t=1.2 > 1.0,
+        # so it must NOT have been slept.
+        assert clk.sleeps == [pytest.approx(0.4)]
+        assert clk.t <= 1.0
+
+    def test_unlisted_exception_propagates_immediately(self):
+        with pytest.raises(KeyError):
+            retry.retry_call(lambda: (_ for _ in ()).throw(KeyError("x")),
+                             retry_on=(OSError,))
+
+    def test_jitter_bounded_and_base_capped(self):
+        import random
+
+        clk = FakeClock()
+        b = retry.Backoff(
+            retry.RetryPolicy(initial_s=1.0, max_s=4.0, multiplier=2.0,
+                              jitter=0.5),
+            rng=random.Random(0), sleep=clk.sleep, clock=clk)
+        bases = [1.0, 2.0, 4.0, 4.0, 4.0]   # capped at max_s
+        for base in bases:
+            d = b.next_delay()
+            assert base * 0.5 <= d <= base, (base, d)
+
+    def test_backoff_reset_rewinds_to_initial(self):
+        import random
+
+        b = retry.Backoff(
+            retry.RetryPolicy(initial_s=1.0, max_s=64.0, jitter=0.0),
+            rng=random.Random(1))
+        assert [b.next_delay() for _ in range(3)] == [1.0, 2.0, 4.0]
+        b.reset()
+        assert b.attempts == 0
+        assert b.next_delay() == 1.0
+
+    def test_backoff_deterministic_given_seed(self):
+        import random
+
+        mk = lambda: retry.Backoff(  # noqa: E731
+            retry.RetryPolicy(initial_s=0.5, jitter=0.5),
+            rng=random.Random(7))
+        a, b = mk(), mk()
+        assert [a.next_delay() for _ in range(5)] == \
+               [b.next_delay() for _ in range(5)]
+
+    def test_wait_until_true_and_timeout(self):
+        clk = FakeClock()
+        state = {"n": 0}
+
+        def pred():
+            state["n"] += 1
+            return state["n"] >= 4
+
+        assert retry.wait_until(pred, 10.0, interval_s=0.5,
+                                sleep=clk.sleep, clock=clk)
+        assert len(clk.sleeps) == 3
+        clk2 = FakeClock()
+        assert not retry.wait_until(lambda: False, 1.0, interval_s=0.3,
+                                    sleep=clk2.sleep, clock=clk2)
+        assert clk2.t <= 1.0 + 1e-9
+
+
+# ---------------------------------------------------- per-fault-class pins
+def _run(fault, tmp_path):
+    from autodist_tpu.chaos import harness
+
+    base = tmp_path / fault
+    base.mkdir(parents=True, exist_ok=True)
+    return harness.SCENARIOS[fault](str(base))
+
+
+class TestFaultClassPins:
+    """One pin per catalog fault class: the scenario runs against the real
+    stack; the harness asserts detection with exactly the promised code
+    and the recovery contract, raising SoakFailure otherwise."""
+
+    def test_control_run_zero_findings(self, tmp_path):
+        res = _run("control", tmp_path)
+        assert res.ok and res.injected == 0 and res.detected == ["DOC000"]
+
+    def test_nan_loss_snt001_doc001(self, tmp_path):
+        res = _run("nan_loss", tmp_path)
+        assert res.detected == ["SNT001", "DOC001"]
+        assert res.recovery_steps <= 4   # detection at the injected step
+
+    def test_loss_spike_snt003_doc000(self, tmp_path):
+        res = _run("loss_spike", tmp_path)
+        assert res.detected == ["SNT003", "DOC000"]
+
+    def test_straggler_snt006_suspect(self, tmp_path):
+        res = _run("straggler", tmp_path)
+        assert res.detected == ["SNT006", "SUSPECT"]
+        assert res.injected == 2    # two windows -> episode re-armed
+
+    def test_heartbeat_drop_transitions(self, tmp_path):
+        res = _run("heartbeat_drop", tmp_path)
+        assert res.detected == ["HEALTHY->SUSPECT", "SUSPECT->DEAD",
+                                "DEAD->HEALTHY"]
+
+    def test_heartbeat_partition_doc003(self, tmp_path):
+        res = _run("heartbeat_partition", tmp_path)
+        assert "DOC003" in res.detected
+
+    def test_snapshot_corrupt_ring_fallback(self, tmp_path):
+        res = _run("snapshot_corrupt", tmp_path)
+        assert "verify_failed" in res.detected
+
+    def test_snapshot_partial_ring_fallback(self, tmp_path):
+        res = _run("snapshot_partial", tmp_path)
+        assert "verify_failed" in res.detected
+
+    def test_snapshot_unwritable_retry_heals(self, tmp_path):
+        res = _run("snapshot_unwritable", tmp_path)
+        assert res.injected == 2 and "retry_healed" in res.detected
+
+    def test_serve_admission_typed_rejection_and_shed(self, tmp_path):
+        res = _run("serve_admission", tmp_path)
+        assert "REJECTED(queue full)" in res.detected
+        assert "shed event" in res.detected
+
+    def test_engine_death_sheds_all_doc006(self, tmp_path):
+        res = _run("engine_death", tmp_path)
+        assert res.detected == ["REJECTED(engine died)", "DOC006"]
+
+    def test_worker_kill_supervised_restart(self, tmp_path):
+        res = _run("worker_kill", tmp_path)
+        assert res.injected == 2
+        assert "budget+backoff reset on progress" in res.detected
+
+
+# ------------------------------------------------------ replay determinism
+class TestReplayDeterminism:
+    def test_snapshot_corrupt_trace_is_byte_identical(self):
+        # The corrupt injector draws its victim file and byte offset from
+        # the plant's seeded RNG — the strongest determinism pin.
+        from autodist_tpu.chaos import harness
+
+        assert harness.replay_is_deterministic("snapshot_corrupt")
+
+    def test_trace_lines_are_canonical_json(self, tmp_path):
+        res = _run("heartbeat_drop", tmp_path)
+        lines = res.trace.decode("utf-8").splitlines()
+        assert len(lines) == res.injected
+        for i, line in enumerate(lines):
+            doc = json.loads(line)
+            assert doc["i"] == i and doc["fault"] == "heartbeat_drop"
+            assert line == json.dumps(doc, sort_keys=True)
+
+
+# ------------------------------------------------------------ CLI surface
+class TestCLI:
+    def test_list_prints_catalog(self, capsys):
+        from autodist_tpu.chaos.__main__ import main
+
+        assert main(["--list"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert set(doc) == set(CATALOG)
+        assert all("detects" in v and "seam" in v for v in doc.values())
+
+    def test_soak_subset_cli(self, tmp_path, capsys):
+        from autodist_tpu.chaos.__main__ import main
+
+        assert main(["--faults", "snapshot_unwritable"]) == 0
+        out = capsys.readouterr().out
+        assert "chaos soak ok" in out
+
+
+# ------------------------------------------- serve admission retry adoption
+class _StubEngine:
+    """Just enough surface for ContinuousBatcher admission (the scheduler
+    thread is never started, so decode is never touched)."""
+    decode_model = object()
+    n_slots = 2
+    max_len = 16
+    _bucket_lens = (16,)
+
+    @staticmethod
+    def bucket_for(total):
+        return 16 if total <= 16 else None
+
+
+class TestServeAdmissionRetry:
+    def _batcher(self, max_queue=1):
+        from autodist_tpu import metrics as M
+        from autodist_tpu.serve.batcher import ContinuousBatcher
+
+        return ContinuousBatcher(_StubEngine(), max_queue=max_queue,
+                                 registry=M.MetricsRegistry())
+
+    def test_admitted_first_try_no_retry(self):
+        b = self._batcher(max_queue=2)
+        req = b.submit_with_retry([1, 2, 3], max_new_tokens=4)
+        assert req.state.value == "queued"
+
+    def test_budget_exhausted_reraises_backpressure(self):
+        from autodist_tpu.serve.batcher import Backpressure
+
+        b = self._batcher(max_queue=1)
+        b.submit([1, 2, 3], max_new_tokens=4)        # fills the queue
+        with pytest.raises(Backpressure, match="queue full"):
+            b.submit_with_retry(
+                [1, 2, 3], max_new_tokens=4,
+                policy=retry.RetryPolicy(initial_s=0.001, max_s=0.002,
+                                         max_attempts=3))
+
+    def test_try_submit_is_typed_never_raises(self):
+        from autodist_tpu.serve.batcher import RequestState
+
+        b = self._batcher(max_queue=1)
+        b.submit([1, 2, 3], max_new_tokens=4)
+        shed = b.try_submit([1, 2, 3], max_new_tokens=4)
+        assert shed.state is RequestState.REJECTED
+        assert "queue full" in shed.error
+        assert shed.done                    # terminal: wait() returns now
+
+
+# ---------------------------------------------- launcher backoff satellite
+def test_launch_supervised_backoff_is_jittered_exponential(monkeypatch):
+    """Without progress, restart delays grow exponentially with bounded
+    jitter; the budget still gives up on schedule."""
+    import autodist_tpu.runtime.launcher as L
+
+    monkeypatch.setattr(L, "launch", lambda *a, **k: 9)
+    delays = []
+    rc = L.launch_supervised(
+        None, ["true"], max_restarts=3, restart_backoff_s=1.0,
+        restart_backoff_max_s=100.0, backoff_seed=3,
+        restart_sleep=delays.append)
+    assert rc == 9
+    assert len(delays) == 3
+    for i, d in enumerate(delays):
+        base = 2.0 ** i
+        assert base * 0.5 <= d <= base, (i, d)

@@ -44,6 +44,18 @@ exits 1 listing ``file:line`` offenders. Rules:
    container/async-copy double-count guard. (``tools/`` is exempt: the
    golden-trace generator builds a synthetic xplane on purpose.)
 
+6. **ONE retry/backoff home** — ``time.sleep(`` anywhere in
+   ``autodist_tpu/`` outside ``utils/retry.py`` is banned: ad-hoc
+   sleep-retry/poll loops are exactly the drift the chaos soak harness
+   exists to flush out (unjittered restarts storm in lockstep; uncapped
+   polls hang; see docs/chaos.md § retry). Retry through
+   ``retry_call``/``Backoff``; poll through ``wait_until``. ``bench.py``
+   and ``examples/`` are outside the scanned root on purpose (the bench
+   probe ladder and queue-driver grace periods are driver-side deadline
+   machinery, not package retry loops); the heartbeat escalation
+   scheduler needs no exemption — it paces itself on ``Event.wait``
+   deadlines, which the rule never matches.
+
 Pure stdlib, no third-party deps — runs anywhere Python runs.
 """
 from __future__ import annotations
@@ -64,6 +76,10 @@ PSUM_CALL_RE = re.compile(r"\blax\.psum(_scatter)?\s*\(")
 FLIGHT_WRITE_RE = re.compile(r"open\([^)\n]*flight|['\"]flight-")
 # Rule 5: the xplane proto import / trace-file glob, outside obs/attrib.py.
 XPLANE_RE = re.compile(r"\bxplane_pb2\b|xplane\.pb\b")
+# Rule 6: a literal time.sleep call — retry/poll loops go through
+# utils/retry.py (passing `time.sleep` as a callable default is fine; the
+# rule targets call sites).
+TIME_SLEEP_RE = re.compile(r"\btime\.sleep\s*\(")
 
 
 def _py_files(*roots):
@@ -153,6 +169,24 @@ def main() -> int:
                         f"{rel}:{i}: xplane parsing outside obs/attrib.py "
                         f"— capture/parse through the attribution library "
                         f"(the ONE trace reader; docs/observability.md)")
+
+    # (ft/heartbeat.py needs no exemption: its escalation scheduler paces
+    # itself on Event.wait deadlines, which this regex never matches.)
+    sleep_allowed = {
+        os.path.join("autodist_tpu", "utils", "retry.py"),
+    }
+    for rel in _py_files("autodist_tpu"):
+        if rel in sleep_allowed:
+            continue
+        with open(os.path.join(REPO, rel), "r", encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                code = line.split("#", 1)[0]
+                if TIME_SLEEP_RE.search(code):
+                    errors.append(
+                        f"{rel}:{i}: ad-hoc time.sleep retry/poll loop — "
+                        f"go through autodist_tpu/utils/retry.py "
+                        f"(retry_call/Backoff/wait_until, the ONE "
+                        f"jittered-backoff home; docs/chaos.md)")
 
     if errors:
         print("banned-pattern lint FAILED:", file=sys.stderr)
