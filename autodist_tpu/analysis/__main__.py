@@ -10,18 +10,27 @@ acceptance claim fails**:
    TopK+bf16, host offload,
    hybrid DCN) lowers, compiles, and the analyzer re-derives its pinned
    wire from the plan's promise with ZERO error/warning findings — the
-   analyzer agrees with every existing wire pin on every family;
+   analyzer agrees with every existing wire pin on every family. The
+   schedule passes (``analysis/sched.py``) are active throughout, and
+   family #12 (bucketed overlap) must additionally report >= 2 gradsync
+   buckets with scheduled overlap > 0 from its compiled schedule;
 2. **seeded defects trip** — deliberately broken programs each raise the
    intended finding code: a leaked full-table collective (SLW001), a
    zero1 plan whose program re-fused to all-reduce (SLW002+SLW001), an
    HBM-overcommitted plan (SLM001), a plan whose shard_update flags drift
    from the shared predicate (SLH003), rendezvousing programs with
-   reordered collectives / permuted replica groups (SLH001), and a
-   donated-alias size mismatch (SLH002);
+   reordered collectives / permuted replica groups (SLH001), a
+   donated-alias size mismatch (SLH002), a structurally serialized
+   gradsync bucket (SLO001), a scheduled-peak overcommit the static
+   totals miss (SLM003), and a cross-program channel-ordering cycle
+   (SLH004);
 3. **cache eviction carries the finding** — a plan-cache entry that
    lowers but overcommits the spec's HBM is evicted loudly on ``get``
    (counted invalidated, warning text carries the SLM001 finding), never
-   served or crashed on.
+   served or crashed on; an entry with a SCHEDULE finding (degenerate
+   bucketing, SLO001) is evicted the same way, and the planner's search
+   records schedule-screen rejections in provenance
+   ``screen_rejected`` before pricing.
 """
 from __future__ import annotations
 
@@ -99,6 +108,7 @@ def selftest() -> int:  # noqa: C901 - one linear proof, mirrors plan's
 
     # ------------------------------------------- 1. family conformance
     family_rows = {}
+    sched_buckets_overlapped = 0
     try:
         runners = _families()
     except ImportError as e:
@@ -139,7 +149,10 @@ def selftest() -> int:  # noqa: C901 - one linear proof, mirrors plan's
                       "expert_parallel": "expert",
                       "bucketed_overlap": "zero1"}.get(tag)
             # Family #12: the analyzer's promised-wire table must carry
-            # the bucket attribution (per-bucket allowances in VarWire).
+            # the bucket attribution (per-bucket allowances in VarWire),
+            # and the SCHEDULE pass must see >= 2 buckets whose compiled
+            # schedule actually provides overlap (> 0) — the zero-
+            # execution face of the family's latency-hiding claim.
             if tag == "bucketed_overlap":
                 bucket_ids = {row.get("bucket")
                               for row in report.tables.get("wire", [])
@@ -148,6 +161,15 @@ def selftest() -> int:  # noqa: C901 - one linear proof, mirrors plan's
                     failures.append(
                         f"family {tag}: wire table attributes "
                         f"{len(bucket_ids)} bucket(s); expected >= 2")
+                sched_rows = report.tables.get("sched_overlap", [])
+                overlapped = [r for r in sched_rows
+                              if r.get("scheduled_overlap", 0) > 0]
+                sched_buckets_overlapped = len(overlapped)
+                if len(overlapped) < 2:
+                    failures.append(
+                        f"family {tag}: {len(overlapped)} bucket(s) show "
+                        f"scheduled overlap > 0 (rows: {sched_rows}); "
+                        f"expected >= 2")
             if expect and expect not in renderings:
                 failures.append(
                     f"family {tag}: promised wire lost the {expect!r} "
@@ -300,6 +322,132 @@ def selftest() -> int:  # noqa: C901 - one linear proof, mirrors plan's
     expect_codes("alias_mismatch",
                  [f.code for f in alias_hazards(bad_alias)], ["SLH002"])
 
+    # (g) structurally serialized gradsync bucket: the reduce-scatter's
+    # result is consumed by the very next instruction with nothing
+    # schedulable in between — the schedule provides zero overlap.
+    from autodist_tpu.analysis import (
+        ProgramGraph,
+        channel_cycle_hazards,
+        liveness_check,
+        overlap_check,
+    )
+
+    serialized = (
+        "HloModule serialized, is_scheduled=true\n\n"
+        "ENTRY %main (p0: f32[64,64]) -> f32[8,64] {\n"
+        "  %p0 = f32[64,64]{1,0} parameter(0)\n"
+        "  %rs = f32[8,64]{1,0} reduce-scatter(f32[64,64]{1,0} %p0), "
+        "channel_id=1, replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}, "
+        "metadata={op_name=\"jit(_step)/transpose(jvp(gradsync.bucket_0))"
+        "/reduce_scatter\"}\n"
+        "  ROOT %out = f32[8,64]{1,0} copy(f32[8,64]{1,0} %rs)\n"
+        "}\n")
+    f_ser, _rows = overlap_check(
+        ProgramGraph.from_hlo(serialized, "defect:serialized"))
+    expect_codes("serialized_bucket", [f.code for f in f_ser], ["SLO001"])
+    # the family-#12 control above already proves the clean side: real
+    # bucketed programs analyze with zero SLO findings.
+
+    # (h) scheduled-peak overcommit: the schedule materializes two big
+    # transients simultaneously; the (tiny-capacity) spec's static totals
+    # are not consulted here — liveness judges the schedule itself.
+    transient = (
+        "HloModule transient, is_scheduled=true\n\n"
+        "ENTRY %main (p0: f32[512,512]) -> f32[512,512] {\n"
+        "  %p0 = f32[512,512]{1,0} parameter(0)\n"
+        "  %g1 = f32[512,512]{1,0} multiply(f32[512,512]{1,0} %p0, "
+        "f32[512,512]{1,0} %p0)\n"
+        "  %g2 = f32[512,512]{1,0} add(f32[512,512]{1,0} %g1, "
+        "f32[512,512]{1,0} %p0)\n"
+        "  ROOT %out = f32[512,512]{1,0} add(f32[512,512]{1,0} %g1, "
+        "f32[512,512]{1,0} %g2)\n"
+        "}\n")
+    f_peak, peak_summary = liveness_check(
+        ProgramGraph.from_hlo(transient, "defect:transient"),
+        resource_spec=tiny, static_totals_ok=True)
+    expect_codes("scheduled_overcommit", [f.code for f in f_peak],
+                 ["SLM003"])
+    if peak_summary.get("scheduled_peak_bytes", 0) != 3 * 512 * 512 * 4:
+        failures.append(
+            f"scheduled liveness mis-measured the transient peak: "
+            f"{peak_summary}")
+
+    # (i) cross-program channel cycle: three stages each order a shared
+    # channel pair consistently pairwise, but the union is a cycle — the
+    # MPMD deadlock SLH001's pairwise diff cannot see.
+    def chan_prog(label, c1, c2):
+        return ProgramGraph.from_hlo(
+            "HloModule " + label + ", is_scheduled=true\n\n"
+            "ENTRY %main (p0: f32[64]) -> f32[64] {\n"
+            "  %p0 = f32[64]{0} parameter(0)\n"
+            f"  %ar1 = f32[64]{{0}} all-reduce(f32[64]{{0}} %p0), "
+            f"channel_id={c1}, replica_groups={{{{0,1}}}}, "
+            f"to_apply=%add\n"
+            f"  ROOT %ar2 = f32[64]{{0}} all-reduce(f32[64]{{0}} %ar1), "
+            f"channel_id={c2}, replica_groups={{{{0,1}}}}, "
+            f"to_apply=%add\n"
+            "}\n", label)
+
+    f_cycle = channel_cycle_hazards({
+        "stage0": chan_prog("s0", 1, 2),
+        "stage1": chan_prog("s1", 2, 3),
+        "stage2": chan_prog("s2", 3, 1)})
+    expect_codes("channel_cycle", [f.code for f in f_cycle], ["SLH004"])
+    f_acyclic = channel_cycle_hazards({
+        "stage0": chan_prog("s0", 1, 2),
+        "stage1": chan_prog("s1", 2, 3),
+        "stage2": chan_prog("s2", 1, 3)})
+    if f_acyclic:
+        failures.append("consistently-ordered programs reported a "
+                        "channel cycle")
+
+    # (j) the planner's search screen-rejects a schedule-defective seed
+    # BEFORE pricing, recorded in provenance (the acceptance path: a
+    # candidate that requests bucketed overlap with zero bucket-eligible
+    # vars is structurally serialized — SLO001).
+    import importlib
+
+    # NB: `from autodist_tpu.plan import search` resolves to the search()
+    # FUNCTION (plan/__init__ rebinds the name); go through sys.modules
+    # for the module object (the tests/test_analysis.py convention).
+    search_mod = importlib.import_module("autodist_tpu.plan.search")
+    from autodist_tpu.strategy.ir import (
+        NodeConfig,
+        PSSynchronizer,
+        Strategy,
+    )
+
+    def degenerate_bucketed_strategy(mi, rs):
+        from autodist_tpu.strategy.base import reduction_devices
+
+        dest = reduction_devices(rs)[0]
+        s = Strategy(id=Strategy.new_id(rs.fingerprint()))
+        s.graph_config.bucket_bytes = 1 << 20
+        for var in mi.trainable_variables:
+            s.node_config.append(NodeConfig(
+                var_name=var.name,
+                synchronizer=PSSynchronizer(reduction_destination=dest)))
+        return s
+
+    class _DegenerateSeed:
+        def build(self, mi, rs):
+            return degenerate_bucketed_strategy(mi, rs)
+
+    real_slate = search_mod.candidate_slate
+    search_mod.candidate_slate = lambda *a, **kw: (
+        real_slate(*a, **kw) + [("DegenerateBucketed", _DegenerateSeed())])
+    try:
+        result = search_mod.PlanSearch(
+            mitem, spec,
+            search_mod.SearchConfig(generations=1)).run()
+    finally:
+        search_mod.candidate_slate = real_slate
+    rejected = result.provenance.get("screen_rejected", {})
+    expect_codes("search_screen_sched",
+                 rejected.get("DegenerateBucketed", []), ["SLO001"])
+    if "DegenerateBucketed" in result.provenance.get("seeds", {}):
+        failures.append("schedule-screened seed was priced anyway")
+
     # ------------------------------- 3. cache eviction carries the finding
     from autodist_tpu.plan.cache import PlanCache
 
@@ -325,6 +473,22 @@ def selftest() -> int:  # noqa: C901 - one linear proof, mirrors plan's
         failures.append("overcommitted entry was not counted invalidated")
     if "SLM001" not in log_buf.getvalue():
         failures.append("cache eviction warning carried no SLM001 finding")
+    # ...and an entry with a SCHEDULE finding (degenerate bucketing:
+    # bucket machinery requested, zero bucket-eligible vars — SLO001) is
+    # evicted the same loud way, never trusted.
+    cache.put(mitem, spec, degenerate_bucketed_strategy(mitem, spec))
+    sched_buf = io.StringIO()
+    handler = _pylogging.StreamHandler(sched_buf)
+    _pylogging.getLogger("autodist_tpu").addHandler(handler)
+    try:
+        degenerate = cache.get(mitem, spec)
+    finally:
+        _pylogging.getLogger("autodist_tpu").removeHandler(handler)
+    if degenerate is not None:
+        failures.append(
+            "cache entry with a schedule finding was served as a hit")
+    if "SLO001" not in sched_buf.getvalue():
+        failures.append("cache eviction warning carried no SLO001 finding")
 
     ok = not failures
     line = {
@@ -335,6 +499,8 @@ def selftest() -> int:  # noqa: C901 - one linear proof, mirrors plan's
             1 for v in family_rows.values() if v == "clean"),
         "seeded_defects": defect_rows,
         "cache_eviction_finding": "SLM001" in log_buf.getvalue(),
+        "cache_eviction_sched_finding": "SLO001" in sched_buf.getvalue(),
+        "sched_buckets_overlapped": sched_buckets_overlapped,
         "device": jax.devices()[0].platform,
         "n_devices": n,
     }

@@ -1,0 +1,325 @@
+"""Def-use DAG over a post-optimization HLO dump (the schedule substrate).
+
+``inventory.py`` reads a dump one op line at a time — enough for payload
+and presence pins, blind to *order*. The schedule passes
+(``analysis/sched.py``) need more: post-optimization dumps are emitted in
+schedule order (``is_scheduled=true``), so the textual instruction
+sequence IS the executor's issue order, and def→use edges over it give
+liveness intervals and overlap windows with zero execution. This module
+is the second (and last) HLO reader in the parser home — the same
+single-parser policy as ``CollectiveInventory``
+(``tools/check_patterns.py`` rule 7 bans ``.as_text()`` parsing anywhere
+else).
+
+Reading rules, shared with the inventory:
+
+- named-scope metadata (``metadata={op_name=...}``) is attached to the
+  node but never creates one;
+- result shapes sit between ``=`` and the op token, operands after it;
+  names that resolve to no instruction in the same computation
+  (``to_apply=%region``, ``calls=%fused_computation``, ``body=``/
+  ``condition=`` computation refs) are dropped, so data edges never point
+  at computations;
+- ``tuple`` / ``get-tuple-element`` / ``bitcast`` define views, not
+  buffers (their ``result_bytes`` reads 0 for liveness purposes via
+  :attr:`HloInstr.is_view`).
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from autodist_tpu.analysis.inventory import (
+    COLLECTIVE_KINDS,
+    _CHANNEL_RE,
+    _GROUPS_EXPLICIT_RE,
+    _GROUPS_IOTA_RE,
+    _METADATA_RE,
+    _OP_NAME_RE,
+    _SHAPE_RE,
+    _expand_iota_groups,
+    dtype_bytes,
+)
+
+#: Ops that define a *view* of an existing buffer, not a new one — they
+#: contribute zero bytes to scheduled liveness (XLA's buffer assignment
+#: aliases them).
+VIEW_OPS = frozenset({"tuple", "get-tuple-element", "bitcast"})
+
+#: Async-pair spellings: ``<kind>-start`` / ``<kind>-done`` (TPU dumps),
+#: plus the generic ``async-start``/``async-done`` wrappers.
+_ASYNC_START_SUFFIX = "-start"
+_ASYNC_DONE_SUFFIX = "-done"
+
+_DEF_RE = re.compile(r"^(ROOT\s+)?%?([A-Za-z0-9_.-]+)\s*=\s*(.*)$")
+# First `name(` token after the result type — the opcode. Hyphenated HLO
+# op names (reduce-scatter, dynamic-update-slice, all-reduce-start).
+_OP_TOKEN_RE = re.compile(r"(?<![\w.%-])([a-z][a-z0-9-]*(?:-[a-z0-9]+)*)\(")
+_OPERAND_NAME_RE = re.compile(r"%([A-Za-z0-9_.-]+)")
+_COMPUTATION_RE = re.compile(r"^(ENTRY\s+)?%?([A-Za-z0-9_.-]+)\s*\(")
+_SOURCE_TARGET_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)*)\}")
+_PAIR_RE = re.compile(r"\{(\d+),(\d+)\}")
+
+
+@dataclass
+class HloInstr:
+    """One instruction in one computation of a post-optimization dump."""
+
+    name: str
+    op: str
+    index: int                                # schedule position
+    results: Tuple[Tuple[str, Tuple[int, ...]], ...] = ()
+    operands: Tuple[str, ...] = ()            # resolved same-computation defs
+    op_name: str = ""                         # metadata named-scope path
+    channel_id: Optional[int] = None
+    replica_groups: Tuple[Tuple[int, ...], ...] = ()
+    source_target_pairs: Tuple[Tuple[int, int], ...] = ()
+    is_root: bool = False
+    line: str = ""
+
+    @property
+    def result_bytes(self) -> int:
+        """Bytes this instruction's result buffer(s) occupy; 0 for views."""
+        if self.is_view:
+            return 0
+        total = 0
+        for dt, dims in self.results:
+            n = 1
+            for d in dims:
+                n *= d
+            total += n * dtype_bytes(dt)
+        return total
+
+    @property
+    def is_view(self) -> bool:
+        return self.op in VIEW_OPS
+
+    @property
+    def is_parameter(self) -> bool:
+        return self.op == "parameter"
+
+    @property
+    def collective_kind(self) -> Optional[str]:
+        """Canonical collective kind when this is (any spelling of) a
+        collective op — ``all-reduce-start`` and the ``async-start``
+        wrapper both read as their base kind; None otherwise."""
+        op = self.op
+        for suffix in (_ASYNC_START_SUFFIX, _ASYNC_DONE_SUFFIX):
+            if op.endswith(suffix):
+                op = op[: -len(suffix)]
+                break
+        if op in COLLECTIVE_KINDS:
+            return op
+        if self.op in ("async-start", "async-done"):
+            for kind in COLLECTIVE_KINDS:
+                if kind in self.line:
+                    return kind
+        return None
+
+    @property
+    def is_collective(self) -> bool:
+        return self.collective_kind is not None
+
+    @property
+    def is_async_start(self) -> bool:
+        return self.is_collective and self.op.endswith(_ASYNC_START_SUFFIX)
+
+    @property
+    def is_async_done(self) -> bool:
+        return self.is_collective and self.op.endswith(_ASYNC_DONE_SUFFIX)
+
+
+@dataclass
+class HloComputation:
+    """One computation's instructions, in schedule (textual) order."""
+
+    name: str
+    is_entry: bool = False
+    instrs: List[HloInstr] = field(default_factory=list)
+    _by_name: Dict[str, HloInstr] = field(default_factory=dict)
+    _users: Optional[Dict[str, List[HloInstr]]] = None
+
+    def instr(self, name: str) -> Optional[HloInstr]:
+        return self._by_name.get(name)
+
+    @property
+    def root(self) -> Optional[HloInstr]:
+        for i in reversed(self.instrs):
+            if i.is_root:
+                return i
+        return self.instrs[-1] if self.instrs else None
+
+    def users(self, name: str) -> List[HloInstr]:
+        """Instructions consuming ``name``'s result (def→use edges)."""
+        if self._users is None:
+            users: Dict[str, List[HloInstr]] = {}
+            for instr in self.instrs:
+                for op_name in instr.operands:
+                    users.setdefault(op_name, []).append(instr)
+            self._users = users
+        return self._users.get(name, [])
+
+    def first_use(self, name: str) -> Optional[int]:
+        us = self.users(name)
+        return min(u.index for u in us) if us else None
+
+    def last_use(self, name: str) -> Optional[int]:
+        us = self.users(name)
+        return max(u.index for u in us) if us else None
+
+
+@dataclass
+class ProgramGraph:
+    """A whole dump: module attributes + every computation's DAG."""
+
+    module_name: str = ""
+    is_scheduled: bool = False
+    computations: Dict[str, HloComputation] = field(default_factory=dict)
+    #: ``input_output_alias`` pairs as (output_index, parameter_number).
+    alias_pairs: Tuple[Tuple[int, int], ...] = ()
+    program: str = ""
+
+    @property
+    def entry(self) -> Optional[HloComputation]:
+        for comp in self.computations.values():
+            if comp.is_entry:
+                return comp
+        return None
+
+    @classmethod
+    def from_hlo(cls, text: str, program: str = "") -> "ProgramGraph":
+        graph = cls(program=program)
+        comp: Optional[HloComputation] = None
+        for raw in text.splitlines():
+            stripped = raw.strip()
+            if raw.startswith("HloModule"):
+                header = raw
+                m = re.match(r"HloModule\s+([\w.-]+)", header)
+                graph.module_name = m.group(1) if m else ""
+                graph.is_scheduled = "is_scheduled=true" in header
+                graph.alias_pairs = _parse_alias_pairs(header)
+                continue
+            if not stripped:
+                continue
+            # Computation header: column-0 `%name (params) -> type {` or
+            # `ENTRY %name (...) -> type {` (instructions are indented).
+            if not raw[:1].isspace() and stripped.endswith("{"):
+                m = _COMPUTATION_RE.match(stripped)
+                if m:
+                    comp = HloComputation(
+                        name=m.group(2), is_entry=bool(m.group(1)))
+                    graph.computations[comp.name] = comp
+                continue
+            if stripped == "}":
+                comp = None
+                continue
+            if comp is None:
+                continue
+            instr = _parse_instr(raw, index=len(comp.instrs))
+            if instr is not None:
+                comp.instrs.append(instr)
+                comp._by_name[instr.name] = instr
+        # Resolve operands against same-computation defs (drops refs to
+        # called computations / regions).
+        for comp in graph.computations.values():
+            for instr in comp.instrs:
+                instr.operands = tuple(
+                    n for n in instr.operands if n in comp._by_name
+                    and n != instr.name)
+        return graph
+
+    # ------------------------------------------------------------- summaries
+    def describe(self) -> str:
+        entry = self.entry
+        lines = [
+            f"ProgramGraph({self.program or self.module_name}: "
+            f"{len(self.computations)} computations, "
+            f"scheduled={self.is_scheduled})"]
+        if entry:
+            n_coll = sum(1 for i in entry.instrs if i.is_collective)
+            n_edges = sum(len(i.operands) for i in entry.instrs)
+            lines.append(
+                f"  entry {entry.name}: {len(entry.instrs)} instructions, "
+                f"{n_edges} def-use edges, {n_coll} collectives")
+        return "\n".join(lines)
+
+
+def _parse_alias_pairs(header: str) -> Tuple[Tuple[int, int], ...]:
+    """``input_output_alias={ {1}: (1, {}, must-alias), ... }`` →
+    ((output_index, param_no), ...) — the same pair grammar
+    ``passes.alias_hazards`` checks for size mismatches."""
+    if "input_output_alias=" not in header:
+        return ()
+    blob = header.split("input_output_alias=", 1)[1]
+    pairs = []
+    for m in re.finditer(r"\{([0-9, ]*)\}:\s*\((\d+)", blob):
+        out_ix = [int(x) for x in m.group(1).split(",") if x.strip()]
+        pairs.append((out_ix[0] if out_ix else 0, int(m.group(2))))
+    return tuple(pairs)
+
+
+def _parse_instr(raw: str, index: int) -> Optional[HloInstr]:
+    op_name_m = _OP_NAME_RE.search(raw)
+    line = _METADATA_RE.sub("", raw).strip()
+    m = _DEF_RE.match(line)
+    if not m:
+        return None
+    is_root, name, rhs = bool(m.group(1)), m.group(2), m.group(3)
+    op_m = _OP_TOKEN_RE.search(rhs)
+    if not op_m:
+        return None
+    op = op_m.group(1)
+    results = tuple(
+        (sm.group(1), tuple(int(d) for d in sm.group(2).split(",") if d))
+        for sm in _SHAPE_RE.finditer(rhs[: op_m.start()])
+    )
+    # Data operands live INSIDE the op's argument parens; everything after
+    # the closing paren is attributes — and attributes like
+    # ``control-predecessors={%x}`` (standard in TPU scheduled dumps)
+    # reference same-computation instructions, so the name-resolution
+    # filter below would NOT drop them. Walk to the balanced close.
+    depth, end = 0, len(rhs)
+    for i in range(op_m.end() - 1, len(rhs)):
+        ch = rhs[i]
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                end = i
+                break
+    operands = tuple(_OPERAND_NAME_RE.findall(rhs[op_m.end():end]))
+    groups: Tuple[Tuple[int, ...], ...] = ()
+    gm = _GROUPS_EXPLICIT_RE.search(line)
+    if gm:
+        groups = tuple(
+            tuple(int(x) for x in g.split(",") if x.strip())
+            for g in re.findall(r"\{([0-9, ]*)\}", gm.group(1)))
+    else:
+        im = _GROUPS_IOTA_RE.search(line)
+        if im:
+            dims = tuple(int(x) for x in im.group(3).split(","))
+            perm = (tuple(int(x) for x in im.group(4).split(","))
+                    if im.group(4) else None)
+            groups = _expand_iota_groups(
+                int(im.group(1)), int(im.group(2)), dims, perm)
+    st_pairs: Tuple[Tuple[int, int], ...] = ()
+    sm = _SOURCE_TARGET_RE.search(line)
+    if sm:
+        st_pairs = tuple(
+            (int(a), int(b)) for a, b in _PAIR_RE.findall(sm.group(1)))
+    cm = _CHANNEL_RE.search(line)
+    return HloInstr(
+        name=name,
+        op=op,
+        index=index,
+        results=results,
+        operands=operands,
+        op_name=op_name_m.group(1) if op_name_m else "",
+        channel_id=int(cm.group(1)) if cm else None,
+        replica_groups=groups,
+        source_target_pairs=st_pairs,
+        is_root=is_root,
+        line=line,
+    )

@@ -130,12 +130,94 @@ def collective_sizes(hlo_text: str, ops: Iterable[str] = COLLECTIVE_OPS,
     return sizes
 
 
+# ----------------------------------------------- compiled-program cache
+# Lowering + XLA compile is the dominant cost of EVERY analyzer call; one
+# bench/lint/attrib run used to re-lower the same program up to three
+# times (explain --lint, bench --lint, the attribution capture). The text
+# is cached per (step identity, arg shapes/dtypes): the same step object
+# with the same abstract signature always lowers to the same program, so
+# the cache can never serve a stale dump within a process. Keyed weakly —
+# a released step releases its dumps.
+_COMPILED_CACHE = None  # weakref.WeakKeyDictionary, created lazily
+
+
+def _arg_signature(*trees) -> str:
+    import jax
+
+    parts = []
+    for leaf in jax.tree_util.tree_leaves(trees):
+        dtype = getattr(leaf, "dtype", type(leaf).__name__)
+        shape = getattr(leaf, "shape", ())
+        parts.append(f"{dtype}{tuple(shape)}")
+    return "|".join(parts)
+
+
+def _step_cache(step) -> Optional[Dict]:
+    """The per-step cache dict, or None when the step can't be weakly
+    referenced (caching silently off — correctness never depends on it)."""
+    global _COMPILED_CACHE
+    if _COMPILED_CACHE is None:
+        import weakref
+
+        _COMPILED_CACHE = weakref.WeakKeyDictionary()
+    try:
+        return _COMPILED_CACHE.setdefault(step, {})
+    except TypeError:
+        return None
+
+
+def compiled_artifacts(step, state, batch) -> Tuple[str, float]:
+    """(post-optimization HLO text, compiled temp/peak bytes) of a
+    DistributedTrainStep's single-step program, cached per (step, arg
+    shapes). The temp figure feeds the SLM002 budget; 0.0 when the
+    backend doesn't expose ``memory_analysis``."""
+    cache = _step_cache(step)
+    key = ("step", _arg_signature(state, batch))
+    if cache is not None and key in cache:
+        return cache[key]
+    compiled = step._compile(state, batch).lower(state, batch).compile()
+    text = compiled.as_text()
+    temp = 0.0
+    try:
+        mem = compiled.memory_analysis()
+        temp = float(getattr(mem, "temp_size_in_bytes", 0))
+    except Exception:  # noqa: BLE001 - optional backend API
+        pass
+    if cache is not None:
+        cache[key] = (text, temp)
+    return text, temp
+
+
 def compiled_hlo(step, state, batch) -> str:
     """Post-optimization HLO of a DistributedTrainStep's single-step
-    program — the text every wire pin greps. (StableHLO from
-    ``lower_text`` shows collectives only when they are explicit in the
-    traced program; GSPMD-inserted ones exist only post-compile.)"""
-    return step._compile(state, batch).lower(state, batch).compile().as_text()
+    program — the text every wire pin greps, cached per (step, shapes)
+    (StableHLO from ``lower_text`` shows collectives only when they are
+    explicit in the traced program; GSPMD-inserted ones exist only
+    post-compile.)"""
+    return compiled_artifacts(step, state, batch)[0]
+
+
+def compiled_window(step, state, batch, num_steps: int,
+                    stacked: bool = False):
+    """(compiled window program, its post-optimization HLO text), cached
+    per (step, arg shapes, window) — the one-compile contract the
+    measured-wire attribution rides (``obs/attrib.py``): the SAME compile
+    serves the instruction-name → scope map and the captured execution.
+    Lowered on abstract shapes only; nothing executes here."""
+    import jax
+
+    cache = _step_cache(step)
+    key = ("window", _arg_signature(state, batch), int(num_steps),
+           bool(stacked))
+    if cache is not None and key in cache:
+        return cache[key]
+    fn = step._window_program(state, batch, num_steps, stacked, False)
+    compiled = fn.lower(jax.eval_shape(lambda: state),
+                        jax.eval_shape(lambda: batch)).compile()
+    out = (compiled, compiled.as_text())
+    if cache is not None:
+        cache[key] = out
+    return out
 
 
 def _expand_iota_groups(num_groups: int, group_size: int,

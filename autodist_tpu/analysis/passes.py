@@ -291,6 +291,7 @@ def hbm_budget(
     capacity = float(resource_spec.tpu.hbm_bytes) if resource_spec else 0.0
     usable = capacity * headroom
     n_chips = max(int(resource_spec.num_chips), 1) if resource_spec else 1
+    top_vars = sorted(per_var, key=per_var.get, reverse=True)[:5]
     summary = {
         "state_gb_per_chip": state / 1e9,
         "temp_gb_per_chip": float(temp_bytes) / 1e9,
@@ -298,8 +299,13 @@ def hbm_budget(
         "usable_gb_per_chip": usable / 1e9,
         "headroom": headroom,
         "n_chips": n_chips,
-        "top_vars": sorted(per_var, key=per_var.get, reverse=True)[:5],
+        "top_vars": top_vars,
     }
+    # An overcommit is actionable only if it names the tenants: the top-3
+    # contributing variables (param + slots + grad transient, per-chip)
+    # ride the message so the fix needs no debugger rerun.
+    top3 = ", ".join(
+        f"{name} ({per_var[name] / 1e9:.3f} GB)" for name in top_vars[:3])
     if resource_spec is None:
         return findings, summary
     if state > usable:
@@ -309,7 +315,8 @@ def hbm_budget(
                 f"static state {state / 1e9:.3f} GB/chip overcommits "
                 f"{usable / 1e9:.3f} GB usable "
                 f"({headroom:.0%} headroom of {capacity / 1e9:.2f} GB "
-                f"HBM): OOM at step 1, re-shard or offload"),
+                f"HBM): OOM at step 1, re-shard or offload"
+                + (f" — top contributors: {top3}" if top3 else "")),
             details=summary,
         ))
     elif temp_bytes and state + float(temp_bytes) > usable:
@@ -318,7 +325,8 @@ def hbm_budget(
             message=(
                 f"state {state / 1e9:.3f} GB + compiled temp "
                 f"{float(temp_bytes) / 1e9:.3f} GB/chip overcommits "
-                f"{usable / 1e9:.3f} GB usable"),
+                f"{usable / 1e9:.3f} GB usable"
+                + (f" — top state contributors: {top3}" if top3 else "")),
             details=summary,
         ))
     return findings, summary

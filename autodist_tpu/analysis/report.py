@@ -23,10 +23,12 @@ FINDING_CODES: Dict[str, str] = {
     # static memory budget
     "SLM001": "per-chip state overcommits HBM headroom",
     "SLM002": "state + compiled temp/peak overcommits HBM headroom",
+    "SLM003": "scheduled peak live bytes overcommit HBM though totals fit",
     # deadlock / ordering / consistency hazards
     "SLH001": "replica-group ordering mismatch across rendezvousing programs",
     "SLH002": "donated/aliased buffer size mismatch",
     "SLH003": "degradation drift: plan flags disagree with the shared predicate",
+    "SLH004": "cross-program channel/permute ordering cycle (potential deadlock)",
     # strategy screening (pre-lowering)
     "SLS001": "strategy node cannot lower (screen reject)",
     # measured wire (trace attribution vs the promise — obs/attrib.py;
@@ -34,6 +36,9 @@ FINDING_CODES: Dict[str, str] = {
     "SLT001": "measured collective with no planned counterpart",
     "SLT002": "promised collective never observed in the trace",
     "SLT003": "per-bucket measured overlap below the priced exposure",
+    # schedule passes (analysis/sched.py over the compiled-HLO DAG)
+    "SLO001": "gradsync bucket structurally unable to overlap (serialized)",
+    "SLO002": "scheduled overlap below the priced hidden fraction",
 }
 
 ERROR, WARNING, INFO = "error", "warning", "info"
@@ -151,6 +156,29 @@ def report_to_text(report: AnalysisReport) -> str:
                 + (f"{row['actual_bytes'] / 1e6:8.3f}MB"
                    if row.get("actual_bytes") is not None else f"{'—':>10s}")
             )
+    sched = report.tables.get("sched_overlap")
+    if sched:
+        out.append("")
+        out.append(f"{'bucket':>6s} {'collectives':>11s} {'wire':>10s} "
+                   f"{'window':>10s} {'sched ovl':>9s} {'async':>6s}")
+        out.append("-" * 58)
+        for row in sched:
+            out.append(
+                f"{row['bucket']:6d} {row['n_collectives']:11d} "
+                f"{row['wire_bytes'] / 1e6:8.3f}MB "
+                f"{row['window_compute_bytes'] / 1e6:8.3f}MB "
+                f"{row['scheduled_overlap'] * 100:8.1f}% "
+                f"{'yes' if row['async_pairs'] else 'no':>6s}")
+    smem = report.tables.get("sched_memory")
+    if smem and smem.get("n_buffers"):
+        top = ", ".join(f"{t['name']} ({t['bytes'] / 1e6:.2f}MB)"
+                        for t in smem.get("top_buffers", []))
+        out.append(
+            f"\nscheduled peak: "
+            f"{smem['scheduled_peak_bytes'] / 1e9:.3f} GB/chip live at "
+            f"position {smem.get('peak_position', 0)} of "
+            f"{smem.get('n_instructions', 0)}"
+            + (f" (top: {top})" if top else ""))
     mem = report.tables.get("memory")
     if mem:
         out.append("")

@@ -1,0 +1,608 @@
+"""schedlint: schedule, liveness, and overlap passes over the compiled DAG.
+
+The wire passes (``analysis/passes.py``) prove WHAT a program moves; these
+passes prove WHEN. Post-optimization dumps are emitted in schedule order
+(``is_scheduled=true``), so a :class:`~autodist_tpu.analysis.graph.ProgramGraph`
+carries the executor's issue order — enough to decide, with zero device
+execution, whether the latency hiding the cost model priced is
+*structurally possible* and whether the schedule's transient buffers fit.
+
+Pass families:
+
+- **static overlap** (:func:`overlap_check`) — per gradsync bucket
+  (collectives under a ``gradsync.bucket_{i}`` named scope,
+  ``kernel/bucketing.py``), the compute scheduled inside each collective's
+  overlap window. For a TPU-style async pair the window is the
+  instructions strictly between ``-start`` and ``-done``; for a
+  synchronous spelling (CPU dumps) it is the span from the collective to
+  its first consumer — the slack an async runtime would stretch the wire
+  over. ``SLO001`` (error) fires when a bucket's windows contain NO
+  compute at all (its done is consumed immediately, or only other
+  collectives sit between start and done): the bucket is structurally
+  unable to overlap and the per-bucket machinery is pure overhead.
+  ``SLO002`` (warning) fires — only on programs that actually carry async
+  pairs, i.e. a latency-hiding schedule — when a bucket's scheduled
+  overlap falls below the fraction the cost model priced as hidden
+  (``1 - OVERLAP_EXPOSED_FRACTION``), catching at compile time what
+  SLT003 only catches from a device trace. The per-collective fraction is
+  ``min(1, window compute bytes / wire bytes)`` — a structural
+  bytes-touched proxy, not a time model: 0 is exact (nothing can hide),
+  1 means the schedule provides at least wire-sized compute to hide
+  under.
+- **scheduled liveness** (:func:`liveness_check`) — walk the entry
+  schedule with each buffer born at its producer and dying after its last
+  consumer; parameters are live from program start, module outputs to
+  program end, and ``input_output_alias``/donation pairs are folded (an
+  aliased output writes into its donor parameter's buffer and contributes
+  no new bytes). ``SLM003`` (error) fires when the scheduled peak
+  exceeds the ResourceSpec's HBM × headroom even though SLM001/002's
+  static totals passed — the transient overcommit (gradient + zero-embed
+  double-buffers co-live at a sync boundary) the totals bound cannot see.
+  Fusion-internal temps are invisible to the entry walk, so the peak is a
+  LOWER bound on the true footprint: exceeding it statically is always
+  real.
+- **cross-program channel cycles** (:func:`channel_cycle_hazards`) — the
+  SLH001 rendezvous pass generalized over the DAG for the MPMD world:
+  each program contributes its channel issue order (channel-carrying
+  collectives, including collective-permute send/recv chains) as ordering
+  edges over channel ids; a cycle in the union — two programs ordering a
+  shared pair inconsistently, or a longer loop through three stages —
+  is a potential deadlock no pairwise sequence diff can see (``SLH004``).
+- **schedule screen** (:func:`screen_schedule`) — the pre-lowering,
+  pure-arithmetic projection of SLO001/SLM003 the planner's search runs
+  on every candidate before pricing: a candidate that requests bucketed
+  overlap with zero bucket-eligible variables is structurally serialized
+  (SLO001), and one whose bucket zero-embed transient pushes a fitting
+  static state over the HBM headroom is a scheduled-peak overcommit
+  (SLM003) — both rejected before a single cost-model evaluation.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from autodist_tpu.analysis.graph import HloComputation, HloInstr, ProgramGraph
+from autodist_tpu.analysis.report import ERROR, WARNING, Finding
+
+#: Wire-volume factor per collective kind: all-reduce moves ~2x the
+#: payload of a one-way reshard (reduce+broadcast halves); the others move
+#: ~1x. A structural proxy shared by the overlap fraction's denominator.
+_WIRE_FACTOR = {"all-reduce": 2.0}
+
+_BUCKET_SCOPE_RE = re.compile(r"gradsync\.bucket_(\d+)")
+
+#: Tolerance on the scheduled-overlap fraction before SLO002 fires —
+#: the byte proxy is structural, not a clock.
+OVERLAP_TOLERANCE = 0.10
+
+
+def _bucket_of(instr: HloInstr) -> Optional[int]:
+    m = _BUCKET_SCOPE_RE.search(instr.op_name)
+    return int(m.group(1)) if m else None
+
+
+def _payload_bytes(instr: HloInstr, comp: HloComputation) -> int:
+    """Largest single array a collective touches (result or operand),
+    in bytes — the wire-volume base, mirroring
+    ``Collective.max_payload_elements``."""
+    best = 0
+    for dt, dims in instr.results:
+        n = 1
+        for d in dims:
+            n *= d
+        best = max(best, n * _dtype_b(dt))
+    for name in instr.operands:
+        op = comp.instr(name)
+        if op is not None:
+            best = max(best, op.result_bytes if not op.is_view
+                       else _raw_bytes(op))
+    return best
+
+
+def _dtype_b(dt: str) -> int:
+    from autodist_tpu.analysis.inventory import dtype_bytes
+
+    return dtype_bytes(dt)
+
+
+def _raw_bytes(instr: HloInstr) -> int:
+    total = 0
+    for dt, dims in instr.results:
+        n = 1
+        for d in dims:
+            n *= d
+        total += n * _dtype_b(dt)
+    return total
+
+
+def _compute_weight(instr: HloInstr, comp: HloComputation) -> int:
+    """Bytes-touched proxy for one schedulable compute op: result bytes +
+    resolved operand bytes. Collectives, parameters and views weigh 0 —
+    they are not compute the wire can hide under."""
+    if instr.is_collective or instr.is_parameter or instr.is_view:
+        return 0
+    total = _raw_bytes(instr)
+    for name in instr.operands:
+        op = comp.instr(name)
+        if op is not None:
+            total += _raw_bytes(op)
+    return total
+
+
+# ------------------------------------------------------------------ overlap
+@dataclass
+class BucketOverlap:
+    """Scheduled-overlap summary for one gradsync bucket."""
+
+    bucket: int
+    n_collectives: int = 0
+    wire_bytes: int = 0
+    window_compute_bytes: int = 0
+    #: wire-weighted mean of per-collective min(1, compute/wire).
+    overlap_fraction: float = 0.0
+    async_pairs: bool = False
+
+    def to_json(self) -> Dict:
+        return {
+            "bucket": self.bucket,
+            "n_collectives": self.n_collectives,
+            "wire_bytes": self.wire_bytes,
+            "window_compute_bytes": self.window_compute_bytes,
+            "scheduled_overlap": round(self.overlap_fraction, 4),
+            "async_pairs": self.async_pairs,
+        }
+
+
+def _overlap_window(instr: HloInstr, comp: HloComputation,
+                    ) -> Tuple[int, int, bool]:
+    """(start, end) schedule positions (exclusive bounds) of the span the
+    collective's wire may overlap, and whether it came from an async pair.
+
+    Async pair: strictly between ``-start`` and its ``-done``. Sync
+    spelling: strictly between the collective and its first consumer
+    (end of schedule when unconsumed)."""
+    if instr.is_async_start:
+        done = next((u for u in comp.users(instr.name) if u.is_async_done),
+                    None)
+        if done is not None:
+            return instr.index, done.index, True
+    first = comp.first_use(instr.name)
+    return instr.index, (first if first is not None
+                         else len(comp.instrs)), False
+
+
+def scheduled_overlap(graph: ProgramGraph) -> List[BucketOverlap]:
+    """Per-gradsync-bucket scheduled overlap over the entry schedule.
+
+    Programs without bucket scopes return ``[]`` — unbucketed gradient
+    sync never promised overlap, so there is nothing to judge."""
+    comp = graph.entry
+    if comp is None:
+        return []
+    buckets: Dict[int, BucketOverlap] = {}
+    for instr in comp.instrs:
+        if not instr.is_collective or instr.is_async_done:
+            continue
+        b = _bucket_of(instr)
+        if b is None:
+            continue
+        row = buckets.setdefault(b, BucketOverlap(bucket=b))
+        lo, hi, is_async = _overlap_window(instr, comp)
+        window = sum(_compute_weight(comp.instrs[i], comp)
+                     for i in range(lo + 1, hi))
+        wire = int(_payload_bytes(instr, comp)
+                   * _WIRE_FACTOR.get(instr.collective_kind or "", 1.0))
+        wire = max(wire, 1)
+        row.n_collectives += 1
+        row.wire_bytes += wire
+        row.window_compute_bytes += window
+        row.async_pairs = row.async_pairs or is_async
+        # incremental wire-weighted mean of min(1, compute/wire)
+        frac = min(1.0, window / wire)
+        prev_wire = row.wire_bytes - wire
+        row.overlap_fraction = (
+            (row.overlap_fraction * prev_wire + frac * wire)
+            / row.wire_bytes)
+    return sorted(buckets.values(), key=lambda r: r.bucket)
+
+
+def overlap_check(
+    graph: ProgramGraph,
+    priced_exposed_fraction: Optional[float] = None,
+) -> Tuple[List[Finding], List[Dict]]:
+    """SLO001/SLO002 over one scheduled program; returns
+    ``(findings, per-bucket table)``."""
+    if priced_exposed_fraction is None:
+        from autodist_tpu.strategy.cost_model import OVERLAP_EXPOSED_FRACTION
+
+        priced_exposed_fraction = OVERLAP_EXPOSED_FRACTION
+    findings: List[Finding] = []
+    rows = scheduled_overlap(graph)
+    want_hidden = 1.0 - float(priced_exposed_fraction)
+    for row in rows:
+        if row.window_compute_bytes == 0:
+            findings.append(Finding(
+                code="SLO001", severity=ERROR, pass_name="sched",
+                message=(
+                    f"bucket {row.bucket}: structurally unable to overlap "
+                    f"— {row.n_collectives} collective(s), "
+                    f"{row.wire_bytes} wire bytes, and ZERO compute "
+                    f"scheduled inside any overlap window (done consumed "
+                    f"immediately / only collectives between start and "
+                    f"done); the bucketed emission is pure overhead here"),
+                details=row.to_json(),
+            ))
+        elif row.async_pairs and (
+                row.overlap_fraction + OVERLAP_TOLERANCE < want_hidden):
+            findings.append(Finding(
+                code="SLO002", severity=WARNING, pass_name="sched",
+                message=(
+                    f"bucket {row.bucket}: scheduled overlap "
+                    f"{row.overlap_fraction:.0%} is below the priced "
+                    f"{want_hidden:.0%} hidden fraction — the schedule "
+                    f"cannot deliver the latency hiding the cost model "
+                    f"charged for (the compile-time face of SLT003)"),
+                details=row.to_json(),
+            ))
+    return findings, [r.to_json() for r in rows]
+
+
+# ----------------------------------------------------------------- liveness
+def scheduled_liveness(graph: ProgramGraph) -> Dict:
+    """Walk the entry schedule; return the scheduled peak summary.
+
+    Buffers are born at their producer's position, die after their last
+    consumer; parameters are live from position 0; module outputs (root
+    operands) to the end; donated (``input_output_alias``) outputs write
+    into their parameter's buffer and contribute no new bytes."""
+    comp = graph.entry
+    if comp is None or not comp.instrs:
+        return {"scheduled_peak_bytes": 0, "n_buffers": 0, "top_buffers": []}
+    n = len(comp.instrs)
+    root = comp.root
+    # Producers of aliased outputs: root operand at each aliased output
+    # index reuses its donor parameter's buffer.
+    aliased_producers = set()
+    if root is not None and graph.alias_pairs:
+        for out_ix, _param_no in graph.alias_pairs:
+            if out_ix < len(root.operands):
+                aliased_producers.add(root.operands[out_ix])
+    out_names = set(root.operands) if root is not None else set()
+    # A buffer read through a chain of views (tuple / get-tuple-element /
+    # bitcast) lives until the LAST view use — propagate deaths through
+    # views in reverse schedule order so a view chain cannot shorten its
+    # underlying buffer's life.
+    death: Dict[str, int] = {}
+    for instr in comp.instrs:
+        last = comp.last_use(instr.name)
+        death[instr.name] = last if last is not None else instr.index
+    for instr in reversed(comp.instrs):
+        if instr.is_view:
+            for op_name in instr.operands:
+                death[op_name] = max(death[op_name], death[instr.name])
+    births: List[int] = [0] * (n + 1)   # +bytes at position
+    deaths: List[int] = [0] * (n + 2)   # -bytes after position
+    sized: List[Tuple[str, int, int, int]] = []  # (name, bytes, born, die)
+    for instr in comp.instrs:
+        nbytes = instr.result_bytes
+        if nbytes <= 0 or instr.name in aliased_producers:
+            continue
+        born = 0 if instr.is_parameter else instr.index
+        die = death[instr.name]
+        if instr.name in out_names or instr.is_root or (
+                instr.is_parameter and _is_donor(instr, graph, comp)):
+            die = n
+        births[born] += nbytes
+        deaths[die + 1] += nbytes
+        sized.append((instr.name, nbytes, born, die))
+    live, peak, peak_pos = 0, 0, 0
+    for pos in range(n + 1):
+        live += births[pos] - deaths[pos]
+        if live > peak:
+            peak, peak_pos = live, pos
+    at_peak = sorted(
+        ((name, b) for name, b, born, die in sized
+         if born <= peak_pos <= die),
+        key=lambda x: x[1], reverse=True)
+    return {
+        "scheduled_peak_bytes": int(peak),
+        "peak_position": int(peak_pos),
+        "n_buffers": len(sized),
+        "n_instructions": n,
+        "top_buffers": [
+            {"name": name, "bytes": int(b)} for name, b in at_peak[:3]],
+    }
+
+
+def _is_donor(instr: HloInstr, graph: ProgramGraph,
+              comp: HloComputation) -> bool:
+    """True when this parameter is the donor side of an alias pair (its
+    buffer is rewritten in place and stays resident to the end)."""
+    if not graph.alias_pairs:
+        return False
+    m = re.search(r"parameter\((\d+)\)", instr.line)
+    if not m:
+        return False
+    param_no = int(m.group(1))
+    return any(p == param_no for _o, p in graph.alias_pairs)
+
+
+def liveness_check(
+    graph: ProgramGraph,
+    resource_spec=None,
+    headroom: float = 0.75,
+    static_totals_ok: bool = True,
+) -> Tuple[List[Finding], Dict]:
+    """SLM003 over one scheduled program. ``static_totals_ok`` suppresses
+    the finding when SLM001/SLM002 already reported the overcommit — the
+    scheduled pass exists for the transients the totals bound misses, not
+    to restate a failure the totals already caught."""
+    summary = scheduled_liveness(graph)
+    findings: List[Finding] = []
+    if resource_spec is None:
+        return findings, summary
+    capacity = float(resource_spec.tpu.hbm_bytes)
+    usable = capacity * headroom
+    summary["usable_bytes"] = int(usable)
+    peak = summary["scheduled_peak_bytes"]
+    if static_totals_ok and usable > 0 and peak > usable:
+        top = ", ".join(
+            f"{t['name']} ({t['bytes'] / 1e6:.2f} MB)"
+            for t in summary["top_buffers"])
+        findings.append(Finding(
+            code="SLM003", severity=ERROR, pass_name="sched",
+            message=(
+                f"scheduled peak live bytes {peak / 1e9:.3f} GB/chip "
+                f"overcommit {usable / 1e9:.3f} GB usable "
+                f"({headroom:.0%} of {capacity / 1e9:.2f} GB HBM) even "
+                f"though the static totals fit — transient buffers at "
+                f"schedule position {summary['peak_position']} "
+                f"(top: {top}); re-bucket, remat, or offload"),
+            details=summary,
+        ))
+    return findings, summary
+
+
+# ----------------------------------------------------------- channel cycles
+def channel_cycle_hazards(
+    graphs: Dict[str, ProgramGraph]) -> List[Finding]:
+    """SLH004: cross-program channel/permute ordering cycles.
+
+    Each program's entry schedule contributes its channel issue order
+    (first occurrence per channel id) as directed edges over channel ids;
+    a cycle in the union of those orders means no global issue order can
+    satisfy every program — a potential rendezvous deadlock. Catches the
+    3-stage loop (A: c1<c2, B: c2<c3, C: c3<c1) the pairwise SLH001
+    sequence diff structurally cannot see."""
+    order: Dict[str, List[int]] = {}
+    participants: Dict[int, set] = {}
+    for name, graph in sorted(graphs.items()):
+        comp = graph.entry
+        if comp is None:
+            continue
+        seen: List[int] = []
+        for instr in comp.instrs:
+            if instr.channel_id is None or not (
+                    instr.is_collective or instr.source_target_pairs):
+                continue
+            if instr.is_async_done:
+                continue
+            cid = int(instr.channel_id)
+            if cid not in seen:
+                seen.append(cid)
+            devs = participants.setdefault(cid, set())
+            for g in instr.replica_groups:
+                devs.update(g)
+            for a, b in instr.source_target_pairs:
+                devs.update((a, b))
+        if seen:
+            order[name] = seen
+    # Union digraph over channel ids; remember which program asserts each
+    # edge so the finding can name the disagreeing stages.
+    edges: Dict[int, Dict[int, str]] = {}
+    for prog, seq in order.items():
+        for i, a in enumerate(seq):
+            for b in seq[i + 1:]:
+                edges.setdefault(a, {}).setdefault(b, prog)
+    cycle = _find_cycle(edges)
+    if cycle is None:
+        return []
+    progs = sorted({edges[a][b] for a, b in zip(cycle, cycle[1:])})
+    return [Finding(
+        code="SLH004", severity=ERROR, pass_name="hazard",
+        message=(
+            f"cross-program channel cycle "
+            f"{' -> '.join(str(c) for c in cycle)}: programs "
+            f"{progs} order these channels inconsistently — no global "
+            f"issue order satisfies all of them (potential rendezvous "
+            f"deadlock; the MPMD hazard SLH001's pairwise diff cannot "
+            f"see)"),
+        details={
+            "cycle": list(cycle),
+            "programs": progs,
+            "participants": {
+                str(c): sorted(participants.get(c, ()))
+                for c in cycle},
+        },
+    )]
+
+
+def _find_cycle(edges: Dict[int, Dict[int, str]]) -> Optional[List[int]]:
+    """First cycle in the channel digraph as [c0, c1, ..., c0]; None if
+    acyclic. Recursive white/grey/black DFS — channel counts are tiny."""
+    WHITE, GREY, BLACK = 0, 1, 2
+    color: Dict[int, int] = {}
+
+    def visit(node: int, path: List[int]) -> Optional[List[int]]:
+        color[node] = GREY
+        path.append(node)
+        for nxt in sorted(edges.get(node, ())):
+            c = color.get(nxt, WHITE)
+            if c == GREY:
+                return path[path.index(nxt):] + [nxt]
+            if c == WHITE:
+                found = visit(nxt, path)
+                if found is not None:
+                    return found
+        path.pop()
+        color[node] = BLACK
+        return None
+
+    for start in sorted(edges):
+        if color.get(start, WHITE) == WHITE:
+            found = visit(start, [])
+            if found is not None:
+                return found
+    return None
+
+
+# ------------------------------------------------------------------- screen
+@dataclass
+class ScheduleScreen:
+    """Pure-arithmetic projection of the schedule passes onto an unlowered
+    Strategy — what the planner's search can afford on every candidate."""
+
+    findings: List[Finding] = field(default_factory=list)
+    state_bytes: float = 0.0
+    transient_bytes: float = 0.0
+    n_buckets: int = 0
+    n_eligible: int = 0
+
+
+def screen_schedule(
+    strategy,
+    model_item,
+    resource_spec=None,
+    headroom: float = 0.75,
+) -> List[Finding]:
+    """Pre-lowering SLO001/SLM003 screen (no jax, no lowering, no compile).
+
+    - SLO001: the candidate sets ``bucket_bytes > 0`` but NO variable is
+      bucket-eligible (every gradient rides a PS / sparse / expert /
+      partitioned / compressed wire): the per-bucket custom_vjp machinery
+      is emitted with nothing to overlap — structurally serialized.
+    - SLM003: static state fits the HBM headroom but the bucketed
+      zero-embed transient (each bucketed zero1 gradient co-lives with a
+      full-shape zero-fill buffer at its sync boundary —
+      ``kernel/bucketing.py`` shape note) pushes the scheduled peak over:
+      the overcommit SLM001's totals cannot see.
+    """
+    return _screen_schedule(
+        strategy, model_item, resource_spec, headroom).findings
+
+
+def _screen_schedule(
+    strategy,
+    model_item,
+    resource_spec=None,
+    headroom: float = 0.75,
+) -> ScheduleScreen:
+    import numpy as np
+
+    from autodist_tpu.kernel.bucketing import (
+        assign_buckets,
+        bucket_exclusion_reasons,
+    )
+    from autodist_tpu.strategy.cost_model import OPTIMIZER_SLOT_FACTOR
+    from autodist_tpu.strategy.ir import (
+        AllReduceSynchronizer,
+        PSSynchronizer,
+    )
+
+    out = ScheduleScreen()
+    bucket_bytes = int(getattr(
+        strategy.graph_config, "bucket_bytes", 0) or 0)
+    mesh = resource_spec.mesh_shape(("data", "model")) if resource_spec \
+        else {"data": 1, "model": 1}
+    n_data = max(int(mesh.get("data", 1)), 1)
+    n_model = max(int(mesh.get("model", 1)), 1)
+    slot_factor = OPTIMIZER_SLOT_FACTOR.get(
+        getattr(model_item.optimizer_spec, "name", ""), 2.0)
+
+    eligible: List[Tuple[str, int]] = []
+    bucketed_su: Dict[str, int] = {}
+    state = 0.0
+    for node in strategy.node_config:
+        try:
+            var = model_item.var(node.var_name)
+        except KeyError:
+            continue  # screen_strategy's SLS001 owns unknown vars
+        b = float(int(np.prod(tuple(var.shape) or (1,)))
+                  * np.dtype(var.dtype).itemsize)
+        sync = node.synchronizer
+        try:
+            part_axis = node.active_partition_axis
+        except ValueError:
+            part_axis = None
+        shards = max(int(node.num_shards), 1) if part_axis is not None else 1
+        shard_update = bool(isinstance(sync, AllReduceSynchronizer)
+                            and sync.shard_update)
+        contrib = b / shards
+        if var.trainable:
+            contrib += slot_factor * b / (n_data if shard_update else shards)
+            contrib += b  # full-gradient transient (the SLM001 accounting)
+        state += contrib
+        if not var.trainable:
+            continue
+        reasons = bucket_exclusion_reasons(
+            var.shape,
+            trainable=var.trainable,
+            is_ps=isinstance(sync, PSSynchronizer),
+            sparse_update=var.sparse_update,
+            expert=var.expert,
+            part_axis=part_axis,
+            compressor=getattr(sync, "compressor", "") or "NoneCompressor",
+            n_data=n_data, n_model=n_model,
+        )
+        if not reasons:
+            eligible.append((node.var_name, int(b)))
+            if shard_update:
+                bucketed_su[node.var_name] = int(b)
+    out.state_bytes = state
+    out.n_eligible = len(eligible)
+
+    if bucket_bytes > 0:
+        buckets = assign_buckets(eligible, bucket_bytes)
+        out.n_buckets = len(buckets)
+        if not eligible:
+            out.findings.append(Finding(
+                code="SLO001", severity=ERROR, pass_name="sched",
+                message=(
+                    f"candidate requests bucketed overlap "
+                    f"(bucket_bytes={bucket_bytes}) but NO variable is "
+                    f"bucket-eligible — every gradient rides a "
+                    f"PS/sparse/expert/partitioned/compressed wire, so "
+                    f"the bucket machinery is structurally unable to "
+                    f"overlap anything"),
+                details={"bucket_bytes": bucket_bytes, "n_eligible": 0},
+            ))
+        else:
+            sizes = dict(eligible)
+            # Zero-embed transient: each bucketed shard_update gradient
+            # co-lives with its full-shape zero-fill buffer at the
+            # bucket's sync boundary — the largest bucket bounds the
+            # simultaneous extra bytes.
+            out.transient_bytes = max(
+                (sum(bucketed_su.get(nm, 0) for nm in bucket)
+                 for bucket in buckets), default=0.0)
+    if resource_spec is not None and out.transient_bytes > 0:
+        usable = float(resource_spec.tpu.hbm_bytes) * headroom
+        if usable > 0 and state <= usable < state + out.transient_bytes:
+            out.findings.append(Finding(
+                code="SLM003", severity=ERROR, pass_name="sched",
+                message=(
+                    f"scheduled-peak estimate {state / 1e6:.3f} MB state "
+                    f"+ {out.transient_bytes / 1e6:.3f} MB bucket "
+                    f"zero-embed transient overcommits "
+                    f"{usable / 1e6:.3f} MB usable even though the static "
+                    f"state alone fits — shrink bucket_bytes or drop the "
+                    f"bucketed rendering for this topology"),
+                details={
+                    "state_bytes": state,
+                    "transient_bytes": out.transient_bytes,
+                    "usable_bytes": usable,
+                    "n_buckets": out.n_buckets,
+                },
+            ))
+    return out

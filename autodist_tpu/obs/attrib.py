@@ -878,13 +878,13 @@ def windowed_hlo(step, state, batch, num_steps: int,
                  stacked: bool = False) -> str:
     """Post-optimization HLO text of the SAME window program a capture
     runs — the text whose instruction names the trace events carry.
-    Shapes only (eval_shape): nothing executes, donated buffers untouched."""
-    import jax
+    Shapes only (eval_shape): nothing executes, donated buffers untouched.
+    Served from the analysis package's compiled-program cache
+    (``analysis/inventory.py::compiled_window``) so an ``--attrib`` +
+    ``--lint`` run lowers the window program once."""
+    from autodist_tpu.analysis import compiled_window
 
-    fn = step._window_program(state, batch, num_steps, stacked, False)
-    state_shapes = jax.eval_shape(lambda: state)
-    batch_shapes = jax.eval_shape(lambda: batch)
-    return fn.lower(state_shapes, batch_shapes).compile().as_text()
+    return compiled_window(step, state, batch, num_steps, stacked)[1]
 
 
 def attribute(step, state, batch, num_steps: int = 4,
@@ -907,12 +907,10 @@ def attribute(step, state, batch, num_steps: int = 4,
     import jax
     import numpy as np
 
+    from autodist_tpu.analysis import compiled_window
     from autodist_tpu.utils import tracing
 
-    fn = step._window_program(state, batch, num_steps, stacked, False)
-    compiled = fn.lower(jax.eval_shape(lambda: state),
-                        jax.eval_shape(lambda: batch)).compile()
-    hlo = compiled.as_text()
+    compiled, hlo = compiled_window(step, state, batch, num_steps, stacked)
 
     def barrier(metrics):
         loss = metrics.get("loss") if isinstance(metrics, dict) else None

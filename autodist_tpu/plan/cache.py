@@ -120,9 +120,14 @@ def dryrun_lowers(strategy: Strategy, model_item: ModelItem,
     compiled = StrategyCompiler(model_item).compile(candidate)
     mesh = build_mesh(resource_spec)
     plan = GraphTransformer(compiled, model_item, mesh).transform()
+    # model_item joins the call so the schedule screen (sched.py: a cached
+    # winner whose bucketing is structurally serialized — SLO001 — or
+    # whose bucket transient overcommits — SLM003) evicts too: a plan
+    # with a schedule finding is never trusted.
     report = analyze_plan(
         plan, strategy=compiled, resource_spec=resource_spec,
-        optimizer=model_item.optimizer_spec.name, program="plan-cache")
+        optimizer=model_item.optimizer_spec.name, program="plan-cache",
+        model_item=model_item)
     if not report.ok:
         raise AnalysisError(report)
     return True

@@ -311,7 +311,7 @@ class PlanSearch:
                 for s in iter_synchronizers(node)
             )
 
-        from autodist_tpu.analysis import screen_strategy
+        from autodist_tpu.analysis import screen_schedule, screen_strategy
 
         built: Dict[str, Strategy] = {}
         genomes: Dict[str, Genome] = {}
@@ -328,8 +328,14 @@ class PlanSearch:
             # candidate that cannot lower (bad part tables, over-sharded
             # axes, async PS) must never enter the pool — pricing it would
             # let an unlowerable plan win the search and fail at build.
-            findings = [f for f in screen_strategy(
-                strategy, self.model_item, self.spec)
+            # The schedule screen (SLO001 degenerate bucketing / SLM003
+            # bucket-transient overcommit, sched.py) rejects for the same
+            # reason: a candidate whose overlap is structurally impossible
+            # or whose scheduled peak cannot fit must never be priced as
+            # if its wire were hidden.
+            findings = [f for f in (
+                screen_strategy(strategy, self.model_item, self.spec)
+                + screen_schedule(strategy, self.model_item, self.spec))
                 if f.severity == "error"]
             if findings:
                 self._screen_rejected[name] = [f.code for f in findings]
@@ -394,6 +400,20 @@ class PlanSearch:
         cost = self.cost_model.strategy_cost(strategy)
         return _objective(cost, self.calibration), cost
 
+    def _screen_genome(self, genome: Genome) -> List[str]:
+        """Schedule-screen a mutated child pre-pricing (sched.py): a
+        genome whose bucketing is structurally serialized (SLO001) or
+        whose bucket transient overcommits (SLM003) never enters the
+        pool. Genome-rendered strategies are well-formed by construction,
+        so the SLS001 lowering screen is skipped here."""
+        from autodist_tpu.analysis import screen_schedule
+
+        strategy = genome_to_strategy(genome, self.model_item, self.spec)
+        return sorted({
+            f.code for f in screen_schedule(
+                strategy, self.model_item, self.spec)
+            if f.severity == "error"})
+
     # ------------------------------------------------------------------- run
     def run(self) -> SearchResult:
         cfg = self.config
@@ -436,11 +456,20 @@ class PlanSearch:
             "best_predicted_s": scored[beam[0]][1].total_s,
             "visited": len(scored) + len(slate_scored),
         }]
+        screened_bad: set = set()
         for gen in range(1, cfg.generations + 1):
             for parent in list(beam):
                 for _ in range(cfg.mutations_per_survivor):
                     child = self._mutate(parent)
-                    if child in scored:
+                    if child in scored or child in screened_bad:
+                        continue
+                    codes = self._screen_genome(child)
+                    if codes:
+                        screened_bad.add(child)
+                        merged = self._screen_rejected.setdefault(
+                            "mutations", [])
+                        self._screen_rejected["mutations"] = sorted(
+                            set(merged) | set(codes))
                         continue
                     scored[child] = self._score(child)
                     origin.setdefault(

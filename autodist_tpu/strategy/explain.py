@@ -257,12 +257,15 @@ def lint(
     out=None,
 ) -> int:
     """``--lint``: lower + compile the (model × builder × cluster) step on
-    this process's devices and run the static analyzer (shardlint,
-    ``autodist_tpu.analysis``) over the compiled program — findings table
-    plus the per-variable planned-vs-actual wire bytes. Falls back to the
-    plan-only passes (degradation drift + HBM budget, no wire conformance)
-    when the runtime doesn't have the spec's device count, since wire
-    conformance needs the real compiled program.
+    this process's devices and run the static analyzer (shardlint +
+    schedlint, ``autodist_tpu.analysis``) over the compiled program —
+    findings table, the per-variable planned-vs-actual wire bytes, the
+    per-bucket SCHEDULED overlap column (next to what pricing assumed and
+    what a trace measures — docs/analysis.md § schedule passes), and the
+    scheduled-liveness peak. Falls back to the plan-only passes
+    (degradation drift + HBM budget + schedule screen, no wire/schedule
+    conformance) when the runtime doesn't have the spec's device count,
+    since those need the real compiled program.
 
     Returns a process exit code: 0 clean, 1 when any error-severity
     finding survives (CI-friendly)."""
@@ -303,7 +306,7 @@ def lint(
         report = analyze_plan(
             plan, strategy=strategy, resource_spec=None,
             optimizer=model_item.optimizer_spec.name,
-            program=f"{builder_name} (plan-only)")
+            program=f"{builder_name} (plan-only)", model_item=model_item)
     else:
         mesh = build_mesh(resource_spec)
         plan = GraphTransformer(strategy, model_item, mesh).transform()
@@ -318,20 +321,17 @@ def lint(
         step = DistributedTrainStep(plan, model_spec.loss_fn, optimizer)
         params = model_spec.init(jax.random.PRNGKey(0))
         state = step.init(params)
-        # ONE compile serves both the HLO text and the memory analysis —
-        # the XLA compile is the dominant cost of lint.
-        compiled = step._compile(state, batch).lower(state, batch).compile()
-        hlo = compiled.as_text()
-        temp = 0.0
-        try:
-            mem = compiled.memory_analysis()
-            temp = float(getattr(mem, "temp_size_in_bytes", 0))
-        except Exception:  # noqa: BLE001 - optional backend API
-            pass
+        # ONE compile serves the HLO text, the memory analysis AND any
+        # later analyzer call in this process — compiled_artifacts caches
+        # per (step, shapes), and the XLA compile is the dominant cost of
+        # lint (analysis/inventory.py).
+        from autodist_tpu.analysis import compiled_artifacts
+
+        hlo, temp = compiled_artifacts(step, state, batch)
         report = analyze_program(
             plan, hlo, strategy=strategy, resource_spec=resource_spec,
             optimizer=model_item.optimizer_spec.name, batch=batch,
-            temp_bytes=temp, program=builder_name)
+            temp_bytes=temp, program=builder_name, model_item=model_item)
     print(report_to_text(report), file=out)
     return 0 if report.ok else 1
 

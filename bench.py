@@ -391,10 +391,24 @@ def measure_workload(model_name: str, on_accel: bool,
                 step.plan, compiled_hlo(step, state, batch),
                 resource_spec=ad.resource_spec, batch=batch,
                 program=f"bench:{model_name}")
+            # Schedule-pass codes ride their own field so the static
+            # OOM / no-overlap verdict survives an rc=124 wedge exactly
+            # like the wire codes do: the verdict prints BEFORE any timed
+            # window is attempted.
+            sched_codes = sorted(
+                {c for c in rep.codes()
+                 if c.startswith("SLO") or c in ("SLM003", "SLH004")})
+            verdict = []
+            if "SLM003" in sched_codes:
+                verdict.append("static-oom")
+            if "SLO001" in sched_codes:
+                verdict.append("no-overlap")
             lint_info.update({
                 "lint_findings": len(rep.findings),
                 "lint_errors": len(rep.errors),
                 "lint_codes": sorted(set(rep.codes())),
+                "lint_sched_codes": sched_codes,
+                "lint_sched_verdict": "+".join(verdict) or "ok",
             })
         except Exception as e:  # noqa: BLE001 - lint must never eat a bench
             lint_info.update({"lint_findings": -1,

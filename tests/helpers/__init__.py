@@ -15,6 +15,8 @@ from autodist_tpu.analysis.inventory import (  # noqa: F401 - re-exports
     CollectiveInventory,
     assert_hlo_wire,
     collective_sizes,
+    compiled_artifacts,
     compiled_hlo,
+    compiled_window,
     hlo_contains,
 )

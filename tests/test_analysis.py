@@ -24,10 +24,17 @@ from helpers import collective_sizes, compiled_hlo
 from autodist_tpu.analysis import (
     AnalysisError,
     CollectiveInventory,
+    ProgramGraph,
     alias_hazards,
     analyze_plan,
     analyze_program,
+    channel_cycle_hazards,
+    liveness_check,
+    overlap_check,
     rendezvous_hazards,
+    scheduled_liveness,
+    scheduled_overlap,
+    screen_schedule,
     screen_strategy,
 )
 from autodist_tpu.analysis.report import FINDING_CODES, Finding
@@ -291,8 +298,9 @@ class TestSeededDefects:
         # Codes are append-only API: a Finding with an unknown code or
         # severity must be unconstructable.
         assert set(FINDING_CODES) >= {
-            "SLW001", "SLW002", "SLW003", "SLM001", "SLM002",
-            "SLH001", "SLH002", "SLH003", "SLS001"}
+            "SLW001", "SLW002", "SLW003", "SLM001", "SLM002", "SLM003",
+            "SLH001", "SLH002", "SLH003", "SLH004", "SLS001",
+            "SLO001", "SLO002"}
         with pytest.raises(ValueError):
             Finding(code="SLX999", severity="error", message="x")
         with pytest.raises(ValueError):
@@ -405,6 +413,362 @@ class TestCacheAnalyzerValidation:
         assert dryrun_lowers(strategy, item, _spec()) is True
 
 
+# ------------------------------------------------- schedlint: golden fixture
+def _golden_module():
+    """Load tools/make_golden_hlo.py as a module (tools/ is not a
+    package) — the golden contract constants live next to the generator."""
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "make_golden_hlo.py")
+    spec = importlib.util.spec_from_file_location("make_golden_hlo", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def golden_graph():
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "data",
+                        "golden_sched.hlo")
+    with open(path, "r", encoding="utf-8") as f:
+        return ProgramGraph.from_hlo(f.read(), program="golden")
+
+
+class TestGoldenSchedule:
+    """The checked-in golden post-opt HLO (tests/data/golden_sched.hlo,
+    regenerated by tools/make_golden_hlo.py) pins the DAG parse shape,
+    the overlap interval math, and the liveness peak to exact numbers —
+    the schedlint sibling of the golden-xplane contract."""
+
+    def test_fixture_matches_generator(self, golden_graph):
+        # The checked-in file IS the generator's output — regeneration is
+        # a no-op until someone changes the contract on both sides.
+        import os
+
+        mod = _golden_module()
+        path = os.path.join(os.path.dirname(__file__), "data",
+                            "golden_sched.hlo")
+        with open(path, "r", encoding="utf-8") as f:
+            assert f.read() == mod.GOLDEN
+
+    def test_dag_parse_shape(self, golden_graph):
+        mod = _golden_module()
+        entry = golden_graph.entry
+        assert golden_graph.is_scheduled
+        assert entry is not None
+        assert len(entry.instrs) == mod.N_INSTRUCTIONS
+        assert sum(len(i.operands) for i in entry.instrs) == mod.N_EDGES
+        assert sum(1 for i in entry.instrs if i.is_collective) == 3
+        assert golden_graph.alias_pairs == ((0, 0),)
+        # def-use edges resolve to instructions, never to called
+        # computations (to_apply=%add is dropped).
+        assert all(entry.instr(n) is not None
+                   for i in entry.instrs for n in i.operands)
+
+    def test_overlap_interval_math(self, golden_graph):
+        mod = _golden_module()
+        rows = {r.bucket: r for r in scheduled_overlap(golden_graph)}
+        assert set(rows) == set(mod.BUCKET_OVERLAPS)
+        # bucket 0: async start/done pair, window holds 2 compute ops
+        # worth 6x the wire -> fully hidden.
+        assert rows[0].async_pairs is True
+        assert rows[0].overlap_fraction == mod.BUCKET_OVERLAPS[0]
+        assert rows[0].window_compute_bytes == 6 * rows[0].wire_bytes
+        # bucket 1: sync spelling, window holds exactly a quarter of the
+        # wire bytes -> 0.25, pinned exactly.
+        assert rows[1].async_pairs is False
+        assert rows[1].overlap_fraction == mod.BUCKET_OVERLAPS[1]
+        findings, table = overlap_check(golden_graph,
+                                        priced_exposed_fraction=0.25)
+        assert findings == []  # 0.25 sync bucket: SLO002 is async-gated
+        assert [r["bucket"] for r in table] == [0, 1]
+
+    def test_control_predecessors_are_not_data_operands(self):
+        # TPU scheduled dumps carry control-predecessors={%x} attributes
+        # whose names RESOLVE in the same computation — they must not
+        # become def-use edges, or a tiny op in an overlap window would
+        # count its control dependency's full buffer as compute and a
+        # liveness interval would stretch past the real last use.
+        text = (
+            "HloModule m, is_scheduled=true\n\n"
+            "ENTRY %main (p0: f32[64,64]) -> f32[8] {\n"
+            "  %big = f32[64,64]{1,0} parameter(0)\n"
+            "  %tiny = f32[8]{0} iota(), iota_dimension=0, "
+            "control-predecessors={%big}\n"
+            "  ROOT %out = f32[8]{0} negate(f32[8]{0} %tiny)\n"
+            "}\n")
+        entry = ProgramGraph.from_hlo(text).entry
+        assert entry.instr("tiny").operands == ()
+        assert entry.instr("out").operands == ("tiny",)
+
+    def test_liveness_peak_exact(self, golden_graph):
+        mod = _golden_module()
+        summary = scheduled_liveness(golden_graph)
+        assert summary["scheduled_peak_bytes"] == mod.PEAK_BYTES
+        assert summary["peak_position"] == mod.PEAK_POSITION
+        # donation fold: the aliased output (out.0 -> p0) contributes no
+        # new bytes, so every at-peak top buffer is a 256 KiB tenant.
+        assert all(t["bytes"] == 256 * 1024
+                   for t in summary["top_buffers"])
+
+
+# ---------------------------------------------------- schedlint: seeded defects
+def _sched_hlo(body, alias=""):
+    alias_attr = f", input_output_alias={alias}" if alias else ""
+    return (f"HloModule m, is_scheduled=true{alias_attr}\n\n"
+            f"ENTRY %main (p0: f32[64,64]) -> f32[8,64] {{\n"
+            f"{body}"
+            f"}}\n")
+
+
+_BUCKET_META = ('metadata={op_name="jit(_step)/transpose(jvp('
+                'gradsync.bucket_0))/reduce_scatter"}')
+
+
+class TestScheduleDefects:
+    def test_serialized_bucket_is_slo001(self):
+        text = _sched_hlo(
+            "  %p0 = f32[64,64]{1,0} parameter(0)\n"
+            "  %rs = f32[8,64]{1,0} reduce-scatter(f32[64,64]{1,0} %p0), "
+            "channel_id=1, replica_groups={{0,1,2,3,4,5,6,7}}, "
+            "dimensions={0}, " + _BUCKET_META + "\n"
+            "  ROOT %out = f32[8,64]{1,0} copy(f32[8,64]{1,0} %rs)\n")
+        findings, _ = overlap_check(ProgramGraph.from_hlo(text))
+        assert [f.code for f in findings] == ["SLO001"]
+        assert "structurally unable to overlap" in findings[0].message
+
+    def test_only_collectives_in_window_is_still_slo001(self):
+        # A monolithic post-backward sync: the ops between a collective
+        # and its consumer are OTHER collectives — no compute hides wire.
+        text = _sched_hlo(
+            "  %p0 = f32[64,64]{1,0} parameter(0)\n"
+            "  %rs = f32[8,64]{1,0} reduce-scatter(f32[64,64]{1,0} %p0), "
+            "channel_id=1, replica_groups={{0,1,2,3,4,5,6,7}}, "
+            "dimensions={0}, " + _BUCKET_META + "\n"
+            "  %ar = f32[64,64]{1,0} all-reduce(f32[64,64]{1,0} %p0), "
+            "channel_id=2, replica_groups={{0,1,2,3,4,5,6,7}}, "
+            "to_apply=%add\n"
+            "  ROOT %out = f32[8,64]{1,0} copy(f32[8,64]{1,0} %rs)\n")
+        findings, _ = overlap_check(ProgramGraph.from_hlo(text))
+        assert [f.code for f in findings] == ["SLO001"]
+
+    def test_starved_async_window_is_slo002(self):
+        # An async pair whose window holds a sliver of compute: the
+        # schedule is latency-hiding-shaped but cannot deliver the priced
+        # hidden fraction -> warning, not error.
+        text = _sched_hlo(
+            "  %p0 = f32[64,64]{1,0} parameter(0)\n"
+            "  %seed = f32[8]{0} iota(), iota_dimension=0\n"
+            "  %rss = f32[8,64]{1,0} reduce-scatter-start("
+            "f32[64,64]{1,0} %p0), channel_id=1, "
+            "replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}, "
+            + _BUCKET_META + "\n"
+            "  %tiny = f32[8]{0} negate(f32[8]{0} %seed)\n"
+            "  %rsd = f32[8,64]{1,0} reduce-scatter-done("
+            "f32[8,64]{1,0} %rss), " + _BUCKET_META + "\n"
+            "  ROOT %out = f32[8,64]{1,0} copy(f32[8,64]{1,0} %rsd)\n")
+        findings, table = overlap_check(
+            ProgramGraph.from_hlo(text), priced_exposed_fraction=0.25)
+        assert [f.code for f in findings] == ["SLO002"]
+        assert findings[0].severity == "warning"
+        assert table[0]["async_pairs"] is True
+        assert 0 < table[0]["scheduled_overlap"] < 0.65
+
+    def test_scheduled_overcommit_is_slm003(self):
+        text = (
+            "HloModule m, is_scheduled=true\n\n"
+            "ENTRY %main (p0: f32[512,512]) -> f32[512,512] {\n"
+            "  %p0 = f32[512,512]{1,0} parameter(0)\n"
+            "  %g1 = f32[512,512]{1,0} multiply(f32[512,512]{1,0} %p0, "
+            "f32[512,512]{1,0} %p0)\n"
+            "  %g2 = f32[512,512]{1,0} add(f32[512,512]{1,0} %g1, "
+            "f32[512,512]{1,0} %p0)\n"
+            "  ROOT %out = f32[512,512]{1,0} add(f32[512,512]{1,0} %g1, "
+            "f32[512,512]{1,0} %g2)\n"
+            "}\n")
+        graph = ProgramGraph.from_hlo(text)
+        tiny = _spec(tpu={"hbm_gb": 1e-5})
+        findings, summary = liveness_check(graph, resource_spec=tiny)
+        assert [f.code for f in findings] == ["SLM003"]
+        assert summary["scheduled_peak_bytes"] == 3 * 512 * 512 * 4
+        assert "re-bucket, remat, or offload" in findings[0].message
+        # suppressed when the static totals already failed: SLM001/002
+        # own that report, SLM003 exists for what they cannot see.
+        suppressed, _ = liveness_check(
+            graph, resource_spec=tiny, static_totals_ok=False)
+        assert suppressed == []
+        # and a sane capacity is clean
+        ok, _ = liveness_check(graph, resource_spec=_spec())
+        assert ok == []
+
+    def test_channel_cycle_is_slh004(self):
+        def prog(label, c1, c2):
+            return ProgramGraph.from_hlo(
+                "HloModule " + label + ", is_scheduled=true\n\n"
+                "ENTRY %main (p0: f32[64]) -> f32[64] {\n"
+                "  %p0 = f32[64]{0} parameter(0)\n"
+                f"  %a = f32[64]{{0}} all-reduce(f32[64]{{0}} %p0), "
+                f"channel_id={c1}, replica_groups={{{{0,1}}}}, "
+                f"to_apply=%add\n"
+                f"  ROOT %b = f32[64]{{0}} all-reduce(f32[64]{{0}} %a), "
+                f"channel_id={c2}, replica_groups={{{{0,1}}}}, "
+                f"to_apply=%add\n"
+                "}\n", label)
+
+        # 3-stage loop: pairwise-consistent, globally cyclic — the case
+        # SLH001's pairwise sequence diff structurally cannot see.
+        findings = channel_cycle_hazards({
+            "s0": prog("s0", 1, 2), "s1": prog("s1", 2, 3),
+            "s2": prog("s2", 3, 1)})
+        assert [f.code for f in findings] == ["SLH004"]
+        assert "channel cycle" in findings[0].message
+        assert findings[0].details["cycle"][0] == \
+            findings[0].details["cycle"][-1]
+        # consistent global order: clean
+        assert channel_cycle_hazards({
+            "s0": prog("s0", 1, 2), "s1": prog("s1", 2, 3),
+            "s2": prog("s2", 1, 3)}) == []
+
+    def test_permute_chain_cycle_is_slh004(self):
+        # collective-permute send/recv chains carry channel ids too; two
+        # stages permuting to each other in opposite channel order
+        # deadlock the same way.
+        def prog(label, c1, c2):
+            return ProgramGraph.from_hlo(
+                "HloModule " + label + ", is_scheduled=true\n\n"
+                "ENTRY %main (p0: f32[64]) -> f32[64] {\n"
+                "  %p0 = f32[64]{0} parameter(0)\n"
+                f"  %a = f32[64]{{0}} collective-permute(f32[64]{{0}} "
+                f"%p0), channel_id={c1}, "
+                f"source_target_pairs={{{{0,1}}}}\n"
+                f"  ROOT %b = f32[64]{{0}} collective-permute("
+                f"f32[64]{{0}} %a), channel_id={c2}, "
+                f"source_target_pairs={{{{1,0}}}}\n"
+                "}\n", label)
+
+        findings = channel_cycle_hazards(
+            {"s0": prog("s0", 1, 2), "s1": prog("s1", 2, 1)})
+        assert [f.code for f in findings] == ["SLH004"]
+        assert findings[0].details["participants"]
+
+
+# -------------------------------------------- schedlint: screen + consumers
+class TestScheduleScreen:
+    def _degenerate(self, item, spec):
+        from autodist_tpu.strategy.base import reduction_devices
+
+        dest = reduction_devices(spec)[0]
+        s = Strategy(id=Strategy.new_id(spec.fingerprint()))
+        s.graph_config.bucket_bytes = 1 << 20
+        for var in item.trainable_variables:
+            s.node_config.append(NodeConfig(
+                var_name=var.name,
+                synchronizer=PSSynchronizer(reduction_destination=dest)))
+        return s
+
+    def test_degenerate_bucketing_is_slo001(self, zero1_setup):
+        _plan, _s, item, *_ = zero1_setup
+        findings = screen_schedule(self._degenerate(item, _spec()),
+                                   item, _spec())
+        assert [f.code for f in findings] == ["SLO001"]
+        assert "no variable is bucket-eligible" in \
+            findings[0].message.lower()
+
+    def test_bucket_transient_is_slm003(self, zero1_setup):
+        from autodist_tpu.analysis.sched import _screen_schedule
+
+        _plan, strategy, item, *_ = zero1_setup
+        import copy
+
+        bucketed = copy.deepcopy(strategy)
+        bucketed.graph_config.bucket_bytes = 4096
+        est = _screen_schedule(bucketed, item, _spec())
+        assert est.transient_bytes > 0 and est.n_buckets >= 2
+        # capacity between state and state + transient: totals fit, the
+        # scheduled peak does not.
+        cap_gb = (est.state_bytes + est.transient_bytes / 2) / 0.75 / 1e9
+        between = _spec(tpu={"hbm_gb": cap_gb})
+        codes = [f.code for f in screen_schedule(bucketed, item, between)]
+        assert codes == ["SLM003"]
+        # the same spec through analyze_plan's model_item path
+        plan = GraphTransformer(
+            bucketed, item, build_mesh(_spec())).transform()
+        report = analyze_plan(plan, strategy=bucketed,
+                              resource_spec=between, optimizer="adam",
+                              model_item=item)
+        assert "SLM003" in report.codes(), report.render()
+        # and an unbucketed plan on the same capacity stays clean
+        assert screen_schedule(strategy, item, between) == []
+
+    def test_search_screen_rejects_schedule_defect(
+            self, zero1_setup, monkeypatch):
+        import importlib
+
+        search_mod = importlib.import_module("autodist_tpu.plan.search")
+        _plan, _s, item, *_ = zero1_setup
+        degenerate = self._degenerate(item, _spec())
+
+        class BadSeed:
+            def build(self, mi, rs):
+                import copy
+
+                return copy.deepcopy(degenerate)
+
+        real_slate = search_mod.candidate_slate
+        monkeypatch.setattr(
+            search_mod, "candidate_slate",
+            lambda *a, **kw: real_slate(*a, **kw)
+            + [("DegenerateBucketed", BadSeed())])
+        result = search_mod.PlanSearch(
+            item, _spec(),
+            search_mod.SearchConfig(generations=1)).run()
+        rejected = result.provenance.get("screen_rejected", {})
+        assert rejected.get("DegenerateBucketed") == ["SLO001"]
+        assert "DegenerateBucketed" not in result.provenance["seeds"]
+
+    def test_cache_evicts_schedule_finding(self, zero1_setup, tmp_path):
+        _plan, _s, item, *_ = zero1_setup
+        from autodist_tpu.plan.cache import PlanCache
+
+        cache = PlanCache(cache_dir=str(tmp_path / "cache"), validate=True)
+        cache.put(item, _spec(), self._degenerate(item, _spec()))
+        import io
+
+        buf = io.StringIO()
+        handler = pylogging.StreamHandler(buf)
+        logger = pylogging.getLogger("autodist_tpu")
+        logger.addHandler(handler)
+        try:
+            entry = cache.get(item, _spec())
+        finally:
+            logger.removeHandler(handler)
+        assert entry is None
+        assert cache.stats["invalidated"] == 1
+        assert "SLO001" in buf.getvalue()
+
+
+class TestCompiledHloCache:
+    def test_second_call_never_recompiles(self, zero1_setup):
+        # satellite contract: one (step, shapes) pair compiles once per
+        # process — the second analyzer call is served from the cache.
+        _plan, _s, _i, step, state, batch, *_ = zero1_setup
+        first = compiled_hlo(step, state, batch)
+        original = step._compile
+
+        def boom(*a, **kw):
+            raise AssertionError("compiled-HLO cache missed: re-lowering")
+
+        step._compile = boom
+        try:
+            assert compiled_hlo(step, state, batch) == first
+        finally:
+            step._compile = original
+
+
 # ----------------------------------------------------------------- selftest
 def test_selftest_cli():
     """The fast-lane wiring of ``python -m autodist_tpu.analysis
@@ -418,3 +782,13 @@ def test_selftest_cli():
     assert line["ok"] is True
     assert line["n_families_clean"] >= 9
     assert line["seeded_defects"]["hbm_overcommit"] == ["SLM001"]
+    # schedlint claims: family #12's compiled schedule shows >= 2 buckets
+    # with overlap > 0, the seeded schedule defects trip their codes, the
+    # search screen-rejected the degenerate seed pre-pricing, and a cache
+    # entry with a schedule finding was evicted loudly.
+    assert line["sched_buckets_overlapped"] >= 2
+    assert line["seeded_defects"]["serialized_bucket"] == ["SLO001"]
+    assert line["seeded_defects"]["scheduled_overcommit"] == ["SLM003"]
+    assert line["seeded_defects"]["channel_cycle"] == ["SLH004"]
+    assert line["seeded_defects"]["search_screen_sched"] == ["SLO001"]
+    assert line["cache_eviction_sched_finding"] is True

@@ -325,7 +325,7 @@ class TestComputeDtype:
         state = step.init(params)
         # Stored parameters and optimizer state remain full precision.
         assert state.params["w"].dtype == jnp.float32
-        hlo = step._compile(state, batch).lower(state, batch).as_text()
+        hlo = step.lower_text(state, batch)
         assert "bf16" in hlo, "no bf16 operand reached the lowered program"
         state, metrics = step(state, batch)
         assert state.params["w"].dtype == jnp.float32  # update ran in f32

@@ -12,6 +12,8 @@ import numpy as np
 import optax
 import pytest
 
+from helpers import compiled_hlo
+
 from autodist_tpu.kernel import DistributedTrainStep, GraphTransformer, build_mesh
 from autodist_tpu.kernel.compressor import (
     HorovodCompressor,
@@ -204,7 +206,7 @@ def test_powersgd_collective_payloads_match_wire_factor():
             StrategyCompiler(mi).compile(strategy), mi, mesh).transform()
         step = DistributedTrainStep(plan, mat_loss, optax.sgd(0.1))
         state = step.init(params)
-        hlo = step._compile(state, batch).lower(state, batch).compile().as_text()
+        hlo = compiled_hlo(step, state, batch)
         return _collective_sizes(hlo)
 
     dense = m * k
@@ -704,7 +706,7 @@ def test_topk_collective_payloads_match_wire_factor():
         StrategyCompiler(mi).compile(strategy), mi, mesh).transform()
     step = DistributedTrainStep(plan, mat_loss, optax.sgd(0.1))
     state = step.init(params)
-    hlo = step._compile(state, batch).lower(state, batch).compile().as_text()
+    hlo = compiled_hlo(step, state, batch)
     sizes = _collective_sizes(hlo)
     assert sizes, "expected collectives in the compressed step"
     dense = m * k
@@ -737,7 +739,7 @@ def test_none_alias_is_a_true_noop():
         step = DistributedTrainStep(plan, loss_fn, optax.sgd(0.1))
         state = step.init(params)
         batch = batch0()
-        hlo = step._compile(state, batch).lower(state, batch).compile().as_text()
+        hlo = compiled_hlo(step, state, batch)
         cost = CostModel(mi, spec).strategy_cost(strategy)
         return _collective_sizes(hlo), cost.total_s
 

@@ -12,6 +12,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+from helpers import compiled_hlo
+
 from autodist_tpu.kernel.lowering import DistributedTrainStep, GraphTransformer
 from autodist_tpu.kernel.mesh import build_mesh
 from autodist_tpu.model_item import ModelItem, OptimizerSpec
@@ -47,7 +49,7 @@ def _compiled_hlo(chunk_size):
     plan = GraphTransformer(strategy, mi, build_mesh(rs)).transform()
     step = DistributedTrainStep(plan, _loss, opt.make())
     state = step.init(params)
-    return step._compile(state, batch).lower(state, batch).compile().as_text()
+    return compiled_hlo(step, state, batch)
 
 
 @pytest.mark.parametrize("chunk_size", [4, 128])

@@ -13,6 +13,7 @@ import pytest
 
 from helpers import COLLECTIVE_OPS as _COLLECTIVES  # noqa: F401 - re-export
 from helpers import collective_sizes as _collective_sizes
+from helpers import compiled_hlo
 
 from autodist_tpu.kernel.lowering import DistributedTrainStep, GraphTransformer
 from autodist_tpu.kernel.mesh import build_mesh
@@ -67,7 +68,7 @@ def test_no_table_sized_collective(builder):
     table_plan = plan.plan_for("embedding")
     # The table must actually be row-sharded for the wire claim to hold.
     assert table_plan.pspec[0] is not None, table_plan
-    hlo = step._compile(state, batch).lower(state, batch).compile().as_text()
+    hlo = compiled_hlo(step, state, batch)
     sizes = _collective_sizes(hlo)
     assert sizes, "expected gradient-sync collectives in the compiled step"
     # Every collective payload must be far below the table size: sync wire
@@ -97,6 +98,6 @@ def test_replicated_table_would_psum_full_table():
         "w": jax.random.normal(k, (EDIM, 1)),
     }
     state2 = step2.init(params)
-    hlo = step2._compile(state2, batch).lower(state2, batch).compile().as_text()
+    hlo = compiled_hlo(step2, state2, batch)
     sizes = _collective_sizes(hlo)
     assert sizes and max(sizes) >= TABLE_ELEMS

@@ -56,6 +56,20 @@ exits 1 listing ``file:line`` offenders. Rules:
    scheduler needs no exemption — it paces itself on ``Event.wait``
    deadlines, which the rule never matches.
 
+7. **ONE HLO parser home** — calling ``.as_text()`` on a lowered/compiled
+   program anywhere in ``autodist_tpu/``, ``tests/``, ``examples/``,
+   ``bench.py`` or ``__graft_entry__.py`` outside
+   ``autodist_tpu/analysis/`` is banned (same single-reader policy as
+   rules 4–6): ``analysis/inventory.py`` and ``analysis/graph.py`` are
+   the ONE place HLO text is produced and parsed, and the compiled-text
+   cache there is what keeps ``--lint``/``--attrib``/plan-cache
+   validation from re-lowering the same program three times per run. Get
+   text via ``analysis.compiled_hlo / compiled_artifacts /
+   compiled_window`` (or ``step.lower_text`` for the StableHLO debug
+   surface). Exempt: ``utils/tracing.py`` (the HLO dump-file writer — it
+   writes artifacts, never parses them) and ``kernel/lowering.py`` (the
+   ``lower_text`` debug surface itself).
+
 Pure stdlib, no third-party deps — runs anywhere Python runs.
 """
 from __future__ import annotations
@@ -80,6 +94,8 @@ XPLANE_RE = re.compile(r"\bxplane_pb2\b|xplane\.pb\b")
 # utils/retry.py (passing `time.sleep` as a callable default is fine; the
 # rule targets call sites).
 TIME_SLEEP_RE = re.compile(r"\btime\.sleep\s*\(")
+# Rule 7: HLO text production/parsing outside the analysis parser home.
+AS_TEXT_RE = re.compile(r"\.as_text\s*\(")
 
 
 def _py_files(*roots):
@@ -187,6 +203,28 @@ def main() -> int:
                         f"go through autodist_tpu/utils/retry.py "
                         f"(retry_call/Backoff/wait_until, the ONE "
                         f"jittered-backoff home; docs/chaos.md)")
+
+    as_text_exempt = {
+        # The dump-file writer (writes debug artifacts, parses nothing)
+        # and the lower_text StableHLO debug surface itself.
+        os.path.join("autodist_tpu", "utils", "tracing.py"),
+        os.path.join("autodist_tpu", "kernel", "lowering.py"),
+    }
+    for rel in _py_files("autodist_tpu", "tests", "examples", "bench.py",
+                         "__graft_entry__.py"):
+        if rel in as_text_exempt or rel.startswith(
+                os.path.join("autodist_tpu", "analysis") + os.sep):
+            continue
+        with open(os.path.join(REPO, rel), "r", encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                code = line.split("#", 1)[0]
+                if AS_TEXT_RE.search(code):
+                    errors.append(
+                        f"{rel}:{i}: .as_text() HLO text outside "
+                        f"autodist_tpu/analysis/ — go through "
+                        f"analysis.compiled_hlo/compiled_artifacts/"
+                        f"compiled_window (the ONE parser home with the "
+                        f"compiled-text cache; docs/analysis.md)")
 
     if errors:
         print("banned-pattern lint FAILED:", file=sys.stderr)

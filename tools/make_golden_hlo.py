@@ -1,0 +1,77 @@
+#!/usr/bin/env python3
+"""Regenerate the checked-in golden post-optimization HLO for
+tests/test_analysis.py (the schedlint pins).
+
+Builds a tiny SYNTHETIC scheduled module — the ``tools/`` sibling of
+``make_golden_xplane.py`` — that mimics the post-optimization layout a
+real ``compiled.as_text()`` dump carries: ``is_scheduled=true``, an
+``input_output_alias`` donation pair, a TPU-style async collective pair
+(``reduce-scatter-start``/``-done``) under a ``gradsync.bucket_0`` scope
+with two compute ops scheduled inside the window (overlap 1.0), a
+synchronous ``reduce-scatter`` under ``gradsync.bucket_1`` whose window
+holds exactly a quarter of its wire bytes (overlap 0.25), and buffers
+whose scheduled-liveness peak is an exact, hand-computable byte count.
+
+The numbers are the golden contract ``tests/test_analysis.py`` asserts —
+change them here and there together. Run from the repo root::
+
+    python tools/make_golden_hlo.py
+"""
+from __future__ import annotations
+
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "tests", "data", "golden_sched.hlo")
+
+#: The golden contract (mirrored in tests/test_analysis.py):
+#: - 14 entry instructions, 20 def-use edges, 3 collectives;
+#: - bucket 0: async pair, window = 2 compute ops, overlap 1.0;
+#: - bucket 1: sync rs, window = 1 small op (64 KiB touched vs 256 KiB
+#:   wire), overlap 0.25;
+#: - scheduled liveness peak = 4 x 256 KiB + 32 KiB = 1_081_344 bytes at
+#:   position 4 (p0 + p1 + dot.1 + grad.0 + rs-start.0).
+N_INSTRUCTIONS = 14
+N_EDGES = 20
+PEAK_BYTES = 4 * 256 * 1024 + 32 * 1024
+PEAK_POSITION = 4
+BUCKET_OVERLAPS = {0: 1.0, 1: 0.25}
+
+_SCOPE = "jit(_step)/jit(main)/transpose(jvp(gradsync.bucket_{b}))/{op}"
+
+
+def _meta(bucket: int, op: str) -> str:
+    return ('metadata={op_name="'
+            + _SCOPE.format(b=bucket, op=op) + '"}')
+
+
+GOLDEN = f"""HloModule golden_sched, is_scheduled=true, input_output_alias={{ {{0}}: (0, {{}}, must-alias) }}, entry_computation_layout={{(f32[256,256]{{1,0}}, f32[256,256]{{1,0}})->(f32[256,256]{{1,0}}, f32[32,256]{{1,0}})}}
+
+ENTRY %main.1 (p0: f32[256,256], p1: f32[256,256]) -> (f32[256,256], f32[32,256]) {{
+  %p0 = f32[256,256]{{1,0}} parameter(0), metadata={{op_name="state.params['w0']"}}
+  %p1 = f32[256,256]{{1,0}} parameter(1), metadata={{op_name="state.params['w1']"}}
+  %dot.1 = f32[256,256]{{1,0}} dot(f32[256,256]{{1,0}} %p0, f32[256,256]{{1,0}} %p1), lhs_contracting_dims={{1}}, rhs_contracting_dims={{0}}
+  %grad.0 = f32[256,256]{{1,0}} multiply(f32[256,256]{{1,0}} %dot.1, f32[256,256]{{1,0}} %p1), {_meta(0, 'div')}
+  %rs-start.0 = f32[32,256]{{1,0}} reduce-scatter-start(f32[256,256]{{1,0}} %grad.0), channel_id=1, replica_groups={{{{0,1,2,3,4,5,6,7}}}}, use_global_device_ids=true, dimensions={{0}}, to_apply=%add, {_meta(0, 'reduce_scatter')}
+  %bwd.0 = f32[256,256]{{1,0}} add(f32[256,256]{{1,0}} %dot.1, f32[256,256]{{1,0}} %p1)
+  %bwd.1 = f32[256,256]{{1,0}} multiply(f32[256,256]{{1,0}} %bwd.0, f32[256,256]{{1,0}} %p0)
+  %rs-done.0 = f32[32,256]{{1,0}} reduce-scatter-done(f32[32,256]{{1,0}} %rs-start.0), {_meta(0, 'reduce_scatter')}
+  %grad.1 = f32[256,256]{{1,0}} add(f32[256,256]{{1,0}} %bwd.1, f32[256,256]{{1,0}} %p1), {_meta(1, 'div')}
+  %rs.1 = f32[32,256]{{1,0}} reduce-scatter(f32[256,256]{{1,0}} %grad.1), channel_id=2, replica_groups={{{{0,1,2,3,4,5,6,7}}}}, use_global_device_ids=true, dimensions={{0}}, to_apply=%add, {_meta(1, 'reduce_scatter')}
+  %small = f32[32,256]{{1,0}} negate(f32[32,256]{{1,0}} %rs-done.0)
+  %upd.1 = f32[32,256]{{1,0}} add(f32[32,256]{{1,0}} %rs.1, f32[32,256]{{1,0}} %small)
+  %out.0 = f32[256,256]{{1,0}} add(f32[256,256]{{1,0}} %p0, f32[256,256]{{1,0}} %bwd.1)
+  ROOT %t = (f32[256,256]{{1,0}}, f32[32,256]{{1,0}}) tuple(f32[256,256]{{1,0}} %out.0, f32[32,256]{{1,0}} %upd.1)
+}}
+"""
+
+
+def main() -> None:
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w", encoding="utf-8") as fh:
+        fh.write(GOLDEN)
+    print(f"golden scheduled HLO -> {OUT}")
+
+
+if __name__ == "__main__":
+    main()
