@@ -87,12 +87,15 @@ def analyze_plan(
     optimizer: str = "",
     headroom: float = DEFAULT_HEADROOM,
     temp_bytes: float = 0.0,
+    serve_pool_bytes: float = 0.0,
     program: str = "",
     model_item=None,
 ) -> AnalysisReport:
     """Static passes over a lowered :class:`ShardingPlan` (no program text
     needed): degradation drift vs the shared predicate, and — when a
-    ``resource_spec`` is given — the per-chip HBM budget. With
+    ``resource_spec`` is given — the per-chip HBM budget
+    (``serve_pool_bytes`` accounts a serving engine's static KV page pool
+    as a named tenant, ``InferenceEngine.page_pool_bytes`` per chip). With
     ``model_item`` (and ``strategy``), the pure-arithmetic schedule screen
     (``sched.screen_schedule``: degenerate bucketing SLO001, bucket
     zero-embed transient SLM003) joins in. This is the validation the
@@ -101,7 +104,8 @@ def analyze_plan(
     report.extend(degradation_check(plan, strategy))
     mem_findings, mem_summary = hbm_budget(
         plan, resource_spec=resource_spec, optimizer=optimizer,
-        headroom=headroom, temp_bytes=temp_bytes)
+        headroom=headroom, temp_bytes=temp_bytes,
+        serve_pool_bytes=serve_pool_bytes)
     report.extend(mem_findings)
     report.tables["memory"] = mem_summary
     if strategy is not None and model_item is not None:
@@ -119,6 +123,7 @@ def analyze_program(
     optimizer: str = "",
     headroom: float = DEFAULT_HEADROOM,
     temp_bytes: float = 0.0,
+    serve_pool_bytes: float = 0.0,
     batch=None,
     batch_elements: Optional[int] = None,
     program: str = "",
@@ -134,6 +139,7 @@ def analyze_program(
     report = analyze_plan(
         plan, strategy=strategy, resource_spec=resource_spec,
         optimizer=optimizer, headroom=headroom, temp_bytes=temp_bytes,
+        serve_pool_bytes=serve_pool_bytes,
         program=program, model_item=model_item)
     if batch_elements is None and batch is not None:
         batch_elements = batch_element_count(batch)

@@ -249,6 +249,7 @@ def hbm_budget(
     optimizer: str = "",
     headroom: float = DEFAULT_HEADROOM,
     temp_bytes: float = 0.0,
+    serve_pool_bytes: float = 0.0,
 ) -> Tuple[List[Finding], Dict]:
     """Static per-chip HBM budget from the lowered plan.
 
@@ -257,7 +258,12 @@ def hbm_budget(
     ``_weight_update_spec`` accounting the cost model prices) + one
     full-gradient transient per trainable var; ``temp_bytes`` adds the
     compiled program's own temp/peak figure when the caller has one
-    (``DistributedTrainStep.window_cost``). Host-offloaded vars live in
+    (``DistributedTrainStep.window_cost``). ``serve_pool_bytes`` adds a
+    serving engine's static KV page pool (per-chip bytes —
+    ``InferenceEngine.page_pool_bytes`` over the data degree), so a
+    serving plan's resident state is accounted by the same SLM passes as
+    a training plan's: the pool is a named tenant (``serve.page_pool``)
+    that can head the overcommit blame line. Host-offloaded vars live in
     pinned host memory and are excluded from the HBM sum.
     """
     from autodist_tpu.strategy.cost_model import OPTIMIZER_SLOT_FACTOR
@@ -288,6 +294,9 @@ def hbm_budget(
             contrib += b  # transient full-gradient buffer
         state += contrib
         per_var[name] = contrib
+    if serve_pool_bytes:
+        state += float(serve_pool_bytes)
+        per_var["serve.page_pool"] = float(serve_pool_bytes)
     capacity = float(resource_spec.tpu.hbm_bytes) if resource_spec else 0.0
     usable = capacity * headroom
     n_chips = max(int(resource_spec.num_chips), 1) if resource_spec else 1
@@ -295,6 +304,7 @@ def hbm_budget(
     summary = {
         "state_gb_per_chip": state / 1e9,
         "temp_gb_per_chip": float(temp_bytes) / 1e9,
+        "serve_pool_gb_per_chip": float(serve_pool_bytes) / 1e9,
         "capacity_gb_per_chip": capacity / 1e9,
         "usable_gb_per_chip": usable / 1e9,
         "headroom": headroom,

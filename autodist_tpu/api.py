@@ -488,8 +488,10 @@ class AutoDist:
         decode_model=None,
         checkpoint: Optional[str] = None,
         n_slots: int = 8,
-        bucket_lens: Optional[Sequence[int]] = None,
         max_len: Optional[int] = None,
+        page_len: int = 16,
+        n_pages: Optional[int] = None,
+        prefill_chunk: Optional[int] = None,
     ):
         """Compile a sharded *inference* engine over this AutoDist's mesh —
         the serving counterpart of :meth:`build` (same capture → strategy →
@@ -499,12 +501,17 @@ class AutoDist:
         ``apply_fn(params, batch)`` enables one-shot inference
         (:meth:`~autodist_tpu.serve.InferenceEngine.infer`); ``decode_model``
         (e.g. ``autodist_tpu.models.transformer.decode_model(cfg)``) enables
-        autoregressive KV-cache decode behind the continuous batcher.
-        ``checkpoint`` restores parameters from a ``checkpoint/saver.py``
-        checkpoint directly into the plan's shardings (partial parallel
-        reads — no host ever holds the full logical arrays). The strategy
-        comes from this AutoDist's builder with the usual chief-builds/
-        workers-receive handoff, so a fleet serves one consistent plan.
+        autoregressive paged KV-cache decode behind the continuous batcher:
+        ``n_slots`` decode rows over a fixed pool of ``page_len``-token KV
+        pages (``n_pages`` overrides the pool size; the default funds it
+        from this AutoDist's ResourceSpec HBM headroom), with prompts
+        prefilled in ``prefill_chunk``-token chunks (default: one page)
+        interleaved with decode. ``checkpoint`` restores parameters from a
+        ``checkpoint/saver.py`` checkpoint directly into the plan's
+        shardings (partial parallel reads — no host ever holds the full
+        logical arrays). The strategy comes from this AutoDist's builder
+        with the usual chief-builds/workers-receive handoff, so a fleet
+        serves one consistent plan.
         """
         from autodist_tpu.serve.engine import InferenceEngine
 
@@ -517,7 +524,9 @@ class AutoDist:
             params = InferenceEngine.restore_params(checkpoint, params, plan)
         engine = InferenceEngine(
             params, plan, apply_fn=apply_fn, decode_model=decode_model,
-            n_slots=n_slots, bucket_lens=bucket_lens, max_len=max_len,
+            n_slots=n_slots, page_len=page_len, n_pages=n_pages,
+            prefill_chunk=prefill_chunk, max_len=max_len,
+            resource_spec=self.resource_spec,
         )
         self._strategy, self._model_item = compiled, model_item
         return engine

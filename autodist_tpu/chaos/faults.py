@@ -103,6 +103,15 @@ CATALOG: Dict[str, FaultSpec] = {s.kind: s for s in (
         "queued work completes once the window closes; overflow is shed "
         "at the edge, nothing hangs"),
     FaultSpec(
+        "page_exhaustion", hooks.SEAM_SERVE_PAGES,
+        "report the KV page pool exhausted to every allocation while the "
+        "window is open (a burst past pool capacity)",
+        "admissions defer typed (requests stay QUEUED); queue overflow "
+        "sheds typed REJECTED + shed flight events (doctor timeline shows "
+        "the pressure window)",
+        "pages recycle when the window closes: queued work completes, "
+        "overflow was shed at the edge — no hang, no OOM"),
+    FaultSpec(
         "engine_death", hooks.SEAM_SERVE_STEP,
         "raise EngineDeadError from the decode step mid-batch",
         "every in-flight/queued request finished typed REJECTED with an "
@@ -287,6 +296,17 @@ def make_handlers(plant) -> Dict[str, Callable]:
             return None
 
         handlers[hooks.SEAM_SERVE_ADMIT] = serve_admit
+
+    if hooks.SEAM_SERVE_PAGES in seams:
+        def serve_pages(**_):
+            for e in events(hooks.SEAM_SERVE_PAGES):
+                if e.fault == "page_exhaustion":
+                    plant.record_once(("page_exhaustion", e.at_step),
+                                      "page_exhaustion",
+                                      detail="pool reported exhausted")
+                    return "exhaust"
+
+        handlers[hooks.SEAM_SERVE_PAGES] = serve_pages
 
     if hooks.SEAM_SERVE_STEP in seams:
         def serve_step(**_):

@@ -71,10 +71,12 @@ def replay_requests(path: str, batcher) -> List:
 
     - a corrupt/unreadable file is renamed aside (``.corrupt``) and
       skipped, not raised;
-    - an entry the restarted server can never run (``ValueError`` — e.g.
-      an elastic resize shrank the decode buckets below the prompt) is
-      dropped with a warning, since re-persisting it would wedge every
-      future restart on the same entry;
+    - an entry the restarted server can never run (a typed terminal
+      ``REJECTED`` from ``submit`` — e.g. an elastic resize shrank the
+      engine's ``max_len`` ceiling below the prompt — or a ``ValueError``
+      on a malformed entry) is dropped with a warning, since
+      re-persisting it would wedge every future restart on the same
+      entry;
     - :class:`~autodist_tpu.serve.batcher.Backpressure` (replaying more
       entries than the new queue admits) stops the replay and atomically
       RE-PERSISTS the not-yet-submitted remainder, so already-submitted
@@ -100,9 +102,18 @@ def replay_requests(path: str, batcher) -> List:
     remainder: List[dict] = []
     for i, e in enumerate(entries):
         try:
-            reqs.append(batcher.submit(
+            req = batcher.submit(
                 e["prompt"], max_new_tokens=e["max_new_tokens"],
-                timeout_s=e.get("timeout_s")))
+                timeout_s=e.get("timeout_s"))
+            if req.unservable:
+                # Typed unservable (e.g. over the restarted engine's
+                # max_len ceiling): dropping it is the only move that
+                # cannot wedge every future restart on the same entry.
+                logging.warning(
+                    "dropping unservable persisted entry %r (%s)",
+                    e, req.error)
+                continue
+            reqs.append(req)
         except Backpressure:
             remainder = entries[i:]
             logging.warning(

@@ -304,10 +304,169 @@ def forward_decode_step(params, tokens, positions, cache, cfg: TransformerConfig
     return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32), cache
 
 
+# --------------------------------------------------------- paged KV decode
+def init_paged_kv_cache(cfg: TransformerConfig, n_pages: int, page_len: int,
+                        dtype: Any = None) -> Dict[str, Any]:
+    """Paged decode cache: ONE pool of fixed-size KV pages shared by every
+    concurrent request — ``[num_layers, n_pages, page_len, heads,
+    head_dim]`` per projection. Which pages hold which request's timeline
+    is the engine's page tables (``serve/pages.py``); the arrays here are
+    donated through the two compiled serving programs and rewritten in
+    place, so steady-state serving allocates nothing and slot utilization
+    no longer depends on guessing a length distribution (the vLLM
+    rendering of GSPMD's static-annotation premise, docs/serving.md).
+    """
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, n_pages, page_len, cfg.num_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _paged_gather(cache_layer, page_tables):
+    """Gather one layer's KV timeline(s) by page index.
+
+    ``cache_layer [n_pages, page_len, H, D]``; ``page_tables`` is ``[P]``
+    (one request) or ``[B, P]`` (the decode batch). Returns the gathered
+    timeline ``[..., P * page_len, H, D]``. Pad entries point at the
+    scratch page — finite garbage the caller's position mask excludes.
+    """
+    page_len, h, d = cache_layer.shape[1:]
+    gathered = cache_layer[page_tables]          # [..., P, page_len, H, D]
+    return gathered.reshape(
+        page_tables.shape[:-1] + (page_tables.shape[-1] * page_len, h, d))
+
+
+def forward_paged_prefill_chunk(params, tokens, start, length, cache,
+                                page_table, cfg: TransformerConfig):
+    """One chunk of a paged prefill: the SINGLE compiled prefill program.
+
+    ``tokens [1, C]`` are prompt positions ``[start, start + C)`` (padded
+    past ``length``); each layer writes the chunk's k/v through
+    ``page_table [P]`` and its queries attend causally over the gathered
+    timeline — previously prefilled chunks included, so any prompt length
+    runs as ``ceil(len / C)`` invocations of this one program, interleaved
+    with decode steps by the batcher.
+
+    Pad positions (``>= length``) write garbage into the request's own
+    FUTURE timeline slots (decode overwrites each before its position
+    enters any mask) or, past the table's real pages, into the reserved
+    scratch page — never into another request's pages. The engine
+    guarantees ``start + C <= max_len`` (``max_len`` is rounded to a
+    multiple of the chunk), so ``pos // page_len`` never leaves the table.
+
+    Returns ``(next_token [1], cache)``; the token is the argmax at
+    position ``length - 1``, meaningful only on the chunk containing it
+    (the host uses the final chunk's value — prefill emits the first
+    generated token, exactly like the unpaged prefill).
+    """
+    b, c = tokens.shape
+    page_len = cache["k"].shape[2]
+    timeline = page_table.shape[0] * page_len
+    pos = start + jnp.arange(c)                                   # [C] absolute
+    page_of = page_table[pos // page_len]                         # [C]
+    off = pos % page_len
+    # Clamp the positional-embedding lookup only: pad positions may sit past
+    # the table (their k/v land in scratch) but must still embed in-range.
+    emb_pos = jnp.minimum(pos, cfg.max_seq_len - 1)
+    x = L.embedding_lookup(params["embed"], tokens).astype(cfg.dtype)
+    x = x + L.embedding_lookup(params["pos_embed"], emb_pos).astype(cfg.dtype)
+    mask = jnp.arange(timeline)[None, :] <= pos[:, None]          # [C, T]
+    for i in range(cfg.num_layers):
+        block_params = params[f"layers_{i}"]
+        h = L.layernorm(block_params["ln1"], x)
+        attn_p = block_params["attn"]
+        q = L.dense(attn_p["wq"], h, compute_dtype=cfg.dtype)
+        k = L.dense(attn_p["wk"], h, compute_dtype=cfg.dtype)
+        v = L.dense(attn_p["wv"], h, compute_dtype=cfg.dtype)
+        q = q.reshape(c, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(c, cfg.num_heads, cfg.head_dim)
+        v = v.reshape(c, cfg.num_heads, cfg.head_dim)
+        cache_dtype = cache["k"].dtype
+        cache["k"] = cache["k"].at[i, page_of, off].set(k.astype(cache_dtype))
+        cache["v"] = cache["v"].at[i, page_of, off].set(v.astype(cache_dtype))
+        ck = _paged_gather(cache["k"][i], page_table).astype(cfg.dtype)
+        cv = _paged_gather(cache["v"][i], page_table).astype(cfg.dtype)
+        logits = jnp.einsum("chd,thd->hct", q, ck).astype(jnp.float32)
+        logits = logits / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
+        logits = jnp.where(mask[None, :, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        o = jnp.einsum("hct,thd->chd", probs, cv).reshape(b, c, cfg.d_model)
+        x = x + L.dense(attn_p["wo"], o, compute_dtype=cfg.dtype)
+        h = L.layernorm(block_params["ln2"], x)
+        h = L.dense(block_params["mlp"]["fc1"], h, compute_dtype=cfg.dtype)
+        h = jax.nn.gelu(h)
+        h = L.dense(block_params["mlp"]["fc2"], h, compute_dtype=cfg.dtype)
+        x = x + h
+    x = L.layernorm(params["ln_f"], x)
+    frontier = jnp.clip(length - 1 - start, 0, c - 1)
+    last = x[jnp.arange(b), frontier]                             # [1, D]
+    logits = (last.astype(cfg.dtype)
+              @ params["embed"]["embedding"].T.astype(cfg.dtype))
+    return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32), cache
+
+
+def forward_paged_decode_step(params, tokens, positions, cache, page_tables,
+                              cfg: TransformerConfig):
+    """One incremental decode step over every decode row: the SINGLE
+    compiled decode program for all active requests.
+
+    ``tokens [B]`` / ``positions [B]`` as in :func:`forward_decode_step`;
+    ``page_tables [B, P]`` maps each row's timeline onto pool pages (idle
+    rows carry all-scratch tables and compute finite garbage the engine
+    ignores). Each layer scatters the token's k/v through the row's table
+    and attends over the gathered timeline under ``j <= positions[b]`` —
+    the paged rendering of the stacked-cache step, so one program serves
+    any mix of request lengths.
+
+    Returns ``(next_token [B] int32, cache)``.
+    """
+    b = tokens.shape[0]
+    page_len = cache["k"].shape[2]
+    timeline = page_tables.shape[1] * page_len
+    rows = jnp.arange(b)
+    page_of = page_tables[rows, positions // page_len]            # [B]
+    off = positions % page_len
+    emb_pos = jnp.minimum(positions, cfg.max_seq_len - 1)
+    x = L.embedding_lookup(params["embed"], tokens).astype(cfg.dtype)
+    x = x + L.embedding_lookup(params["pos_embed"], emb_pos).astype(cfg.dtype)
+    mask = jnp.arange(timeline)[None, :] <= positions[:, None]    # [B, T]
+    for i in range(cfg.num_layers):
+        block_params = params[f"layers_{i}"]
+        h = L.layernorm(block_params["ln1"], x)
+        attn_p = block_params["attn"]
+        q = L.dense(attn_p["wq"], h, compute_dtype=cfg.dtype)
+        k = L.dense(attn_p["wk"], h, compute_dtype=cfg.dtype)
+        v = L.dense(attn_p["wv"], h, compute_dtype=cfg.dtype)
+        q = q.reshape(b, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, cfg.num_heads, cfg.head_dim)
+        v = v.reshape(b, cfg.num_heads, cfg.head_dim)
+        cache_dtype = cache["k"].dtype
+        cache["k"] = cache["k"].at[i, page_of, off].set(k.astype(cache_dtype))
+        cache["v"] = cache["v"].at[i, page_of, off].set(v.astype(cache_dtype))
+        ck = _paged_gather(cache["k"][i], page_tables).astype(cfg.dtype)
+        cv = _paged_gather(cache["v"][i], page_tables).astype(cfg.dtype)
+        logits = jnp.einsum("bhd,bthd->bht", q, ck).astype(jnp.float32)
+        logits = logits / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
+        logits = jnp.where(mask[:, None, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        o = jnp.einsum("bht,bthd->bhd", probs, cv).reshape(b, cfg.d_model)
+        x = x + L.dense(attn_p["wo"], o, compute_dtype=cfg.dtype)
+        h = L.layernorm(block_params["ln2"], x)
+        h = L.dense(block_params["mlp"]["fc1"], h, compute_dtype=cfg.dtype)
+        h = jax.nn.gelu(h)
+        h = L.dense(block_params["mlp"]["fc2"], h, compute_dtype=cfg.dtype)
+        x = x + h
+    x = L.layernorm(params["ln_f"], x)
+    logits = (x.astype(cfg.dtype)
+              @ params["embed"]["embedding"].T.astype(cfg.dtype))
+    return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32), cache
+
+
 def decode_model(cfg: TransformerConfig, eos_id: Optional[int] = None):
     """The transformer's serving adapter — the pure cache functions bound to
     one config, in the shape :class:`autodist_tpu.serve.InferenceEngine`
-    consumes (see serve/engine.py DecodeModel)."""
+    consumes (see serve/engine.py DecodeModel). Carries BOTH cache
+    renderings: the paged functions the production engine compiles, and
+    the stacked bucketed ones the legacy baseline/oracle engine keeps."""
     from autodist_tpu.serve.engine import DecodeModel
 
     return DecodeModel(
@@ -316,6 +475,14 @@ def decode_model(cfg: TransformerConfig, eos_id: Optional[int] = None):
             params, tokens, length, cache, slot, cfg),
         decode_step=lambda params, tokens, positions, cache: forward_decode_step(
             params, tokens, positions, cache, cfg),
+        init_paged_cache=lambda n_pages, page_len: init_paged_kv_cache(
+            cfg, n_pages, page_len),
+        prefill_chunk=lambda params, tokens, start, length, cache, table:
+            forward_paged_prefill_chunk(
+                params, tokens, start, length, cache, table, cfg),
+        decode_paged=lambda params, tokens, positions, cache, tables:
+            forward_paged_decode_step(
+                params, tokens, positions, cache, tables, cfg),
         eos_id=eos_id,
         max_len=cfg.max_seq_len,
     )

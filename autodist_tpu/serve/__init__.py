@@ -1,4 +1,4 @@
-"""Inference serving (L5b): sharded engine + continuous batching front end.
+"""Inference serving (L5b): sharded paged engine + continuous batching.
 
 The training half of the framework ends at a compiled
 :class:`~autodist_tpu.kernel.DistributedTrainStep`; this package opens the
@@ -10,14 +10,23 @@ across requests of ragged lengths, and a thin asyncio front end exposes it.
 
 Layers:
 
+- :mod:`autodist_tpu.serve.pages` — the ONE page-table/pool allocator home
+  (fixed-size KV pages, all-or-nothing reservation, scratch-page padding).
 - :mod:`autodist_tpu.serve.engine` — :class:`InferenceEngine`: params
   restored from a checkpoint into plan shardings, a jitted one-shot apply,
-  and a preallocated length-bucketed KV-cache decode loop (slots × buckets).
+  and a paged KV-cache decode loop — exactly TWO compiled serving programs
+  (one decode over all slot rows + one fixed-size prefill chunk) for any
+  request-length mix. :class:`BucketedInferenceEngine` keeps the previous
+  length-bucketed design as the selftest's equal-HBM baseline.
 - :mod:`autodist_tpu.serve.batcher` — :class:`ContinuousBatcher`: bounded
-  admission queue with backpressure, dynamic batch assembly under a token
-  budget, per-request deadlines, slot recycling mid-batch.
+  admission queue with backpressure, page-availability admission (typed
+  :class:`~autodist_tpu.serve.engine.AdmissionDenied` — retryable pool
+  pressure vs never-placeable rejection), chunked prefill interleaved with
+  decode, per-request deadlines, page recycling on retirement.
 - :mod:`autodist_tpu.serve.server` — asyncio HTTP front end and the
-  ``python -m autodist_tpu.serve --selftest`` CPU-sim proof.
+  ``python -m autodist_tpu.serve --selftest`` CPU-sim proof (>=2x
+  concurrency vs the bucketed baseline at equal KV HBM, zero drops,
+  bit-identical greedy streams, exactly 2 compiled programs).
 
 Entry point: ``autodist.build_inference(...)`` (api.py) or
 :meth:`InferenceEngine.build` directly.
@@ -29,19 +38,27 @@ from autodist_tpu.serve.batcher import (
     RequestState,
 )
 from autodist_tpu.serve.engine import (
+    AdmissionDenied,
+    BucketedInferenceEngine,
     DecodeModel,
     EngineDeadError,
     InferenceEngine,
     Slot,
 )
+from autodist_tpu.serve.pages import PagePool, PageTable, build_pool
 
 __all__ = [
+    "AdmissionDenied",
     "Backpressure",
+    "BucketedInferenceEngine",
     "ContinuousBatcher",
     "DecodeModel",
     "EngineDeadError",
     "GenRequest",
     "InferenceEngine",
+    "PagePool",
+    "PageTable",
     "RequestState",
     "Slot",
+    "build_pool",
 ]

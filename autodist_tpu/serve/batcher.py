@@ -1,28 +1,39 @@
-"""Continuous batching: bounded admission queue + slot scheduler.
+"""Continuous batching: bounded admission queue + paged slot scheduler.
 
 The serving analog of the training data pipeline's "keep the device fed"
 contract. Requests enter a bounded FIFO (``submit`` raises
 :class:`Backpressure` when full — admission control, never silent drops);
-a single scheduler thread assembles the active batch dynamically under a
-max-token budget, prefills new requests into free engine slots, runs one
-decode step per tick across every active slot, and retires sequences the
-moment they finish (EOS / ``max_new_tokens`` / deadline / bucket capacity),
-recycling their slot in the same tick — no batch barrier, a request never
-waits for its batchmates (Orca-style iteration-level scheduling).
+a single scheduler thread assembles the active batch dynamically under
+**page availability** (admission reserves a request's whole
+``prompt + max_new`` timeline in the engine's page pool, all-or-nothing),
+advances every mid-prefill request by one fixed-size chunk per tick —
+chunked prefill interleaved with decode, so a long prompt never stalls
+in-flight decodes — runs ONE decode step per tick across every decoding
+slot, and retires sequences the moment they finish (EOS /
+``max_new_tokens`` / deadline), recycling their pages in the same tick —
+no batch barrier, a request never waits for its batchmates (Orca-style
+iteration-level scheduling over a vLLM-style paged cache).
 
-Progress is guaranteed by construction: every active sequence has a finite
-timeline (its bucket length bounds it even if EOS never fires), so slots
-always free; a queued request that can never be placed (longer than the
-largest bucket) is rejected at submit time rather than head-blocking the
-FIFO. Liveness is therefore a property, not a tuning outcome — the
+Admission is typed end to end: a request that can NEVER run (over the
+engine's static ``max_len`` ceiling) comes back from ``submit`` already
+terminal ``REJECTED`` — impossibility is a value at the edge, not an
+exception and never a stuck queue head; a request the pool cannot place
+YET stays queued (retirement frees pages), with pool pressure
+flight-recorded so the postmortem doctor's timeline shows when the pool —
+not the queue bound — was the limiter. Progress is guaranteed by
+construction: every admitted sequence has a finite timeline
+(``max_new_tokens`` bounds it even if EOS never fires), so pages always
+recycle; liveness is a property, not a tuning outcome — the
 ``--selftest`` acceptance bar (zero dropped/deadlocked) tests it.
 
 Metrics (through :mod:`autodist_tpu.metrics`' registry):
-``serve_queue_depth`` / ``serve_active_slots`` gauges,
+``serve_queue_depth`` / ``serve_active_slots`` /
+``serve_page_pool_utilization`` / ``serve_page_fragmentation`` gauges,
 ``serve_requests_{submitted,completed,timeout,rejected}_total`` counters,
-``serve_tokens_generated_total`` counter, ``serve_tokens_per_sec`` gauge
-(rolling), and ``serve_request_latency_s`` / ``serve_ttft_s`` histograms
-(p50/p99 exported by the registry).
+``serve_tokens_generated_total`` counter, ``serve_tokens_per_sec`` and
+``serve_decode_tokens_per_sec`` gauges (rolling), and
+``serve_request_latency_s`` / ``serve_ttft_s`` histograms (p50/p99
+exported by the registry).
 """
 from __future__ import annotations
 
@@ -32,14 +43,19 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 from enum import Enum
-from typing import Any, Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
 from autodist_tpu import metrics as M
 from autodist_tpu.obs import recorder as obs_recorder
 from autodist_tpu.obs import spans as obs_spans
-from autodist_tpu.serve.engine import EngineDeadError, InferenceEngine, Slot
+from autodist_tpu.serve.engine import (
+    AdmissionDenied,
+    EngineDeadError,
+    InferenceEngine,
+    Slot,
+)
 from autodist_tpu.utils import logging, retry
 
 
@@ -76,6 +92,12 @@ class GenRequest:
     tokens: List[int] = field(default_factory=list)
     state: RequestState = RequestState.QUEUED
     error: str = ""
+    # Typed rejection cause: True when the request can NEVER be served by
+    # this engine (over the static max_len ceiling) — the front end maps
+    # it to HTTP 400 and the drain replay drops it, WITHOUT parsing the
+    # error prose (the AdmissionDenied.retryable contract, kept typed all
+    # the way to the edge).
+    unservable: bool = False
     _event: threading.Event = field(default_factory=threading.Event, repr=False)
     _cb_lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
     _callbacks: List[Callable[["GenRequest"], None]] = field(
@@ -109,6 +131,14 @@ class GenRequest:
 
     def _finish(self, state: RequestState, error: str = "") -> None:
         with self._cb_lock:
+            if self._event.is_set():
+                # Already terminal: first writer wins. Closes the race
+                # where a drain/stop whose scheduler join TIMED OUT
+                # preempts a request whose in-flight tick then completes —
+                # without this, the late DONE would overwrite PREEMPTED
+                # after the drain controller persisted it for replay
+                # (a double-serve on restart).
+                return
             self.state = state
             self.error = error
             self.t_done = time.monotonic()
@@ -123,20 +153,19 @@ class GenRequest:
 
 
 class ContinuousBatcher:
-    """Request queue + scheduler around one :class:`InferenceEngine`.
+    """Request queue + scheduler around one paged :class:`InferenceEngine`.
 
-    ``max_queue`` bounds admission (backpressure); ``max_active_tokens``
-    bounds the assembled batch by *allocated timeline tokens* (sum of
-    admitted requests' bucket lengths — capacity actually reserved in HBM),
-    defaulting to the engine's full slot pool. ``start()`` spawns the
-    scheduler thread; ``submit`` is thread-safe and wakes it.
+    ``max_queue`` bounds admission (backpressure). The active batch is
+    bounded by the engine itself — decode rows and page-pool capacity —
+    so there is no separate token budget to tune: what HBM actually holds
+    IS the admission limit. ``start()`` spawns the scheduler thread;
+    ``submit`` is thread-safe and wakes it.
     """
 
     def __init__(
         self,
         engine: InferenceEngine,
         max_queue: int = 256,
-        max_active_tokens: Optional[int] = None,
         registry: Optional[M.MetricsRegistry] = None,
     ):
         if engine.decode_model is None:
@@ -144,8 +173,6 @@ class ContinuousBatcher:
                              "decode_model")
         self.engine = engine
         self.max_queue = max_queue
-        self.max_active_tokens = max_active_tokens or (
-            engine.n_slots * engine.max_len * len(engine._bucket_lens))
         self._queue: deque[GenRequest] = deque()
         self._active: Dict[Slot, GenRequest] = {}
         self._lock = threading.Lock()
@@ -154,21 +181,26 @@ class ContinuousBatcher:
         self._stopped = False
         self._draining = False  # quiesced: no new admissions, finish active
         self._thread: Optional[threading.Thread] = None
-        self._tick_tokens: deque = deque(maxlen=64)  # (t, n) for tokens/sec
+        self._tick_tokens: deque = deque(maxlen=64)   # (t, n) for tokens/sec
+        self._decode_tokens: deque = deque(maxlen=64)  # decode-only window
         self._shed_lock = threading.Lock()
         self._shed_last = -1e9   # monotonic stamp of the last shed
         self._shed_count = 0
+        self._pressure_last = -1e9  # last pool-pressure flight event
         self._SHED_WINDOW_S = 1.0
 
         reg = registry or M.registry
         self._m_depth = reg.gauge("serve_queue_depth")
         self._m_active = reg.gauge("serve_active_slots")
+        self._m_pool_util = reg.gauge("serve_page_pool_utilization")
+        self._m_frag = reg.gauge("serve_page_fragmentation")
         self._m_submitted = reg.counter("serve_requests_submitted_total")
         self._m_completed = reg.counter("serve_requests_completed_total")
         self._m_timeout = reg.counter("serve_requests_timeout_total")
         self._m_rejected = reg.counter("serve_requests_rejected_total")
         self._m_tokens = reg.counter("serve_tokens_generated_total")
         self._m_tps = reg.gauge("serve_tokens_per_sec")
+        self._m_decode_tps = reg.gauge("serve_decode_tokens_per_sec")
         self._m_latency = reg.histogram("serve_request_latency_s")
         self._m_ttft = reg.histogram("serve_ttft_s")
 
@@ -179,23 +211,30 @@ class ContinuousBatcher:
         max_new_tokens: int = 32,
         timeout_s: Optional[float] = None,
     ) -> GenRequest:
-        """Enqueue a request. Raises :class:`Backpressure` when the queue is
-        at ``max_queue``; raises ValueError when the request can never fit a
-        bucket (so impossibility surfaces at the edge, not as a stuck queue
-        head). ``timeout_s`` sets the request deadline relative to now."""
+        """Enqueue a request. Raises :class:`Backpressure` when the queue
+        is at ``max_queue`` (or the batcher is stopped/draining). A
+        request that can NEVER be placed — over the engine's static
+        ``max_len`` ceiling — comes back already terminal
+        ``RequestState.REJECTED`` with the reason in ``.error``: a typed
+        admission rejection at the edge, not an exception and never a
+        stuck queue head. ``timeout_s`` sets the request deadline
+        relative to now."""
         prompt = np.asarray(prompt, np.int32).ravel()
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if self.engine.bucket_for(len(prompt) + max_new_tokens) is None:
-            self._m_rejected.inc()
-            raise ValueError(
-                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
-                f"exceeds the largest decode bucket ({self.engine.max_len})")
         req = GenRequest(
             prompt=prompt,
             max_new_tokens=max_new_tokens,
             deadline=(time.monotonic() + timeout_s) if timeout_s else None,
         )
+        denied = self.engine.check_admissible(len(prompt), max_new_tokens)
+        if denied is not None:
+            self._m_rejected.inc()
+            self._shed("unservable request")
+            req.unservable = True
+            req._finish(RequestState.REJECTED,
+                        f"admission rejected: {denied.reason}")
+            return req
         shed_reason = None
         with self._wake:
             if self._stopped:
@@ -231,8 +270,9 @@ class ContinuousBatcher:
         returns a :class:`GenRequest`. A shed request comes back already
         terminal — ``state == RequestState.REJECTED`` with the reason in
         ``.error`` — so load-shedding under chaos (engine death, admission
-        stalls, queue overflow) is a value the caller can route on, never
-        a hang and never an anonymous exception (docs/chaos.md)."""
+        stalls, page-pool bursts, queue overflow) is a value the caller
+        can route on, never a hang and never an anonymous exception
+        (docs/chaos.md)."""
         try:
             return self.submit(prompt, max_new_tokens, timeout_s=timeout_s)
         except (Backpressure, ValueError) as e:
@@ -279,7 +319,26 @@ class ContinuousBatcher:
             n = self._shed_count
         if opens:
             obs_recorder.record_event("shed", critical=False,
-                                      reason=reason, total_shed=n)
+                                      reason=reason, total_shed=n,
+                                      pool_free_pages=getattr(
+                                          self.engine, "pool", None)
+                                      and self.engine.pool.free_pages)
+
+    def _pool_pressure(self, reason: str) -> None:
+        """Flight-record page-pool pressure (rate-limited like ``_shed``):
+        admission is deferring because HBM pages — not the queue bound —
+        are the limiter. Retirement recycles pages, so this is a signal,
+        not a failure; the doctor's timeline shows the pressure window."""
+        now = time.monotonic()
+        with self._shed_lock:
+            opens = now - self._pressure_last > self._SHED_WINDOW_S
+            self._pressure_last = now
+        if opens:
+            obs_recorder.record_event(
+                "pool_pressure", critical=False, reason=reason,
+                free_pages=self.engine.pool.free_pages,
+                used_pages=self.engine.pool.used_pages,
+                queue_depth=len(self._queue))
 
     # -------------------------------------------------------------- lifecycle
     def start(self) -> "ContinuousBatcher":
@@ -311,10 +370,29 @@ class ContinuousBatcher:
             self._running = False
             self._stopped = True
             self._wake.notify()
-        if self._thread is not None:
-            self._thread.join(timeout=timeout_s)
-            self._thread = None
-        self._fail_all("batcher stopped before this request completed")
+        stuck = self._join_scheduler(timeout_s)
+        self._fail_all("batcher stopped before this request completed",
+                       release=not stuck)
+
+    def _join_scheduler(self, timeout_s: float) -> bool:
+        """Join the scheduler thread; True when it OUTLIVED the timeout
+        (blocked in a device call — first-tick compile, wedged chip).
+        A live scheduler still owns the engine's single-writer state, so
+        the caller must not touch slot tables or release pages: leaking
+        them to process teardown beats corrupting a dispatch mid-flight
+        (or a double page free racing the stuck tick's own retire)."""
+        thread = self._thread
+        if thread is None:
+            return False
+        thread.join(timeout=timeout_s)
+        self._thread = None
+        if thread.is_alive():
+            logging.warning(
+                "serve scheduler still running after %.1fs join; leaving "
+                "engine slot state to it (pages reclaimed at teardown)",
+                timeout_s)
+            return True
+        return False
 
     def quiesce(self) -> None:
         """Stop admitting — new ``submit``s are refused and queued entries
@@ -348,9 +426,7 @@ class ContinuousBatcher:
             self._running = False
             self._stopped = True
             self._wake.notify()
-        if self._thread is not None:
-            self._thread.join(timeout=max(1.0, deadline_s))
-            self._thread = None
+        stuck = self._join_scheduler(max(1.0, deadline_s))
         with self._lock:
             active = list(self._active.items())
             self._active.clear()
@@ -358,8 +434,9 @@ class ContinuousBatcher:
             self._queue.clear()
             self._m_depth.set(0)
             self._m_active.set(0)
-        for slot, req in active:
-            self.engine.release(slot)
+        if not stuck:
+            for slot, _req in active:
+                self.engine.release(slot)
         leftovers = [req for _, req in active] + leftovers
         for req in leftovers:
             req._finish(RequestState.PREEMPTED,
@@ -383,7 +460,16 @@ class ContinuousBatcher:
                     self._wake.wait(timeout=0.5)
                     continue
             try:
-                self._tick()
+                if not self._tick():
+                    # Queue non-empty but nothing progressed (a page-
+                    # pressure window with an empty active set, or a
+                    # drain with untouched leftovers): pace the poll
+                    # instead of spinning a core — retirement/submit
+                    # notify the condition, so 20 ms is a backstop, not
+                    # the latency floor.
+                    with self._wake:
+                        if self._running:
+                            self._wake.wait(timeout=0.02)
             except EngineDeadError as e:
                 # The engine cannot decode anymore: shed ALL load with
                 # explicit typed rejections (never hang a client on a dead
@@ -401,12 +487,16 @@ class ContinuousBatcher:
                 break
             except Exception:  # noqa: BLE001 - scheduler must survive
                 # A tick failure (e.g. transient compile/OOM) fails the
-                # requests it touched via _fail_active below rather than
+                # requests it touched via _fail_all below rather than
                 # killing the loop silently.
                 logging.warning("batcher tick failed", exc_info=True)
                 self._fail_all("scheduler tick failed; see server log")
 
-    def _fail_all(self, msg: str) -> None:
+    def _fail_all(self, msg: str, release: bool = True) -> None:
+        """Terminally fail everything. ``release=False`` when a LIVE
+        scheduler thread may still own the engine (post-join-timeout
+        stop): requests still unblock — ``_finish`` is first-writer-wins
+        — but slot state is left to the thread that owns it."""
         with self._lock:
             active = list(self._active.items())
             self._active.clear()
@@ -414,18 +504,23 @@ class ContinuousBatcher:
             self._queue.clear()
             self._m_depth.set(0)
         for slot, req in active:
-            self.engine.release(slot)
+            if release:
+                self.engine.release(slot)
             req._finish(RequestState.REJECTED, msg)
         for req in queued:
             req._finish(RequestState.REJECTED, msg)
         self._m_rejected.inc(len(active) + len(queued))
 
-    def _tick(self) -> None:
-        """One scheduler iteration: expire → admit → decode → retire."""
+    def _tick(self) -> bool:
+        """One scheduler iteration: expire → admit → prefill → decode →
+        retire. Returns whether anything progressed (admission, a prefill
+        chunk, a decode step, an expiry) — False lets the loop pace
+        itself instead of spinning on a blocked queue."""
+        progress = False
         now = time.monotonic()
 
         # Queued requests whose deadline already passed will only get staler
-        # waiting for a slot: time them out from the queue.
+        # waiting for pages: time them out from the queue.
         with self._lock:
             expired = [r for r in self._queue
                        if r.deadline is not None and now > r.deadline]
@@ -434,14 +529,14 @@ class ContinuousBatcher:
             self._m_depth.set(len(self._queue))
         for r in expired:
             self._m_timeout.inc()
+            progress = True
             r._finish(RequestState.TIMEOUT, "deadline expired in queue")
 
-        # Admission: fill free slots FIFO under the token budget. Prefill
-        # (including any first-use XLA compile) runs OUTSIDE self._lock —
-        # only this scheduler thread ever pops, so the peeked head is
-        # stable, and submit()/the asyncio event loop never block on the
-        # device. The budget rides into admit() so a full small bucket
-        # cannot spill into a larger one past max_active_tokens.
+        # Admission: FIFO while the engine can place the head. admit() is
+        # host bookkeeping only (page + row reservation — prefill compute
+        # happens chunk-by-chunk below), and runs OUTSIDE self._lock: only
+        # this scheduler thread ever pops, so the peeked head is stable,
+        # and submit()/the asyncio event loop never block on it.
         while True:
             dead = None
             with self._lock:
@@ -458,41 +553,70 @@ class ContinuousBatcher:
                     self._m_depth.set(len(self._queue))
             if dead is not None:
                 self._m_timeout.inc()
+                progress = True
                 dead._finish(RequestState.TIMEOUT, "deadline expired in queue")
                 continue
-            budget = self.max_active_tokens - self.engine.active_tokens
-            # Wall anchor taken BEFORE admit(): the span must end where the
-            # prefill span begins, and admit() runs the prefill (plus a
-            # bucket's first-use compile) before returning.
             t_admit, t_admit_wall = time.monotonic(), time.time()
-            admitted = self.engine.admit(
-                head.prompt, head.max_new_tokens, token_budget=budget)
-            if admitted is None:
-                break  # no free slot / over budget; retire will wake us again
+            admitted = self.engine.admit(head.prompt, head.max_new_tokens)
+            if isinstance(admitted, AdmissionDenied):
+                if admitted.retryable:
+                    # Pages/rows will free on retirement; keep it queued
+                    # and flight-record the pressure window.
+                    self._pool_pressure(admitted.reason)
+                    break
+                with self._lock:
+                    self._queue.popleft()
+                    self._m_depth.set(len(self._queue))
+                self._m_rejected.inc()
+                progress = True
+                head.unservable = True
+                head._finish(RequestState.REJECTED,
+                             f"admission rejected: {admitted.reason}")
+                continue
             # Queue-wait span, recorded retroactively now the wait is known
-            # (submit → prefill start; the prefill span follows it on the
-            # same timeline, so a request reads as wait → prefill → decode).
+            # (submit → admission; the prefill-chunk spans follow on the
+            # same timeline, so a request reads wait → prefill → decode).
             wait_s = max(t_admit - head.t_submit, 0.0)
             obs_spans.add_span("serve.queue_wait", t_admit_wall - wait_s,
                                wait_s, request_id=head.id)
-            slot, first = admitted
             with self._lock:
                 self._queue.popleft()
                 self._m_depth.set(len(self._queue))
                 head.state = RequestState.ACTIVE
-                head.t_first_token = time.monotonic()
-                head.tokens.append(first)
-                self._active[slot] = head
-            self._m_ttft.observe(head.t_first_token - head.t_submit)
-            self._count_tokens(1)
-            self._maybe_retire(slot, head)
+                self._active[admitted] = head
+            progress = True
 
-        # One decode step over every active slot (all buckets).
+        # Chunked prefill: every mid-prefill slot advances ONE chunk per
+        # tick, so a long prompt interleaves with (never stalls) the
+        # decode step below. The first generated token arrives with the
+        # final chunk — prefill emits it, exactly like the unpaged design.
+        for slot in self.engine.prefill_pending():
+            with self._lock:
+                req = self._active.get(slot)
+            if req is None:
+                continue
+            if req.deadline is not None and time.monotonic() > req.deadline:
+                self._retire(slot, req, RequestState.TIMEOUT,
+                             "deadline expired mid-prefill")
+                progress = True
+                continue
+            first = self.engine.prefill_step(slot)
+            progress = True
+            if first is None:
+                continue
+            req.t_first_token = time.monotonic()
+            req.tokens.append(first)
+            self._m_ttft.observe(req.t_first_token - req.t_submit)
+            self._count_tokens(1)
+            self._maybe_retire(slot, req)
+
+        # One decode step over every decoding slot (ONE compiled program).
         with self._lock:
             have_active = bool(self._active)
         if have_active:
             emitted = self.engine.step()
-            self._count_tokens(len(emitted))
+            self._count_tokens(len(emitted), decode=True)
+            progress = progress or bool(emitted)
             for slot, token in emitted.items():
                 with self._lock:
                     req = self._active.get(slot)
@@ -502,9 +626,17 @@ class ContinuousBatcher:
                 self._maybe_retire(slot, req)
         with self._lock:
             self._m_active.set(len(self._active))
+        self._m_pool_util.set(self.engine.page_utilization)
+        self._m_frag.set(self.engine.page_fragmentation)
+        return progress
 
     def _maybe_retire(self, slot: Slot, req: GenRequest) -> None:
-        """Finish + recycle the slot when the sequence is done."""
+        """Finish + recycle the slot's pages when the sequence is done.
+
+        Liveness needs no per-bucket defensive bound anymore: admission
+        reserved the full ``prompt + max_new`` timeline in pages, and
+        ``max_new_tokens`` retires the sequence before its last write
+        could leave that reservation."""
         now = time.monotonic()
         eos = self.engine.decode_model.eos_id
         state = None
@@ -514,13 +646,12 @@ class ContinuousBatcher:
             state, why = RequestState.DONE, ""
         elif len(req.tokens) >= req.max_new_tokens:
             state, why = RequestState.DONE, ""
-        elif self.engine.slot_len(slot) >= slot.bucket:
-            # Bucket timeline exhausted (cannot happen when admit sized the
-            # bucket to prompt+max_new, but a defensive bound keeps liveness
-            # even if a model emits past its positional ceiling).
-            state, why = RequestState.DONE, "bucket timeline exhausted"
         if state is None:
             return
+        self._retire(slot, req, state, why)
+
+    def _retire(self, slot: Slot, req: GenRequest, state: RequestState,
+                why: str) -> None:
         with self._lock:
             self._active.pop(slot, None)
         self.engine.release(slot)
@@ -529,9 +660,9 @@ class ContinuousBatcher:
         req._finish(state, why)
         self._m_latency.observe(time.monotonic() - req.t_submit)
         with self._wake:
-            self._wake.notify()  # a slot freed: admission may proceed
+            self._wake.notify()  # pages freed: admission may proceed
 
-    def _count_tokens(self, n: int) -> None:
+    def _count_tokens(self, n: int, decode: bool = False) -> None:
         self._m_tokens.inc(n)
         now = time.monotonic()
         self._tick_tokens.append((now, n))
@@ -540,3 +671,10 @@ class ContinuousBatcher:
             dt = now - window[0][0]
             if dt > 0:
                 self._m_tps.set(sum(k for _, k in window) / dt)
+        if decode:
+            self._decode_tokens.append((now, n))
+            dwin = [(t, k) for t, k in self._decode_tokens if now - t <= 5.0]
+            if len(dwin) >= 2:
+                dt = now - dwin[0][0]
+                if dt > 0:
+                    self._m_decode_tps.set(sum(k for _, k in dwin) / dt)

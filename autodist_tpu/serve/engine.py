@@ -1,4 +1,4 @@
-"""Sharded inference engine: one-shot apply + KV-cache decode over a plan.
+"""Sharded inference engine: one-shot apply + paged KV-cache decode.
 
 The engine is the inference counterpart of
 :class:`~autodist_tpu.kernel.DistributedTrainStep`: it consumes the SAME
@@ -11,22 +11,29 @@ Params land in their plan shardings (optionally restored straight from a
 path), batches shard over the mesh data axis, and GSPMD inserts the
 collectives for model-sharded parameters exactly as in training.
 
-Decode state is **preallocated and length-bucketed**: the engine owns a
-fixed pool of slots per bucket length (powers-of-two timelines up to the
-model's ``max_len``), each bucket one stacked KV-cache array donated through
-its jitted decode step (in-place on device, no per-step allocation). A
-request is routed to the smallest bucket that fits ``prompt + max_new``;
-within a bucket, decode always runs the full slot batch with finished slots
-masked host-side — admission (prefill into a free slot) and retirement never
-recompile anything. Compiled programs: one prefill + one decode per bucket.
+Decode state is a **paged KV-cache** (the vLLM rendering of GSPMD-style
+static annotations, arXiv 2105.04663): ONE fixed pool of
+``[layers, n_pages, page_len, heads, head_dim]`` device pages sized from
+``ResourceSpec`` HBM headroom and donated through the compiled steps, with
+per-request page tables (host int32 lists, ``serve/pages.py`` — the one
+allocator home) padded to a static width. The engine compiles exactly TWO
+serving programs regardless of the request-length mix: one decode step over
+every slot row, and one fixed-size prefill chunk — long prompts prefill
+chunk by chunk, interleaved with decode ticks by the batcher, so a 4k-token
+prompt never stalls in-flight decodes. Admission reserves pages
+all-or-nothing; retirement recycles them in the same tick.
+
+:class:`BucketedInferenceEngine` keeps the previous length-bucketed stacked
+slot pools as the comparison baseline the serve selftest measures the paged
+design against (>=2x concurrency at equal KV HBM, bit-identical greedy
+streams) — production traffic uses the paged engine.
 """
 from __future__ import annotations
 
 import math
-import threading
 import time
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -38,9 +45,12 @@ from autodist_tpu.kernel import GraphTransformer, ShardingPlan, build_mesh, data
 from autodist_tpu.model_item import ModelItem
 from autodist_tpu.obs import recorder as obs_recorder
 from autodist_tpu.obs import spans as obs_spans
-from autodist_tpu.utils import logging
+from autodist_tpu.serve import pages as serve_pages
 
 DEFAULT_BUCKET_LENS = (32, 64, 128, 256, 512, 1024)
+
+#: Slot phases (host bookkeeping; single scheduler-thread writer).
+_FREE, _PREFILL, _DECODE = 0, 1, 2
 
 
 class EngineDeadError(RuntimeError):
@@ -54,75 +64,67 @@ class EngineDeadError(RuntimeError):
 class DecodeModel:
     """Model adapter for autoregressive decode — pure functions, one config.
 
-    - ``init_cache(n_slots, max_len) -> cache`` pytree of device arrays with
-      slot dim 1 (after any leading stack dims — the engine shards dim 1 of
-      rank>=2 leaves over the data axis);
-    - ``prefill(params, tokens [1,S], length, cache, slot) ->
-      (next_token [1], cache)`` — writes the prompt's k/v into cache row
-      ``slot`` and returns the greedy first token;
-    - ``decode_step(params, tokens [B], positions [B], cache) ->
-      (next_token [B], cache)`` with ``B == n_slots``;
-    - ``eos_id``: generation stops when emitted (None = length-only);
-    - ``max_len``: the model's positional ceiling (caps bucket lengths).
+    Paged surface (the production engine; all three required):
 
-    ``autodist_tpu.models.transformer.decode_model(cfg)`` builds one for the
-    zoo transformer; any model matching the contract serves the same way.
+    - ``init_paged_cache(n_pages, page_len) -> cache`` pytree whose
+      rank>=2 leaves carry the page dim at dim 1 (the engine shards it
+      over the mesh data axis);
+    - ``prefill_chunk(params, tokens [1,C], start, length, cache,
+      page_table [P]) -> (next_token [1], cache)`` — writes prompt
+      positions ``[start, start+C)`` through the page table; the returned
+      token is the argmax at ``length - 1`` (used on the final chunk);
+    - ``decode_paged(params, tokens [B], positions [B], cache,
+      page_tables [B,P]) -> (next_token [B], cache)`` with
+      ``B == n_slots``.
+
+    Bucketed surface (:class:`BucketedInferenceEngine`, the selftest's
+    equal-HBM baseline and the oracle's cached side): ``init_cache``,
+    ``prefill``, ``decode_step`` — see that class.
+
+    ``eos_id``: generation stops when emitted (None = length-only);
+    ``max_len``: the model's positional ceiling.
+
+    ``autodist_tpu.models.transformer.decode_model(cfg)`` builds one for
+    the zoo transformer; any model matching the contract serves the same
+    way.
     """
 
-    init_cache: Callable[[int, int], Any]
-    prefill: Callable[..., Tuple[Any, Any]]
-    decode_step: Callable[..., Tuple[Any, Any]]
+    init_cache: Optional[Callable[[int, int], Any]] = None
+    prefill: Optional[Callable[..., Tuple[Any, Any]]] = None
+    decode_step: Optional[Callable[..., Tuple[Any, Any]]] = None
+    init_paged_cache: Optional[Callable[[int, int], Any]] = None
+    prefill_chunk: Optional[Callable[..., Tuple[Any, Any]]] = None
+    decode_paged: Optional[Callable[..., Tuple[Any, Any]]] = None
     eos_id: Optional[int] = None
     max_len: Optional[int] = None
 
 
 @dataclass(frozen=True)
 class Slot:
-    """One occupied decode slot: (bucket timeline length, row index)."""
+    """One occupied decode row (paged engine) — index into the static
+    decode batch."""
 
-    bucket: int
     index: int
 
 
-@dataclass
-class _Bucket:
-    """Host-side bookkeeping for one bucket's device cache."""
+@dataclass(frozen=True)
+class AdmissionDenied:
+    """Typed admission outcome: WHY a request was not placed, and whether
+    waiting can ever help. ``retryable=True`` (pool exhausted, no free
+    row, chaos defer) means retirement will free resources — the batcher
+    keeps the request queued; ``retryable=False`` (over the engine's
+    static ceiling) means the request can NEVER be placed — the batcher
+    finishes it typed REJECTED instead of head-blocking the FIFO."""
 
-    length: int                 # timeline capacity per slot
-    n_slots: int
-    cache: Any                  # device pytree, donated through decode
-    lengths: np.ndarray         # [slots] int32 — next write position
-    active: np.ndarray          # [slots] bool
-    last_token: np.ndarray      # [slots] int32 — token to feed next step
-    prefill_fn: Any = None      # compiled lazily
-    decode_fn: Any = None
+    reason: str
+    retryable: bool
 
 
-class InferenceEngine:
-    """Serve a (possibly sharded) model: ``infer`` for one-shot batches,
-    ``admit``/``step``/``release`` for continuous-batching decode.
+class _EngineBase:
+    """Shared params-in-plan-shardings setup + one-shot inference."""
 
-    The admit/step/release surface is deliberately scheduler-free: the
-    :class:`~autodist_tpu.serve.batcher.ContinuousBatcher` owns queueing,
-    deadlines and retirement policy; the engine owns device state. All three
-    methods must be called from one scheduler thread (they mutate host-side
-    slot tables without locking — single-writer by contract).
-    """
-
-    def __init__(
-        self,
-        params: Any,
-        plan: ShardingPlan,
-        apply_fn: Optional[Callable] = None,
-        decode_model: Optional[DecodeModel] = None,
-        n_slots: int = 8,
-        bucket_lens: Optional[Sequence[int]] = None,
-        max_len: Optional[int] = None,
-    ):
-        if apply_fn is None and decode_model is None:
-            raise ValueError(
-                "InferenceEngine needs apply_fn (one-shot), decode_model "
-                "(autoregressive), or both")
+    def __init__(self, params: Any, plan: ShardingPlan,
+                 apply_fn: Optional[Callable] = None):
         self.plan = plan
         self.mesh = plan.mesh
         self._data_axis = data_axis(self.mesh)
@@ -144,83 +146,6 @@ class InferenceEngine:
             jax.jit(lambda p, b: apply_fn(plan.unpad_params(p), b))
             if apply_fn is not None else None
         )
-        self.decode_model = decode_model
-
-        self._buckets: Dict[int, _Bucket] = {}
-        if decode_model is not None:
-            # Slot batch must divide over the data axis (cache dim 1 shards
-            # there); round up rather than reject.
-            if n_slots % self._data_degree:
-                n_slots += self._data_degree - n_slots % self._data_degree
-            self.n_slots = n_slots
-            ceiling = min(
-                x for x in (max_len, decode_model.max_len) if x is not None
-            ) if (max_len or decode_model.max_len) else None
-            lens = list(bucket_lens or DEFAULT_BUCKET_LENS)
-            if ceiling is not None:
-                lens = [l for l in lens if l < ceiling] + [ceiling]
-            self._bucket_lens = tuple(sorted(set(lens)))
-            self.max_len = self._bucket_lens[-1]
-            cache_sh = self._cache_shardings(decode_model.init_cache)
-            for length in self._bucket_lens:
-                cache = jax.device_put(
-                    decode_model.init_cache(n_slots, length), cache_sh)
-                self._buckets[length] = _Bucket(
-                    length=length,
-                    n_slots=n_slots,
-                    cache=cache,
-                    lengths=np.zeros(n_slots, np.int32),
-                    active=np.zeros(n_slots, bool),
-                    last_token=np.zeros(n_slots, np.int32),
-                )
-
-    # ------------------------------------------------------------ construction
-    @classmethod
-    def build(
-        cls,
-        params: Any,
-        apply_fn: Optional[Callable] = None,
-        decode_model: Optional[DecodeModel] = None,
-        *,
-        strategy_builder=None,
-        resource_spec=None,
-        mesh=None,
-        checkpoint: Optional[str] = None,
-        **engine_kwargs,
-    ) -> "InferenceEngine":
-        """Standalone construction: capture → strategy → lower → engine.
-
-        The one-call path for scripts that don't hold an
-        :class:`~autodist_tpu.api.AutoDist` (which offers the same through
-        ``build_inference`` with the chief/worker strategy handoff).
-        ``checkpoint`` restores params from a ``Saver`` checkpoint directly
-        into the plan's shardings — each process reads only the file regions
-        its devices need, so loading a sharded model never materializes the
-        full logical arrays on one host.
-        """
-        from autodist_tpu.resource_spec import ResourceSpec
-        from autodist_tpu.strategy import AllReduce
-        from autodist_tpu.strategy.base import StrategyCompiler
-
-        if resource_spec is None and mesh is None:
-            resource_spec = ResourceSpec.from_local_devices()
-        if mesh is None:
-            mesh = build_mesh(resource_spec)
-        # Inference default is AllReduce (replicated params, data-sharded
-        # batch): with no gradient wire, PS/ZeRO residency choices only add
-        # gathers to the forward. Model-partitioned builders (TensorParallel,
-        # PartitionedAR) carry over as-is — their pspecs shard the serving
-        # params the same way they sharded training.
-        builder = strategy_builder or AllReduce()
-        model_item = ModelItem.from_params(params)
-        strategy = builder.build(model_item, resource_spec) if resource_spec \
-            else builder.build(model_item, ResourceSpec.from_local_devices())
-        compiled = StrategyCompiler(model_item).compile(strategy)
-        plan = GraphTransformer(compiled, model_item, mesh).transform()
-        if checkpoint is not None:
-            params = cls.restore_params(checkpoint, params, plan)
-        return cls(params, plan, apply_fn=apply_fn, decode_model=decode_model,
-                   **engine_kwargs)
 
     @staticmethod
     def restore_params(checkpoint: str, params_template: Any,
@@ -272,11 +197,563 @@ class InferenceEngine:
             batch, self.plan.batch_shardings(batch, strict=False))
         return self._apply_jit(self.params, batch)
 
+
+class InferenceEngine(_EngineBase):
+    """Serve a (possibly sharded) model: ``infer`` for one-shot batches,
+    ``admit``/``prefill_step``/``step``/``release`` for paged
+    continuous-batching decode.
+
+    The surface is deliberately scheduler-free: the
+    :class:`~autodist_tpu.serve.batcher.ContinuousBatcher` owns queueing,
+    deadlines, prefill/decode interleaving and retirement policy; the
+    engine owns device state. All decode-state methods must be called from
+    one scheduler thread (they mutate host-side slot tables without
+    locking — single-writer by contract; the page pool itself is locked so
+    accounting reads from other threads stay coherent).
+
+    Exactly two programs compile (``compiled_programs`` counts them): the
+    decode step over all ``n_slots`` rows and the fixed-``prefill_chunk``
+    prefill — admission, chunking, retirement and any request-length mix
+    never recompile anything.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        plan: ShardingPlan,
+        apply_fn: Optional[Callable] = None,
+        decode_model: Optional[DecodeModel] = None,
+        n_slots: int = 8,
+        page_len: int = serve_pages.DEFAULT_PAGE_LEN,
+        n_pages: Optional[int] = None,
+        prefill_chunk: Optional[int] = None,
+        max_len: Optional[int] = None,
+        resource_spec: Any = None,
+        serve_hbm_frac: float = 0.5,
+    ):
+        if apply_fn is None and decode_model is None:
+            raise ValueError(
+                "InferenceEngine needs apply_fn (one-shot), decode_model "
+                "(autoregressive), or both")
+        super().__init__(params, plan, apply_fn=apply_fn)
+        self.decode_model = decode_model
+        if decode_model is None:
+            return
+        for fn in ("init_paged_cache", "prefill_chunk", "decode_paged"):
+            if getattr(decode_model, fn) is None:
+                raise ValueError(
+                    f"decode_model lacks the paged surface ({fn}); the "
+                    f"paged engine needs init_paged_cache + prefill_chunk "
+                    f"+ decode_paged (see DecodeModel)")
+        # Decode rows shard over the data axis via the batch dim of the
+        # per-step tensors; keep the row count divisible so gathers stay
+        # even (round up rather than reject).
+        if n_slots % self._data_degree:
+            n_slots += self._data_degree - n_slots % self._data_degree
+        self.n_slots = n_slots
+        self.page_len = int(page_len)
+        self.prefill_chunk = int(prefill_chunk or page_len)
+        # Static timeline ceiling: the positional limit rounded DOWN to a
+        # multiple of lcm(page_len, chunk) — guarantees every chunk's pad
+        # positions stay inside the static page-table width (see
+        # forward_paged_prefill_chunk's safety contract).
+        ceiling = min(
+            x for x in (max_len, decode_model.max_len) if x is not None
+        ) if (max_len or decode_model.max_len) else 1024
+        quantum = math.lcm(self.page_len, self.prefill_chunk)
+        self.max_len = (int(ceiling) // quantum) * quantum
+        if self.max_len <= 0:
+            raise ValueError(
+                f"max_len {ceiling} cannot fit one page_len={page_len} x "
+                f"prefill_chunk={self.prefill_chunk} quantum ({quantum})")
+        self.max_pages = self.max_len // self.page_len
+
+        # Pool sizing: explicit n_pages wins; else ResourceSpec HBM
+        # headroom funds it (capped at the point more pages cannot help —
+        # every row at the full timeline). Per-page bytes from an abstract
+        # eval of the model's own cache shape, so any DecodeModel prices
+        # correctly.
+        page_bytes = sum(
+            int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree_util.tree_leaves(jax.eval_shape(
+                lambda: decode_model.init_paged_cache(1, self.page_len))))
+        self.page_bytes = page_bytes
+        max_useful = self.n_slots * self.max_pages
+        if n_pages is None:
+            if resource_spec is not None:
+                params_bytes = sum(
+                    int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+                    for leaf in jax.tree_util.tree_leaves(
+                        jax.eval_shape(lambda: params)))
+                n_pages = serve_pages.pool_size_from_spec(
+                    resource_spec, page_bytes, params_bytes=params_bytes,
+                    serve_frac=serve_hbm_frac,
+                    shard_degree=self._data_degree,
+                    max_useful_pages=max_useful,
+                    min_useful_pages=self.max_pages)
+            else:
+                n_pages = max_useful + 1
+        n_pages = max(int(n_pages), self.max_pages + 1)
+        if n_pages % self._data_degree:
+            n_pages += self._data_degree - n_pages % self._data_degree
+        self.pool = serve_pages.build_pool(n_pages, self.page_len)
+        self._cache_sh = self._cache_shardings(
+            decode_model.init_paged_cache, n_pages)
+        self._cache = jax.device_put(
+            decode_model.init_paged_cache(n_pages, self.page_len),
+            self._cache_sh)
+
+        # Host-side slot tables (single scheduler-thread writer).
+        self._phase = np.full(n_slots, _FREE, np.int8)
+        self._tables: List[Optional[serve_pages.PageTable]] = [None] * n_slots
+        # Per-slot full table (prefill reads its row); decode sees a row
+        # only once the slot ENTERS decode — a prefilling slot's pages
+        # must never take decode-step scatter writes.
+        self._table_np = np.full(
+            (n_slots, self.max_pages), serve_pages.SCRATCH_PAGE, np.int32)
+        self._decode_table_np = np.full(
+            (n_slots, self.max_pages), serve_pages.SCRATCH_PAGE, np.int32)
+        self._lengths = np.zeros(n_slots, np.int32)
+        self._last_token = np.zeros(n_slots, np.int32)
+        self._prompts: List[Optional[np.ndarray]] = [None] * n_slots
+        self._prefill_pos = np.zeros(n_slots, np.int32)
+        self._prefill_t0 = np.zeros(n_slots, np.float64)
+        self._prefill_fn = None
+        self._decode_fn = None
+        self._decode_step_count = 0
+
+    # ------------------------------------------------------------ construction
+    @classmethod
+    def build(
+        cls,
+        params: Any,
+        apply_fn: Optional[Callable] = None,
+        decode_model: Optional[DecodeModel] = None,
+        *,
+        strategy_builder=None,
+        resource_spec=None,
+        mesh=None,
+        checkpoint: Optional[str] = None,
+        **engine_kwargs,
+    ) -> "InferenceEngine":
+        """Standalone construction: capture → strategy → lower → engine.
+
+        The one-call path for scripts that don't hold an
+        :class:`~autodist_tpu.api.AutoDist` (which offers the same through
+        ``build_inference`` with the chief/worker strategy handoff).
+        ``checkpoint`` restores params from a ``Saver`` checkpoint directly
+        into the plan's shardings — each process reads only the file regions
+        its devices need, so loading a sharded model never materializes the
+        full logical arrays on one host.
+        """
+        from autodist_tpu.resource_spec import ResourceSpec
+        from autodist_tpu.strategy import AllReduce
+        from autodist_tpu.strategy.base import StrategyCompiler
+
+        if resource_spec is None and mesh is None:
+            resource_spec = ResourceSpec.from_local_devices()
+        if mesh is None:
+            mesh = build_mesh(resource_spec)
+        # Inference default is AllReduce (replicated params, data-sharded
+        # batch): with no gradient wire, PS/ZeRO residency choices only add
+        # gathers to the forward. Model-partitioned builders (TensorParallel,
+        # PartitionedAR) carry over as-is — their pspecs shard the serving
+        # params the same way they sharded training.
+        builder = strategy_builder or AllReduce()
+        model_item = ModelItem.from_params(params)
+        strategy = builder.build(model_item, resource_spec) if resource_spec \
+            else builder.build(model_item, ResourceSpec.from_local_devices())
+        compiled = StrategyCompiler(model_item).compile(strategy)
+        plan = GraphTransformer(compiled, model_item, mesh).transform()
+        if checkpoint is not None:
+            params = cls.restore_params(checkpoint, params, plan)
+        return cls(params, plan, apply_fn=apply_fn, decode_model=decode_model,
+                   resource_spec=resource_spec, **engine_kwargs)
+
     # ------------------------------------------------------------ decode pool
-    def _cache_shardings(self, init_cache):
-        """Slot dim (dim 1 of rank>=2 leaves) over the data axis; scalars and
-        vectors replicate. Evaluated on abstract shapes — no device cache is
-        built to derive its own sharding."""
+    def _cache_shardings(self, init_cache, n_pages: int):
+        """Page dim (dim 1 of rank>=2 leaves) over the data axis; scalars
+        and vectors replicate. Evaluated on abstract shapes — no device
+        cache is built to derive its own sharding."""
+        from autodist_tpu.kernel.mesh import data_sharding
+
+        shaped = jax.eval_shape(lambda: init_cache(n_pages, self.page_len))
+
+        def leaf_sh(leaf):
+            if len(leaf.shape) >= 2 and leaf.shape[1] == n_pages:
+                return data_sharding(self.mesh, len(leaf.shape), dim=1)
+            return NamedSharding(self.mesh, P())
+
+        return jax.tree_util.tree_map(leaf_sh, shaped)
+
+    def _compile(self) -> None:
+        dm = self.decode_model
+        # Donate the cache: both programs rewrite the page pool in place on
+        # device — steady-state serving allocates nothing. The cache's
+        # OUTPUT sharding is pinned to the canonical pool sharding: left to
+        # GSPMD's choice it can drift between programs, and a
+        # differently-sharded cache argument would silently compile a third
+        # serving program (the exactly-2 acceptance pin).
+        token_sh = NamedSharding(self.mesh, P())
+        self._prefill_fn = jax.jit(
+            lambda p, tokens, start, length, cache, table: dm.prefill_chunk(
+                self.plan.unpad_params(p), tokens, start, length, cache,
+                table),
+            donate_argnums=(4,),
+            out_shardings=(token_sh, self._cache_sh))
+        self._decode_fn = jax.jit(
+            lambda p, tokens, positions, cache, tables: dm.decode_paged(
+                self.plan.unpad_params(p), tokens, positions, cache, tables),
+            donate_argnums=(3,),
+            out_shardings=(token_sh, self._cache_sh))
+
+    @property
+    def compiled_programs(self) -> int:
+        """How many serving programs have actually compiled — the
+        acceptance pin is exactly 2 (one decode + one chunked prefill)
+        regardless of the request-length mix. Counts real XLA cache
+        entries; raising (not guessing) on a jax that drops the
+        introspection keeps the pin honest — a fallback of "1 per
+        wrapped fn" would pass forever while a sharding drift silently
+        compiled a third program."""
+        total = 0
+        for fn in (self._prefill_fn, self._decode_fn):
+            if fn is None:
+                continue
+            size = getattr(fn, "_cache_size", None)
+            if size is None:
+                raise RuntimeError(
+                    "jax.jit lost _cache_size(); compiled_programs cannot "
+                    "count real compilations — update the pin (the "
+                    "exactly-2-programs acceptance bar must count actual "
+                    "XLA cache entries, never assume)")
+            total += int(size())
+        return total
+
+    # -------------------------------------------------------------- accounting
+    @property
+    def free_slots(self) -> int:
+        return int((self._phase == _FREE).sum())
+
+    @property
+    def active_slots(self) -> int:
+        return int((self._phase != _FREE).sum())
+
+    @property
+    def active_tokens(self) -> int:
+        """Timeline tokens reserved across active requests (allocated page
+        capacity — the admission budget's currency)."""
+        return self.pool.allocated_tokens
+
+    @property
+    def written_tokens(self) -> int:
+        """Tokens actually resident in reserved pages (prompt progress for
+        prefilling slots, full timeline length for decoding ones)."""
+        total = 0
+        for idx in np.flatnonzero(self._phase != _FREE):
+            idx = int(idx)
+            if self._phase[idx] == _PREFILL:
+                prompt = self._prompts[idx]
+                total += min(int(self._prefill_pos[idx]),
+                             len(prompt) if prompt is not None else 0)
+            else:
+                total += int(self._lengths[idx])
+        return total
+
+    @property
+    def page_utilization(self) -> float:
+        return self.pool.utilization
+
+    @property
+    def page_fragmentation(self) -> float:
+        return self.pool.fragmentation(self.written_tokens)
+
+    @property
+    def page_pool_bytes(self) -> int:
+        """Device bytes of the static page pool (whole pool; divide by the
+        data degree for per-chip when sharded) — the figure the analyzer's
+        SLM passes account (``hbm_budget(serve_pool_bytes=...)``)."""
+        return int(self.page_bytes) * self.pool.n_pages
+
+    # --------------------------------------------------------------- admission
+    def check_admissible(self, prompt_len: int,
+                         max_new_tokens: int) -> Optional[AdmissionDenied]:
+        """The static (never-serveable) admission checks, shared by
+        :meth:`admit` and the batcher's ``submit`` edge — ONE home for the
+        ceiling arithmetic and its prose, so the typed-at-the-edge
+        contract and the engine-side check cannot drift apart. Returns a
+        non-retryable :class:`AdmissionDenied` or None (admissible as far
+        as static shape goes — capacity is :meth:`admit`'s call)."""
+        total = int(prompt_len) + int(max_new_tokens)
+        if prompt_len < 1:
+            return AdmissionDenied("empty prompt", retryable=False)
+        if total > self.max_len:
+            return AdmissionDenied(
+                f"request needs a {total}-token timeline; engine ceiling is "
+                f"{self.max_len} (prompt {prompt_len} + max_new_tokens "
+                f"{max_new_tokens})", retryable=False)
+        return None
+
+    def admit(self, prompt: np.ndarray,
+              max_new_tokens: int) -> Union[Slot, AdmissionDenied]:
+        """Reserve a decode row + pages for ``prompt`` — host bookkeeping
+        only, no device work (prefill runs chunk-by-chunk via
+        :meth:`prefill_step`). Returns a :class:`Slot` or a typed
+        :class:`AdmissionDenied` (never raises for load/shape reasons):
+        over the static ceiling is non-retryable — the request can never
+        run; pool/row exhaustion is retryable — retirement recycles pages.
+        """
+        if self.decode_model is None:
+            raise ValueError("engine built without decode_model")
+        prompt = np.asarray(prompt, np.int32).ravel()
+        total = len(prompt) + int(max_new_tokens)
+        unservable = self.check_admissible(len(prompt), max_new_tokens)
+        if unservable is not None:
+            return unservable
+        # Chaos seam: "defer" emulates an admission failure (behaves as no
+        # free capacity — the batcher keeps the request queued and
+        # backpressure does the shedding); the hook may also raise
+        # EngineDeadError.
+        if chaos_hooks.fire(chaos_hooks.SEAM_SERVE_ADMIT,
+                            prompt_len=len(prompt),
+                            max_new_tokens=max_new_tokens) == "defer":
+            return AdmissionDenied("admission deferred (chaos)",
+                                   retryable=True)
+        free = np.flatnonzero(self._phase == _FREE)
+        if not len(free):
+            return AdmissionDenied(
+                f"no free decode row ({self.n_slots} active)",
+                retryable=True)
+        table = self.pool.alloc(total)
+        if table is None:
+            return AdmissionDenied(
+                f"page pool exhausted ({self.pool.free_pages} of "
+                f"{self.pool.usable_pages} pages free; need "
+                f"{serve_pages.pages_for_tokens(total, self.page_len)})",
+                retryable=True)
+        idx = int(free[0])
+        self._phase[idx] = _PREFILL
+        self._tables[idx] = table
+        self._table_np[idx] = table.padded(self.max_pages)
+        self._decode_table_np[idx] = serve_pages.SCRATCH_PAGE
+        self._lengths[idx] = 0
+        self._last_token[idx] = 0
+        self._prompts[idx] = prompt
+        self._prefill_pos[idx] = 0
+        self._prefill_t0[idx] = time.perf_counter()
+        # Flight-record the admit (non-critical: batched fsync — serve load
+        # must not turn into an fsync storm). Rate is bounded by request
+        # admission, not token emission.
+        obs_recorder.record_step(
+            surface="serve", event="admit", prompt_len=len(prompt),
+            pages=len(table.pages),
+            pool_used=self.pool.used_pages, pool_free=self.pool.free_pages)
+        return Slot(idx)
+
+    def prefill_pending(self) -> List[Slot]:
+        """Slots mid-prefill, in row order — the batcher advances each by
+        one chunk per tick (chunked prefill interleaves with decode)."""
+        return [Slot(int(i)) for i in np.flatnonzero(self._phase == _PREFILL)]
+
+    def prefill_step(self, slot: Slot) -> Optional[int]:
+        """Run ONE prefill chunk for ``slot``. Returns the first generated
+        token when the prompt is fully prefilled (the slot then joins the
+        decode batch next :meth:`step`), else None."""
+        idx = slot.index
+        if self._phase[idx] != _PREFILL:
+            raise ValueError(f"slot {idx} is not prefilling")
+        prompt = self._prompts[idx]
+        start = int(self._prefill_pos[idx])
+        c = self.prefill_chunk
+        if self._prefill_fn is None:
+            self._compile()
+        chunk = np.zeros((1, c), np.int32)
+        valid = prompt[start:start + c]
+        chunk[0, : len(valid)] = valid
+        with obs_spans.span("serve.prefill_chunk", start=start,
+                            prompt_len=len(prompt)):
+            first, self._cache = self._prefill_fn(
+                self.params, jnp.asarray(chunk), np.int32(start),
+                np.int32(len(prompt)), self._cache,
+                jnp.asarray(self._table_np[idx]))
+        start += c
+        self._prefill_pos[idx] = start
+        if start < len(prompt):
+            return None
+        first = int(jax.device_get(first)[0])
+        self._phase[idx] = _DECODE
+        self._lengths[idx] = len(prompt)
+        self._last_token[idx] = first
+        self._decode_table_np[idx] = self._table_np[idx]
+        obs_recorder.record_step(
+            surface="serve", event="prefilled", prompt_len=len(prompt),
+            chunks=-(-len(prompt) // c),
+            prefill_s=round(time.perf_counter() - self._prefill_t0[idx], 6))
+        return first
+
+    def step(self) -> Dict[Slot, int]:
+        """One decode step over the full slot batch (ONE compiled program).
+
+        Feeds each decoding row its last emitted token at its current
+        position, returns ``{slot: next_token}`` for decoding rows only
+        (idle and prefilling rows ride along against the scratch page —
+        finite garbage, ignored). Host-side lengths advance here — the
+        emitted token's k/v will be written at the advanced position next
+        step.
+        """
+        out: Dict[Slot, int] = {}
+        # Chaos seam: may raise EngineDeadError (mid-decode engine death).
+        chaos_hooks.fire(chaos_hooks.SEAM_SERVE_STEP,
+                         active=self.active_slots)
+        decoding = np.flatnonzero(self._phase == _DECODE)
+        if not len(decoding):
+            return out
+        if self._decode_fn is None:
+            self._compile()
+        with obs_spans.span("serve.decode_step", active=int(len(decoding))):
+            tokens, self._cache = self._decode_fn(
+                self.params,
+                jnp.asarray(self._last_token),
+                jnp.asarray(self._lengths),
+                self._cache,
+                jnp.asarray(self._decode_table_np))
+            tokens = np.asarray(jax.device_get(tokens))
+        for idx in decoding:
+            idx = int(idx)
+            self._lengths[idx] += 1
+            self._last_token[idx] = tokens[idx]
+            out[Slot(idx)] = int(tokens[idx])
+        # Sampled flight record (1 per 64 decode rounds): enough black-box
+        # trail to show "serving was alive and at depth N" in a postmortem
+        # without a per-token write amplifying the hot loop.
+        self._decode_step_count += 1
+        if self._decode_step_count % 64 == 1:
+            obs_recorder.record_step(
+                surface="serve", event="decode",
+                decode_steps=self._decode_step_count, active_slots=len(out),
+                pool_utilization=round(self.page_utilization, 4))
+        return out
+
+    def slot_len(self, slot: Slot) -> int:
+        return int(self._lengths[slot.index])
+
+    def release(self, slot: Slot) -> None:
+        """Retire a row: its pages recycle into the pool immediately (the
+        next admission may reuse them; stale KV rows are dead weight
+        overwritten before any mask can admit them)."""
+        idx = slot.index
+        table = self._tables[idx]
+        if table is not None:
+            self.pool.release(table)
+        self._tables[idx] = None
+        self._phase[idx] = _FREE
+        self._table_np[idx] = serve_pages.SCRATCH_PAGE
+        self._decode_table_np[idx] = serve_pages.SCRATCH_PAGE
+        self._lengths[idx] = 0
+        self._last_token[idx] = 0
+        self._prompts[idx] = None
+        self._prefill_pos[idx] = 0
+
+    # ------------------------------------------------------------- generation
+    def generate(self, prompt: np.ndarray, max_new_tokens: int) -> List[int]:
+        """Single-request greedy decode — the sequential baseline (and the
+        correctness oracle's cached side). Production traffic should go
+        through the batcher; this admits one request and steps it alone.
+        """
+        admitted = self.admit(prompt, max_new_tokens)
+        if isinstance(admitted, AdmissionDenied):
+            raise RuntimeError(
+                f"single-request generate() not admitted: {admitted.reason}")
+        slot = admitted
+        try:
+            first = None
+            while first is None:
+                first = self.prefill_step(slot)
+            tokens = [first]
+            eos = self.decode_model.eos_id
+            while len(tokens) < max_new_tokens and (
+                    eos is None or tokens[-1] != eos):
+                tokens.append(self.step()[slot])
+        finally:
+            self.release(slot)
+        return tokens
+
+
+@dataclass(frozen=True)
+class BucketSlot:
+    """One occupied bucketed-engine slot: (bucket timeline length, row)."""
+
+    bucket: int
+    index: int
+
+
+@dataclass
+class _Bucket:
+    """Host-side bookkeeping for one bucket's stacked device cache."""
+
+    length: int                 # timeline capacity per slot
+    n_slots: int
+    cache: Any                  # device pytree, donated through decode
+    lengths: np.ndarray         # [slots] int32 — next write position
+    active: np.ndarray          # [slots] bool
+    last_token: np.ndarray      # [slots] int32 — token to feed next step
+    prefill_fn: Any = None      # compiled lazily
+    decode_fn: Any = None
+
+
+class BucketedInferenceEngine(_EngineBase):
+    """The pre-paging design, kept as the measured baseline: preallocated
+    length-bucketed stacked slot pools (one cache + one prefill + one
+    decode program PER bucket; a request routes to the smallest bucket
+    fitting ``prompt + max_new``). The serve selftest proves the paged
+    engine carries >=2x the concurrent requests of this engine at equal
+    KV HBM with bit-identical greedy streams; keep it for that proof and
+    as a second independent decode-path oracle — production serving is
+    :class:`InferenceEngine`.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        plan: ShardingPlan,
+        decode_model: DecodeModel,
+        n_slots: int = 8,
+        bucket_lens: Optional[Sequence[int]] = None,
+        max_len: Optional[int] = None,
+    ):
+        super().__init__(params, plan, apply_fn=None)
+        for fn in ("init_cache", "prefill", "decode_step"):
+            if getattr(decode_model, fn) is None:
+                raise ValueError(f"decode_model lacks the bucketed surface "
+                                 f"({fn})")
+        self.decode_model = decode_model
+        if n_slots % self._data_degree:
+            n_slots += self._data_degree - n_slots % self._data_degree
+        self.n_slots = n_slots
+        ceiling = min(
+            x for x in (max_len, decode_model.max_len) if x is not None
+        ) if (max_len or decode_model.max_len) else None
+        lens = list(bucket_lens or DEFAULT_BUCKET_LENS)
+        if ceiling is not None:
+            lens = [l for l in lens if l < ceiling] + [ceiling]
+        self._bucket_lens = tuple(sorted(set(lens)))
+        self.max_len = self._bucket_lens[-1]
+        self._buckets: Dict[int, _Bucket] = {}
+        cache_sh = self._slot_cache_shardings(decode_model.init_cache)
+        for length in self._bucket_lens:
+            cache = jax.device_put(
+                decode_model.init_cache(n_slots, length), cache_sh)
+            self._buckets[length] = _Bucket(
+                length=length,
+                n_slots=n_slots,
+                cache=cache,
+                lengths=np.zeros(n_slots, np.int32),
+                active=np.zeros(n_slots, bool),
+                last_token=np.zeros(n_slots, np.int32),
+            )
+
+    def _slot_cache_shardings(self, init_cache):
+        """Slot dim (dim 1 of rank>=2 leaves) over the data axis."""
         from autodist_tpu.kernel.mesh import data_sharding
 
         shaped = jax.eval_shape(lambda: init_cache(self.n_slots, 8))
@@ -289,7 +766,8 @@ class InferenceEngine:
         return jax.tree_util.tree_map(leaf_sh, shaped)
 
     def bucket_for(self, total_len: int) -> Optional[int]:
-        """Smallest bucket whose timeline fits ``total_len``; None = too long."""
+        """Smallest bucket whose timeline fits ``total_len``; None = too
+        long."""
         for length in self._bucket_lens:
             if total_len <= length:
                 return length
@@ -305,14 +783,19 @@ class InferenceEngine:
 
     @property
     def active_tokens(self) -> int:
-        """Allocated timeline tokens across active slots — the admission
-        budget's currency (capacity reserved, not yet-decoded length)."""
+        """Allocated timeline tokens across active slots (capacity
+        reserved, not yet-decoded length)."""
         return sum(
             int(b.active.sum()) * b.length for b in self._buckets.values())
 
+    @property
+    def kv_pool_tokens(self) -> int:
+        """Total timeline tokens the stacked pools hold in HBM — the
+        equal-HBM axis the selftest sizes the paged pool against."""
+        return sum(b.n_slots * b.length for b in self._buckets.values())
+
     def _compile_bucket(self, bucket: _Bucket) -> None:
         dm = self.decode_model
-        # donate the cache: decode/prefill rewrite it in place on device.
         bucket.prefill_fn = jax.jit(
             lambda p, tokens, length, cache, slot: dm.prefill(
                 self.plan.unpad_params(p), tokens, length, cache, slot),
@@ -323,21 +806,12 @@ class InferenceEngine:
             donate_argnums=(3,))
 
     def admit(self, prompt: np.ndarray, max_new_tokens: int,
-              token_budget: Optional[int] = None) -> Optional[Tuple[Slot, int]]:
-        """Prefill ``prompt`` into a free slot of the smallest fitting bucket.
-
-        Returns ``(slot, first_token)`` — prefill already emits the first
-        generated token — or None when every fitting bucket is full (the
-        batcher keeps the request queued). ``token_budget`` caps the
-        timeline length this admission may *allocate*: a full small bucket
-        must not spill into a larger one past the batcher's max-token
-        budget. Raises ValueError when ``len(prompt) + max_new_tokens``
-        exceeds the largest bucket: such a request can never be placed, and
-        queueing it would head-block the FIFO forever (the deadlock the
-        acceptance bar forbids).
-        """
-        if self.decode_model is None:
-            raise ValueError("engine built without decode_model")
+              token_budget: Optional[int] = None,
+              ) -> Optional[Tuple[BucketSlot, int]]:
+        """Prefill ``prompt`` into a free slot of the smallest fitting
+        bucket (spilling to larger ones when full). Returns ``(slot,
+        first_token)`` or None when every fitting bucket is full; raises
+        ValueError past the largest bucket."""
         prompt = np.asarray(prompt, np.int32).ravel()
         total = len(prompt) + max_new_tokens
         fit = self.bucket_for(total)
@@ -346,13 +820,6 @@ class InferenceEngine:
                 f"request needs a {total}-token timeline; largest bucket is "
                 f"{self._bucket_lens[-1]} (prompt {len(prompt)} + "
                 f"max_new_tokens {max_new_tokens})")
-        # Chaos seam: "defer" emulates an admission failure (behaves as no
-        # free slot — the batcher keeps the request queued and backpressure
-        # does the shedding); the hook may also raise EngineDeadError.
-        if chaos_hooks.fire(chaos_hooks.SEAM_SERVE_ADMIT,
-                            prompt_len=len(prompt),
-                            max_new_tokens=max_new_tokens) == "defer":
-            return None
         for length in self._bucket_lens:
             if length < fit:
                 continue
@@ -367,83 +834,46 @@ class InferenceEngine:
                 self._compile_bucket(bucket)
             padded = np.zeros((1, length), np.int32)
             padded[0, : len(prompt)] = prompt
-            t_prefill = time.perf_counter()
-            with obs_spans.span("serve.prefill", bucket=length,
-                                prompt_len=len(prompt)):
-                first, bucket.cache = bucket.prefill_fn(
-                    self.params, jnp.asarray(padded),
-                    jnp.int32(len(prompt)), bucket.cache, jnp.int32(idx))
-                first = int(jax.device_get(first)[0])
-            # Flight-record the admit (non-critical: batched fsync — serve
-            # load must not turn into an fsync storm). Rate is bounded by
-            # request admission, not token emission.
-            obs_recorder.record_step(
-                surface="serve", event="admit", bucket=length,
-                prompt_len=len(prompt),
-                prefill_s=round(time.perf_counter() - t_prefill, 6))
+            first, bucket.cache = bucket.prefill_fn(
+                self.params, jnp.asarray(padded),
+                jnp.int32(len(prompt)), bucket.cache, jnp.int32(idx))
+            first = int(jax.device_get(first)[0])
             bucket.active[idx] = True
             bucket.lengths[idx] = len(prompt)
             bucket.last_token[idx] = first
-            return Slot(length, idx), first
+            return BucketSlot(length, idx), first
         return None
 
-    def step(self) -> Dict[Slot, int]:
-        """One decode step over every bucket with active slots.
-
-        Feeds each slot its last emitted token at its current position,
-        returns ``{slot: next_token}`` for active slots only. Host-side
-        lengths advance here — the emitted token's k/v will be written at
-        the advanced position next step.
-        """
-        out: Dict[Slot, int] = {}
-        # Chaos seam: may raise EngineDeadError (mid-decode engine death).
-        chaos_hooks.fire(chaos_hooks.SEAM_SERVE_STEP,
-                         active=self.active_slots)
+    def step(self) -> Dict[BucketSlot, int]:
+        """One decode step over every bucket with active slots (one
+        compiled program per bucket — the per-length-mix compile cost the
+        paged engine exists to delete)."""
+        out: Dict[BucketSlot, int] = {}
         for length, bucket in self._buckets.items():
             if not bucket.active.any():
                 continue
             if bucket.decode_fn is None:
                 self._compile_bucket(bucket)
-            with obs_spans.span("serve.decode_step", bucket=length,
-                                active=int(bucket.active.sum())):
-                tokens, bucket.cache = bucket.decode_fn(
-                    self.params,
-                    jnp.asarray(bucket.last_token),
-                    jnp.asarray(bucket.lengths),
-                    bucket.cache)
-                tokens = np.asarray(jax.device_get(tokens))
+            tokens, bucket.cache = bucket.decode_fn(
+                self.params,
+                jnp.asarray(bucket.last_token),
+                jnp.asarray(bucket.lengths),
+                bucket.cache)
+            tokens = np.asarray(jax.device_get(tokens))
             for idx in np.flatnonzero(bucket.active):
                 idx = int(idx)
                 bucket.lengths[idx] += 1
                 bucket.last_token[idx] = tokens[idx]
-                out[Slot(length, idx)] = int(tokens[idx])
-        # Sampled flight record (1 per 64 decode rounds): enough black-box
-        # trail to show "serving was alive and at depth N" in a postmortem
-        # without a per-token write amplifying the hot loop.
-        self._decode_step_count = getattr(self, "_decode_step_count", 0) + 1
-        if self._decode_step_count % 64 == 1:
-            obs_recorder.record_step(
-                surface="serve", event="decode",
-                decode_steps=self._decode_step_count, active_slots=len(out))
+                out[BucketSlot(length, idx)] = int(tokens[idx])
         return out
 
-    def slot_len(self, slot: Slot) -> int:
-        return int(self._buckets[slot.bucket].lengths[slot.index])
-
-    def release(self, slot: Slot) -> None:
-        """Recycle a slot mid-batch: the row is immediately admittable; its
-        cache rows are dead weight overwritten by the next prefill."""
+    def release(self, slot: BucketSlot) -> None:
         bucket = self._buckets[slot.bucket]
         bucket.active[slot.index] = False
         bucket.lengths[slot.index] = 0
         bucket.last_token[slot.index] = 0
 
-    # ------------------------------------------------------------- generation
     def generate(self, prompt: np.ndarray, max_new_tokens: int) -> List[int]:
-        """Single-request greedy decode — the sequential baseline (and the
-        correctness oracle's cached side). Production traffic should go
-        through the batcher; this admits one request and steps it alone.
-        """
         admitted = self.admit(prompt, max_new_tokens)
         if admitted is None:
             raise RuntimeError("no free slot for a single-request generate()")
@@ -451,7 +881,8 @@ class InferenceEngine:
         tokens = [first]
         eos = self.decode_model.eos_id
         try:
-            while len(tokens) < max_new_tokens and (eos is None or tokens[-1] != eos):
+            while len(tokens) < max_new_tokens and (
+                    eos is None or tokens[-1] != eos):
                 tokens.append(self.step()[slot])
         finally:
             self.release(slot)

@@ -1,0 +1,205 @@
+"""Paged KV-cache bookkeeping: the ONE page-table/pool allocator home.
+
+The serving engine's decode state is a single fixed-size pool of KV pages
+(device arrays ``[layers, n_pages, page_len, heads, head_dim]``, owned by
+:class:`~autodist_tpu.serve.engine.InferenceEngine`); WHICH pages belong
+to WHICH request is pure host arithmetic, and it all lives here — the
+same single-home pattern as ``kernel/bucketing.py`` (gradient collectives)
+and ``utils/retry.py`` (backoff): ``tools/check_patterns.py`` rule 8 bans
+page-pool/page-table construction anywhere else, so the admission math,
+the analyzer's HBM accounting, the obs gauges and the chaos injector all
+share one source of truth for "how many tokens fit".
+
+Page 0 is a reserved **scratch page** that is never allocated: page
+tables are padded to a static length with it, so a request's pad entries
+(and idle decode rows) scatter/gather against scratch instead of a live
+request's pages — static shapes everywhere with zero masking in the
+kernel's index math.
+
+Chaos seam: :data:`~autodist_tpu.chaos.hooks.SEAM_SERVE_PAGES` fires on
+every allocation; a planted ``"exhaust"`` directive makes the pool report
+exhaustion (the ``page_exhaustion`` fault class — a burst past pool
+capacity must shed typed, never hang or OOM; docs/chaos.md).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from autodist_tpu.chaos import hooks as chaos_hooks
+
+__all__ = [
+    "DEFAULT_PAGE_LEN",
+    "SCRATCH_PAGE",
+    "PagePool",
+    "PageTable",
+    "build_pool",
+    "pages_for_tokens",
+]
+
+DEFAULT_PAGE_LEN = 16
+#: Reserved page index — never allocated, pads every page table.
+SCRATCH_PAGE = 0
+
+
+def pages_for_tokens(n_tokens: int, page_len: int) -> int:
+    """Pages needed to hold ``n_tokens`` timeline tokens (ceil division)."""
+    return max(1, -(-int(n_tokens) // int(page_len)))
+
+
+class PageTable:
+    """One request's page list: ``capacity`` timeline tokens of KV rows.
+
+    Token position ``p`` lives at device page ``pages[p // page_len]``,
+    offset ``p % page_len``. :meth:`padded` renders the static-shape int32
+    row the compiled programs consume (pad entries point at scratch).
+    """
+
+    __slots__ = ("pages", "page_len")
+
+    def __init__(self, pages: List[int], page_len: int):
+        self.pages = list(pages)
+        self.page_len = int(page_len)
+
+    @property
+    def capacity(self) -> int:
+        """Timeline tokens these pages can hold."""
+        return len(self.pages) * self.page_len
+
+    def padded(self, max_pages: int) -> np.ndarray:
+        """Static ``[max_pages]`` int32 row, padded with the scratch page."""
+        row = np.full(max_pages, SCRATCH_PAGE, np.int32)
+        row[: len(self.pages)] = self.pages
+        return row
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"PageTable(pages={self.pages}, page_len={self.page_len})"
+
+
+class PagePool:
+    """Fixed pool of KV pages with LIFO recycling.
+
+    Thread-safe (``alloc``/``release`` may race between a scheduler thread
+    and a draining controller); allocation is all-or-nothing — a request
+    either gets every page its ``prompt + max_new_tokens`` timeline needs
+    or ``None`` (the batcher keeps it queued until retirement recycles
+    pages). Page 0 (scratch) is never handed out.
+    """
+
+    def __init__(self, n_pages: int, page_len: int):
+        if n_pages < 2:
+            raise ValueError(f"pool needs >=2 pages (1 scratch + >=1 "
+                             f"allocatable), got {n_pages}")
+        self.n_pages = int(n_pages)
+        self.page_len = int(page_len)
+        self._lock = threading.Lock()
+        # LIFO free list: recycled pages are reused first (warm HBM rows).
+        self._free = list(range(self.n_pages - 1, SCRATCH_PAGE, -1))
+        self._allocated: set = set()
+
+    # ------------------------------------------------------------- accounting
+    @property
+    def usable_pages(self) -> int:
+        """Allocatable pages (total minus the scratch page)."""
+        return self.n_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        with self._lock:
+            return len(self._allocated)
+
+    @property
+    def utilization(self) -> float:
+        """Allocated fraction of the usable pool, 0..1."""
+        return self.used_pages / max(self.usable_pages, 1)
+
+    @property
+    def allocated_tokens(self) -> int:
+        """Timeline capacity currently reserved (pages * page_len) — the
+        admission budget's currency."""
+        return self.used_pages * self.page_len
+
+    def fragmentation(self, written_tokens: int) -> float:
+        """Internal fragmentation: the fraction of reserved timeline slots
+        not (yet) holding a real token — tail waste inside part-filled
+        pages plus capacity reserved for tokens not yet decoded."""
+        alloc = self.allocated_tokens
+        if alloc <= 0:
+            return 0.0
+        return max(0.0, 1.0 - float(written_tokens) / alloc)
+
+    # ------------------------------------------------------------- allocation
+    def alloc(self, n_tokens: int) -> Optional[PageTable]:
+        """Reserve pages for an ``n_tokens`` timeline, or None when the
+        pool cannot cover it (all-or-nothing; the chaos seam may force
+        the None path to exercise the exhaustion contract)."""
+        need = pages_for_tokens(n_tokens, self.page_len)
+        if chaos_hooks.fire(chaos_hooks.SEAM_SERVE_PAGES,
+                            need=need, tokens=int(n_tokens)) == "exhaust":
+            return None
+        with self._lock:
+            if need > len(self._free):
+                return None
+            got = [self._free.pop() for _ in range(need)]
+            self._allocated.update(got)
+        return PageTable(got, self.page_len)
+
+    def release(self, table: PageTable) -> None:
+        """Recycle a table's pages; immediately reallocatable."""
+        with self._lock:
+            for p in table.pages:
+                if p not in self._allocated:
+                    raise ValueError(f"double free of page {p}")
+                self._allocated.discard(p)
+                self._free.append(p)
+        table.pages = []
+
+
+def build_pool(n_pages: int, page_len: int = DEFAULT_PAGE_LEN) -> PagePool:
+    """The one constructor call sites use (check_patterns rule 8 bans
+    direct pool/table construction outside this module)."""
+    return PagePool(n_pages, page_len)
+
+
+def pool_size_from_spec(
+    resource_spec,
+    bytes_per_page: float,
+    params_bytes: float = 0.0,
+    headroom: float = 0.8,
+    serve_frac: float = 0.5,
+    shard_degree: int = 1,
+    max_useful_pages: Optional[int] = None,
+    min_useful_pages: int = 1,
+) -> int:
+    """Page count (INCLUDING the scratch page) from per-chip HBM headroom.
+
+    ``serve_frac`` of the usable HBM left after the resident params funds
+    the KV pool — the same capacity/headroom vocabulary as the analyzer's
+    SLM passes (``analysis/passes.py::hbm_budget``), so what the engine
+    allocates and what shardlint accounts are one formula.
+    ``bytes_per_page`` is the FULL logical bytes of one page;
+    ``shard_degree`` is how many chips the pool's page dim shards over —
+    the per-chip budget funds ``degree`` times more logical pages than it
+    could hold replicated (``params_bytes`` stays the conservative full
+    logical size: exact for replicated-param serving, an under-estimate
+    of headroom for model-parallel plans — never an overcommit).
+    ``max_useful_pages`` caps at the point more pages cannot help (every
+    decode row at the full ``max_len`` timeline); ``min_useful_pages``
+    floors at a functioning pool — an overcommit is the analyzer's SLM
+    finding to report, not a constructor crash.
+    """
+    capacity = float(resource_spec.tpu.hbm_bytes) if resource_spec else 0.0
+    budget = max(0.0, capacity * headroom - float(params_bytes)) * serve_frac
+    budget *= max(int(shard_degree), 1)
+    n = int(budget // max(float(bytes_per_page), 1.0))
+    if max_useful_pages is not None:
+        n = min(n, int(max_useful_pages))
+    n = max(n, int(min_useful_pages))
+    return n + 1  # + the reserved scratch page

@@ -165,6 +165,12 @@ class ServeFrontend:
         except ValueError as e:
             self._respond(writer, 400, {"error": str(e)})
             return
+        if req.state is RequestState.REJECTED and req.unservable:
+            # Typed admission rejection for an unservable request (over the
+            # engine's static max_len) — the client's bug, not load: 400,
+            # matching the pre-paging ValueError contract.
+            self._respond(writer, 400, {"error": req.error})
+            return
         self._respond(writer, 200, {
             "id": req.id,
             "state": req.state.value,
@@ -174,19 +180,38 @@ class ServeFrontend:
 
 
 # ---------------------------------------------------------------- selftest
-def _tiny_engine(n_slots: int = 8):
-    """CPU-sim engine: a tiny fp32 transformer through the full
-    ``AutoDist.build_inference`` path (strategy → plan → engine)."""
-    import jax
+#: The bucketed baseline's geometry: 4 slots in each of three buckets =
+#: 448 KV timeline tokens resident in HBM. The paged engine is sized to
+#: the SAME 448 tokens (56 pages x 8 incl. the scratch page) — the
+#: equal-HBM axis of the >=2x concurrency proof.
+_BASELINE_SLOTS = 4
+_BASELINE_BUCKETS = (16, 32, 64)
+_PAGE_LEN = 8
+_N_PAGES = _BASELINE_SLOTS * sum(_BASELINE_BUCKETS) // _PAGE_LEN
+
+
+def _tiny_cfg():
     import jax.numpy as jnp
 
-    from autodist_tpu.api import AutoDist
-    from autodist_tpu.models.transformer import (
-        TransformerConfig, decode_model, init_params)
+    from autodist_tpu.models.transformer import TransformerConfig
 
-    cfg = TransformerConfig(
+    return TransformerConfig(
         vocab_size=128, num_layers=2, d_model=32, num_heads=2, d_ff=64,
         max_seq_len=64, causal=True, dtype=jnp.float32)
+
+
+def _tiny_engine(n_slots: int = 32, page_len: int = _PAGE_LEN,
+                 n_pages: Optional[int] = _N_PAGES):
+    """CPU-sim paged engine: a tiny fp32 transformer through the full
+    ``AutoDist.build_inference`` path (strategy → plan → engine).
+    Returns ``(engine, params, cfg)`` so callers can stand a bucketed
+    baseline on the same checkpoint + plan."""
+    import jax
+
+    from autodist_tpu.api import AutoDist
+    from autodist_tpu.models.transformer import decode_model, init_params
+
+    cfg = _tiny_cfg()
     params = init_params(jax.random.PRNGKey(0), cfg)
     AutoDist.reset_default()
     autodist = AutoDist()
@@ -194,29 +219,102 @@ def _tiny_engine(n_slots: int = 8):
         params,
         decode_model=decode_model(cfg),
         n_slots=n_slots,
-        bucket_lens=(16, 32, 64),
+        page_len=page_len,
+        n_pages=n_pages,
+        prefill_chunk=page_len,
     )
     AutoDist.reset_default()
-    return engine
+    return engine, params, cfg
 
 
-def selftest(n_requests: int = 64, n_slots: int = 8, max_new: int = 12,
+def mock_load_prompt(rng, i: Optional[int] = None, long_every: int = 8):
+    """The canonical mixed serving load: mostly short chat-style prompts
+    with every ``long_every``-th request a long (multi-chunk-prefill)
+    one. ONE definition shared by the selftest's acceptance run and
+    ``bench.py``'s ``serve_decode`` workload, so the workload the bench
+    measures IS the workload the acceptance bar proves."""
+    if i is not None and i % long_every == long_every // 2:
+        return rng.integers(1, 127, size=int(rng.integers(30, 45)))
+    return rng.integers(1, 127, size=int(rng.integers(3, 12)))
+
+
+def _admission_capacity(engine, prompt_len: int, max_new: int,
+                        limit: int = 1024) -> int:
+    """How many concurrent requests the engine can hold admitted at once
+    (idle probe: reserve until denied, then release everything). For the
+    paged engine admission is page bookkeeping only; for the bucketed
+    baseline each admit runs its prefill — both count CAPACITY, the HBM
+    figure the >=2x bar compares."""
+    from autodist_tpu.serve.engine import AdmissionDenied
+
+    held = []
+    prompt = np.arange(1, prompt_len + 1, dtype=np.int32)
+    for _ in range(limit):
+        got = engine.admit(prompt, max_new)
+        if got is None or isinstance(got, AdmissionDenied):
+            break
+        held.append(got[0] if isinstance(got, tuple) else got)
+    for slot in held:
+        engine.release(slot)
+    return len(held)
+
+
+def selftest(n_requests: int = 64, n_slots: int = 32, max_new: int = 12,
              seed: int = 0) -> int:
     """The acceptance proof; returns a process exit code.
 
+    Phase 0 (paged-vs-bucketed): a :class:`BucketedInferenceEngine` is
+    stood up on the SAME checkpoint and plan with 448 KV timeline tokens
+    in HBM; the paged engine is sized to the same 448 tokens and must (a)
+    hold >=2x the concurrently-admitted requests on a short-request mix
+    with zero admission drops, and (b) produce bit-identical greedy token
+    streams on shared prompts (short, page-crossing, multi-chunk).
     Phase 1 (sequential baseline): single requests generated back-to-back
-    through the engine — one active slot, no batching. Phase 2 (batched):
-    ``n_requests`` concurrent mock clients through the asyncio bridge and
-    the continuous batcher. Asserts zero dropped/deadlocked requests and
-    batched tokens/sec strictly above sequential, then prints one JSON line
-    with p50/p99 latency and throughput from the metrics registry.
+    through the paged engine. Phase 2 (batched): ``n_requests``
+    concurrent mock clients — mixed short and long (chunked-prefill)
+    prompts — through the asyncio bridge and the continuous batcher.
+    Asserts zero dropped/deadlocked requests, batched tokens/sec strictly
+    above sequential, and exactly TWO compiled serving programs (one
+    decode + one chunked prefill) after the whole mixed-length run, then
+    prints one JSON line with p50/p99 latency and throughput from the
+    metrics registry.
     """
+    from autodist_tpu.serve.engine import BucketedInferenceEngine
+
     registry = M.MetricsRegistry()
     rng = np.random.default_rng(seed)
-    engine = _tiny_engine(n_slots=n_slots)
+    engine, params, cfg = _tiny_engine(n_slots=n_slots)
 
-    def mock_prompt():
-        return rng.integers(1, 127, size=int(rng.integers(3, 12)))
+    from autodist_tpu.models.transformer import decode_model as _dm
+
+    bucketed = BucketedInferenceEngine(
+        params, engine.plan, decode_model=_dm(cfg),
+        n_slots=_BASELINE_SLOTS, bucket_lens=_BASELINE_BUCKETS)
+    paged_pool_tokens = engine.pool.n_pages * engine.page_len
+    if paged_pool_tokens > bucketed.kv_pool_tokens:
+        raise AssertionError(
+            f"equal-HBM premise broken: paged pool holds "
+            f"{paged_pool_tokens} timeline tokens vs bucketed "
+            f"{bucketed.kv_pool_tokens}")
+
+    # ---- concurrency at equal HBM (short-request mix: 6 prompt + 6 new).
+    paged_cap = _admission_capacity(engine, 6, 6)
+    bucketed_cap = _admission_capacity(bucketed, 6, 6)
+    concurrency_x = paged_cap / max(bucketed_cap, 1)
+
+    # ---- greedy bit-equality on the same checkpoint (short, page-
+    # crossing, multi-chunk prompts).
+    parity_prompts = [
+        np.array([5, 17, 3, 88, 2], np.int32),
+        rng.integers(1, 127, size=20).astype(np.int32),   # crosses pages
+        rng.integers(1, 127, size=41).astype(np.int32),   # many chunks
+    ]
+    parity_ok = all(
+        engine.generate(p, 10) == bucketed.generate(p, 10)
+        for p in parity_prompts)
+
+    def mock_prompt(i=None):
+        return mock_load_prompt(rng, i)
 
     # Warm the compile caches outside both timed phases (compile time is a
     # one-off; the throughput comparison is about steady-state batching).
@@ -224,7 +322,7 @@ def selftest(n_requests: int = 64, n_slots: int = 8, max_new: int = 12,
 
     t0 = time.monotonic()
     seq_tokens = 0
-    for _ in range(max(4, n_slots)):
+    for _ in range(8):
         seq_tokens += len(engine.generate(mock_prompt(), max_new))
     seq_tps = seq_tokens / (time.monotonic() - t0)
 
@@ -236,7 +334,7 @@ def selftest(n_requests: int = 64, n_slots: int = 8, max_new: int = 12,
             # Stagger arrivals slightly: a realistic open-loop trickle, and
             # it exercises admission racing retirement.
             await asyncio.sleep(0.001 * (i % 8))
-            return await async_generate(batcher, mock_prompt(), max_new)
+            return await async_generate(batcher, mock_prompt(i), max_new)
 
         return await asyncio.gather(*(client(i) for i in range(n_requests)))
 
@@ -253,9 +351,13 @@ def selftest(n_requests: int = 64, n_slots: int = 8, max_new: int = 12,
     states = {s: sum(1 for r in results if r.state is s) for s in RequestState}
     snap = registry.snapshot()
     lat = snap.get("serve_request_latency_s", {})
+    programs = engine.compiled_programs
     ok = (
         states.get(RequestState.DONE, 0) == n_requests
         and batched_tps > seq_tps
+        and concurrency_x >= 2.0
+        and parity_ok
+        and programs == 2
     )
     line = {
         "selftest": "autodist_tpu.serve",
@@ -270,12 +372,22 @@ def selftest(n_requests: int = 64, n_slots: int = 8, max_new: int = 12,
         "speedup": round(batched_tps / seq_tps, 2) if seq_tps else None,
         "tokens_generated": int(snap.get("serve_tokens_generated_total", 0)),
         "queue_depth_final": int(snap.get("serve_queue_depth", 0)),
-        "n_slots": n_slots,
+        "paged_capacity": paged_cap,
+        "bucketed_capacity": bucketed_cap,
+        "concurrency_x_vs_bucketed": round(concurrency_x, 2),
+        "kv_pool_tokens": paged_pool_tokens,
+        "paged_vs_bucketed_bit_equal": bool(parity_ok),
+        "programs_compiled": programs,
+        "page_len": engine.page_len,
+        "n_pages": engine.pool.n_pages,
+        "n_slots": engine.n_slots,
         "device": __import__("jax").devices()[0].platform,
     }
     print(json.dumps(line))
     if not ok:
-        logging.warning("selftest failed: states=%s seq=%.1f batched=%.1f",
-                        {s.value: n for s, n in states.items() if n},
-                        seq_tps, batched_tps)
+        logging.warning(
+            "selftest failed: states=%s seq=%.1f batched=%.1f "
+            "concurrency_x=%.2f parity=%s programs=%d",
+            {s.value: n for s, n in states.items() if n},
+            seq_tps, batched_tps, concurrency_x, parity_ok, programs)
     return 0 if ok else 1

@@ -263,6 +263,12 @@ class TestFaultClassPins:
         assert "REJECTED(queue full)" in res.detected
         assert "shed event" in res.detected
 
+    def test_page_exhaustion_burst_sheds_typed_then_drains(self, tmp_path):
+        res = _run("page_exhaustion", tmp_path)
+        assert "REJECTED(queue full)" in res.detected
+        assert "shed event" in res.detected
+        assert "QUEUED(deferred)" in res.detected
+
     def test_engine_death_sheds_all_doc006(self, tmp_path):
         res = _run("engine_death", tmp_path)
         assert res.detected == ["REJECTED(engine died)", "DOC006"]
@@ -313,15 +319,19 @@ class TestCLI:
 # ------------------------------------------- serve admission retry adoption
 class _StubEngine:
     """Just enough surface for ContinuousBatcher admission (the scheduler
-    thread is never started, so decode is never touched)."""
+    thread is never started, so neither decode nor the page pool is ever
+    touched)."""
     decode_model = object()
     n_slots = 2
     max_len = 16
-    _bucket_lens = (16,)
 
     @staticmethod
-    def bucket_for(total):
-        return 16 if total <= 16 else None
+    def check_admissible(prompt_len, max_new_tokens):
+        from autodist_tpu.serve.engine import AdmissionDenied
+
+        if prompt_len + max_new_tokens > 16:
+            return AdmissionDenied("over stub ceiling", retryable=False)
+        return None
 
 
 class TestServeAdmissionRetry:

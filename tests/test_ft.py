@@ -375,7 +375,7 @@ def serve_engine():
         autodist = AutoDist(strategy_builder=AllReduce())
         yield autodist.build_inference(
             params, decode_model=decode_model(cfg),
-            n_slots=8, bucket_lens=(16, 32))
+            n_slots=8, page_len=8, n_pages=33, prefill_chunk=8)
     finally:
         AutoDist.reset_default()
 

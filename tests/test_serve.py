@@ -1,11 +1,14 @@
-"""Serving subsystem tests: KV-cache decode correctness, engine slot pool,
-continuous batcher semantics (admission, backpressure, deadlines, slot
-recycling), the metrics registry, and the build_inference API seam.
+"""Serving subsystem tests: paged KV-cache decode correctness, page-pool
+admission, continuous batcher semantics (page-availability admission,
+backpressure, typed rejection, deadlines, page recycling), the metrics
+registry, and the build_inference API seam.
 
 The load-bearing test is the correctness anchor the acceptance bar names:
 cached greedy decode must match the uncached full-sequence forward
 token-for-token — including a request that JOINS MID-BATCH, which is the
 case continuous batching actually creates (per-slot positions diverge).
+Paged-vs-bucketed engine parity and the chunked-prefill interleaving pins
+live in tests/test_serve_paged.py.
 """
 import time
 
@@ -23,6 +26,7 @@ from autodist_tpu.models.transformer import (
     init_params,
 )
 from autodist_tpu.serve import (
+    AdmissionDenied,
     Backpressure,
     ContinuousBatcher,
     InferenceEngine,
@@ -48,7 +52,7 @@ def engine(params):
         autodist = AutoDist(strategy_builder=AllReduce())
         yield autodist.build_inference(
             params, decode_model=decode_model(CFG),
-            n_slots=8, bucket_lens=(16, 32))
+            n_slots=8, page_len=8, n_pages=33, prefill_chunk=8)
     finally:
         AutoDist.reset_default()
 
@@ -70,6 +74,16 @@ def uncached_greedy(params, prompt, n_new, pad_to=CFG.max_seq_len):
     return seq[len(prompt):]
 
 
+def admit_and_prefill(engine, prompt, n_new):
+    """Admit + run every prefill chunk; returns (slot, first_token)."""
+    slot = engine.admit(np.asarray(prompt, np.int32), n_new)
+    assert not isinstance(slot, AdmissionDenied), slot
+    first = None
+    while first is None:
+        first = engine.prefill_step(slot)
+    return slot, first
+
+
 # ----------------------------------------------------------- decode kernel
 def test_cached_greedy_decode_matches_uncached_forward(params, engine):
     """Acceptance anchor: cached == uncached, token for token, INCLUDING a
@@ -79,11 +93,11 @@ def test_cached_greedy_decode_matches_uncached_forward(params, engine):
     p2 = np.array([9, 1, 42], np.int32)
     n_new = 10
 
-    slot1, first1 = engine.admit(p1, n_new)
+    slot1, first1 = admit_and_prefill(engine, p1, n_new)
     got1 = [first1]
     for _ in range(3):  # r1 decodes alone for a few steps...
         got1.append(engine.step()[slot1])
-    slot2, first2 = engine.admit(p2, n_new)  # ...then r2 joins mid-batch
+    slot2, first2 = admit_and_prefill(engine, p2, n_new)  # ...r2 joins
     got2 = [first2]
     while len(got1) < n_new or len(got2) < n_new:
         out = engine.step()
@@ -98,31 +112,62 @@ def test_cached_greedy_decode_matches_uncached_forward(params, engine):
     assert got2 == uncached_greedy(params, p2, n_new)
 
 
-def test_generate_matches_oracle_per_bucket(params, engine):
-    # Exercise both bucket lengths (prompt+new <=16 vs <=32): each bucket is
-    # a separate compiled program and cache pool.
+def test_generate_matches_oracle_across_page_counts(params, engine):
+    # Short (1 page) and long (3 pages, multiple prefill chunks) prompts:
+    # same two compiled programs, same oracle stream.
     for prompt, n_new in (([7, 11, 13], 8), (list(range(1, 20)), 8)):
         got = engine.generate(np.asarray(prompt, np.int32), n_new)
         assert got == uncached_greedy(params, np.asarray(prompt), n_new)
+    assert engine.compiled_programs == 2
 
 
 def test_slot_accounting_and_release(engine):
     assert engine.active_slots == 0
-    slot, _ = engine.admit(np.array([1, 2, 3], np.int32), 4)
+    pool_free = engine.pool.free_pages
+    slot = engine.admit(np.array([1, 2, 3], np.int32), 4)
     assert engine.active_slots == 1
-    assert engine.active_tokens == slot.bucket
+    # prompt 3 + max_new 4 = 7 tokens -> 1 page of 8; capacity reserved.
+    assert engine.pool.free_pages == pool_free - 1
+    assert engine.active_tokens == engine.page_len
     engine.release(slot)
     assert engine.active_slots == 0 and engine.active_tokens == 0
+    assert engine.pool.free_pages == pool_free
 
 
-def test_admit_rejects_impossible_request(engine):
-    with pytest.raises(ValueError, match="largest bucket"):
-        engine.admit(np.arange(30, dtype=np.int32) % 7, 100)
+def test_admit_denies_impossible_request_typed(engine):
+    denied = engine.admit(np.arange(30, dtype=np.int32) % 7, 100)
+    assert isinstance(denied, AdmissionDenied)
+    assert not denied.retryable
+    assert "ceiling" in denied.reason
+
+
+def test_admit_denies_exhausted_pool_retryable(params):
+    """A pool too small for the load defers typed-retryable; releasing a
+    request recycles its pages and admission proceeds."""
+    AutoDist.reset_default()
+    try:
+        autodist = AutoDist(strategy_builder=AllReduce())
+        # 8 pages (data-degree aligned) -> 7 usable after scratch.
+        small = autodist.build_inference(
+            params, decode_model=decode_model(CFG),
+            n_slots=8, page_len=8, n_pages=8, prefill_chunk=8)
+    finally:
+        AutoDist.reset_default()
+    s1 = small.admit(np.array([1, 2], np.int32), 30)   # 32 tok -> 4 pages
+    assert not isinstance(s1, AdmissionDenied)
+    denied = small.admit(np.array([3, 4], np.int32), 30)  # needs 4, 3 free
+    assert isinstance(denied, AdmissionDenied) and denied.retryable
+    assert "page pool exhausted" in denied.reason
+    small.release(s1)
+    s2 = small.admit(np.array([3, 4], np.int32), 30)
+    assert not isinstance(s2, AdmissionDenied)
+    small.release(s2)
 
 
 # ---------------------------------------------------------------- batcher
-def test_batcher_completes_all_with_slot_recycling(engine):
-    """More requests than slots: completion requires recycling mid-run."""
+def test_batcher_completes_all_with_page_recycling(engine):
+    """More requests than rows or pages: completion requires recycling
+    mid-run."""
     reg = M.MetricsRegistry()
     rng = np.random.default_rng(0)
     with ContinuousBatcher(engine, max_queue=64, registry=reg) as batcher:
@@ -135,6 +180,7 @@ def test_batcher_completes_all_with_slot_recycling(engine):
             r.wait(timeout=120)
     assert all(r.state is RequestState.DONE for r in reqs)
     assert all(len(r.tokens) == 5 for r in reqs)
+    assert engine.pool.used_pages == 0  # every page recycled
     snap = reg.snapshot()
     assert snap["serve_requests_completed_total"] == 20
     assert snap["serve_tokens_generated_total"] == 100
@@ -164,9 +210,22 @@ def test_backpressure_bounded_queue(engine):
     with pytest.raises(Backpressure):
         batcher.submit([5, 6], max_new_tokens=2)
     assert reg.snapshot()["serve_requests_rejected_total"] == 1
-    # Unservable requests reject at the edge (never head-block the FIFO).
-    with pytest.raises(ValueError, match="exceeds the largest"):
-        batcher.submit(list(range(1, 31)), max_new_tokens=50)
+
+
+def test_over_ceiling_submit_is_typed_rejection(engine):
+    """A request that can NEVER run (over the engine's static max_len)
+    comes back already terminal REJECTED — typed admission at the edge,
+    not an exception, never a stuck queue head."""
+    reg = M.MetricsRegistry()
+    batcher = ContinuousBatcher(engine, max_queue=8, registry=reg)
+    req = batcher.submit(list(range(1, 31)), max_new_tokens=50)
+    assert req.done
+    assert req.state is RequestState.REJECTED
+    assert req.unservable          # typed cause: HTTP 400 / replay-drop
+    assert "ceiling" in req.error
+    assert reg.snapshot()["serve_requests_rejected_total"] == 1
+    # The queue stayed empty: the rejection never head-blocked anything.
+    assert len(batcher._queue) == 0
 
 
 def test_deadline_times_out_queued_request(engine):
@@ -255,7 +314,7 @@ def test_build_inference_checkpoint_roundtrip(tmp_path, params):
             jax.eval_shape(lambda: params),  # template only: shapes, no values
             decode_model=decode_model(CFG),
             checkpoint=str(tmp_path),
-            n_slots=8, bucket_lens=(16,),
+            n_slots=8, page_len=8, n_pages=17,
         )
     finally:
         AutoDist.reset_default()
@@ -274,14 +333,3 @@ def test_stop_fails_leftover_requests_terminally(engine):
     assert "stopped" in r1.error
     with pytest.raises(Backpressure, match="stopped"):
         batcher.submit([3, 4], max_new_tokens=2)
-
-
-def test_admit_token_budget_blocks_bucket_spillover(engine):
-    """A full/over-budget small bucket must not silently allocate a larger
-    timeline past the batcher's token budget."""
-    assert engine.admit(np.array([1, 2], np.int32), 4, token_budget=8) is None
-    admitted = engine.admit(np.array([1, 2], np.int32), 4, token_budget=16)
-    assert admitted is not None
-    slot, _ = admitted
-    assert slot.bucket == 16
-    engine.release(slot)

@@ -70,6 +70,15 @@ exits 1 listing ``file:line`` offenders. Rules:
    writes artifacts, never parses them) and ``kernel/lowering.py`` (the
    ``lower_text`` debug surface itself).
 
+8. **ONE page-table/pool allocator home** — constructing a KV page pool
+   or page table anywhere outside ``autodist_tpu/serve/pages.py`` is
+   banned (same single-home policy as rules 3 and 6): the paged serving
+   engine's admission math, the analyzer's static pool accounting, the
+   obs utilization/fragmentation gauges and the chaos page-exhaustion
+   injector are only mutually consistent because every page is accounted
+   by the one allocator. Build pools via ``serve.pages.build_pool``;
+   tables only ever come out of ``PagePool.alloc`` (docs/serving.md).
+
 Pure stdlib, no third-party deps — runs anywhere Python runs.
 """
 from __future__ import annotations
@@ -96,6 +105,8 @@ XPLANE_RE = re.compile(r"\bxplane_pb2\b|xplane\.pb\b")
 TIME_SLEEP_RE = re.compile(r"\btime\.sleep\s*\(")
 # Rule 7: HLO text production/parsing outside the analysis parser home.
 AS_TEXT_RE = re.compile(r"\.as_text\s*\(")
+# Rule 8: page-pool/page-table construction outside serve/pages.py.
+PAGES_RE = re.compile(r"\bPagePool\s*\(|\bPageTable\s*\(")
 
 
 def _py_files(*roots):
@@ -225,6 +236,21 @@ def main() -> int:
                         f"analysis.compiled_hlo/compiled_artifacts/"
                         f"compiled_window (the ONE parser home with the "
                         f"compiled-text cache; docs/analysis.md)")
+
+    pages_allowed = {os.path.join("autodist_tpu", "serve", "pages.py")}
+    for rel in _py_files("autodist_tpu", "tests", "examples", "bench.py"):
+        if rel in pages_allowed:
+            continue
+        with open(os.path.join(REPO, rel), "r", encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                code = line.split("#", 1)[0]
+                if PAGES_RE.search(code):
+                    errors.append(
+                        f"{rel}:{i}: page-pool/page-table construction "
+                        f"outside autodist_tpu/serve/pages.py — build "
+                        f"pools via serve.pages.build_pool and get tables "
+                        f"from PagePool.alloc (the ONE allocator home; "
+                        f"docs/serving.md)")
 
     if errors:
         print("banned-pattern lint FAILED:", file=sys.stderr)
