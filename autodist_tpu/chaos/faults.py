@@ -125,6 +125,36 @@ CATALOG: Dict[str, FaultSpec] = {s.kind: s for s in (
         "supervised restart with jittered exponential backoff",
         "restart budget and backoff reset on snapshot-ring progress; the "
         "relaunched attempt completes"),
+    FaultSpec(
+        "replica_death", hooks.SEAM_SERVE_STEP,
+        "raise EngineDeadError from ONE replica's decode step "
+        "(host-targeted) while the survivors keep serving behind the "
+        "router",
+        "replica self-reports DEAD; router failover — every in-flight "
+        "request completes exactly once on survivors with the delivered "
+        "stream bit-identical to an uninterrupted run; error event -> "
+        "DOC006",
+        "the router reroutes journaled work with prefix resume (the "
+        "overlap token re-derived and asserted bit-equal); no duplicate "
+        "delivery, no drop"),
+    FaultSpec(
+        "replica_partition", hooks.SEAM_HB_PUBLISH,
+        "drop ONE replica's control-plane beats for the window (the "
+        "replica itself keeps serving — a partition, not a death)",
+        "router view READY -> SUSPECT; new work routed around the "
+        "suspect",
+        "beats resume -> READY -> routed again; work that stayed on the "
+        "partitioned replica delivers exactly once (no duplicate, no "
+        "drop, no spurious failover)"),
+    FaultSpec(
+        "rolling_upgrade_under_load", "process",
+        "drain + restart every replica in turn under sustained traffic "
+        "(no hook — the 'fault' is the upgrade itself)",
+        "zero dropped requests; only typed shed; p99 bounded; every "
+        "replica restarted exactly once",
+        "each drained replica's leftovers fail over through the journal "
+        "(ids + delivered watermarks); the restarted replica re-admits "
+        "on its READY beat"),
 )}
 
 
@@ -198,6 +228,18 @@ def make_handlers(plant) -> Dict[str, Callable]:
                 if e.fault == "heartbeat_drop" and int(e.host) == int(process_id):
                     plant.record("heartbeat_drop", host=int(process_id))
                     return None  # the beat never lands
+                if (e.fault == "replica_partition"
+                        and int(e.host) == int(process_id)):
+                    # record_once: replica heartbeat threads publish on a
+                    # wall-clock cadence, so a per-drop trace would be
+                    # timing-dependent — one entry per window keeps the
+                    # trace replay-deterministic.
+                    plant.record_once(("replica_partition", e.at_step,
+                                       int(process_id)),
+                                      "replica_partition",
+                                      host=int(process_id),
+                                      detail="control-plane beats dropped")
+                    return None
             return payload
 
         handlers[hooks.SEAM_HB_PUBLISH] = hb_publish
@@ -309,7 +351,7 @@ def make_handlers(plant) -> Dict[str, Callable]:
         handlers[hooks.SEAM_SERVE_PAGES] = serve_pages
 
     if hooks.SEAM_SERVE_STEP in seams:
-        def serve_step(**_):
+        def serve_step(host=0, **_):
             for e in events(hooks.SEAM_SERVE_STEP):
                 if e.fault == "engine_death":
                     from autodist_tpu.serve.engine import EngineDeadError
@@ -319,6 +361,16 @@ def make_handlers(plant) -> Dict[str, Callable]:
                                       detail="decode step raised")
                     raise EngineDeadError(
                         "chaos: injected engine death mid-decode")
+                if (e.fault == "replica_death"
+                        and int(e.host) == int(host)):
+                    from autodist_tpu.serve.engine import EngineDeadError
+
+                    plant.record_once(("replica_death", e.at_step,
+                                       int(host)),
+                                      "replica_death", host=int(host),
+                                      detail="decode step raised")
+                    raise EngineDeadError(
+                        f"chaos: injected replica {host} death mid-decode")
 
         handlers[hooks.SEAM_SERVE_STEP] = serve_step
 

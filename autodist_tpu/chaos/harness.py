@@ -723,6 +723,258 @@ def scenario_engine_death(base: str) -> SoakResult:
         trace=trace)
 
 
+# ------------------------------------------------------- router scenarios
+def _router_fleet(base: str, registry=None, config=None):
+    """A 3-replica in-process router fleet + lone control engine, rooted
+    at ``base`` (journals under ``base/journals``). Shares the
+    byte-identical plan across replicas the way a production factory
+    shares the persistent plan cache."""
+    from autodist_tpu.serve.router import build_test_fleet
+
+    return build_test_fleet(
+        n_replicas=3, journal_dir=os.path.join(base, "journals"),
+        registry=registry or M.MetricsRegistry(), config=config)
+
+
+def scenario_replica_death(base: str) -> SoakResult:
+    """Kill one of 3 replicas mid-decode (host-targeted EngineDeadError
+    through the serve step seam): the replica self-reports DEAD, the
+    router fails every in-flight request over to the survivors, and every
+    request completes EXACTLY ONCE with its delivered stream bit-identical
+    to an uninterrupted control run; the death is DOC006-attributed."""
+    from autodist_tpu.obs import doctor
+    from autodist_tpu.obs import recorder as obs_recorder
+    from autodist_tpu.serve.batcher import RequestState
+    from autodist_tpu.serve.replica import ReplicaState
+
+    fault = "replica_death"
+    obs_recorder.enable(obs_recorder.flight_dir(base))
+    reg = M.MetricsRegistry()
+    router, control = _router_fleet(base, registry=reg)
+    rng = np.random.default_rng(101)
+    prompts = [rng.integers(1, 127, size=int(rng.integers(3, 10)))
+               .astype(np.int32) for _ in range(12)]
+    expected = [control.generate(p, 6) for p in prompts]
+
+    schedule = ChaosSchedule(seed=47, events=(
+        ChaosEvent(fault, at_step=0, host=1),))
+    try:
+        with ChaosPlant(schedule) as plant:
+            router.start()
+            for rep in router.replicas.values():
+                rep.wait_ready(120.0)
+            fronts = [router.submit(p, max_new_tokens=6) for p in prompts]
+            states = [f.wait(120.0).state for f in fronts]
+            _check(all(s is RequestState.DONE for s in states), fault,
+                   f"not every request completed on the survivors: "
+                   f"{[s.value for s in states]}")
+            _check(plant.injected(fault) == 1, fault,
+                   "the targeted decode-step seam never fired")
+            _check(retry.wait_until(
+                lambda: router.replica_state(1) is ReplicaState.DEAD, 10.0),
+                fault, "router never classified the killed replica DEAD")
+            trace = plant.trace_bytes()
+        streams_ok = all(f.tokens == expected[i]
+                         for i, f in enumerate(fronts))
+        _check(streams_ok, fault,
+               "a failed-over stream diverged from the uninterrupted "
+               "control run (prefix resume broke bit-identity)")
+        ledger = router.ledger()
+        _check(len(ledger) == len(prompts)
+               and all(v == 1 for v in ledger.values()), fault,
+               f"exactly-once violated: ledger {ledger}")
+        rerouted = int(reg.counter(
+            "serve_router_requests_rerouted_total").value)
+        _check(rerouted >= 1, fault,
+               "no request was actually in flight on the killed replica")
+        router.stop(drain=False)
+    finally:
+        obs_recorder.disable(ok=True)
+
+    diag = doctor.diagnose(base)
+    _check(diag.code == "DOC006", fault,
+           f"doctor said {diag.code}, expected DOC006 (crash)")
+    return SoakResult(
+        fault=fault, ok=True, injected=1,
+        detected=["DEAD", "exactly_once", "DOC006"],
+        expected=CATALOG[fault].detects, recovery_steps=0,
+        notes=f"{rerouted} in-flight rerouted to survivors; streams "
+              f"bit-identical to control; no duplicate, no drop",
+        trace=trace)
+
+
+def scenario_replica_partition(base: str) -> SoakResult:
+    """Drop one replica's control-plane beats (the replica keeps
+    serving): the router marks it SUSPECT and routes new work around it,
+    its in-flight work keeps progressing and delivers exactly once (no
+    spurious failover), and when beats resume the replica rejoins and
+    receives new work again."""
+    from autodist_tpu.serve.batcher import RequestState
+    from autodist_tpu.serve.replica import ReplicaState
+    from autodist_tpu.serve.router import RouterConfig
+
+    fault = "replica_partition"
+    reg = M.MetricsRegistry()
+    # DEAD needs a long silence: the partition must pin SUSPECT routing,
+    # not decay into a failover.
+    router, control = _router_fleet(base, registry=reg, config=RouterConfig(
+        heartbeat_interval_s=0.05, health_interval_s=0.02,
+        suspect_after_misses=2, dead_after_misses=60))
+    rng = np.random.default_rng(103)
+    prompts = [rng.integers(1, 127, size=int(rng.integers(3, 8)))
+               .astype(np.int32) for _ in range(15)]
+    expected = [control.generate(p, 24 if i < 9 else 6)
+                for i, p in enumerate(prompts)]
+
+    schedule = ChaosSchedule(seed=59, events=(
+        ChaosEvent(fault, at_step=1, host=1),))
+    with ChaosPlant(schedule) as plant:
+        router.start()
+        for rep in router.replicas.values():
+            rep.wait_ready(120.0)
+        # Long-running requests spread across the fleet (beats flowing).
+        fronts = [router.submit(p, max_new_tokens=24) for p in prompts[:9]]
+
+        def on_victim() -> bool:
+            with router._lock:
+                return any(f.replica_id == 1 and len(f.front.tokens) > 0
+                           for f in router._flights.values())
+
+        _check(retry.wait_until(on_victim, 60.0, interval_s=0.005), fault,
+               "no in-flight work landed on the victim before the window")
+        plant.advance(1)                                  # partition opens
+        _check(retry.wait_until(
+            lambda: router.replica_state(1) is ReplicaState.SUSPECT, 10.0),
+            fault, "router never classified the partitioned replica "
+                   "SUSPECT")
+        d_before = router.dispatch_counts()[1]
+        late = [router.submit(p, max_new_tokens=6) for p in prompts[9:]]
+        late_states = [f.wait(120.0).state for f in late]
+        _check(all(s is RequestState.DONE for s in late_states), fault,
+               f"new work did not complete on the non-suspect replicas: "
+               f"{[s.value for s in late_states]}")
+        _check(router.dispatch_counts()[1] == d_before, fault,
+               "new work was routed TO the suspect replica")
+        states = [f.wait(120.0).state for f in fronts]
+        _check(all(s is RequestState.DONE for s in states), fault,
+               f"in-flight work on the partitioned replica was lost: "
+               f"{[s.value for s in states]}")
+        plant.advance(1)                                  # window closes
+        _check(retry.wait_until(
+            lambda: router.replica_state(1) is ReplicaState.READY, 10.0),
+            fault, "replica did not rejoin READY after the partition")
+        rejoin = [router.submit(p, max_new_tokens=6) for p in prompts[:6]]
+        _check(all(f.wait(120.0).state is RequestState.DONE
+                   for f in rejoin), fault, "post-rejoin work failed")
+        _check(retry.wait_until(
+            lambda: router.dispatch_counts()[1] > d_before, 5.0), fault,
+            "the rejoined replica never received new work")
+        trace = plant.trace_bytes()
+
+    streams_ok = all(f.tokens == expected[i]
+                     for i, f in enumerate(fronts + late))
+    _check(streams_ok, fault,
+           "a stream forked during the partition (duplicate or dropped "
+           "token)")
+    rerouted = int(reg.counter("serve_router_requests_rerouted_total").value)
+    _check(rerouted == 0, fault,
+           f"a SUSPECT-only partition triggered {rerouted} spurious "
+           f"failover(s)")
+    router.stop(drain=False)
+    return SoakResult(
+        fault=fault, ok=True, injected=1,
+        detected=["SUSPECT", "routed around", "rejoined"],
+        expected=CATALOG[fault].detects, recovery_steps=0,
+        notes="suspect excluded from new work, in-flight delivered "
+              "exactly once, zero spurious failovers, rejoined on first "
+              "fresh beat",
+        trace=trace)
+
+
+def scenario_rolling_upgrade_under_load(base: str) -> SoakResult:
+    """Drain + restart every replica in turn while a background loader
+    keeps submitting: zero dropped requests (typed shed only — and at
+    this load, none), every request completes exactly once, p99 stays
+    bounded, and every replica cycles through exactly one restart."""
+    import threading
+
+    from autodist_tpu.serve.batcher import Backpressure, RequestState
+    from autodist_tpu.serve.replica import ReplicaState
+
+    fault = "rolling_upgrade_under_load"
+    reg = M.MetricsRegistry()
+    router, _control = _router_fleet(base, registry=reg)
+    rng = np.random.default_rng(107)
+    prompts = [rng.integers(1, 127, size=int(rng.integers(3, 8)))
+               .astype(np.int32) for _ in range(200)]
+
+    schedule = ChaosSchedule(seed=61, events=(
+        ChaosEvent(fault, at_step=0),))
+    plant = ChaosPlant(schedule)  # no hooks: the "fault" is the upgrade
+    router.start()
+    for rep in router.replicas.values():
+        rep.wait_ready(120.0)
+
+    fronts: List = []
+    shed = [0]
+    stop_load = threading.Event()
+
+    def loader():
+        i = 0
+        while not stop_load.is_set() and i < len(prompts):
+            try:
+                fronts.append(router.submit(prompts[i], max_new_tokens=5))
+                i += 1
+            except Backpressure:
+                shed[0] += 1  # typed shed at the edge is allowed, a drop
+                #               is not — nothing here ever hangs
+            stop_load.wait(0.01)
+
+    thread = threading.Thread(target=loader, daemon=True)
+    thread.start()
+    try:
+        results = router.rolling_upgrade(deadline_s=30.0,
+                                         ready_timeout_s=120.0)
+    finally:
+        stop_load.set()
+        thread.join(timeout=10.0)
+    for r in results:
+        plant.record(fault, replica=int(r["replica"]))
+
+    _check(len(results) == 3, fault, "not every replica was upgraded")
+    _check(all(rep.restarts == 1 for rep in router.replicas.values()),
+           fault, "a replica did not restart exactly once")
+    # A straggler escalation can hold a just-restarted replica SUSPECT
+    # for one beat (alive-but-sick scrutiny, by design); it heals on the
+    # next fresh beat — bound the wait instead of racing it.
+    _check(retry.wait_until(
+        lambda: all(router.replica_state(rid) is ReplicaState.READY
+                    for rid in router.replicas), 15.0, interval_s=0.02),
+        fault, "fleet not fully READY after the upgrade")
+    states = [f.wait(120.0).state for f in fronts]
+    n_done = sum(1 for s in states if s is RequestState.DONE)
+    _check(n_done == len(fronts), fault,
+           f"{len(fronts) - n_done} of {len(fronts)} requests dropped "
+           f"during the rolling upgrade")
+    ledger = router.ledger()
+    _check(all(v == 1 for rid_, v in ledger.items()), fault,
+           "exactly-once violated during the upgrade")
+    p99 = reg.snapshot().get("serve_router_request_latency_s",
+                             {}).get("p99", float("inf"))
+    _check(p99 < 60.0, fault, f"p99 unbounded during the upgrade "
+           f"({p99:.1f}s)")
+    rerouted = int(reg.counter("serve_router_requests_rerouted_total").value)
+    router.stop(drain=False)
+    return SoakResult(
+        fault=fault, ok=True, injected=3,
+        detected=["zero drops", "exactly_once", "p99 bounded"],
+        expected=CATALOG[fault].detects, recovery_steps=0,
+        notes=f"{len(fronts)} requests served across 3 drain/restart "
+              f"cycles, {rerouted} failed over from drains, {shed[0]} "
+              f"typed sheds, p99 {p99:.2f}s",
+        trace=plant.trace_bytes())
+
+
 # -------------------------------------------------------- supervised kill
 _KILL_CHILD = """\
 import json, os, signal, sys
@@ -812,6 +1064,9 @@ SCENARIOS: Dict[str, Callable[[str], SoakResult]] = {
     "page_exhaustion": scenario_page_exhaustion,
     "engine_death": scenario_engine_death,
     "worker_kill": scenario_worker_kill,
+    "replica_death": scenario_replica_death,
+    "replica_partition": scenario_replica_partition,
+    "rolling_upgrade_under_load": scenario_rolling_upgrade_under_load,
 }
 
 

@@ -23,7 +23,17 @@ were seconds from finishing, and queued requests nobody started. The
 The persist format is deliberately prompt-level (prompt tokens +
 ``max_new_tokens`` + remaining deadline), not KV-cache state: replay
 re-decodes from scratch on whatever mesh/shardings the restarted server
-compiled, which composes with elastic resizes for free.
+compiled, which composes with elastic resizes for free. Format version 2
+additionally journals the request's **identity and delivery watermark**:
+a stable ``request_id``, the ``delivered`` token count, and the delivered
+token prefix itself (``tokens``). The id + watermark are what make
+multi-journal replay exactly-once: two replicas (or a replica and the
+router in front of it, ``serve/router.py``) may both have journaled the
+same failed-over request — :func:`merge_journal_entries` dedupes by id,
+keeping the entry that delivered furthest, and the prefix lets the
+router resume generation from the last delivered token instead of
+re-serving from scratch (greedy decode is deterministic, so the resumed
+stream is bit-identical to an uninterrupted one).
 """
 from __future__ import annotations
 
@@ -31,7 +41,7 @@ import json
 import os
 import signal
 import threading
-from typing import List, Optional
+from typing import List, Optional, Sequence, Union
 
 from autodist_tpu import metrics as M
 from autodist_tpu.utils import logging
@@ -41,29 +51,92 @@ def persist_requests(path: str, requests) -> int:
     """Atomically write the replay file for ``requests`` (anything with
     ``prompt`` / ``max_new_tokens`` / ``deadline`` — i.e. ``GenRequest``).
     Deadlines are stored as remaining seconds (absolute monotonic times do
-    not survive a process restart). Returns the entry count."""
+    not survive a process restart). Requests carrying a ``request_id`` /
+    ``tokens`` surface additionally journal their identity and delivered
+    prefix (format version 2) so replay can dedupe across journals and
+    resume mid-stream. Returns the entry count."""
     import time
 
     now = time.monotonic()
-    entries = [
-        {
+    entries = []
+    for r in requests:
+        entry = {
             "prompt": [int(t) for t in r.prompt],
             "max_new_tokens": int(r.max_new_tokens),
             "timeout_s": (max(0.001, r.deadline - now)
                           if r.deadline is not None else None),
         }
-        for r in requests
-    ]
+        rid = getattr(r, "request_id", "")
+        if rid:
+            entry["request_id"] = str(rid)
+        tokens = getattr(r, "tokens", None)
+        if tokens:
+            entry["delivered"] = len(tokens)
+            entry["tokens"] = [int(t) for t in tokens]
+        entries.append(entry)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tmp = f"{path}.tmp-{os.getpid()}"
     with open(tmp, "w", encoding="utf-8") as f:
-        json.dump({"format_version": 1, "entries": entries}, f)
+        json.dump({"format_version": 2, "entries": entries}, f)
     os.replace(tmp, path)
     return len(entries)
 
 
-def replay_requests(path: str, batcher) -> List:
-    """Resubmit every persisted entry to ``batcher``; consume the file.
+def _load_entries(path: str) -> Optional[List[dict]]:
+    """One journal's entries; None = unreadable (missing is []-like None,
+    corrupt is moved aside) — shared by replay and the merge."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            payload = json.load(f)
+        return list(payload.get("entries", []))
+    except OSError:
+        return None
+    except ValueError:
+        logging.warning("replay file %s is corrupt; moving it aside", path)
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:
+            pass
+        return None
+
+
+def merge_journal_entries(paths: Sequence[str]) -> List[dict]:
+    """Merge entries from several journals, deduping by ``request_id``.
+
+    Exactly-once across a failover: a request that was journaled by two
+    replicas (it was draining on one when it failed over to the other)
+    must replay ONCE — the entry with the highest ``delivered`` watermark
+    wins (it has seen the most client-visible tokens; replaying the lower
+    one would re-deliver tokens the client already has). Entries without
+    a ``request_id`` (format v1) cannot be identified, so they are all
+    kept — v1 journals were always single-writer. Order: first-seen
+    journal order, so FIFO fairness survives the merge."""
+    merged: List[dict] = []
+    by_id: dict = {}
+    for path in paths:
+        for e in _load_entries(path) or []:
+            rid = e.get("request_id")
+            if not rid:
+                merged.append(e)
+                continue
+            seen = by_id.get(rid)
+            if seen is None:
+                by_id[rid] = e
+                merged.append(e)
+            elif int(e.get("delivered", 0)) > int(seen.get("delivered", 0)):
+                merged[merged.index(seen)] = e
+                by_id[rid] = e
+    return merged
+
+
+def replay_requests(path: Union[str, Sequence[str]], batcher) -> List:
+    """Resubmit every persisted entry to ``batcher``; consume the file(s).
+
+    ``path`` may be one journal or a sequence of them (a restarted fleet
+    gathers every replica's drain journal plus the router's): entries are
+    merged with :func:`merge_journal_entries`, so a request two journals
+    both persisted (a failover raced a drain) replays exactly once — the
+    highest ``delivered`` watermark wins.
 
     Returns the new ``GenRequest`` list (empty when no replay file
     exists). Restart-path hardening — replay must never crash server
@@ -85,26 +158,16 @@ def replay_requests(path: str, batcher) -> List:
     """
     from autodist_tpu.serve.batcher import Backpressure
 
-    try:
-        with open(path, encoding="utf-8") as f:
-            payload = json.load(f)
-        entries = list(payload.get("entries", []))
-    except OSError:
-        return []
-    except ValueError:
-        logging.warning("replay file %s is corrupt; moving it aside", path)
-        try:
-            os.replace(path, path + ".corrupt")
-        except OSError:
-            pass
-        return []
+    paths = [path] if isinstance(path, str) else list(path)
+    entries = merge_journal_entries(paths)
     reqs = []
     remainder: List[dict] = []
     for i, e in enumerate(entries):
         try:
             req = batcher.submit(
                 e["prompt"], max_new_tokens=e["max_new_tokens"],
-                timeout_s=e.get("timeout_s"))
+                timeout_s=e.get("timeout_s"),
+                request_id=e.get("request_id") or None)
             if req.unservable:
                 # Typed unservable (e.g. over the restarted engine's
                 # max_len ceiling): dropping it is the only move that
@@ -124,15 +187,21 @@ def replay_requests(path: str, batcher) -> List:
         except (ValueError, KeyError) as err:
             logging.warning("dropping unservable persisted entry %r (%s)",
                             e, err)
+    # Consume: already-submitted entries must never replay again. The
+    # remainder (backpressure cut the replay short) re-persists atomically
+    # into the FIRST journal; the others are spent either way.
     if remainder:
-        tmp = f"{path}.tmp-{os.getpid()}"
+        tmp = f"{paths[0]}.tmp-{os.getpid()}"
         with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"format_version": 1, "entries": remainder}, f)
-        os.replace(tmp, path)
-    else:
-        os.remove(path)
+            json.dump({"format_version": 2, "entries": remainder}, f)
+        os.replace(tmp, paths[0])
+    for p in paths[1 if remainder else 0:]:
+        try:
+            os.remove(p)
+        except OSError:
+            pass
     logging.info("replayed %d persisted serve requests from %s",
-                 len(reqs), path)
+                 len(reqs), ", ".join(paths))
     return reqs
 
 
@@ -157,6 +226,14 @@ class DrainController:
         self._g_drain_s = reg.gauge("serve_last_drain_duration_s")
 
     # ------------------------------------------------------------- shutdown
+    def quiesce(self) -> None:
+        """Phase 1 only: stop the batcher admitting (new ``submit``s are
+        refused, queued entries stop being promoted) while active decodes
+        keep stepping. The rolling-upgrade entry point
+        (``serve/router.py``): the router quiesces a replica, lets
+        in-flight finish, then :meth:`shutdown` persists the rest."""
+        self.batcher.quiesce()
+
     def shutdown(self) -> dict:
         """Run the full drain sequence; idempotent. Returns
         ``{"drained": n_finished_during_drain, "persisted": n}``."""

@@ -28,6 +28,15 @@ Layers:
   concurrency vs the bucketed baseline at equal KV HBM, zero drops,
   bit-identical greedy streams, exactly 2 compiled programs).
 
+- :mod:`autodist_tpu.serve.replica` / :mod:`autodist_tpu.serve.router` —
+  the multi-replica control plane: N supervised replicas exporting typed
+  readiness (``STARTING``/``READY``/``DRAINING``/``SUSPECT``/``DEAD``)
+  through the ft heartbeat transports, fronted by a dependency-free
+  :class:`Router` with journaled exactly-once failover (prefix resume,
+  bit-identity asserted), straggler-weighted least-loaded routing, and
+  rolling drain upgrades (``python -m autodist_tpu.serve
+  --selftest-router`` is the CPU proof).
+
 Entry point: ``autodist.build_inference(...)`` (api.py) or
 :meth:`InferenceEngine.build` directly.
 """
@@ -46,6 +55,8 @@ from autodist_tpu.serve.engine import (
     Slot,
 )
 from autodist_tpu.serve.pages import PagePool, PageTable, build_pool
+from autodist_tpu.serve.replica import Replica, ReplicaState
+from autodist_tpu.serve.router import Router, RouterConfig
 
 __all__ = [
     "AdmissionDenied",
@@ -58,7 +69,11 @@ __all__ = [
     "InferenceEngine",
     "PagePool",
     "PageTable",
+    "Replica",
+    "ReplicaState",
     "RequestState",
+    "Router",
+    "RouterConfig",
     "Slot",
     "build_pool",
 ]

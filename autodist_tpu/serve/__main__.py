@@ -1,18 +1,29 @@
 """CLI: ``python -m autodist_tpu.serve``.
 
-Two modes:
+Three modes:
 
-- ``--selftest``: the zero-hardware acceptance proof (tiny CPU transformer;
-  >=2x concurrency vs the bucketed baseline at equal KV HBM, bit-identical
-  greedy streams, >=64 concurrent mock requests with zero drops, exactly 2
-  compiled serving programs). Run with ``JAX_PLATFORMS=cpu``; exits nonzero
-  on any violated bar.
+- ``--selftest``: the zero-hardware single-engine proof (tiny CPU
+  transformer; >=2x concurrency vs the bucketed baseline at equal KV HBM,
+  bit-identical greedy streams, >=64 concurrent mock requests with zero
+  drops, exactly 2 compiled serving programs). Run with
+  ``JAX_PLATFORMS=cpu``; exits nonzero on any violated bar.
+- ``--selftest-router``: the multi-replica control-plane proof
+  (docs/serving.md § router): 3 in-process replicas behind the router,
+  one killed mid-decode under 64 concurrent requests — every request
+  completes exactly once (journal-verified), every delivered stream
+  bit-identical to an uninterrupted control run.
 - server mode (default): serve a zoo model — optionally restoring a
-  checkpoint — over the asyncio HTTP front end::
+  checkpoint — over the asyncio HTTP front end. With ``--ft-dir`` the
+  process runs as a supervised :class:`~autodist_tpu.serve.replica.
+  Replica`: typed readiness (``STARTING``/``READY``/``DRAINING``) is
+  published through the ft ``FileTransport`` under ``<ft-dir>/heartbeats``
+  for a router/supervisor to observe, ``/healthz`` answers 503 until
+  READY, and ``POST /drain`` persists undone work for exactly-once
+  replay::
 
       python -m autodist_tpu.serve --model transformer \\
           --model-arg num_layers=2 --checkpoint /tmp/autodist-tpu/checkpoints \\
-          --port 8476
+          --ft-dir /tmp/autodist-tpu/ft --replica-id 0 --port 8476
 """
 from __future__ import annotations
 
@@ -40,6 +51,17 @@ def main(argv=None) -> int:
                                  description=__doc__)
     ap.add_argument("--selftest", action="store_true",
                     help="run the CPU-sim serving proof and exit")
+    ap.add_argument("--selftest-router", action="store_true",
+                    help="run the multi-replica router proof (3 replicas, "
+                         "one killed mid-decode, exactly-once asserted) "
+                         "and exit")
+    ap.add_argument("--ft-dir", default=None,
+                    help="server mode: run as a supervised replica, "
+                         "publishing typed readiness through the ft "
+                         "FileTransport under <ft-dir>/heartbeats")
+    ap.add_argument("--replica-id", type=int, default=0,
+                    help="server mode: this replica's id on the ft "
+                         "transport (with --ft-dir)")
     ap.add_argument("--requests", type=int, default=64,
                     help="selftest: concurrent mock requests (>=64 proves "
                          "the acceptance bar)")
@@ -75,6 +97,14 @@ def main(argv=None) -> int:
                         n_slots=args.slots or 32,
                         max_new=args.max_new)
 
+    if args.selftest_router:
+        from autodist_tpu.serve.router import selftest_router
+
+        return selftest_router(n_requests=args.requests,
+                               max_new=args.max_new)
+
+    import os
+
     import jax
 
     import autodist_tpu.strategy as S
@@ -87,19 +117,38 @@ def main(argv=None) -> int:
     spec = get_model(args.model, **_parse_overrides(args.model_arg))
     params = spec.init(jax.random.PRNGKey(0))
     autodist = AutoDist(strategy_builder=S.from_name(args.strategy))
-    engine = autodist.build_inference(
-        params,
-        apply_fn=spec.apply,
-        decode_model=(decode_model(spec.config)
-                      if hasattr(spec.config, "num_heads") else None),
-        checkpoint=args.checkpoint,
-        n_slots=args.slots or 8,
-        page_len=args.page_len,
-        n_pages=args.pages,
-        prefill_chunk=args.prefill_chunk,
-    )
-    frontend = ServeFrontend(ContinuousBatcher(engine),
-                             host=args.host, port=args.port)
+
+    def build_engine():
+        return autodist.build_inference(
+            params,
+            apply_fn=spec.apply,
+            decode_model=(decode_model(spec.config)
+                          if hasattr(spec.config, "num_heads") else None),
+            checkpoint=args.checkpoint,
+            n_slots=args.slots or 8,
+            page_len=args.page_len,
+            n_pages=args.pages,
+            prefill_chunk=args.prefill_chunk,
+        )
+
+    if args.ft_dir:
+        # Supervised-replica mode: readiness + load travel through the
+        # same FileTransport a router/launcher observes; /healthz is 503
+        # until the engine is READY.
+        from autodist_tpu.ft.heartbeat import FileTransport
+        from autodist_tpu.serve.replica import Replica
+
+        replica = Replica(
+            args.replica_id, build_engine,
+            FileTransport(os.path.join(args.ft_dir, "heartbeats")),
+            persist_path=os.path.join(
+                args.ft_dir, f"serve_queue-{args.replica_id}.json"),
+        )
+        frontend = ServeFrontend(None, host=args.host, port=args.port,
+                                 replica=replica)
+    else:
+        frontend = ServeFrontend(ContinuousBatcher(build_engine()),
+                                 host=args.host, port=args.port)
     try:
         asyncio.run(frontend.serve_forever())
     except KeyboardInterrupt:

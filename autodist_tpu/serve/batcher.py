@@ -38,6 +38,7 @@ exported by the registry).
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from collections import deque
@@ -86,6 +87,11 @@ class GenRequest:
     max_new_tokens: int
     deadline: Optional[float] = None      # absolute time.monotonic() cutoff
     id: int = field(default_factory=lambda: next(_ids))
+    # Stable identity for journaling/dedupe across process boundaries
+    # (ft/drain.py format v2, serve/router.py exactly-once): unlike the
+    # in-process ``id`` counter, it survives persist/replay and lets two
+    # journals recognize the same failed-over request.
+    request_id: str = ""
     t_submit: float = field(default_factory=time.monotonic)
     t_first_token: Optional[float] = None
     t_done: Optional[float] = None
@@ -99,6 +105,10 @@ class GenRequest:
     # the way to the edge).
     unservable: bool = False
     _event: threading.Event = field(default_factory=threading.Event, repr=False)
+
+    def __post_init__(self):
+        if not self.request_id:
+            self.request_id = f"g{os.getpid()}-{self.id}"
     _cb_lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
     _callbacks: List[Callable[["GenRequest"], None]] = field(
         default_factory=list, repr=False)
@@ -152,6 +162,21 @@ class GenRequest:
                                 exc_info=True)
 
 
+def make_rejected(prompt, max_new_tokens: int, error: str,
+                  request_id: Optional[str] = None) -> GenRequest:
+    """Build an already-terminal typed-``REJECTED`` request — the ONE
+    rendering of the typed-shed fallback (``try_submit`` here and on the
+    router), so the contract's prose and coercion rules cannot drift."""
+    try:
+        arr = np.asarray(prompt, np.int32).ravel()
+    except (TypeError, ValueError):
+        arr = np.zeros(0, np.int32)
+    req = GenRequest(prompt=arr, max_new_tokens=max_new_tokens,
+                     request_id=request_id or "")
+    req._finish(RequestState.REJECTED, f"admission rejected: {error}")
+    return req
+
+
 class ContinuousBatcher:
     """Request queue + scheduler around one paged :class:`InferenceEngine`.
 
@@ -167,12 +192,18 @@ class ContinuousBatcher:
         engine: InferenceEngine,
         max_queue: int = 256,
         registry: Optional[M.MetricsRegistry] = None,
+        on_tick: Optional[Callable[[float], None]] = None,
     ):
         if engine.decode_model is None:
             raise ValueError("ContinuousBatcher needs an engine with a "
                              "decode_model")
         self.engine = engine
         self.max_queue = max_queue
+        # Scheduler-tick duration observer (seconds per progressing tick):
+        # the replica wrapper (serve/replica.py) feeds these into its
+        # obs.aggregate.HostAggregator so the router's straggler scores
+        # see real per-replica step times.
+        self.on_tick = on_tick
         self._queue: deque[GenRequest] = deque()
         self._active: Dict[Slot, GenRequest] = {}
         self._lock = threading.Lock()
@@ -210,6 +241,7 @@ class ContinuousBatcher:
         prompt,
         max_new_tokens: int = 32,
         timeout_s: Optional[float] = None,
+        request_id: Optional[str] = None,
     ) -> GenRequest:
         """Enqueue a request. Raises :class:`Backpressure` when the queue
         is at ``max_queue`` (or the batcher is stopped/draining). A
@@ -218,7 +250,8 @@ class ContinuousBatcher:
         ``RequestState.REJECTED`` with the reason in ``.error``: a typed
         admission rejection at the edge, not an exception and never a
         stuck queue head. ``timeout_s`` sets the request deadline
-        relative to now."""
+        relative to now; ``request_id`` carries a caller-assigned stable
+        identity (router journaling, drain replay dedupe)."""
         prompt = np.asarray(prompt, np.int32).ravel()
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
@@ -226,6 +259,7 @@ class ContinuousBatcher:
             prompt=prompt,
             max_new_tokens=max_new_tokens,
             deadline=(time.monotonic() + timeout_s) if timeout_s else None,
+            request_id=request_id or "",
         )
         denied = self.engine.check_admissible(len(prompt), max_new_tokens)
         if denied is not None:
@@ -265,6 +299,7 @@ class ContinuousBatcher:
         prompt,
         max_new_tokens: int = 32,
         timeout_s: Optional[float] = None,
+        request_id: Optional[str] = None,
     ) -> GenRequest:
         """Admission that degrades *typed* instead of raising: always
         returns a :class:`GenRequest`. A shed request comes back already
@@ -274,15 +309,11 @@ class ContinuousBatcher:
         can route on, never a hang and never an anonymous exception
         (docs/chaos.md)."""
         try:
-            return self.submit(prompt, max_new_tokens, timeout_s=timeout_s)
+            return self.submit(prompt, max_new_tokens, timeout_s=timeout_s,
+                               request_id=request_id)
         except (Backpressure, ValueError) as e:
-            try:
-                arr = np.asarray(prompt, np.int32).ravel()
-            except (TypeError, ValueError):
-                arr = np.zeros(0, np.int32)
-            req = GenRequest(prompt=arr, max_new_tokens=max_new_tokens)
-            req._finish(RequestState.REJECTED, f"admission rejected: {e}")
-            return req
+            return make_rejected(prompt, max_new_tokens, str(e),
+                                 request_id=request_id)
 
     def submit_with_retry(
         self,
@@ -340,6 +371,23 @@ class ContinuousBatcher:
                 used_pages=self.engine.pool.used_pages,
                 queue_depth=len(self._queue))
 
+    # -------------------------------------------------------------- accounting
+    @property
+    def stopped(self) -> bool:
+        """True once the scheduler will never run again (orderly stop OR
+        engine death) — the replica's supervision reads it to notice a
+        batcher that died out from under a READY replica."""
+        with self._lock:
+            return self._stopped
+
+    @property
+    def outstanding(self) -> int:
+        """Queued + active request count — the router's
+        least-outstanding-work routing currency (also published in the
+        replica heartbeat payload)."""
+        with self._lock:
+            return len(self._queue) + len(self._active)
+
     # -------------------------------------------------------------- lifecycle
     def start(self) -> "ContinuousBatcher":
         with self._lock:
@@ -393,6 +441,28 @@ class ContinuousBatcher:
                 timeout_s)
             return True
         return False
+
+    def die(self, reason: str) -> None:
+        """Abrupt-death path (replica kill, chaos): shed ALL queued and
+        in-flight work with typed ``REJECTED`` results carrying an
+        engine-death reason, flight-record the error for the postmortem
+        doctor, and stop — the same contract the scheduler's own
+        ``EngineDeadError`` handler keeps, callable from outside the
+        scheduler thread (``serve/replica.py``'s ``kill()``). Idempotent;
+        never blocks a client."""
+        with self._wake:
+            already = self._stopped
+            self._running = False
+            self._stopped = True
+            self._wake.notify()
+        if already:
+            return
+        obs_recorder.record_event(
+            "error", error=f"EngineDeadError: {reason}"[:500])
+        self._shed(f"engine dead: {reason}")
+        stuck = self._join_scheduler(2.0)
+        self._fail_all(f"engine died mid-decode: {reason}",
+                       release=not stuck)
 
     def quiesce(self) -> None:
         """Stop admitting — new ``submit``s are refused and queued entries
@@ -460,7 +530,15 @@ class ContinuousBatcher:
                     self._wake.wait(timeout=0.5)
                     continue
             try:
-                if not self._tick():
+                t_tick = time.monotonic()
+                progressed = self._tick()
+                if progressed and self.on_tick is not None:
+                    try:
+                        self.on_tick(time.monotonic() - t_tick)
+                    except Exception:  # noqa: BLE001 - observer only
+                        logging.warning("on_tick observer raised",
+                                        exc_info=True)
+                if not progressed:
                     # Queue non-empty but nothing progressed (a page-
                     # pressure window with an empty active set, or a
                     # drain with untouched leftovers): pace the poll

@@ -321,6 +321,11 @@ class InferenceEngine(_EngineBase):
         self._prefill_fn = None
         self._decode_fn = None
         self._decode_step_count = 0
+        # Replica identity carried into the chaos seams so a schedule can
+        # target ONE replica of a fleet (replica_death injects
+        # EngineDeadError only where host matches — docs/chaos.md).
+        # serve/replica.py sets it; 0 for standalone engines.
+        self.chaos_host = 0
 
     # ------------------------------------------------------------ construction
     @classmethod
@@ -602,9 +607,11 @@ class InferenceEngine(_EngineBase):
         step.
         """
         out: Dict[Slot, int] = {}
-        # Chaos seam: may raise EngineDeadError (mid-decode engine death).
+        # Chaos seam: may raise EngineDeadError (mid-decode engine death);
+        # host identifies this engine's replica so fleet schedules can
+        # kill exactly one of N.
         chaos_hooks.fire(chaos_hooks.SEAM_SERVE_STEP,
-                         active=self.active_slots)
+                         active=self.active_slots, host=self.chaos_host)
         decoding = np.flatnonzero(self._phase == _DECODE)
         if not len(decoding):
             return out

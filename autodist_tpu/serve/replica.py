@@ -1,0 +1,285 @@
+"""One supervised serving replica: engine + batcher + typed readiness.
+
+A :class:`Replica` is the unit the multi-replica control plane
+(:mod:`autodist_tpu.serve.router`) supervises: one
+:class:`~autodist_tpu.serve.engine.InferenceEngine` (built by a caller
+supplied ``engine_factory`` — in a fleet that factory goes through
+``AutoDist.build_inference`` with the persistent plan cache, so a restart
+is a *plan-cache-backed cold start*: ``plan/cache.py`` is
+byte-deterministic, only engine state recompiles) behind one
+:class:`~autodist_tpu.serve.batcher.ContinuousBatcher`, plus the
+fault-tolerance wiring a single engine never needed:
+
+- **Typed readiness** (:class:`ReplicaState`): ``STARTING`` while the
+  factory builds/compiles, ``READY`` when serving, ``DRAINING`` during a
+  graceful drain, ``DEAD`` after a kill. ``SUSPECT`` is *observer-side
+  only* — the router's :class:`~autodist_tpu.ft.heartbeat.HealthMonitor`
+  derives it from missed beats; a replica never claims it about itself.
+- **State travels in the heartbeat payload** through the existing ft
+  transports (:class:`~autodist_tpu.ft.heartbeat.MemoryTransport` for
+  in-process tests, ``FileTransport``/``CoordinatorTransport`` for
+  fleets), alongside the load signals the router routes on:
+  ``outstanding`` work and page-pool utilization. One transport, one
+  payload — the router and an external supervisor probe the same facts
+  the ``/healthz`` endpoint serves (``serve/server.py``).
+- **Step-time feed**: the batcher's ``on_tick`` observer lands scheduler
+  tick durations in an :class:`~autodist_tpu.obs.aggregate.HostAggregator`
+  so the router's straggler scores (``host_p50 / fleet_median``) are
+  computed from the same obs machinery training uses.
+- **Drain/restart**: :meth:`drain` runs the
+  :class:`~autodist_tpu.ft.drain.DrainController` sequence (quiesce →
+  finish in-flight → persist leftovers with request ids + delivered
+  watermarks), :meth:`restart` rebuilds the engine through the factory
+  and returns to ``READY`` — the rolling-upgrade primitive.
+  :meth:`kill` is the abrupt-death path (chaos, tests): all work is shed
+  typed through :meth:`ContinuousBatcher.die`, beats stop, and the
+  router fails the in-flight work over to survivors.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from enum import Enum
+from typing import Any, Callable, Optional
+
+from autodist_tpu import metrics as M
+from autodist_tpu.ft.drain import DrainController
+from autodist_tpu.serve.batcher import ContinuousBatcher, GenRequest
+from autodist_tpu.utils import logging, retry
+
+__all__ = ["Replica", "ReplicaState"]
+
+
+class ReplicaState(Enum):
+    """Typed readiness — the value the heartbeat payload carries and the
+    router routes on. ``SUSPECT`` is assigned by the *observer* (missed
+    beats / straggler escalation), never self-reported."""
+
+    STARTING = "starting"
+    READY = "ready"
+    DRAINING = "draining"
+    SUSPECT = "suspect"
+    DEAD = "dead"
+
+
+class Replica:
+    """One engine + batcher under supervision, publishing typed readiness.
+
+    ``replica_id`` is the process-id analog on the heartbeat transport;
+    ``engine_factory()`` builds (or rebuilds, on :meth:`restart`) the
+    engine — it owns the plan-cache story. ``transport`` is any ft
+    heartbeat transport; ``aggregator`` optionally publishes scheduler
+    step times for straggler scoring. ``persist_path`` roots the drain
+    journal (request ids + delivered watermarks, ft/drain.py format v2).
+    """
+
+    def __init__(
+        self,
+        replica_id: int,
+        engine_factory: Callable[[], Any],
+        transport,
+        persist_path: Optional[str] = None,
+        max_queue: int = 256,
+        drain_deadline_s: float = 30.0,
+        heartbeat_interval_s: float = 1.0,
+        aggregator=None,
+        registry: Optional[M.MetricsRegistry] = None,
+    ):
+        self.replica_id = int(replica_id)
+        self.engine_factory = engine_factory
+        self.transport = transport
+        self.persist_path = persist_path or os.path.join(
+            ".", f"replica-{replica_id}-queue.json")
+        self.max_queue = int(max_queue)
+        self.drain_deadline_s = float(drain_deadline_s)
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.aggregator = aggregator
+        self.registry = registry or M.registry
+
+        self.engine = None
+        self.batcher: Optional[ContinuousBatcher] = None
+        self.drain_controller: Optional[DrainController] = None
+        self.restarts = 0
+        self._state = ReplicaState.STARTING
+        self._state_lock = threading.Lock()
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ state
+    @property
+    def state(self) -> ReplicaState:
+        with self._state_lock:
+            return self._state
+
+    def _set_state(self, state: ReplicaState) -> None:
+        with self._state_lock:
+            if self._state is state:
+                return
+            self._state = state
+        logging.info("replica %d -> %s", self.replica_id, state.value)
+        self.publish_now()  # state changes beat immediately, not next tick
+
+    @property
+    def outstanding(self) -> int:
+        """Queued + active work — the router's routing currency."""
+        return self.batcher.outstanding if self.batcher is not None else 0
+
+    @property
+    def page_utilization(self) -> float:
+        pool = getattr(self.engine, "pool", None)
+        return float(pool.utilization) if pool is not None else 0.0
+
+    def healthz(self) -> dict:
+        """The readiness facts ``/healthz`` and the heartbeat payload
+        share — ONE rendering of replica health."""
+        return {
+            "replica_id": self.replica_id,
+            "state": self.state.value,
+            "outstanding": self.outstanding,
+            "page_pool_utilization": round(self.page_utilization, 4),
+            "restarts": self.restarts,
+        }
+
+    # -------------------------------------------------------------- heartbeat
+    def publish_now(self) -> None:
+        """One beat, immediately (rides the chaos SEAM_HB_PUBLISH like any
+        transport publish — a partition schedule can drop it)."""
+        payload = {"time": time.time(), "pid": os.getpid(), **self.healthz()}
+        try:
+            self.transport.publish(self.replica_id, payload)
+        except Exception as e:  # noqa: BLE001 - liveness signal, never fatal
+            logging.warning("replica %d heartbeat publish failed (%s)",
+                            self.replica_id, e)
+
+    def _hb_loop(self) -> None:
+        while not self._hb_stop.is_set():
+            # Self-supervision: a batcher that died out from under a READY
+            # replica (mid-decode EngineDeadError — the scheduler shed all
+            # work and stopped) is a dead replica; say so on the transport
+            # instead of beating "ready" over a corpse. Orderly paths
+            # (drain/kill) change state BEFORE stopping the batcher, so
+            # only the abrupt death trips this.
+            if (self.state is ReplicaState.READY
+                    and self.batcher is not None and self.batcher.stopped):
+                logging.warning("replica %d: batcher died; reporting DEAD",
+                                self.replica_id)
+                self._set_state(ReplicaState.DEAD)
+            self.publish_now()
+            if self.aggregator is not None:
+                try:
+                    self.aggregator.tick()
+                except Exception:  # noqa: BLE001 - observability never fatal
+                    logging.warning("replica %d aggregator tick failed",
+                                    self.replica_id, exc_info=True)
+            self._hb_stop.wait(self.heartbeat_interval_s)
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self) -> "Replica":
+        """STARTING → build the engine (plan-cache cold start is the
+        factory's business) → READY. Idempotent once READY."""
+        if self.batcher is not None and self.state is ReplicaState.READY:
+            return self
+        self._set_state(ReplicaState.STARTING)
+        if self._hb_thread is None:
+            self._hb_stop.clear()
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, name=f"serve-replica-{self.replica_id}",
+                daemon=True)
+            self._hb_thread.start()
+        self.engine = self.engine_factory()
+        # Fleet schedules target one replica: the engine's chaos seam
+        # context carries this replica's id.
+        self.engine.chaos_host = self.replica_id
+        on_tick = (self.aggregator.observe_step
+                   if self.aggregator is not None else None)
+        self.batcher = ContinuousBatcher(
+            self.engine, max_queue=self.max_queue, registry=self.registry,
+            on_tick=on_tick).start()
+        self.drain_controller = DrainController(
+            self.batcher, self.persist_path,
+            drain_deadline_s=self.drain_deadline_s, registry=self.registry)
+        self._set_state(ReplicaState.READY)
+        return self
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               timeout_s: Optional[float] = None,
+               request_id: Optional[str] = None) -> GenRequest:
+        """Admission passthrough (raises
+        :class:`~autodist_tpu.serve.batcher.Backpressure` when saturated —
+        the router's signal to try the next replica)."""
+        if self.batcher is None:
+            from autodist_tpu.serve.batcher import Backpressure
+
+            raise Backpressure(f"replica {self.replica_id} is not started")
+        return self.batcher.submit(prompt, max_new_tokens,
+                                   timeout_s=timeout_s,
+                                   request_id=request_id)
+
+    def quiesce(self) -> None:
+        """Stop admitting; active decodes keep stepping (rolling-upgrade
+        phase 1, via the DrainController surface)."""
+        self._set_state(ReplicaState.DRAINING)
+        if self.drain_controller is not None:
+            self.drain_controller.quiesce()
+
+    def drain(self) -> dict:
+        """Graceful drain: quiesce → finish in-flight within the deadline
+        → persist leftovers (request ids + delivered watermarks) as
+        ``PREEMPTED``. Returns ``{"drained": n, "persisted": n}``. The
+        replica stays DRAINING until :meth:`restart`."""
+        self._set_state(ReplicaState.DRAINING)
+        if self.drain_controller is None:
+            return {"drained": 0, "persisted": 0}
+        out = self.drain_controller.shutdown()
+        self.batcher = None
+        self.drain_controller = None
+        return out
+
+    def restart(self) -> "Replica":
+        """Rebuild through the factory (byte-identical plan from the plan
+        cache; fresh engine state) and return to READY. Counted, so the
+        rolling-upgrade scenario can assert every replica cycled."""
+        if self.batcher is not None:
+            # A restart over a live batcher is a hard bounce: shed typed.
+            self.batcher.die(f"replica {self.replica_id} restarting")
+            self.batcher = None
+            self.drain_controller = None
+        self.engine = None
+        self.restarts += 1
+        return self.start()
+
+    def kill(self, reason: str = "replica killed") -> None:
+        """Abrupt death (chaos / tests): shed ALL work with typed
+        engine-death rejections, go silent on the transport, publish one
+        final DEAD beat so in-process observers see it immediately (a
+        SIGKILL'd subprocess would simply go silent — the router's
+        monitor reaches DEAD through missed beats either way)."""
+        with self._state_lock:
+            self._state = ReplicaState.DEAD
+        self._hb_stop.set()
+        if self.batcher is not None:
+            self.batcher.die(reason)
+            self.batcher = None
+            self.drain_controller = None
+        self.publish_now()
+        self._join_hb()
+
+    def stop(self) -> None:
+        """Orderly full stop (tests/teardown): drain, then stop beating."""
+        if self.batcher is not None:
+            self.drain()
+        self._hb_stop.set()
+        self._join_hb()
+
+    def _join_hb(self) -> None:
+        thread, self._hb_thread = self._hb_thread, None
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=5.0)
+
+    def wait_ready(self, timeout_s: float = 60.0) -> bool:
+        """Bounded readiness wait through the ONE poll loop
+        (utils/retry.py)."""
+        return retry.wait_until(
+            lambda: self.state is ReplicaState.READY, timeout_s,
+            interval_s=0.01)

@@ -1,0 +1,1024 @@
+"""Fault-tolerant multi-replica serving control plane.
+
+One :class:`~autodist_tpu.serve.engine.InferenceEngine` is one fault
+domain: a replica death takes every in-flight request with it and there
+is no way to upgrade without an outage. The :class:`Router` is the
+dependency-free control plane in front of N
+:class:`~autodist_tpu.serve.replica.Replica` instances (in-process for
+tests; subprocess replicas publish the same payloads over the ft
+``FileTransport``/``CoordinatorTransport``, so the launcher's supervision
+seams carry a fleet unchanged):
+
+- **Health-routed admission.** Replicas export typed readiness
+  (``STARTING``/``READY``/``DRAINING``/``SUSPECT``/``DEAD``) through the
+  existing :class:`~autodist_tpu.ft.heartbeat.HealthMonitor` transports:
+  self-reported state rides the heartbeat payload; SUSPECT/DEAD come
+  from the router's observer monitor when beats stop (the same
+  missed-beat escalation training fleets use). Work goes to the READY
+  replica with the least outstanding work, weighted by
+  :mod:`autodist_tpu.obs.aggregate` straggler scores — a slow-but-alive
+  replica is demoted before it misses a single beat.
+- **Journaled exactly-once delivery.** Every admitted request is
+  journaled (request-id keyed, the ``ft/drain.py`` format-v2
+  persist/replay family) with its delivered-token watermark and prefix.
+  The router is the single client-visible delivery point: tokens reach
+  the client exactly once because the router harvests only from the
+  currently-assigned backend and dedupes resumed streams against the
+  watermark — a zombie replica finishing a failed-over request can waste
+  compute but can never deliver a duplicate.
+- **Exactly-once failover.** On replica death the router resubmits each
+  in-flight request to a survivor, resuming *from the last delivered
+  token*: the re-prefill runs over ``prompt + delivered[:-1]`` and its
+  first emitted token must reproduce ``delivered[-1]`` **bit-identically**
+  (greedy decode is deterministic; the router asserts it and fails the
+  request typed on a mismatch rather than delivering a forked stream).
+  The regenerated overlap token is skipped, so the client-visible stream
+  is the uninterrupted stream, no token delivered twice or dropped.
+- **Rolling drain upgrades.** :meth:`Router.rolling_upgrade` cycles the
+  fleet one replica at a time: quiesce + drain via the
+  :class:`~autodist_tpu.ft.drain.DrainController` sequence (leftovers
+  persist with ids + watermarks and fail over like a death, minus the
+  death), restart with a plan-cache-backed cold start
+  (``plan/cache.py`` is byte-deterministic — the factory's business),
+  re-admit on READY — zero dropped requests.
+- **Typed overload.** The router sheds with the same typed
+  ``AdmissionDenied``/``REJECTED``/:class:`~autodist_tpu.serve.batcher.
+  Backpressure` contract the single-engine path keeps (PR 10/12): when
+  every replica is saturated the queue bounds admission at the edge;
+  nothing ever hangs. All failover/retry timing goes through
+  ``utils/retry.py``.
+
+Chaos classes ``replica_death`` / ``replica_partition`` /
+``rolling_upgrade_under_load`` soak this module against the real stack
+(docs/chaos.md); ``python -m autodist_tpu.serve --selftest-router`` is
+the CPU acceptance proof (3 replicas, one killed mid-decode under 64
+concurrent requests, every stream bit-identical to an uninterrupted
+control run, journal-verified exactly-once).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from autodist_tpu import metrics as M
+from autodist_tpu.ft import drain as ft_drain
+from autodist_tpu.ft.config import FTConfig
+from autodist_tpu.ft.heartbeat import HealthMonitor, PeerState
+from autodist_tpu.obs import recorder as obs_recorder
+from autodist_tpu.serve.batcher import (
+    Backpressure,
+    GenRequest,
+    RequestState,
+    make_rejected,
+)
+from autodist_tpu.serve.replica import Replica, ReplicaState
+from autodist_tpu.utils import logging, retry
+
+__all__ = ["Router", "RouterConfig", "selftest_router"]
+
+_router_ids = itertools.count()
+
+
+@dataclass(frozen=True)
+class RouterConfig:
+    """Control-plane knobs (serving cadences are subsecond by design —
+    failover latency is a product metric, not a liveness afterthought).
+
+    ``heartbeat_interval_s`` must match what the replicas publish at: the
+    observer monitor's SUSPECT/DEAD windows are counted in it.
+    """
+
+    max_queue: int = 1024
+    dispatch_interval_s: float = 0.005   # loop pacing backstop
+    health_interval_s: float = 0.05      # monitor tick + straggler sweep
+    heartbeat_interval_s: float = 0.5
+    suspect_after_misses: int = 2
+    dead_after_misses: int = 6
+    straggler_threshold: float = 1.5
+    journal_interval_s: float = 0.05     # dirty-journal flush cadence
+    drain_deadline_s: float = 30.0       # rolling upgrade per-replica drain
+    ready_timeout_s: float = 120.0       # rolling upgrade restart wait
+
+
+@dataclass
+class _Flight:
+    """Router bookkeeping for one client request across backend attempts."""
+
+    front: GenRequest                      # the client-visible handle
+    backend: Optional[GenRequest] = None   # current replica-side request
+    replica_id: Optional[int] = None
+    harvested: int = 0       # backend tokens consumed (incl. skipped overlap)
+    skip: int = 0            # overlap tokens to skip after a prefix resume
+    expect: Optional[int] = None  # bit-identity oracle for the overlap token
+    reroutes: int = 0
+    t_backend_fail: Optional[float] = None  # failover-latency clock start
+
+
+class Router:
+    """Supervise N replicas; admit, route, journal, fail over, upgrade.
+
+    ``replicas`` maps replica id → :class:`Replica` (ids are the
+    heartbeat process ids). ``transport`` is the heartbeat transport the
+    replicas publish on — the router observes it with a non-publishing
+    :class:`HealthMonitor`. ``aggregator`` (optional) is a
+    :class:`~autodist_tpu.obs.aggregate.HostAggregator` on the replicas'
+    step-time transport; its straggler scores weight the routing.
+    """
+
+    def __init__(
+        self,
+        replicas: Dict[int, Replica],
+        transport,
+        journal_path: Optional[str] = None,
+        config: Optional[RouterConfig] = None,
+        aggregator=None,
+        registry: Optional[M.MetricsRegistry] = None,
+    ):
+        self.replicas: Dict[int, Replica] = {
+            int(k): v for k, v in replicas.items()}
+        self.config = config or RouterConfig()
+        self.journal_path = journal_path
+        self.aggregator = aggregator
+        cfg = self.config
+        self.monitor = HealthMonitor(
+            transport,
+            publish=False,
+            expected=sorted(self.replicas),
+            config=FTConfig(
+                heartbeat_interval_s=cfg.heartbeat_interval_s,
+                suspect_after_misses=cfg.suspect_after_misses,
+                dead_after_misses=cfg.dead_after_misses,
+                backoff_initial_s=cfg.heartbeat_interval_s,
+            ),
+            registry=registry,
+        )
+        if aggregator is not None and getattr(aggregator, "monitor", None) is None:
+            # Persistent stragglers escalate into the monitor (SUSPECT
+            # while still beating) — the aggregate.py contract.
+            aggregator.monitor = self.monitor
+
+        self._instance = next(_router_ids)
+        self._rid_counter = itertools.count()
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        # Serializes token harvesting across threads: the router loop's
+        # periodic _harvest and a DEAD-transition _fail_over (which can
+        # run on rolling_upgrade's caller thread via the forced health
+        # sweep) must never consume the same flight concurrently — an
+        # interleaved harvested++/tokens.append would deliver a token
+        # twice, the exact duplication the exactly-once contract bans.
+        self._harvest_mutex = threading.Lock()
+        self._queue: List[_Flight] = []          # undispatched, FIFO
+        self._flights: Dict[str, _Flight] = {}   # dispatched, by request_id
+        self._ledger: Dict[str, int] = {}        # request_id -> completions
+        self._view: Dict[int, ReplicaState] = {
+            rid: ReplicaState.STARTING for rid in self.replicas}
+        self._admin_draining: set = set()        # rolling-upgrade holdout
+        self._scores: Dict[int, float] = {}
+        self._dispatches: Dict[int, int] = {rid: 0 for rid in self.replicas}
+        self._running = False
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        self._last_health = -1e9
+        self._last_journal = -1e9
+        self._journal_dirty = False
+
+        reg = registry or M.registry
+        self._g_ready = reg.gauge("serve_router_replicas_ready")
+        self._g_total = reg.gauge("serve_router_replicas_total")
+        self._g_depth = reg.gauge("serve_router_queue_depth")
+        self._g_failover_s = reg.gauge("serve_router_failover_latency_s")
+        self._c_failovers = reg.counter("serve_router_failovers_total")
+        self._c_rerouted = reg.counter("serve_router_requests_rerouted_total")
+        self._c_submitted = reg.counter("serve_router_requests_total")
+        self._c_completed = reg.counter("serve_router_requests_completed_total")
+        self._c_rejected = reg.counter("serve_router_requests_rejected_total")
+        self._c_mismatch = reg.counter("serve_router_prefix_mismatch_total")
+        self._h_latency = reg.histogram("serve_router_request_latency_s")
+        self._g_total.set(len(self.replicas))
+
+    # ---------------------------------------------------------------- clients
+    def submit(self, prompt, max_new_tokens: int = 32,
+               timeout_s: Optional[float] = None,
+               request_id: Optional[str] = None) -> GenRequest:
+        """Admit one request; returns the client-visible
+        :class:`GenRequest` (its ``tokens``/``state`` are the delivered,
+        exactly-once stream). Raises :class:`Backpressure` when the
+        router queue is at ``max_queue`` or the router is stopped —
+        overload is typed at the edge, never a hang. A statically
+        unservable request (over every replica's ceiling) comes back
+        already terminal ``REJECTED`` via the backend's typed check."""
+        prompt = np.asarray(prompt, np.int32).ravel()
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        front = GenRequest(
+            prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
+            deadline=(time.monotonic() + timeout_s) if timeout_s else None,
+            request_id=request_id
+            or f"rt{self._instance}-{os.getpid()}-{next(self._rid_counter)}",
+        )
+        # Static shape check against any live engine: typed, immediate,
+        # and identical prose to the single-engine edge (ONE home:
+        # engine.check_admissible).
+        denied = None
+        for rep in self.replicas.values():
+            if rep.engine is not None:
+                denied = rep.engine.check_admissible(
+                    len(prompt), max_new_tokens)
+                break
+        if denied is not None:
+            self._c_rejected.inc()
+            front.unservable = True
+            front._finish(RequestState.REJECTED,
+                          f"admission rejected: {denied.reason}")
+            return front
+        with self._wake:
+            if self._stopped:
+                reason = "router is stopped"
+            elif len(self._queue) + len(self._flights) >= self.config.max_queue:
+                reason = (f"router queue full "
+                          f"({self.config.max_queue} requests)")
+            else:
+                reason = None
+                flight = _Flight(front=front)
+                self._queue.append(flight)
+                self._ledger.setdefault(front.request_id, 0)
+                self._c_submitted.inc()
+                self._g_depth.set(len(self._queue))
+                self._journal_dirty = True
+                self._wake.notify()
+        if reason is not None:
+            self._c_rejected.inc()
+            raise Backpressure(reason)
+        return front
+
+    def try_submit(self, prompt, max_new_tokens: int = 32,
+                   timeout_s: Optional[float] = None,
+                   request_id: Optional[str] = None) -> GenRequest:
+        """Typed admission: a shed request comes back already terminal
+        ``REJECTED`` (the batcher's ``try_submit`` contract, fleet-wide)."""
+        try:
+            return self.submit(prompt, max_new_tokens, timeout_s=timeout_s,
+                               request_id=request_id)
+        except (Backpressure, ValueError) as e:
+            return make_rejected(prompt, max_new_tokens, str(e),
+                                 request_id=request_id)
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self) -> "Router":
+        with self._lock:
+            if self._running:
+                return self
+            self._running = True
+            self._stopped = False
+        for rep in self.replicas.values():
+            if rep.batcher is None and rep.state is not ReplicaState.DEAD:
+                rep.start()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-router", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Stop the control plane. ``drain=True`` waits for in-flight work
+        first; whatever remains is journaled (ids + watermarks) and
+        finished ``PREEMPTED`` — a restarted router :meth:`recover`\\ s it
+        exactly once."""
+        if drain and self._thread is not None:
+            def idle() -> bool:
+                with self._lock:
+                    return not self._queue and not self._flights
+
+            retry.wait_until(idle, timeout_s, interval_s=0.01)
+        with self._wake:
+            self._running = False
+            self._stopped = True
+            self._wake.notify()
+        if self._thread is not None:
+            self._thread.join(timeout=max(1.0, timeout_s))
+            self._thread = None
+        with self._lock:
+            leftovers = [f.front for f in self._queue] + [
+                f.front for f in self._flights.values()]
+            self._queue.clear()
+            self._flights.clear()
+            self._g_depth.set(0)
+        if leftovers and self.journal_path:
+            ft_drain.persist_requests(self.journal_path, leftovers)
+        elif self.journal_path:
+            self._remove_journal()
+        for front in leftovers:
+            front._finish(RequestState.PREEMPTED,
+                          "router stopping; request journaled for recovery")
+        for rep in self.replicas.values():
+            rep.stop()
+            # Same ownership rule as rolling_upgrade: the router's journal
+            # is authoritative for everything it admitted; a fronted
+            # replica's drain journal holds backend-relative entries
+            # (composite prompts, resume-relative tokens) that must never
+            # replay alongside it.
+            self._consume_replica_journal(rep)
+
+    def _consume_replica_journal(self, rep: Replica) -> None:
+        try:
+            os.remove(rep.persist_path)
+        except OSError:
+            pass
+
+    def recover(self, extra_journals: Sequence[str] = ()) -> List[GenRequest]:
+        """Resubmit journaled work, resuming each stream from its
+        journaled prefix. Call before :meth:`start` traffic.
+
+        The router's OWN journal is authoritative: its entries carry the
+        client-relative prompt and delivered watermark. ``extra_journals``
+        (e.g. drain journals of crashed standalone replicas) contribute
+        only request ids the router never journaled — a backend-side
+        entry for a request the router knows about is *resume-relative*
+        (composite prompt, suffix tokens) and replaying it would drop the
+        original prefix, so it never overrides the front entry. Ids that
+        appear only in the extras dedupe among themselves with the
+        highest watermark winning (:func:`merge_journal_entries`)."""
+        own = ([self.journal_path]
+               if self.journal_path and os.path.exists(self.journal_path)
+               else [])
+        extras = [p for p in extra_journals if p and os.path.exists(p)]
+        entries = ft_drain.merge_journal_entries(own)
+        seen = {e.get("request_id") for e in entries if e.get("request_id")}
+        entries += [e for e in ft_drain.merge_journal_entries(extras)
+                    if not e.get("request_id")
+                    or e["request_id"] not in seen]
+        for p in own + extras:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+        fronts: List[GenRequest] = []
+        for e in entries:
+            try:
+                front = self.submit(
+                    e["prompt"], max_new_tokens=int(e["max_new_tokens"]),
+                    timeout_s=e.get("timeout_s"),
+                    request_id=e.get("request_id") or None)
+            except (Backpressure, ValueError, KeyError) as err:
+                logging.warning("dropping unrecoverable journal entry %r "
+                                "(%s)", e, err)
+                continue
+            if front.done:
+                continue  # typed unservable: dropped, loudly, once
+            # Resume from the journaled watermark: the dispatch path
+            # re-prefills prompt+prefix[:-1] and asserts the overlap
+            # token, exactly like a live failover.
+            front.tokens.extend(int(t) for t in e.get("tokens", []))
+            fronts.append(front)
+        return fronts
+
+    # ------------------------------------------------------------------ loop
+    def _notify(self, _req=None) -> None:
+        with self._wake:
+            self._wake.notify()
+
+    def _loop(self) -> None:
+        while True:
+            with self._wake:
+                if not self._running:
+                    break
+                self._wake.wait(timeout=self.config.dispatch_interval_s)
+                if not self._running:
+                    break
+            try:
+                self._sweep_health()
+                self._harvest()
+                self._expire()
+                self._dispatch()
+                self._journal_tick()
+            except Exception:  # noqa: BLE001 - the control plane must survive
+                logging.warning("router tick failed", exc_info=True)
+
+    # ----------------------------------------------------------------- health
+    def replica_state(self, rid: int) -> ReplicaState:
+        """The router's current view of one replica (observer-combined)."""
+        with self._lock:
+            return self._view.get(int(rid), ReplicaState.STARTING)
+
+    def _classify(self, rid: int, peers) -> ReplicaState:
+        if rid in self._admin_draining:
+            return ReplicaState.DRAINING
+        peer = peers.get(rid)
+        payload_state = (peer.last_payload.get("state")
+                         if peer is not None else None)
+        if payload_state == ReplicaState.DEAD.value:
+            return ReplicaState.DEAD
+        if peer is not None and peer.state is PeerState.DEAD:
+            return ReplicaState.DEAD
+        if peer is not None and peer.state is PeerState.SUSPECT:
+            return ReplicaState.SUSPECT
+        try:
+            return ReplicaState(payload_state)
+        except ValueError:
+            return ReplicaState.STARTING
+
+    def _sweep_health(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_health < self.config.health_interval_s:
+            return
+        self._last_health = now
+        self.monitor.tick()
+        if self.aggregator is not None:
+            try:
+                fleet = self.aggregator.tick()
+                self._scores = self.aggregator.straggler_scores(fleet)
+            except Exception:  # noqa: BLE001 - scores are advisory
+                logging.warning("router straggler sweep failed",
+                                exc_info=True)
+        peers = self.monitor.peers()
+        newly_dead: List[int] = []
+        with self._lock:
+            for rid in self.replicas:
+                old = self._view.get(rid)
+                new = self._classify(rid, peers)
+                if new is not old:
+                    logging.info("router: replica %d %s -> %s", rid,
+                                 old.value if old else "?", new.value)
+                    obs_recorder.record_event(
+                        "replica_transition", critical=False, replica=rid,
+                        old=old.value if old else "", new=new.value)
+                    if new is ReplicaState.DEAD:
+                        newly_dead.append(rid)
+                self._view[rid] = new
+            self._g_ready.set(sum(
+                1 for s in self._view.values() if s is ReplicaState.READY))
+        for rid in newly_dead:
+            self._c_failovers.inc()
+            self._fail_over(rid)
+
+    def _fail_over(self, rid: int) -> None:
+        """A replica died: every in-flight request assigned to it reroutes
+        to a survivor (harvest first — tokens its batcher delivered before
+        dying are client-visible and anchor the resume watermark)."""
+        with self._lock:
+            victims = [f for f in self._flights.values()
+                       if f.replica_id == rid]
+        for flight in victims:
+            self._harvest_flight(flight)
+            if not flight.front.done:
+                self._requeue(flight, f"replica {rid} died")
+
+    # ---------------------------------------------------------------- harvest
+    def _harvest(self) -> None:
+        with self._lock:
+            flights = list(self._flights.values())
+        for flight in flights:
+            self._harvest_flight(flight)
+
+    def _harvest_flight(self, flight: _Flight) -> None:
+        with self._harvest_mutex:
+            self._harvest_flight_locked(flight)
+
+    def _harvest_flight_locked(self, flight: _Flight) -> None:
+        front, backend = flight.front, flight.backend
+        if backend is None or front.done:
+            return
+        tokens = backend.tokens
+        while flight.harvested < len(tokens):
+            tok = int(tokens[flight.harvested])
+            flight.harvested += 1
+            if flight.skip > 0:
+                flight.skip -= 1
+                expect, flight.expect = flight.expect, None
+                if expect is not None and tok != expect:
+                    # The failover contract's hard assertion: greedy
+                    # decode is deterministic, so the resumed prefix MUST
+                    # reproduce bit-identically. A mismatch means the
+                    # replicas disagree on the math — delivering a forked
+                    # stream would be silent corruption; fail typed.
+                    self._c_mismatch.inc()
+                    self._finish_flight(
+                        flight, RequestState.REJECTED,
+                        f"failover prefix mismatch: replica "
+                        f"{flight.replica_id} regenerated {tok}, delivered "
+                        f"prefix ends with {expect} (nondeterministic "
+                        f"decode)")
+                    return
+                continue
+            front.tokens.append(tok)
+            self._journal_dirty = True
+            if flight.t_backend_fail is not None:
+                # First client-visible token after a failover: the
+                # failover latency the bench line reports.
+                self._g_failover_s.set(
+                    time.monotonic() - flight.t_backend_fail)
+                flight.t_backend_fail = None
+        if not backend.done:
+            return
+        # Backend terminal: everything harvestable has been harvested.
+        if backend.state is RequestState.DONE:
+            self._finish_flight(flight, RequestState.DONE, "")
+        elif backend.state is RequestState.TIMEOUT:
+            self._finish_flight(flight, RequestState.TIMEOUT, backend.error)
+        elif backend.state is RequestState.REJECTED and backend.unservable:
+            front.unservable = True
+            self._finish_flight(flight, RequestState.REJECTED, backend.error)
+        else:
+            # REJECTED (engine death / scheduler failure / batcher stop)
+            # or PREEMPTED (drain cut it off): fail over to a survivor.
+            self._requeue(flight, backend.error or backend.state.value)
+
+    def _finish_flight(self, flight: _Flight, state: RequestState,
+                       error: str) -> None:
+        front = flight.front
+        with self._lock:
+            self._flights.pop(front.request_id, None)
+            if state is RequestState.DONE:
+                self._ledger[front.request_id] = (
+                    self._ledger.get(front.request_id, 0) + 1)
+            self._journal_dirty = True
+        (self._c_completed if state is RequestState.DONE
+         else self._c_rejected).inc()
+        front._finish(state, error)
+        self._h_latency.observe(time.monotonic() - front.t_submit)
+
+    def _requeue(self, flight: _Flight, why: str) -> None:
+        """Fail a flight over: back to the queue head (it has waited
+        longest), resume spec recomputed from the delivered watermark at
+        dispatch time."""
+        front = flight.front
+        with self._lock:
+            if front.request_id not in self._flights:
+                return  # already finished/requeued (idempotent)
+            self._flights.pop(front.request_id)
+            flight.backend = None
+            flight.replica_id = None
+            flight.harvested = 0
+            flight.skip = 0
+            flight.expect = None
+            flight.reroutes += 1
+            flight.t_backend_fail = time.monotonic()
+            self._queue.insert(0, flight)
+            self._g_depth.set(len(self._queue))
+            self._journal_dirty = True
+        self._c_rerouted.inc()
+        logging.info("router: rerouting %s after %d delivered token(s) "
+                     "(%s)", front.request_id, len(front.tokens), why)
+        obs_recorder.record_event(
+            "reroute", critical=False, request_id=front.request_id,
+            delivered=len(front.tokens), reason=why[:200])
+
+    # ----------------------------------------------------------------- expiry
+    def _expire(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            expired = [f for f in self._queue
+                       if f.front.deadline is not None
+                       and now > f.front.deadline]
+            for f in expired:
+                self._queue.remove(f)
+            if expired:
+                self._g_depth.set(len(self._queue))
+                self._journal_dirty = True
+        for f in expired:
+            f.front._finish(RequestState.TIMEOUT,
+                            "deadline expired in router queue")
+
+    # --------------------------------------------------------------- dispatch
+    def _routable(self) -> List[int]:
+        with self._lock:
+            return [rid for rid, s in self._view.items()
+                    if s is ReplicaState.READY
+                    and self.replicas[rid].batcher is not None]
+
+    def _rank(self, candidates: List[int]) -> List[int]:
+        """Least outstanding work, weighted by straggler score (a 2x-slow
+        replica counts as twice as loaded); ties break to the lowest id
+        for determinism."""
+        def weight(rid: int) -> float:
+            load = self.replicas[rid].outstanding + 1
+            score = max(1.0, float(self._scores.get(rid, 1.0)))
+            return load * score
+
+        return sorted(candidates, key=lambda rid: (weight(rid), rid))
+
+    def _dispatch(self) -> None:
+        saturated: set = set()
+        while True:
+            with self._lock:
+                if not self._queue:
+                    return
+                flight = self._queue[0]
+            candidates = [r for r in self._routable() if r not in saturated]
+            if not candidates:
+                return  # nothing routable: stay queued (bounded at submit)
+            dispatched = False
+            for rid in self._rank(candidates):
+                if self._dispatch_one(flight, rid):
+                    dispatched = True
+                    break
+                saturated.add(rid)
+            if not dispatched:
+                return
+
+    def _dispatch_one(self, flight: _Flight, rid: int) -> bool:
+        front = flight.front
+        timeout_s = None
+        if front.deadline is not None:
+            timeout_s = front.deadline - time.monotonic()
+            if timeout_s <= 0:
+                with self._lock:
+                    if flight in self._queue:
+                        self._queue.remove(flight)
+                        self._g_depth.set(len(self._queue))
+                front._finish(RequestState.TIMEOUT,
+                              "deadline expired in router queue")
+                return True
+        # Prefix resume: k delivered tokens re-prefill as prompt context
+        # minus the last one, whose regeneration is the bit-identity
+        # assertion (skip=1). The timeline length is unchanged:
+        # (prompt + k - 1) + (max_new - k + 1) == prompt + max_new.
+        k = len(front.tokens)
+        if k:
+            prompt = np.concatenate(
+                [front.prompt, np.asarray(front.tokens[:-1], np.int32)])
+            max_new = front.max_new_tokens - k + 1
+            skip, expect = 1, int(front.tokens[-1])
+        else:
+            prompt, max_new = front.prompt, front.max_new_tokens
+            skip, expect = 0, None
+        try:
+            backend = self.replicas[rid].submit(
+                prompt, max_new, timeout_s=timeout_s,
+                request_id=front.request_id)
+        except (Backpressure, ValueError):
+            return False
+        if backend.done and backend.state is RequestState.REJECTED:
+            # Typed immediate rejection (unservable / engine refused):
+            # propagate for unservable, otherwise try the next replica.
+            if backend.unservable:
+                with self._lock:
+                    if flight in self._queue:
+                        self._queue.remove(flight)
+                        self._g_depth.set(len(self._queue))
+                front.unservable = True
+                front._finish(RequestState.REJECTED, backend.error)
+                self._c_rejected.inc()
+                return True
+            return False
+        with self._lock:
+            if flight in self._queue:
+                self._queue.remove(flight)
+            self._g_depth.set(len(self._queue))
+            flight.backend = backend
+            flight.replica_id = rid
+            flight.harvested = 0
+            flight.skip = skip
+            flight.expect = expect
+            self._flights[front.request_id] = flight
+            self._dispatches[rid] = self._dispatches.get(rid, 0) + 1
+            if front.state is RequestState.QUEUED:
+                front.state = RequestState.ACTIVE
+        backend.add_done_callback(self._notify)
+        return True
+
+    # ---------------------------------------------------------------- journal
+    def _journal_tick(self, force: bool = False) -> None:
+        if self.journal_path is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            due = self._journal_dirty and (
+                force or now - self._last_journal
+                >= self.config.journal_interval_s)
+            if not due:
+                return
+            self._journal_dirty = False
+            self._last_journal = now
+            fronts = [f.front for f in self._queue] + [
+                f.front for f in self._flights.values()]
+        if fronts:
+            ft_drain.persist_requests(self.journal_path, fronts)
+        else:
+            self._remove_journal()
+
+    def _remove_journal(self) -> None:
+        try:
+            os.remove(self.journal_path)
+        except OSError:
+            pass
+
+    # ---------------------------------------------------------------- queries
+    @property
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self._queue) + len(self._flights)
+
+    def ledger(self) -> Dict[str, int]:
+        """``{request_id: completion_count}`` — the exactly-once witness
+        (every value must be exactly 1 for a completed request; the
+        selftest and chaos scenarios assert it)."""
+        with self._lock:
+            return dict(self._ledger)
+
+    def dispatch_counts(self) -> Dict[int, int]:
+        """``{replica_id: backend_dispatches}`` — the routing witness
+        (the partition scenario asserts a SUSPECT replica stops receiving
+        new work and resumes after rejoin)."""
+        with self._lock:
+            return dict(self._dispatches)
+
+    # --------------------------------------------------------------- upgrades
+    def rolling_upgrade(self, deadline_s: Optional[float] = None,
+                        ready_timeout_s: Optional[float] = None) -> List[dict]:
+        """Drain → restart → re-admit each replica in turn, zero dropped
+        requests: while one replica drains (quiesce; in-flight finishes
+        within ``deadline_s``; leftovers persist with ids + watermarks and
+        fail over through the normal reroute path), the survivors carry
+        the traffic; the restarted replica re-admits once its READY beat
+        lands. Returns one summary dict per replica."""
+        deadline_s = (self.config.drain_deadline_s
+                      if deadline_s is None else deadline_s)
+        ready_timeout_s = (self.config.ready_timeout_s
+                           if ready_timeout_s is None else ready_timeout_s)
+        results = []
+        for rid in sorted(self.replicas):
+            rep = self.replicas[rid]
+            t0 = time.monotonic()
+            with self._lock:
+                self._admin_draining.add(rid)
+                self._view[rid] = ReplicaState.DRAINING
+            try:
+                out = rep.drain()
+                # The router owns every request a fronted replica drains:
+                # their fronts fail over through the router's OWN journal
+                # (the authoritative delivered watermarks). The replica-
+                # local drain journal would re-serve them on a naive
+                # fleet recover — consume it now.
+                self._consume_replica_journal(rep)
+                rep.restart()
+                ready = rep.wait_ready(ready_timeout_s)
+            finally:
+                with self._lock:
+                    self._admin_draining.discard(rid)
+            # Force a health sweep so the READY beat re-admits the
+            # replica before the next drain shrinks the fleet again.
+            self._sweep_health(force=True)
+            ok = ready and retry.wait_until(
+                lambda: self.replica_state(rid) is ReplicaState.READY,
+                ready_timeout_s, interval_s=0.01)
+            obs_recorder.record_event(
+                "rolling_upgrade", replica=rid, ok=bool(ok),
+                drained=out.get("drained", 0),
+                persisted=out.get("persisted", 0),
+                duration_s=round(time.monotonic() - t0, 3))
+            if not ok:
+                raise RuntimeError(
+                    f"rolling upgrade: replica {rid} did not return to "
+                    f"READY within {ready_timeout_s:.0f}s")
+            results.append({"replica": rid, **out,
+                            "duration_s": time.monotonic() - t0})
+        return results
+
+
+# ------------------------------------------------------------- selftest
+def _tiny_router_cfg():
+    import jax.numpy as jnp
+
+    from autodist_tpu.models.transformer import TransformerConfig
+
+    # vocab 128 keeps every mock_load_prompt token (1..126) IN vocab:
+    # out-of-vocab lookups clamp differently across program shapes, which
+    # would fork the greedy bit-identity oracle.
+    return TransformerConfig(
+        vocab_size=128, num_layers=1, d_model=32, num_heads=2, d_ff=64,
+        max_seq_len=64, causal=True, dtype=jnp.float32)
+
+
+def build_test_fleet(n_replicas: int = 3, n_slots: int = 8,
+                     page_len: int = 8, n_pages: int = 41,
+                     journal_dir: Optional[str] = None,
+                     registry: Optional[M.MetricsRegistry] = None,
+                     config: Optional[RouterConfig] = None):
+    """An in-process CPU fleet for tests/chaos/bench: one plan compiled
+    once (the byte-deterministic artifact a production factory would pull
+    from ``plan/cache.py``), N replicas whose factories rebuild engine
+    state over it, a shared Memory heartbeat transport, a straggler
+    aggregator pair, and a control engine for bit-identity oracles.
+
+    Returns ``(router, control_engine)``; the caller owns ``stop()``.
+    """
+    import tempfile
+
+    import jax
+
+    from autodist_tpu.ft.heartbeat import MemoryTransport
+    from autodist_tpu.models.transformer import decode_model, init_params
+    from autodist_tpu.obs.aggregate import HostAggregator
+    from autodist_tpu.serve.engine import InferenceEngine
+
+    cfg = _tiny_router_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    def make_engine():
+        return InferenceEngine(
+            params, _shared_plan(params), decode_model=decode_model(cfg),
+            n_slots=n_slots, page_len=page_len, n_pages=n_pages,
+            prefill_chunk=page_len)
+
+    control = make_engine()
+    journal_dir = journal_dir or tempfile.mkdtemp(prefix="router-journal-")
+    registry = registry or M.MetricsRegistry()
+    hb_transport = MemoryTransport()
+    agg_transport = MemoryTransport()
+    config = config or RouterConfig(
+        heartbeat_interval_s=0.05, health_interval_s=0.02,
+        suspect_after_misses=2, dead_after_misses=4)
+    replicas = {}
+    for rid in range(n_replicas):
+        agg = HostAggregator(agg_transport, process_id=rid,
+                             registry=M.MetricsRegistry())
+        replicas[rid] = Replica(
+            rid, make_engine, hb_transport,
+            persist_path=os.path.join(journal_dir, f"replica-{rid}.json"),
+            heartbeat_interval_s=config.heartbeat_interval_s,
+            drain_deadline_s=config.drain_deadline_s,
+            aggregator=agg, registry=registry)
+    router_agg = HostAggregator(agg_transport, process_id=-1,
+                                registry=M.MetricsRegistry())
+    router = Router(
+        replicas, hb_transport,
+        journal_path=os.path.join(journal_dir, "router-journal.json"),
+        config=config, aggregator=router_agg, registry=registry)
+    return router, control
+
+
+_PLAN_CACHE: dict = {}
+
+
+def _shared_plan(params):
+    """ONE compiled ShardingPlan per process for the test fleet — the
+    in-process analog of the persistent plan cache: every replica restart
+    reuses the byte-identical plan and pays only engine-state compile.
+
+    Deliberately a ONE-chip plan: each in-process replica is its own
+    single-program fault domain with NO collectives. N sharded replicas
+    sharing one process's device set would interleave collective
+    programs from N scheduler threads over the same global devices — the
+    exact cross-program rendezvous deadlock shardlint's SLH001 pass
+    exists to flag. A real fleet gives each replica its own process (and
+    device set), which is where the sharded-engine-behind-the-router
+    deployment lives (``--ft-dir`` replica mode)."""
+    key = id(type(params))  # one tiny-config plan per process is plenty
+    if key not in _PLAN_CACHE:
+        from autodist_tpu.kernel import GraphTransformer, build_mesh
+        from autodist_tpu.model_item import ModelItem
+        from autodist_tpu.resource_spec import ResourceSpec
+        from autodist_tpu.strategy import AllReduce
+        from autodist_tpu.strategy.base import StrategyCompiler
+
+        import jax
+
+        spec = ResourceSpec(resource_dict={"nodes": [
+            {"address": "localhost", "chips": 1, "chief": True}]})
+        mesh = build_mesh(spec, devices=jax.devices()[:1])
+        mi = ModelItem.from_params(params)
+        strategy = AllReduce().build(mi, spec)
+        compiled = StrategyCompiler(mi).compile(strategy)
+        _PLAN_CACHE[key] = GraphTransformer(compiled, mi, mesh).transform()
+    return _PLAN_CACHE[key]
+
+
+def selftest_router(n_requests: int = 64, n_replicas: int = 3,
+                    max_new: int = 10, kill_replica: int = 1,
+                    seed: int = 0) -> int:
+    """The router acceptance proof; returns a process exit code.
+
+    3 in-process replicas behind the router, 64 concurrent mock clients;
+    one replica is killed mid-decode once it holds in-flight work. Bars:
+
+    - every request completes exactly once (ledger-verified: no
+      duplicate completion, no drop; the journal is empty at the end);
+    - every delivered stream is **bit-identical** to an uninterrupted
+      control run of the same prompt on a lone engine (greedy
+      determinism across the failover's re-prefill);
+    - at least one failover and one reroute actually happened;
+    - the fleet view shows ``n_replicas - 1`` READY replicas afterwards.
+    """
+    import asyncio
+    import shutil
+    import tempfile
+
+    from autodist_tpu.serve.server import async_generate, mock_load_prompt
+
+    registry = M.MetricsRegistry()
+    rng = np.random.default_rng(seed)
+    workdir = tempfile.mkdtemp(prefix="router-selftest-")
+    router, control = build_test_fleet(
+        n_replicas=n_replicas, journal_dir=workdir, registry=registry)
+    prompts = [np.asarray(mock_load_prompt(rng, i), np.int32)
+               for i in range(n_requests)]
+    # Uninterrupted control streams (greedy, deterministic).
+    expected = [control.generate(p, max_new) for p in prompts]
+
+    router.start()
+    for rep in router.replicas.values():
+        rep.wait_ready(120.0)
+    victim = router.replicas[kill_replica]
+
+    killed = {"at": None}
+
+    def killer():
+        # Kill once the victim holds in-flight decode work: a mid-decode
+        # death, not an idle restart.
+        def armed() -> bool:
+            with router._lock:
+                return any(
+                    f.replica_id == kill_replica and len(f.front.tokens) > 0
+                    for f in router._flights.values())
+
+        if retry.wait_until(armed, 60.0, interval_s=0.005):
+            killed["at"] = time.monotonic()
+            victim.kill("selftest: injected mid-decode death")
+
+    kthread = threading.Thread(target=killer, daemon=True)
+
+    async def run_clients():
+        async def client(i):
+            await asyncio.sleep(0.001 * (i % 8))
+            return await async_generate(router, prompts[i], max_new)
+
+        return await asyncio.gather(*(client(i) for i in range(n_requests)))
+
+    t0 = time.monotonic()
+    kthread.start()
+    try:
+        results = asyncio.run(asyncio.wait_for(run_clients(), timeout=300))
+    finally:
+        kthread.join(timeout=5.0)
+    dt = time.monotonic() - t0
+
+    states = {s: sum(1 for r in results if r.state is s) for s in RequestState}
+    streams_ok = all(r.tokens == expected[i] for i, r in enumerate(results))
+    ledger = router.ledger()
+    exactly_once = (len(ledger) == n_requests
+                    and all(v == 1 for v in ledger.values()))
+    snap = registry.snapshot()
+    failovers = int(snap.get("serve_router_failovers_total", 0))
+    rerouted = int(snap.get("serve_router_requests_rerouted_total", 0))
+    mismatches = int(snap.get("serve_router_prefix_mismatch_total", 0))
+    # The journal flusher runs on its own cadence: give it one window to
+    # consume the final completion before reading the empty-journal bar.
+    journal_empty = retry.wait_until(
+        lambda: not os.path.exists(router.journal_path), 5.0,
+        interval_s=0.01)
+    ready_after = int(snap.get("serve_router_replicas_ready", 0))
+    lat = snap.get("serve_router_request_latency_s", {})
+    router.stop(drain=False)
+    shutil.rmtree(workdir, ignore_errors=True)
+
+    ok = (
+        states.get(RequestState.DONE, 0) == n_requests
+        and streams_ok
+        and exactly_once
+        and killed["at"] is not None
+        and failovers >= 1
+        and rerouted >= 1
+        and mismatches == 0
+        and journal_empty
+        and ready_after == n_replicas - 1
+    )
+    line = {
+        "selftest": "autodist_tpu.serve.router",
+        "ok": bool(ok),
+        "n_requests": n_requests,
+        "n_replicas": n_replicas,
+        "completed": states.get(RequestState.DONE, 0),
+        "dropped": n_requests - states.get(RequestState.DONE, 0),
+        "streams_bit_identical_to_control": bool(streams_ok),
+        "exactly_once": bool(exactly_once),
+        "failovers": failovers,
+        "requests_rerouted": rerouted,
+        "prefix_mismatches": mismatches,
+        "failover_latency_s": round(
+            float(snap.get("serve_router_failover_latency_s", 0.0)), 4),
+        "replicas_ready_after_kill": ready_after,
+        "journal_empty": bool(journal_empty),
+        "p50_latency_s": round(lat.get("p50", float("nan")), 4),
+        "p99_latency_s": round(lat.get("p99", float("nan")), 4),
+        "wall_s": round(dt, 2),
+        "device": __import__("jax").devices()[0].platform,
+    }
+    print(json.dumps(line))
+    if not ok:
+        logging.warning(
+            "router selftest failed: states=%s streams_ok=%s "
+            "exactly_once=%s failovers=%d rerouted=%d mismatches=%d "
+            "journal_empty=%s ready=%d",
+            {s.value: n for s, n in states.items() if n}, streams_ok,
+            exactly_once, failovers, rerouted, mismatches, journal_empty,
+            ready_after)
+    return 0 if ok else 1

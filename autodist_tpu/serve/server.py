@@ -12,7 +12,13 @@ The front end is deliberately thin: a dependency-free HTTP/1.1 listener on
 - ``GET /metrics`` → the metrics registry as OpenMetrics text, rendered by
   the one shared exporter (``autodist_tpu.obs.exporter`` — byte-identical
   to the headless file exporter's output on the same snapshot).
-- ``GET /healthz`` → queue/slot gauges as JSON.
+- ``GET /healthz`` → typed readiness (``ReplicaState``) + queue/slot
+  gauges + page-pool utilization as JSON — **503** while
+  ``STARTING``/``DRAINING`` (200 only when READY), so the router and any
+  external supervisor probe a replica the same way.
+- ``POST /drain`` → run the graceful drain (quiesce → finish in-flight →
+  persist leftovers) and report ``{"drained": n, "persisted": n}`` — the
+  admin surface a rolling upgrade drives from outside the process.
 
 ``python -m autodist_tpu.serve --selftest`` is the zero-hardware proof the
 acceptance bar names: a tiny CPU transformer served to >=64 concurrent mock
@@ -52,17 +58,39 @@ async def async_generate(
 
 
 class ServeFrontend:
-    """Minimal HTTP server over one batcher."""
+    """Minimal HTTP server over one batcher (optionally one
+    :class:`~autodist_tpu.serve.replica.Replica`, which adds typed
+    readiness to ``/healthz`` and a real drain to ``POST /drain``)."""
 
     def __init__(self, batcher: ContinuousBatcher, host: str = "127.0.0.1",
-                 port: int = 8476, registry: Optional[M.MetricsRegistry] = None):
-        self.batcher = batcher
+                 port: int = 8476, registry: Optional[M.MetricsRegistry] = None,
+                 replica=None):
+        self._batcher = batcher
         self.host, self.port = host, port
         self.registry = registry or M.registry
+        self.replica = replica
         self._server: Optional[asyncio.AbstractServer] = None
 
+    @property
+    def batcher(self) -> Optional[ContinuousBatcher]:
+        """The live batcher: a replica swaps its batcher across
+        drain/restart cycles, so the frontend always asks it."""
+        if self.replica is not None and self.replica.batcher is not None:
+            return self.replica.batcher
+        return self._batcher
+
     async def start(self) -> "ServeFrontend":
-        self.batcher.start()
+        if self.replica is not None:
+            # Bind the listener BEFORE the (possibly minutes-long) engine
+            # build: the whole point of typed STARTING readiness is that
+            # a supervisor probing /healthz during the build gets a 503
+            # JSON answer, not connection-refused.
+            import threading
+
+            threading.Thread(target=self.replica.start,
+                             name="replica-start", daemon=True).start()
+        else:
+            self.batcher.start()
         self._server = await asyncio.start_server(
             self._handle, self.host, self.port)
         addr = self._server.sockets[0].getsockname()
@@ -79,7 +107,10 @@ class ServeFrontend:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
-        self.batcher.stop()
+        if self.replica is not None:
+            self.replica.stop()
+        elif self.batcher is not None:
+            self.batcher.stop()
 
     # ----------------------------------------------------------------- http
     @staticmethod
@@ -107,7 +138,8 @@ class ServeFrontend:
     @staticmethod
     def _respond(writer, status: int, payload, content_type="application/json"):
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                  429: "Too Many Requests", 500: "Internal Server Error"}
+                  429: "Too Many Requests", 500: "Internal Server Error",
+                  503: "Service Unavailable"}
         body = (json.dumps(payload).encode()
                 if content_type == "application/json" else payload.encode())
         writer.write(
@@ -128,11 +160,9 @@ class ServeFrontend:
                 self._respond(writer, 200, self.registry.render_text(),
                               content_type="text/plain")
             elif method == "GET" and path == "/healthz":
-                self._respond(writer, 200, {
-                    "ok": True,
-                    "queue_depth": len(self.batcher._queue),
-                    "active_slots": self.batcher.engine.active_slots,
-                })
+                self._healthz(writer)
+            elif method == "POST" and path == "/drain":
+                await self._drain(writer)
             elif method == "POST" and path == "/generate":
                 await self._generate(writer, body)
             else:
@@ -147,6 +177,46 @@ class ServeFrontend:
         finally:
             writer.close()
 
+    def _healthz(self, writer) -> None:
+        """Typed readiness probe: 200 only when READY; 503 while
+        STARTING/DRAINING (or DEAD/SUSPECT) — the router and an external
+        supervisor (k8s-style readiness gate) consume the same answer."""
+        from autodist_tpu.serve.replica import ReplicaState
+
+        if self.replica is not None:
+            doc = self.replica.healthz()
+            state = self.replica.state
+        else:
+            # Batcher-only deployment: derive the readiness the batcher
+            # can express (no STARTING phase to observe from here).
+            state = (ReplicaState.DRAINING if self.batcher._draining
+                     else ReplicaState.READY)
+            engine = self.batcher.engine
+            doc = {
+                "state": state.value,
+                "outstanding": self.batcher.outstanding,
+                "page_pool_utilization": round(
+                    float(getattr(engine, "page_utilization", 0.0)), 4),
+            }
+        batcher = self.batcher
+        doc["ok"] = state is ReplicaState.READY
+        doc["queue_depth"] = len(batcher._queue) if batcher else 0
+        doc["active_slots"] = (getattr(batcher.engine, "active_slots", 0)
+                               if batcher else 0)
+        self._respond(writer, 200 if doc["ok"] else 503, doc)
+
+    async def _drain(self, writer) -> None:
+        """Admin drain: quiesce → finish in-flight → persist leftovers.
+        Runs off the event loop (a drain blocks up to its deadline); the
+        response reports what was drained/persisted."""
+        if self.replica is not None:
+            out = await asyncio.to_thread(self.replica.drain)
+        else:
+            finished, leftovers = await asyncio.to_thread(self.batcher.drain)
+            out = {"drained": finished, "persisted": 0,
+                   "preempted": len(leftovers)}
+        self._respond(writer, 200, out)
+
     async def _generate(self, writer, body: bytes) -> None:
         try:
             payload = json.loads(body.decode() or "{}")
@@ -155,9 +225,15 @@ class ServeFrontend:
         except (ValueError, KeyError) as e:
             self._respond(writer, 400, {"error": f"bad request body: {e}"})
             return
+        batcher = self.batcher
+        if batcher is None:
+            self._respond(writer, 503,
+                          {"error": "replica is not ready (starting or "
+                                    "draining)"})
+            return
         try:
             req = await async_generate(
-                self.batcher, tokens, max_new,
+                batcher, tokens, max_new,
                 timeout_s=payload.get("timeout_s"))
         except Backpressure as e:
             self._respond(writer, 429, {"error": str(e)})

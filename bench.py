@@ -728,6 +728,74 @@ def _serve_decode_bench(n_requests: int = 48, max_new: int = 10) -> dict:
     }}
 
 
+def _router_bench(n_requests: int = 24, max_new: int = 6) -> dict:
+    """The ``serve_router`` workload: the multi-replica control plane
+    under a mid-decode replica kill (3 CPU-sim replicas behind the
+    router, the selftest's fleet). Measures failover latency (death →
+    first rerouted token delivered), requests rerouted, and the drop
+    count (the zero-drop contract) — control-plane math, no chip, so it
+    emits before any accelerator preflight and survives rc=124 wedges.
+    """
+    import threading
+
+    import jax
+    import numpy as np
+
+    from autodist_tpu import metrics as M
+    from autodist_tpu.serve.batcher import RequestState
+    from autodist_tpu.serve.router import build_test_fleet
+    from autodist_tpu.serve.server import mock_load_prompt
+    from autodist_tpu.utils import retry
+
+    registry = M.MetricsRegistry()
+    rng = np.random.default_rng(0)
+    router, _control = build_test_fleet(n_replicas=3, registry=registry)
+    prompts = [np.asarray(mock_load_prompt(rng, i), np.int32)
+               for i in range(n_requests)]
+    router.start()
+    for rep in router.replicas.values():
+        rep.wait_ready(120.0)
+
+    def killer():
+        def armed() -> bool:
+            with router._lock:
+                return any(f.replica_id == 1 and len(f.front.tokens) > 0
+                           for f in router._flights.values())
+
+        if retry.wait_until(armed, 60.0, interval_s=0.005):
+            router.replicas[1].kill("bench: injected mid-decode death")
+
+    thread = threading.Thread(target=killer, daemon=True)
+    t0 = time.perf_counter()
+    thread.start()
+    fronts = [router.submit(p, max_new_tokens=max_new) for p in prompts]
+    states = [f.wait(240.0).state for f in fronts]
+    dt = time.perf_counter() - t0
+    thread.join(timeout=5.0)
+    completed = sum(1 for s in states if s is RequestState.DONE)
+    ledger = router.ledger()
+    snap = registry.snapshot()
+    lat = snap.get("serve_router_request_latency_s", {})
+    router.stop(drain=False)
+    return {"bench_router": {
+        "n_requests": n_requests,
+        "n_replicas": 3,
+        "completed": completed,
+        "dropped": n_requests - completed,
+        "exactly_once": bool(len(ledger) == n_requests
+                             and all(v == 1 for v in ledger.values())),
+        "failovers": int(snap.get("serve_router_failovers_total", 0)),
+        "requests_rerouted": int(
+            snap.get("serve_router_requests_rerouted_total", 0)),
+        "failover_latency_s": round(
+            float(snap.get("serve_router_failover_latency_s", 0.0)), 4),
+        "p50_latency_s": round(lat.get("p50", float("nan")), 4),
+        "p99_latency_s": round(lat.get("p99", float("nan")), 4),
+        "wall_s": round(dt, 2),
+        "device": jax.devices()[0].platform,
+    }}
+
+
 def _run_one(name: str, cpu_smoke: bool, plan_cache: str = "") -> None:
     """Child mode: measure one workload, print its raw dict as JSON."""
     import jax
@@ -736,6 +804,9 @@ def _run_one(name: str, cpu_smoke: bool, plan_cache: str = "") -> None:
         jax.config.update("jax_platforms", "cpu")
     if name == "serve_decode":
         print(json.dumps(_serve_decode_bench()))
+        return
+    if name == "serve_router":
+        print(json.dumps(_router_bench()))
         return
     on_accel = jax.devices()[0].platform != "cpu"
     out = measure_workload(name, on_accel, plan_cache=plan_cache)
@@ -1011,6 +1082,14 @@ def _main() -> None:
                                           timeout_s=300.0)
         print(json.dumps(out if out and "bench_serve" in out
                          else {"bench_serve": {"failed": err or "no JSON"}}),
+              flush=True)
+        # bench_router rides next, same rc=124-proof discipline: the
+        # multi-replica failover drill (kill 1 of 3 mid-decode) reports
+        # failover latency / rerouted / drop count before any preflight.
+        out, err = _measure_in_subprocess("serve_router", cpu_smoke=True,
+                                          timeout_s=300.0)
+        print(json.dumps(out if out and "bench_router" in out
+                         else {"bench_router": {"failed": err or "no JSON"}}),
               flush=True)
 
     # Safety net over the budget clamps: if anything blocks anyway, SIGALRM

@@ -278,6 +278,22 @@ class TestFaultClassPins:
         assert res.injected == 2
         assert "budget+backoff reset on progress" in res.detected
 
+    def test_replica_death_exactly_once_doc006(self, tmp_path):
+        res = _run("replica_death", tmp_path)
+        assert res.detected == ["DEAD", "exactly_once", "DOC006"]
+        assert res.injected == 1
+        assert "bit-identical to control" in res.notes
+
+    def test_replica_partition_suspect_routed_around(self, tmp_path):
+        res = _run("replica_partition", tmp_path)
+        assert res.detected == ["SUSPECT", "routed around", "rejoined"]
+        assert "zero spurious failovers" in res.notes
+
+    def test_rolling_upgrade_under_load_zero_drops(self, tmp_path):
+        res = _run("rolling_upgrade_under_load", tmp_path)
+        assert res.detected == ["zero drops", "exactly_once", "p99 bounded"]
+        assert res.injected == 3    # one drain/restart cycle per replica
+
 
 # ------------------------------------------------------ replay determinism
 class TestReplayDeterminism:

@@ -1,0 +1,450 @@
+"""Multi-replica serving control plane tests (docs/serving.md § router).
+
+Four tiers, mirroring the ISSUE-13 acceptance bars:
+
+- **routing** (stub replicas, no device work): work goes to the
+  least-outstanding-work READY replica; a straggler score demotes a slow
+  replica; a saturated fleet sheds typed at the edge (``Backpressure`` /
+  terminal ``REJECTED``), never hangs.
+- **exactly-once failover** (real engines): kill a replica mid-decode —
+  every stream completes on a survivor bit-identical to an uninterrupted
+  control run (the prefix-resume overlap token re-derived and asserted),
+  ledger-verified exactly once; a router restart resumes journaled work
+  from its delivered watermark.
+- **rolling upgrade**: drain/restart every replica with zero dropped
+  requests.
+- **journal format** (ft/drain.py satellites): format-v2 entries carry
+  ``request_id`` + ``delivered`` + the token prefix; the multi-journal
+  merge dedupes by id with the highest watermark winning; ``/healthz``
+  answers 503 while STARTING/DRAINING and 200 only when READY.
+"""
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from autodist_tpu import metrics as M
+from autodist_tpu.ft import drain as ft_drain
+from autodist_tpu.ft.heartbeat import MemoryTransport
+from autodist_tpu.serve.batcher import Backpressure, RequestState
+from autodist_tpu.serve.engine import AdmissionDenied
+from autodist_tpu.serve.replica import Replica, ReplicaState
+from autodist_tpu.serve.router import Router, RouterConfig, build_test_fleet
+from autodist_tpu.utils import retry
+
+FAST = RouterConfig(heartbeat_interval_s=0.02, health_interval_s=0.01,
+                    suspect_after_misses=2, dead_after_misses=4,
+                    dispatch_interval_s=0.002)
+
+
+# ------------------------------------------------------------ stub fleet
+class _StubEngine:
+    """Enough engine surface for admission/queueing — no device work, so
+    routing-policy tests run in milliseconds. Admission always defers
+    (retryable), so dispatched work parks in the replica queue where the
+    test can observe WHERE the router sent it."""
+
+    decode_model = object()
+    n_slots = 4
+    max_len = 64
+    page_utilization = 0.0
+    page_fragmentation = 0.0
+    chaos_host = 0
+    pool = SimpleNamespace(free_pages=0, used_pages=0, utilization=0.0)
+
+    @staticmethod
+    def check_admissible(prompt_len, max_new_tokens):
+        if prompt_len + max_new_tokens > 64:
+            return AdmissionDenied("over stub ceiling", retryable=False)
+        return None
+
+    @staticmethod
+    def admit(prompt, max_new_tokens):
+        return AdmissionDenied("no free row (stub)", retryable=True)
+
+    @staticmethod
+    def prefill_pending():
+        return []
+
+    @staticmethod
+    def release(slot):
+        pass
+
+
+def _stub_fleet(n=3, max_queue=64, config=FAST, registry=None):
+    import tempfile
+
+    transport = MemoryTransport()
+    registry = registry or M.MetricsRegistry()
+    workdir = tempfile.mkdtemp(prefix="router-stub-")
+    replicas = {
+        rid: Replica(rid, _StubEngine, transport,
+                     persist_path=os.path.join(workdir, f"r{rid}.json"),
+                     max_queue=max_queue,
+                     heartbeat_interval_s=config.heartbeat_interval_s,
+                     registry=M.MetricsRegistry())
+        for rid in range(n)
+    }
+    router = Router(replicas, transport, config=config, registry=registry)
+    return router
+
+
+def _wait_view_ready(router, rids, timeout=10.0):
+    assert retry.wait_until(
+        lambda: all(router.replica_state(r) is ReplicaState.READY
+                    for r in rids), timeout, interval_s=0.005), {
+            r: router.replica_state(r) for r in rids}
+
+
+def _wait_dispatched(router, front, timeout=10.0):
+    def placed():
+        with router._lock:
+            f = router._flights.get(front.request_id)
+            return f is not None and f.replica_id is not None
+
+    assert retry.wait_until(placed, timeout, interval_s=0.002)
+    with router._lock:
+        return router._flights[front.request_id].replica_id
+
+
+# ---------------------------------------------------------------- routing
+class TestRouting:
+    def test_routes_least_loaded_ready(self):
+        router = _stub_fleet()
+        try:
+            router.start()
+            _wait_view_ready(router, [0, 1, 2])
+            # Preload replicas 0 and 2 directly (bypassing the router):
+            # replica 1 is now the least-outstanding-work READY target.
+            for _ in range(3):
+                router.replicas[0].submit([1, 2, 3], max_new_tokens=4)
+            router.replicas[2].submit([1, 2, 3], max_new_tokens=4)
+            front = router.submit([5, 6, 7], max_new_tokens=4)
+            assert _wait_dispatched(router, front) == 1
+        finally:
+            router.stop(drain=False)
+
+    def test_straggler_score_demotes_slow_replica(self):
+        from autodist_tpu.obs.aggregate import HostAggregator
+
+        agg_transport = MemoryTransport()
+        router = _stub_fleet()
+        router.aggregator = HostAggregator(
+            agg_transport, process_id=-1, registry=M.MetricsRegistry())
+        try:
+            # Equal (zero) outstanding work everywhere, but replica 0's
+            # published step-time p50 is 3x the fleet median: the weighted
+            # rank must prefer replica 1 even though the id tiebreak
+            # would have picked 0.
+            now = time.time()
+            agg_transport.publish(0, {"time": now, "p50": 0.3, "n": 16})
+            agg_transport.publish(1, {"time": now, "p50": 0.1, "n": 16})
+            agg_transport.publish(2, {"time": now, "p50": 0.1, "n": 16})
+            router.start()
+            _wait_view_ready(router, [0, 1, 2])
+            assert retry.wait_until(
+                lambda: router._scores.get(0, 0) > 1.5, 5.0)
+            front = router.submit([5, 6, 7], max_new_tokens=4)
+            assert _wait_dispatched(router, front) == 1
+        finally:
+            router.stop(drain=False)
+
+    def test_suspect_replica_not_routed(self):
+        # DEAD needs a long silence here: the pin is SUSPECT routing, not
+        # a failover.
+        cfg = RouterConfig(heartbeat_interval_s=0.02,
+                           health_interval_s=0.01,
+                           dispatch_interval_s=0.002,
+                           suspect_after_misses=2, dead_after_misses=60)
+        router = _stub_fleet(config=cfg)
+        try:
+            router.start()
+            _wait_view_ready(router, [0, 1, 2])
+            # Silence replica 0's beats (a control-plane partition): the
+            # observer monitor escalates it to SUSPECT and it must stop
+            # receiving new work.
+            router.replicas[0]._hb_stop.set()
+            assert retry.wait_until(
+                lambda: router.replica_state(0) is ReplicaState.SUSPECT,
+                10.0)
+            for _ in range(4):
+                front = router.submit([5, 6, 7], max_new_tokens=4)
+                assert _wait_dispatched(router, front) != 0
+            assert router.dispatch_counts()[0] == 0
+        finally:
+            router.stop(drain=False)
+
+    def test_typed_shed_when_all_replicas_saturated(self):
+        cfg = RouterConfig(
+            heartbeat_interval_s=0.02, health_interval_s=0.01,
+            dispatch_interval_s=0.002, max_queue=2)
+        router = _stub_fleet(config=cfg)
+        try:
+            router.start()
+            _wait_view_ready(router, [0, 1, 2])
+            fronts = [router.submit([1, 2], max_new_tokens=4)
+                      for _ in range(2)]
+            assert all(not f.done for f in fronts)
+            with pytest.raises(Backpressure, match="router queue full"):
+                router.submit([1, 2], max_new_tokens=4)
+            shed = router.try_submit([1, 2], max_new_tokens=4)
+            assert shed.state is RequestState.REJECTED
+            assert "router queue full" in shed.error
+            assert shed.done  # terminal: a client wait() returns now
+        finally:
+            router.stop(drain=False)
+
+    def test_unservable_is_typed_terminal(self):
+        router = _stub_fleet()
+        try:
+            router.replicas[0].start()  # gives the router a live engine
+            front = router.submit(list(range(60)), max_new_tokens=30)
+            assert front.state is RequestState.REJECTED
+            assert front.unservable
+        finally:
+            router.stop(drain=False)
+
+
+# -------------------------------------------------------- failover (real)
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    """One real 2-replica fleet + control engine for the device-backed
+    pins (module-scoped: the per-test state is requests, not replicas)."""
+    journal_dir = str(tmp_path_factory.mktemp("router-journals"))
+    registry = M.MetricsRegistry()
+    router, control = build_test_fleet(
+        n_replicas=2, journal_dir=journal_dir, registry=registry)
+    router.start()
+    for rep in router.replicas.values():
+        rep.wait_ready(120.0)
+    yield router, control, registry
+    router.stop(drain=False)
+
+
+class TestFailover:
+    def test_failover_streams_bit_identical(self, fleet):
+        router, control, registry = fleet
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(1, 127, size=int(rng.integers(3, 9)))
+                   .astype(np.int32) for _ in range(8)]
+        expected = [control.generate(p, 8) for p in prompts]
+        before = int(registry.counter(
+            "serve_router_requests_rerouted_total").value)
+        fronts = [router.submit(p, max_new_tokens=8) for p in prompts]
+
+        def on_victim():
+            with router._lock:
+                return any(
+                    f.replica_id == 0 and len(f.front.tokens) > 0
+                    for f in router._flights.values())
+
+        assert retry.wait_until(on_victim, 60.0, interval_s=0.002)
+        router.replicas[0].kill("test: mid-decode death")
+        states = [f.wait(120.0).state for f in fronts]
+        assert all(s is RequestState.DONE for s in states), states
+        # Bit-identity: delivered prefix from the dead replica + resumed
+        # continuation from the survivor == the uninterrupted stream.
+        assert all(f.tokens == expected[i] for i, f in enumerate(fronts))
+        after = int(registry.counter(
+            "serve_router_requests_rerouted_total").value)
+        assert after > before
+        ledger = router.ledger()
+        assert all(v == 1 for v in ledger.values())
+        # Restart the victim so later tests see a 2-replica fleet again.
+        router.replicas[0].restart()
+        assert retry.wait_until(
+            lambda: router.replica_state(0) is ReplicaState.READY, 30.0)
+
+    def test_rolling_upgrade_zero_drop(self, fleet):
+        router, control, _registry = fleet
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(1, 127, size=int(rng.integers(3, 8)))
+                   .astype(np.int32) for _ in range(16)]
+        restarts_before = {rid: rep.restarts
+                           for rid, rep in router.replicas.items()}
+        fronts = [router.submit(p, max_new_tokens=5) for p in prompts]
+        results = router.rolling_upgrade(deadline_s=30.0,
+                                         ready_timeout_s=120.0)
+        assert [r["replica"] for r in results] == sorted(router.replicas)
+        assert all(rep.restarts == restarts_before[rid] + 1
+                   for rid, rep in router.replicas.items())
+        states = [f.wait(120.0).state for f in fronts]
+        assert all(s is RequestState.DONE for s in states), states
+        ledger = router.ledger()
+        assert all(v == 1 for v in ledger.values())
+
+
+class TestJournalRecovery:
+    def test_router_restart_resumes_from_watermark(self, tmp_path):
+        registry = M.MetricsRegistry()
+        router, control = build_test_fleet(
+            n_replicas=1, journal_dir=str(tmp_path), registry=registry)
+        prompt = np.arange(1, 7, dtype=np.int32)
+        expected = control.generate(prompt, 10)
+        router.start()
+        router.replicas[0].wait_ready(120.0)
+        front = router.submit(prompt, max_new_tokens=10)
+        assert retry.wait_until(lambda: len(front.tokens) >= 2, 60.0,
+                                interval_s=0.002)
+        router.stop(drain=False)
+        assert front.state is RequestState.PREEMPTED
+        delivered = list(front.tokens)
+        assert delivered  # mid-stream: the watermark is the whole point
+
+        # The journal carries the id + watermark + prefix.
+        with open(router.journal_path, encoding="utf-8") as f:
+            doc = json.load(f)
+        assert doc["format_version"] == 2
+        (entry,) = doc["entries"]
+        assert entry["request_id"] == front.request_id
+        assert entry["delivered"] == len(delivered)
+        assert entry["tokens"] == delivered
+
+        router2, _control2 = build_test_fleet(
+            n_replicas=1, journal_dir=str(tmp_path), registry=registry)
+        (resumed,) = router2.recover()
+        assert resumed.request_id == front.request_id
+        assert resumed.tokens == delivered
+        router2.start()
+        assert resumed.wait(120.0).state is RequestState.DONE
+        # Resumed continuation is bit-identical to the uninterrupted run.
+        assert resumed.tokens == expected
+        router2.stop(drain=False)
+
+
+# --------------------------------------------------- drain journal format
+def _req(rid, prompt, tokens=(), max_new=8, deadline=None):
+    return SimpleNamespace(prompt=np.asarray(prompt, np.int32),
+                           max_new_tokens=max_new, deadline=deadline,
+                           request_id=rid, tokens=list(tokens))
+
+
+class TestJournalMerge:
+    def test_persist_writes_id_and_watermark(self, tmp_path):
+        path = str(tmp_path / "j.json")
+        ft_drain.persist_requests(path, [
+            _req("a", [1, 2, 3], tokens=[7, 8]),
+            _req("", [4, 5], tokens=[]),
+        ])
+        doc = json.load(open(path, encoding="utf-8"))
+        assert doc["format_version"] == 2
+        a, b = doc["entries"]
+        assert a["request_id"] == "a" and a["delivered"] == 2
+        assert a["tokens"] == [7, 8]
+        assert "request_id" not in b and "delivered" not in b
+
+    def test_merge_dedupes_by_id_highest_watermark_wins(self, tmp_path):
+        p1, p2 = str(tmp_path / "r0.json"), str(tmp_path / "r1.json")
+        # The same failed-over request journaled by two replicas: r0 saw
+        # 2 delivered tokens, r1 (the failover target) saw 4.
+        ft_drain.persist_requests(p1, [
+            _req("shared", [1, 2], tokens=[9, 9]),
+            _req("only-r0", [3], tokens=[5]),
+        ])
+        ft_drain.persist_requests(p2, [
+            _req("shared", [1, 2], tokens=[9, 9, 9, 9]),
+        ])
+        merged = ft_drain.merge_journal_entries([p1, p2])
+        by_id = {e.get("request_id"): e for e in merged}
+        assert set(by_id) == {"shared", "only-r0"}
+        assert by_id["shared"]["delivered"] == 4  # max watermark won
+        # First-seen order preserved (FIFO fairness survives the merge).
+        assert [e["request_id"] for e in merged] == ["shared", "only-r0"]
+
+    def test_v1_entries_without_id_all_kept(self, tmp_path):
+        p1 = str(tmp_path / "v1.json")
+        with open(p1, "w", encoding="utf-8") as f:
+            json.dump({"format_version": 1, "entries": [
+                {"prompt": [1], "max_new_tokens": 4, "timeout_s": None},
+                {"prompt": [2], "max_new_tokens": 4, "timeout_s": None},
+            ]}, f)
+        assert len(ft_drain.merge_journal_entries([p1])) == 2
+
+    def test_replay_consumes_multiple_journals_once(self, tmp_path):
+        p1, p2 = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        ft_drain.persist_requests(p1, [_req("x", [1, 2], tokens=[3])])
+        ft_drain.persist_requests(p2, [_req("x", [1, 2], tokens=[3, 4]),
+                                       _req("y", [5])])
+        submitted = []
+
+        class _Batcher:
+            @staticmethod
+            def submit(prompt, max_new_tokens, timeout_s=None,
+                       request_id=None):
+                submitted.append(request_id)
+                return SimpleNamespace(unservable=False)
+
+        reqs = ft_drain.replay_requests([p1, p2], _Batcher)
+        assert len(reqs) == 2 and submitted == ["x", "y"]
+        assert not os.path.exists(p1) and not os.path.exists(p2)
+
+
+# ----------------------------------------------------- /healthz + /drain
+class _Writer:
+    def __init__(self):
+        self.data = b""
+
+    def write(self, b):
+        self.data += b
+
+
+def _status(writer):
+    return int(writer.data.split(b" ", 2)[1])
+
+
+def _body(writer):
+    return json.loads(writer.data.split(b"\r\n\r\n", 1)[1])
+
+
+class TestHealthEndpoints:
+    def test_healthz_503_until_ready_and_while_draining(self):
+        from autodist_tpu.serve.server import ServeFrontend
+
+        rep = Replica(0, _StubEngine, MemoryTransport(),
+                      persist_path="/tmp/unused-hz.json",
+                      registry=M.MetricsRegistry())
+        fe = ServeFrontend(None, replica=rep,
+                           registry=M.MetricsRegistry())
+        w = _Writer()
+        fe._healthz(w)                       # pre-start: STARTING
+        assert _status(w) == 503
+        assert _body(w)["state"] == "starting"
+
+        rep.start()
+        w = _Writer()
+        fe._healthz(w)
+        assert _status(w) == 200
+        assert _body(w)["ok"] is True
+        assert "page_pool_utilization" in _body(w)
+
+        rep.quiesce()                        # DRAINING: probe must fail
+        w = _Writer()
+        fe._healthz(w)
+        assert _status(w) == 503
+        assert _body(w)["state"] == "draining"
+        rep.stop()
+
+    def test_post_drain_reports_persisted(self, tmp_path):
+        import asyncio
+
+        from autodist_tpu.serve.server import ServeFrontend
+
+        rep = Replica(0, _StubEngine, MemoryTransport(),
+                      persist_path=str(tmp_path / "q.json"),
+                      drain_deadline_s=0.2,
+                      registry=M.MetricsRegistry())
+        rep.start()
+        # Park work the stub will never serve: the drain must persist it.
+        rep.submit([1, 2, 3], max_new_tokens=4, request_id="park-1")
+        fe = ServeFrontend(None, replica=rep, registry=M.MetricsRegistry())
+        w = _Writer()
+        asyncio.run(fe._drain(w))
+        assert _status(w) == 200
+        out = _body(w)
+        assert out["persisted"] == 1
+        doc = json.load(open(tmp_path / "q.json", encoding="utf-8"))
+        assert doc["entries"][0]["request_id"] == "park-1"
+        rep.stop()
