@@ -57,12 +57,14 @@ from autodist_tpu.obs.exporter import (
 from autodist_tpu.obs.profiler import StepProfiler, StepTimer, detect_peak_flops
 from autodist_tpu.obs.recorder import FlightRecorder, read_records
 from autodist_tpu.obs.sentry import Finding, Sentry, SentryConfig
+from autodist_tpu.obs.slo import SLOSpec, SLOTracker, replay_flight_records
 from autodist_tpu.obs.spans import (
     Span,
     SpanTracer,
     add_span,
     current_trace_id,
     enable_trace_out,
+    events_for_request,
     get_tracer,
     span,
     stitch,
@@ -78,6 +80,8 @@ __all__ = [
     "MeasuredWire",
     "ObsConfig",
     "ObsRuntime",
+    "SLOSpec",
+    "SLOTracker",
     "Sentry",
     "SentryConfig",
     "Span",
@@ -90,10 +94,12 @@ __all__ = [
     "detect_peak_flops",
     "diagnose",
     "enable_trace_out",
+    "events_for_request",
     "get_tracer",
     "parse_openmetrics",
     "read_records",
     "render_openmetrics",
+    "replay_flight_records",
     "span",
     "stitch",
     "traced",

@@ -9,25 +9,37 @@ silent fleet), and span part-files (``AUTODIST_TRACE_OUT`` dir or
 ``<base>/traces``) — merges them into a time-ordered timeline, and returns
 a **verdict** with the evidence lines that support it:
 
-======== ============ ====================================================
-Code     Verdict      Typical cause
-======== ============ ====================================================
-DOC000   clean        ``run_end ok`` recorded; nothing anomalous after it
-DOC001   nan          sentry SNT001/SNT002, or non-finite loss in the tail
-DOC002   oom          error event matching RESOURCE_EXHAUSTED / OOM
-DOC003   wedge        hang bundle, or heartbeats+records stop mid-stream
-                      with no terminal event
-DOC004   preemption   SIGTERM preempt event (ft snapshot hook)
-DOC005   straggler    hang/abnormal end with SNT006 straggler findings
-DOC006   crash        error event that matches no narrower class
-DOC999   unknown      not enough evidence to classify
-======== ============ ====================================================
+======== =============== =================================================
+Code     Verdict         Typical cause
+======== =============== =================================================
+DOC000   clean           ``run_end ok`` recorded; nothing anomalous after
+DOC001   nan             sentry SNT001/SNT002, or non-finite loss in tail
+DOC002   oom             error event matching RESOURCE_EXHAUSTED / OOM
+DOC003   wedge           hang bundle, or heartbeats+records stop
+                         mid-stream with no terminal event
+DOC004   preemption      SIGTERM preempt event (ft snapshot hook)
+DOC005   straggler       hang/abnormal end with SNT006 straggler findings
+DOC006   crash           error event that matches no narrower class
+DOC007   pool_exhaustion serve died amid KV page-pool pressure: an error
+                         carrying the pool-exhausted signature, or the
+                         record stream ending abruptly inside a
+                         ``pool_pressure`` window
+DOC008   failover_storm  replica flap: repeated DEAD transitions in
+                         the router journal + flight segments of an
+                         abnormal end (reroutes are evidence, not the
+                         trigger — one kill reroutes many)
+DOC999   unknown         not enough evidence to classify
+======== =============== =================================================
 
 Classification is precedence-ordered (strongest causal evidence first):
-oom > nan > hang-bundle (straggler when SNT006 rode along, wedge
-otherwise) > preemption > crash > straggler > clean > abrupt-end wedge >
-unknown. A watchdog-killed fleet therefore reads as *wedge* even though
-the chief also caught SIGTERM — the bundle is the stronger witness.
+oom > nan > pool-exhaustion (typed pool-exhausted error) > failover-storm
+> hang-bundle (straggler when SNT006 rode along, wedge otherwise) >
+preemption > crash > straggler > clean > abrupt-end wedge (pool-exhaustion
+when the stream dies inside a pressure window) > unknown. A
+watchdog-killed fleet therefore reads as *wedge* even though the chief
+also caught SIGTERM — the bundle is the stronger witness; a single
+replica death with an orderly failover stays *crash* (DOC006) — the storm
+verdict needs repeated flap, never one supervised kill.
 
 The module never raises on malformed artifacts (a postmortem runs over
 exactly the files a crash tore) and never needs a device: ``bench.py``
@@ -57,12 +69,27 @@ VERDICT_CODES: Dict[str, str] = {
     "preemption": "DOC004",
     "straggler": "DOC005",
     "crash": "DOC006",
+    "pool_exhaustion": "DOC007",
+    "failover_storm": "DOC008",
     "unknown": "DOC999",
 }
 
 _OOM_RE = re.compile(
     r"RESOURCE[_ ]EXHAUSTED|out of memory|\bOOM\b|allocat\w* failed",
     re.IGNORECASE)
+# DOC007: the page-pool-exhausted signature the serve admission path and
+# the batcher's pressure/shed events carry (serve/engine.py prose).
+# Deliberately narrow — an error merely MENTIONING the pool (accounting
+# bug, double free) is a crash, not an exhaustion collapse.
+_POOL_RE = re.compile(r"page.pool exhausted|pool exhaust", re.IGNORECASE)
+# DOC008 threshold: a storm needs REPEATED death/flap, RECENTLY. Reroute
+# count is deliberately NOT a trigger — ONE supervised kill reroutes
+# every in-flight request (the chaos replica_death class must stay
+# DOC006) — and deaths outside the storm window are history, not the
+# cause of THIS death: two recovered single failovers days apart must
+# not reclassify a later preemption or crash as a storm.
+_STORM_DEAD_TRANSITIONS = 2
+_STORM_WINDOW_S = 600.0
 
 # ft directory layout (FTConfig.resolved's literals — mirrored here so the
 # doctor stays importable without the ft subsystem's jax-adjacent deps).
@@ -283,6 +310,57 @@ def diagnose(base_dir: str, trace_out: str = "",
                 f"step record carries non-finite loss={r.get('loss')!r}")
         return _done("nan")
 
+    # Serving signals (PR: serve-side SLO observability). Raw streams:
+    pool_pressure = [r for r in records if r.get("kind") == "pool_pressure"]
+    reroutes = [r for r in records if r.get("kind") == "reroute"]
+    dead_transitions = [
+        r for r in records if r.get("kind") == "replica_transition"
+        and str(r.get("new", "")).lower() == "dead"]
+    stats["reroutes"] = len(reroutes)
+    stats["replica_dead_transitions"] = len(dead_transitions)
+    stats["pool_pressure_windows"] = len(pool_pressure)
+    clean_end = any(e.get("ok", True) for e in run_end)
+
+    # DOC007 (typed form): the death itself carries the pool-exhausted
+    # signature — the pool, not the code path that tripped over it, is
+    # the limiter a postmortem should name.
+    pool_errors = [r for r in errors
+                   if _POOL_RE.search(str(r.get("error", "")))]
+    if pool_errors:
+        r = pool_errors[-1]
+        _ev("flight", r.get("t"),
+            f"error event carries the page-pool-exhausted signature: "
+            f"{str(r.get('error'))[:200]}")
+        for p in pool_pressure[-3:]:
+            _ev("flight", p.get("t"),
+                f"pool_pressure window: {str(p.get('reason'))[:120]} "
+                f"(free_pages={p.get('free_pages')})")
+        return _done("pool_exhaustion")
+
+    # DOC008: repeated replica flap on an abnormal end, inside the storm
+    # window ending at the last record. One supervised kill with its
+    # orderly failover stays crash (DOC006) — however many in-flight
+    # requests it rerouted — and old recovered deaths are history.
+    last_record_t = float(records[-1].get("t", 0.0)) if records else 0.0
+    recent_dead = [r for r in dead_transitions
+                   if last_record_t - float(r.get("t", 0.0))
+                   <= _STORM_WINDOW_S]
+    if not clean_end and len(recent_dead) >= _STORM_DEAD_TRANSITIONS:
+        for r in recent_dead[-3:]:
+            _ev("flight", r.get("t"),
+                f"replica {r.get('replica')} transitioned "
+                f"{r.get('old')} -> dead")
+        for r in reroutes[-3:]:
+            _ev("flight", r.get("t"),
+                f"reroute of {r.get('request_id')} after "
+                f"{r.get('delivered')} delivered token(s): "
+                f"{str(r.get('reason'))[:120]}")
+        _ev("flight", recent_dead[-1].get("t"),
+            f"failover storm: {len(recent_dead)} DEAD transition(s) inside "
+            f"the {_STORM_WINDOW_S:.0f}s window, {len(reroutes)} "
+            f"reroute(s), no clean run_end")
+        return _done("failover_storm")
+
     if hang_bundles:
         b = hang_bundles[-1]
         _ev("bundle", b.get("t"),
@@ -313,7 +391,6 @@ def diagnose(base_dir: str, trace_out: str = "",
             f"error event: {str(r.get('error'))[:200]}")
         return _done("crash")
 
-    clean_end = any(e.get("ok", True) for e in run_end)
     if straggler_sentry and not clean_end:
         for r in straggler_sentry[-3:]:
             _ev("flight", r.get("t"),
@@ -328,7 +405,21 @@ def diagnose(base_dir: str, trace_out: str = "",
     if steps or heartbeats:
         # Records exist but simply stop: nothing wrote a terminal event —
         # the signature of a wedge (or an unattributed SIGKILL, which is
-        # operationally the same thing: a silent death).
+        # operationally the same thing: a silent death). A stream that
+        # dies INSIDE a page-pool pressure window is the silent form of a
+        # pool-exhaustion collapse: name the pool, not "wedge".
+        if pool_pressure and records:
+            last_t = float(records[-1].get("t", 0.0))
+            tail_pressure = [p for p in pool_pressure
+                             if last_t - float(p.get("t", 0.0)) <= 30.0]
+            if tail_pressure:
+                p = tail_pressure[-1]
+                _ev("flight", p.get("t"),
+                    f"records end abruptly inside a pool_pressure window: "
+                    f"{str(p.get('reason'))[:120]} "
+                    f"(free_pages={p.get('free_pages')}, "
+                    f"queue_depth={p.get('queue_depth')})")
+                return _done("pool_exhaustion")
         if steps:
             r = steps[-1]
             _ev("flight", r.get("t"),

@@ -15,6 +15,11 @@ Format (OpenMetrics-flavored prometheus text):
 - histogram quantiles as ``name{quantile="0.5"}`` plus ``_count``/``_sum``
   (quantile lines are omitted while the histogram is empty — ``nan`` is
   not a valid exposition token);
+- snapshot keys may carry a label set inline (``name{replica="0"}`` —
+  the router's fleet aggregation labels per-replica samples this way);
+  the ``# TYPE`` comment is emitted once per *family*, so labeled
+  samples of one family share it (unlabeled snapshots render
+  byte-identically to before);
 - deterministic ordering (sorted by name) and a trailing ``# EOF``.
 
 :func:`parse_openmetrics` is the matching reader — the selftest and the
@@ -47,22 +52,49 @@ def render_openmetrics(registry: Optional[M.MetricsRegistry] = None,
     if snapshot is None:
         snapshot = (registry or M.registry).snapshot()
     lines = []
-    for name in sorted(snapshot):
+    last_family = None
+
+    def sort_key(name: str):
+        # Group by FAMILY first (labeled siblings adjacent, counters next
+        # to nothing that could reopen their family), then by full name.
+        # Plain name-sort almost gives this, but a family that is a
+        # string-prefix of another (`foo` vs `foo_bar` vs `foo{a="1"}`)
+        # would interleave — a reopened # TYPE family, which strict
+        # OpenMetrics scrapers reject.
+        base = name.partition("{")[0]
+        fam = (base[:-len("_total")]
+               if not isinstance(snapshot[name], dict)
+               and base.endswith("_total") else base)
+        return (fam, name)
+
+    for name in sorted(snapshot, key=sort_key):
         val = snapshot[name]
+        # A snapshot key may carry an inline label set: base name decides
+        # the family/type, the labels ride on every sample line.
+        base, _, labels = name.partition("{")
+        labels = f"{{{labels}" if labels else ""
         if isinstance(val, dict):  # histogram summary
-            lines.append(f"# TYPE {name} summary")
+            if (base, "summary") != last_family:
+                lines.append(f"# TYPE {base} summary")
+                last_family = (base, "summary")
             if val.get("count"):
                 for key, label in _QUANTILES:
-                    lines.append(
-                        f'{name}{{quantile="{label}"}} {_fmt(val[key])}')
-            lines.append(f"{name}_count {_fmt(val.get('count', 0))}")
-            lines.append(f"{name}_sum {_fmt(val.get('sum', 0.0))}")
-        elif name.endswith("_total"):
-            lines.append(f"# TYPE {name[:-len('_total')]} counter")
-            lines.append(f"{name} {_fmt(val)}")
+                    qlabels = (f'{labels[:-1]},quantile="{label}"}}' if labels
+                               else f'{{quantile="{label}"}}')
+                    lines.append(f"{base}{qlabels} {_fmt(val[key])}")
+            lines.append(f"{base}_count{labels} {_fmt(val.get('count', 0))}")
+            lines.append(f"{base}_sum{labels} {_fmt(val.get('sum', 0.0))}")
+        elif base.endswith("_total"):
+            family = base[:-len("_total")]
+            if (family, "counter") != last_family:
+                lines.append(f"# TYPE {family} counter")
+                last_family = (family, "counter")
+            lines.append(f"{base}{labels} {_fmt(val)}")
         else:
-            lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {_fmt(val)}")
+            if (base, "gauge") != last_family:
+                lines.append(f"# TYPE {base} gauge")
+                last_family = (base, "gauge")
+            lines.append(f"{base}{labels} {_fmt(val)}")
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 

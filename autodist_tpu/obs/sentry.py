@@ -61,6 +61,9 @@ CODES: Dict[str, str] = {
     "SNT004": "step-time regression vs rolling median",
     "SNT005": "HBM high-water creep above baseline",
     "SNT006": "straggler host: step-time diverges from fleet median",
+    "SNT007": "serve TTFT regression vs rolling median (per replica)",
+    "SNT008": "serve decode-throughput/ITL regression vs rolling median",
+    "SNT009": "serve shed/error burn rate above the SLO budget",
 }
 
 
@@ -82,6 +85,22 @@ class SentryConfig:
     hbm_growth_fraction: float = 0.05  # SNT005: growth over baseline
     hbm_min_history: int = 8           # SNT005: baseline sample size
     straggler_threshold: float = 1.5   # SNT006: score bar (aggregate's)
+    # Serving codes (docs/observability.md § serving SLOs). TTFT/ITL
+    # regressions mirror SNT004's shape — consecutive observations above
+    # ratio x the per-replica rolling median — so a single slow request
+    # (compile, GC pause) is never a verdict.
+    serve_min_history: int = 8         # SNT007/008: per-replica history
+    ttft_ratio: float = 2.0            # SNT007: TTFT > ratio x median
+    ttft_consecutive: int = 3
+    ttft_min_s: float = 0.1            # SNT007: absolute floor (see below)
+    itl_ratio: float = 2.0             # SNT008: ITL > ratio x median
+    itl_consecutive: int = 3
+    # Absolute floors (the SNT003 precedent): a regressed value must ALSO
+    # exceed the floor — serving latencies at millisecond scale have
+    # ratio-noise (a prefill-heavy tick doubles a 2ms ITL) that is not an
+    # incident anyone should be paged for.
+    itl_min_s: float = 0.05
+    burn_rate_threshold: float = 2.0   # SNT009: fast-window budget burn
 
 
 @dataclass
@@ -133,6 +152,12 @@ class Sentry:
         self._slow_streak = 0
         self._episodes: set = set()   # active (code[, pid]) incidents
         self._n = 0
+        # Serving streams, keyed per replica id (-1 = unattributed):
+        # rolling history + regression streaks for SNT007/SNT008.
+        self._ttft: Dict[int, deque] = {}
+        self._itl: Dict[int, deque] = {}
+        self._ttft_streak: Dict[int, int] = {}
+        self._itl_streak: Dict[int, int] = {}
 
         reg = registry or M.registry
         self._reg = reg
@@ -145,7 +170,8 @@ class Sentry:
     # ------------------------------------------------------------- emission
     def _emit(self, code: str, message: str, value: float = 0.0,
               step: Optional[int] = None,
-              process_id: Optional[int] = None) -> Finding:
+              process_id: Optional[int] = None,
+              escalate: bool = True) -> Finding:
         pid = self.process_id if process_id is None else int(process_id)
         f = Finding(code=code, message=message, value=float(value),
                     step=step, process_id=pid)
@@ -163,7 +189,7 @@ class Sentry:
                     step=step, process_id=pid)
             except Exception:  # noqa: BLE001 - telemetry never fatal
                 pass
-        if self.monitor is not None:
+        if self.monitor is not None and escalate:
             try:
                 self.monitor.escalate(pid, reason=f"{code}: {message}")
             except Exception:  # noqa: BLE001 - monitor may be stopping
@@ -300,6 +326,97 @@ class Sentry:
             else:
                 self._clear(key)
         return self.findings[before:]
+
+    def observe_serve(
+        self,
+        step: Optional[int] = None,
+        ttft_s: Optional[float] = None,
+        itl_s: Optional[float] = None,
+        burn_rate: Optional[float] = None,
+        replica_id: Optional[int] = None,
+    ) -> List[Finding]:
+        """Feed one serving observation (any subset): delivered TTFT and
+        ITL attributed to ``replica_id`` (SNT007/SNT008 — once per
+        episode *per replica*, escalated into the attached monitor so the
+        router demotes the replica the way SNT006 demotes hosts), and the
+        SLO tracker's fast-window burn rate (SNT009 — escalated only when
+        attributed to a replica; a fleet-level burn has no single host to
+        demote)."""
+        cfg = self.config
+        before = len(self.findings)
+        rid = -1 if replica_id is None else int(replica_id)
+        w = max(4, int(cfg.window))
+
+        def _regress(value, hist: Dict[int, deque],
+                     streak: Dict[int, int], code: str, what: str,
+                     ratio_bar: float, consecutive: int,
+                     min_s: float) -> None:
+            series = hist.setdefault(rid, deque(maxlen=w))
+            key = (code, rid)
+            value = float(value)
+            if len(series) >= cfg.serve_min_history:
+                med = float(np.median(np.asarray(series, np.float64)))
+                ratio = value / med if med > 0 else 0.0
+                if ratio > ratio_bar and value > min_s:
+                    streak[rid] = streak.get(rid, 0) + 1
+                    if streak[rid] >= consecutive:
+                        # process_id is ALWAYS rid (-1 when unattributed):
+                        # letting it default would stamp the sentry's own
+                        # host id (0) on a fleet-level finding, and a
+                        # router consumer would demote real replica 0.
+                        self._fire_once(
+                            key, code,
+                            f"replica {rid} {what} regressed: "
+                            f"{value * 1e3:.1f}ms is {ratio:.2f}x the rolling "
+                            f"median ({med * 1e3:.1f}ms) for {streak[rid]} "
+                            f"consecutive requests", value=ratio, step=step,
+                            process_id=rid,
+                            escalate=replica_id is not None)
+                else:
+                    streak[rid] = 0
+                    self._clear(key)
+            series.append(value)
+
+        if ttft_s is not None and ttft_s > 0:
+            _regress(ttft_s, self._ttft, self._ttft_streak, "SNT007",
+                     "TTFT", cfg.ttft_ratio, cfg.ttft_consecutive,
+                     cfg.ttft_min_s)
+        if itl_s is not None and itl_s > 0:
+            _regress(itl_s, self._itl, self._itl_streak, "SNT008",
+                     "inter-token latency", cfg.itl_ratio,
+                     cfg.itl_consecutive, cfg.itl_min_s)
+        if burn_rate is not None:
+            burn_rate = float(burn_rate)
+            if replica_id is None:
+                # The gauge is the FLEET burn: per-replica calls must not
+                # overwrite it (the last replica's 0.0 would mask a
+                # fleet-wide 5x burn from every dashboard).
+                self._reg.gauge("obs_sentry_burn_rate").set(burn_rate)
+            key = ("SNT009", rid)
+            if burn_rate > cfg.burn_rate_threshold:
+                self._fire_once(
+                    key, "SNT009",
+                    f"shed/error burn rate {burn_rate:.2f}x the SLO error "
+                    f"budget (threshold {cfg.burn_rate_threshold}x"
+                    f"{'' if replica_id is None else f', replica {rid}'})",
+                    value=burn_rate, step=step, process_id=rid,
+                    escalate=replica_id is not None)
+            elif burn_rate < cfg.burn_rate_threshold / 2:
+                self._clear(key)
+        return self.findings[before:]
+
+    def reset_serve_episodes(self, replica_id: int) -> None:
+        """Re-arm one replica's serving episodes (SNT007/008/009) and
+        streaks. The router calls this when a demotion cooldown expires:
+        while demoted the replica served no traffic, so nothing could
+        take the recovery path that normally re-arms the episode — and a
+        STILL-sick replica would otherwise be re-admitted permanently
+        (the episode gate swallowing every later verdict)."""
+        rid = int(replica_id)
+        for code in ("SNT007", "SNT008", "SNT009"):
+            self._clear((code, rid))
+        self._ttft_streak.pop(rid, None)
+        self._itl_streak.pop(rid, None)
 
     # --------------------------------------------------------------- queries
     def codes(self) -> List[str]:

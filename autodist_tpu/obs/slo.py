@@ -1,0 +1,318 @@
+"""Serving SLOs: declarative targets, rolling percentiles, burn rates.
+
+Training observability measures *steps* (loss, step wall, HBM); serving
+observability measures *requests*. This module is the serving half of the
+obs stack's measurement layer: a declarative :class:`SLOSpec` (the
+latency/error targets a deployment promises), an :class:`SLOTracker` that
+maintains rolling-window percentiles of the request-level signals —
+time-to-first-token (TTFT), inter-token latency (ITL), queue wait — plus
+good/bad event accounting with **multi-window burn rates** against the
+error budget, and one JSON ``slo_report`` every surface renders from:
+
+- the :class:`~autodist_tpu.serve.router.Router` feeds its tracker from
+  the delivered (client-visible) stream — TTFT at the first harvested
+  token, ITL at completion, queue wait at dispatch — so the SLO measures
+  what clients experienced, failovers included;
+- the :class:`~autodist_tpu.serve.batcher.ContinuousBatcher` feeds a
+  per-replica tracker from its own retire path (single-engine
+  deployments get the same report without a router);
+- measured percentiles and burn rates publish as ``slo_*`` gauges
+  through the ONE :class:`~autodist_tpu.metrics.MetricsRegistry` /
+  OpenMetrics exporter, so ``GET /metrics`` scrapes and the headless
+  ``FileExporter`` carry the SLO position byte-identically;
+- :func:`replay_flight_records` rebuilds a tracker from flight-recorder
+  ``serve``/``request`` records, so a postmortem can compute the SLO
+  position of a run that is already dead.
+
+Burn rate follows the standard multi-window form: the bad-event fraction
+over a window divided by the error budget (1.0 = burning exactly the
+budget; >1 = on track to exhaust it). Two windows — fast (paging-speed)
+and slow (ticket-speed) — are both reported; the serve sentry's SNT009
+fires on the fast window (docs/observability.md § serving SLOs).
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from collections import deque
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from autodist_tpu import metrics as M
+
+__all__ = ["SLOSpec", "SLOTracker", "json_safe", "replay_flight_records"]
+
+
+@dataclass(frozen=True)
+class SLOSpec:
+    """Declarative serving SLO: the targets a deployment promises.
+
+    Latency targets are seconds; ``error_budget`` is the allowed bad
+    fraction (errors + sheds over all terminal outcomes) the availability
+    target implies; windows are seconds of rolling history. Defaults are
+    interactive-chat-shaped — deployments pass their own.
+    """
+
+    name: str = "serve"
+    ttft_p50_s: float = 1.0        # time to first token
+    ttft_p99_s: float = 5.0
+    itl_p50_s: float = 0.2         # inter-token latency (decode cadence)
+    itl_p99_s: float = 1.0
+    queue_wait_p99_s: float = 2.0
+    availability: float = 0.99     # fraction of requests that must succeed
+    window_s: float = 300.0        # rolling percentile window
+    burn_fast_window_s: float = 60.0
+    burn_slow_window_s: float = 600.0
+
+    @property
+    def error_budget(self) -> float:
+        return max(1e-9, 1.0 - self.availability)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "SLOSpec":
+        known = {k: doc[k] for k in doc
+                 if k in cls.__dataclass_fields__}  # type: ignore[attr-defined]
+        return cls(**known)
+
+
+@dataclass
+class _Series:
+    """One rolling (t, value) series bounded by time window and count."""
+
+    window_s: float
+    points: deque = field(default_factory=lambda: deque(maxlen=4096))
+
+    def add(self, t: float, v: float) -> None:
+        self.points.append((float(t), float(v)))
+
+    def values(self, now: float) -> List[float]:
+        cutoff = now - self.window_s
+        return [v for t, v in self.points if t >= cutoff]
+
+
+class SLOTracker:
+    """Streaming SLO accountant (thread-safe; producers on scheduler /
+    router threads, readers on HTTP / sentry threads).
+
+    Feed request-level signals with :meth:`observe`; read the position
+    with :meth:`report` (the ``slo_report`` JSON), :meth:`percentile`, or
+    :meth:`burn_rates`. Gauges ``slo_*`` publish on every report through
+    the shared registry.
+    """
+
+    def __init__(self, spec: Optional[SLOSpec] = None,
+                 registry: Optional[M.MetricsRegistry] = None,
+                 clock=time.monotonic):
+        self.spec = spec or SLOSpec()
+        self.clock = clock
+        self._lock = threading.Lock()
+        w = self.spec.window_s
+        self._ttft = _Series(w)
+        self._itl = _Series(w)
+        self._wait = _Series(w)
+        # Terminal outcomes: (t, ok, shed) — the burn-rate stream.
+        self._events: deque = deque(maxlen=16384)
+        self._totals = {"requests": 0, "errors": 0, "sheds": 0}
+
+        reg = registry or M.registry
+        self._reg = reg
+        self._g = {k: reg.gauge(f"slo_{k}") for k in (
+            "ttft_p50_s", "ttft_p99_s", "itl_p50_s", "itl_p99_s",
+            "queue_wait_p99_s", "availability", "error_rate",
+            "burn_rate_fast", "burn_rate_slow", "compliant")}
+
+    # --------------------------------------------------------------- feeding
+    def observe(self, ttft_s: Optional[float] = None,
+                itl_s: Optional[float] = None,
+                queue_wait_s: Optional[float] = None,
+                ok: Optional[bool] = None, shed: bool = False,
+                t: Optional[float] = None) -> None:
+        """Feed any subset of one request's signals. ``ok`` marks a
+        terminal outcome (True = served within contract, False = error);
+        ``shed`` marks a typed admission rejection (counts against the
+        budget — a shed client did not get an answer). ``t`` overrides
+        the clock for replay."""
+        now = self.clock() if t is None else float(t)
+        with self._lock:
+            if ttft_s is not None and math.isfinite(float(ttft_s)):
+                self._ttft.add(now, ttft_s)
+            if itl_s is not None and math.isfinite(float(itl_s)):
+                self._itl.add(now, itl_s)
+            if queue_wait_s is not None and math.isfinite(float(queue_wait_s)):
+                self._wait.add(now, queue_wait_s)
+            if ok is not None or shed:
+                good = bool(ok) and not shed
+                self._events.append((now, good, bool(shed)))
+                self._totals["requests"] += 1
+                if shed:
+                    self._totals["sheds"] += 1
+                elif not good:
+                    self._totals["errors"] += 1
+
+    # --------------------------------------------------------------- reading
+    @staticmethod
+    def _pct(values: List[float], p: float) -> float:
+        if not values:
+            return float("nan")
+        return float(np.percentile(np.asarray(values, np.float64), p))
+
+    def percentile(self, signal: str, p: float,
+                   now: Optional[float] = None) -> float:
+        """Rolling-window percentile of ``"ttft" | "itl" | "queue_wait"``
+        (NaN while the window is empty)."""
+        series = {"ttft": self._ttft, "itl": self._itl,
+                  "queue_wait": self._wait}[signal]
+        with self._lock:
+            vals = series.values(self.clock() if now is None else now)
+        return self._pct(vals, p)
+
+    def burn_rates(self, now: Optional[float] = None) -> Dict[str, float]:
+        """Error-budget burn per window: bad-fraction / budget. 0.0 while
+        no terminal outcomes landed in the window."""
+        now = self.clock() if now is None else float(now)
+        out = {}
+        with self._lock:
+            events = list(self._events)
+        for key, win in (("fast", self.spec.burn_fast_window_s),
+                         ("slow", self.spec.burn_slow_window_s)):
+            inside = [(good, shed) for t, good, shed in events
+                      if t >= now - win]
+            if not inside:
+                out[key] = 0.0
+                continue
+            bad = sum(1 for good, _ in inside if not good)
+            out[key] = (bad / len(inside)) / self.spec.error_budget
+        return out
+
+    def report(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The ``slo_report``: spec, measured position, burn rates,
+        per-objective compliance. Publishes the ``slo_*`` gauges as a
+        side effect (report IS the render moment)."""
+        now = self.clock() if now is None else float(now)
+        spec = self.spec
+        with self._lock:
+            ttft = self._ttft.values(now)
+            itl = self._itl.values(now)
+            wait = self._wait.values(now)
+            events = list(self._events)
+            totals = dict(self._totals)
+        win_events = [(g, s) for t, g, s in events
+                      if t >= now - spec.window_s]
+        good = sum(1 for g, _ in win_events if g)
+        availability = good / len(win_events) if win_events else float("nan")
+        measured = {
+            "ttft_p50_s": self._pct(ttft, 50.0),
+            "ttft_p99_s": self._pct(ttft, 99.0),
+            "itl_p50_s": self._pct(itl, 50.0),
+            "itl_p99_s": self._pct(itl, 99.0),
+            "queue_wait_p99_s": self._pct(wait, 99.0),
+            "availability": availability,
+            "error_rate": (1.0 - availability
+                           if math.isfinite(availability) else float("nan")),
+        }
+        burn = self.burn_rates(now)
+
+        def _meets(m: float, target: float, higher_is_better=False) -> bool:
+            if not math.isfinite(m):
+                return True   # no data is not a violation
+            return m >= target if higher_is_better else m <= target
+        compliant = {
+            "ttft_p50": _meets(measured["ttft_p50_s"], spec.ttft_p50_s),
+            "ttft_p99": _meets(measured["ttft_p99_s"], spec.ttft_p99_s),
+            "itl_p50": _meets(measured["itl_p50_s"], spec.itl_p50_s),
+            "itl_p99": _meets(measured["itl_p99_s"], spec.itl_p99_s),
+            "queue_wait_p99": _meets(measured["queue_wait_p99_s"],
+                                     spec.queue_wait_p99_s),
+            "availability": _meets(measured["availability"],
+                                   spec.availability, higher_is_better=True),
+        }
+        compliant["overall"] = all(compliant.values())
+        for key, g in self._g.items():
+            if key == "compliant":
+                g.set(1.0 if compliant["overall"] else 0.0)
+            elif key == "burn_rate_fast":
+                g.set(burn["fast"])
+            elif key == "burn_rate_slow":
+                g.set(burn["slow"])
+            else:
+                v = measured[key]
+                g.set(v if math.isfinite(v) else 0.0)
+        return {
+            "slo": spec.to_dict(),
+            "measured": measured,
+            "burn_rate": {**burn,
+                          "windows_s": [spec.burn_fast_window_s,
+                                        spec.burn_slow_window_s]},
+            "counts": {**totals, "window_requests": len(win_events)},
+            "compliant": compliant,
+        }
+
+    def report_json(self, **kw) -> str:
+        return json.dumps(json_safe(self.report(**kw)), default=str)
+
+
+def json_safe(obj):
+    """Recursively replace non-finite floats with None: an empty-window
+    report carries NaN percentiles, and ``json.dumps`` would emit bare
+    ``NaN`` — valid Python, rejected by every RFC-8259 parser. Every
+    HTTP/JSON surface renders reports through this."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    return obj
+
+
+def replay_flight_records(records: Iterable[Dict[str, Any]],
+                          spec: Optional[SLOSpec] = None,
+                          registry: Optional[M.MetricsRegistry] = None,
+                          ) -> SLOTracker:
+    """Rebuild an :class:`SLOTracker` from flight records (the batcher's
+    ``surface="serve", event="request"`` rows plus ``shed`` events), so
+    the SLO position of a dead run is computable postmortem — same spec,
+    same arithmetic, fed with the records' own wall clocks."""
+    tracker = SLOTracker(spec=spec, registry=registry or M.MetricsRegistry())
+    last_t = 0.0
+    last_shed: Dict[Any, int] = {}
+    for r in records:
+        t = float(r.get("t", 0.0))
+        if r.get("kind") == "shed":
+            # Shed events are rate-limited to one per window (batcher /
+            # router `_shed`), with the CUMULATIVE count on the record:
+            # replay the per-process deltas, not the event count — else a
+            # 100-rejection burst would replay as one bad event. (Sheds
+            # after the final window-opening record are lost with the
+            # record that was never written; bounded by one window.)
+            total = r.get("total_shed")
+            # Key deltas by (process, source): an in-process fleet holds
+            # the router's AND a batcher's independent cumulative
+            # counters under one process id.
+            src = (r.get("r", 0), r.get("src"))
+            if isinstance(total, (int, float)) and int(total) >= 1:
+                n = min(max(1, int(total) - last_shed.get(src, 0)), 100_000)
+                last_shed[src] = int(total)
+            else:
+                n = 1
+            for _ in range(n):
+                tracker.observe(ok=False, shed=True, t=t)
+        elif r.get("kind") == "step" and r.get("event") == "request":
+            tracker.observe(
+                ttft_s=r.get("ttft_s"), itl_s=r.get("itl_s"),
+                queue_wait_s=r.get("queue_wait_s"),
+                ok=(r.get("state") == "done"), t=t)
+        else:
+            continue
+        last_t = max(last_t, t)
+    # The replayed stream's own clock is "now": windows are computed
+    # relative to the last record, not this process's monotonic clock.
+    tracker.clock = lambda: last_t
+    return tracker

@@ -54,6 +54,7 @@ __all__ = [
     "add_span",
     "current_trace_id",
     "enable_trace_out",
+    "events_for_request",
     "export",
     "get_tracer",
     "span",
@@ -288,6 +289,31 @@ def add_span(name: str, t_start_s: float, dur_s: float, **attrs) -> Span:
 
 def export(path: str) -> str:
     return get_tracer().export(path)
+
+
+# --------------------------------------------------------- request tracing
+def events_for_request(trace: Dict[str, Any], request_id: str,
+                       ) -> List[Dict[str, Any]]:
+    """Filter a chrome-trace document (``to_chrome_trace()`` output or a
+    stitched file's JSON) down to one request's span chain, time-ordered.
+
+    The serving layers tag every request-scoped span with the stable
+    string ``request_id`` (router admit/route/failover/delivery, batcher
+    queue wait, engine prefill chunks) or, for batched device steps that
+    serve many requests at once (the decode step), a ``request_ids``
+    list — both match here, so the returned chain is the request's full
+    life including a mid-decode failover across replicas."""
+    out = []
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args", {}) or {}
+        if args.get("request_id") == request_id or (
+                isinstance(args.get("request_ids"), (list, tuple))
+                and request_id in args["request_ids"]):
+            out.append(ev)
+    out.sort(key=lambda e: float(e.get("ts", 0.0)))
+    return out
 
 
 # ------------------------------------------------------------------- stitch

@@ -35,7 +35,14 @@ Layers:
   :class:`Router` with journaled exactly-once failover (prefix resume,
   bit-identity asserted), straggler-weighted least-loaded routing, and
   rolling drain upgrades (``python -m autodist_tpu.serve
-  --selftest-router`` is the CPU proof).
+  --selftest-router`` is the CPU proof). The router measures the
+  client-visible stream against a declarative SLO
+  (:mod:`autodist_tpu.obs.slo` — rolling TTFT/ITL/queue-wait
+  percentiles, burn rates, ``slo_report``), feeds the serve-aware
+  sentry (SNT007/008/009 demote a latency-sick replica), and tags
+  every request's spans with its stable id so ONE chrome trace shows a
+  request's full life including a mid-decode failover
+  (docs/observability.md § serving).
 
 Entry point: ``autodist.build_inference(...)`` (api.py) or
 :meth:`InferenceEngine.build` directly.
@@ -57,6 +64,7 @@ from autodist_tpu.serve.engine import (
 from autodist_tpu.serve.pages import PagePool, PageTable, build_pool
 from autodist_tpu.serve.replica import Replica, ReplicaState
 from autodist_tpu.serve.router import Router, RouterConfig
+from autodist_tpu.serve.server import RouterFrontend, ServeFrontend
 
 __all__ = [
     "AdmissionDenied",
@@ -74,6 +82,8 @@ __all__ = [
     "RequestState",
     "Router",
     "RouterConfig",
+    "RouterFrontend",
+    "ServeFrontend",
     "Slot",
     "build_pool",
 ]

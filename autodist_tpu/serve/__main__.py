@@ -62,6 +62,13 @@ def main(argv=None) -> int:
     ap.add_argument("--replica-id", type=int, default=0,
                     help="server mode: this replica's id on the ft "
                          "transport (with --ft-dir)")
+    ap.add_argument("--trace-out", default=None,
+                    help="server mode: flush this process's span part-file "
+                         "into DIR at exit (obs/spans.py); replica "
+                         "processes of one fleet sharing a DIR (and the "
+                         "launcher-exported AUTODIST_TRACE_ID) stitch into "
+                         "ONE chrome trace via obs.spans.stitch, exactly "
+                         "like launcher/worker part-files")
     ap.add_argument("--requests", type=int, default=64,
                     help="selftest: concurrent mock requests (>=64 proves "
                          "the acceptance bar)")
@@ -105,6 +112,16 @@ def main(argv=None) -> int:
 
     import os
 
+    if args.ft_dir and "AUTODIST_PROCESS_ID" not in os.environ:
+        # Replica part-files (spans, flight records) identify as this
+        # replica unless a launcher already pinned a process id — so a
+        # stitched fleet trace shows "role <replica-id>" tracks.
+        os.environ["AUTODIST_PROCESS_ID"] = str(args.replica_id)
+    if args.trace_out:
+        from autodist_tpu.obs import spans as obs_spans
+
+        obs_spans.enable_trace_out(args.trace_out)
+
     import jax
 
     import autodist_tpu.strategy as S
@@ -131,6 +148,12 @@ def main(argv=None) -> int:
             prefill_chunk=args.prefill_chunk,
         )
 
+    # Every server measures its own SLO position (GET /slo renders it;
+    # docs/serving.md § SLO runbook) — deployments tune the spec.
+    from autodist_tpu.obs.slo import SLOTracker
+
+    slo = SLOTracker()
+
     if args.ft_dir:
         # Supervised-replica mode: readiness + load travel through the
         # same FileTransport a router/launcher observes; /healthz is 503
@@ -143,12 +166,19 @@ def main(argv=None) -> int:
             FileTransport(os.path.join(args.ft_dir, "heartbeats")),
             persist_path=os.path.join(
                 args.ft_dir, f"serve_queue-{args.replica_id}.json"),
+            slo=slo,
         )
         frontend = ServeFrontend(None, host=args.host, port=args.port,
                                  replica=replica)
     else:
-        frontend = ServeFrontend(ContinuousBatcher(build_engine()),
+        frontend = ServeFrontend(ContinuousBatcher(build_engine(), slo=slo),
                                  host=args.host, port=args.port)
+    # A supervisor stops a replica with SIGTERM; route it through the
+    # KeyboardInterrupt path so shutdown unwinds (frontend close, atexit
+    # span part-file flush for --trace-out) instead of dying mid-write.
+    import signal
+
+    signal.signal(signal.SIGTERM, signal.default_int_handler)
     try:
         asyncio.run(frontend.serve_forever())
     except KeyboardInterrupt:

@@ -95,6 +95,7 @@ class GenRequest:
     t_submit: float = field(default_factory=time.monotonic)
     t_first_token: Optional[float] = None
     t_done: Optional[float] = None
+    queue_wait_s: Optional[float] = None   # submit -> engine admission
     tokens: List[int] = field(default_factory=list)
     state: RequestState = RequestState.QUEUED
     error: str = ""
@@ -125,6 +126,21 @@ class GenRequest:
     @property
     def latency_s(self) -> Optional[float]:
         return None if self.t_done is None else self.t_done - self.t_submit
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Time to first token (None until one was delivered)."""
+        return (None if self.t_first_token is None
+                else self.t_first_token - self.t_submit)
+
+    @property
+    def itl_s(self) -> Optional[float]:
+        """Mean inter-token latency over the decode phase (needs a
+        terminal request with >= 2 tokens)."""
+        if (self.t_done is None or self.t_first_token is None
+                or len(self.tokens) < 2):
+            return None
+        return (self.t_done - self.t_first_token) / (len(self.tokens) - 1)
 
     def add_done_callback(self, fn: Callable[["GenRequest"], None]) -> None:
         """Run ``fn(request)`` on completion, from the scheduler thread —
@@ -193,12 +209,17 @@ class ContinuousBatcher:
         max_queue: int = 256,
         registry: Optional[M.MetricsRegistry] = None,
         on_tick: Optional[Callable[[float], None]] = None,
+        slo=None,
     ):
         if engine.decode_model is None:
             raise ValueError("ContinuousBatcher needs an engine with a "
                              "decode_model")
         self.engine = engine
         self.max_queue = max_queue
+        # Optional obs.slo.SLOTracker: fed TTFT/ITL/queue-wait at retire
+        # and sheds at the admission edge, so a single-engine deployment
+        # renders the same slo_report the router does fleet-wide.
+        self.slo = slo
         # Scheduler-tick duration observer (seconds per progressing tick):
         # the replica wrapper (serve/replica.py) feeds these into its
         # obs.aggregate.HostAggregator so the router's straggler scores
@@ -219,6 +240,10 @@ class ContinuousBatcher:
         self._shed_count = 0
         self._pressure_last = -1e9  # last pool-pressure flight event
         self._SHED_WINDOW_S = 1.0
+        # Per-instance shed-record source: replay keys cumulative-delta
+        # arithmetic by it (an in-process fleet runs several batchers).
+        self._shed_src = f"batcher-{next(_ids)}"
+        self._tick_seq = 0          # progressing ticks (flight sampling)
 
         reg = registry or M.registry
         self._m_depth = reg.gauge("serve_queue_depth")
@@ -234,6 +259,7 @@ class ContinuousBatcher:
         self._m_decode_tps = reg.gauge("serve_decode_tokens_per_sec")
         self._m_latency = reg.histogram("serve_request_latency_s")
         self._m_ttft = reg.histogram("serve_ttft_s")
+        self._m_itl = reg.histogram("serve_itl_s")
 
     # ---------------------------------------------------------------- clients
     def submit(
@@ -344,16 +370,27 @@ class ContinuousBatcher:
         was refusing work without a per-rejection fsync storm."""
         now = time.monotonic()
         with self._shed_lock:
+            # Fixed windows (advance _shed_last only when one OPENS): a
+            # sustained >1-event/s storm must keep emitting one record
+            # per window — a debounce that slides on every event would
+            # record only the storm's first shed, and the postmortem
+            # replay (obs/slo.py) would recover 1 shed from a 100s storm.
             opens = now - self._shed_last > self._SHED_WINDOW_S
-            self._shed_last = now
+            if opens:
+                self._shed_last = now
             self._shed_count += 1
             n = self._shed_count
         if opens:
+            # src keys the replay's cumulative-delta arithmetic: router
+            # and batcher counters are independent even in one process.
             obs_recorder.record_event("shed", critical=False,
+                                      src=self._shed_src,
                                       reason=reason, total_shed=n,
                                       pool_free_pages=getattr(
                                           self.engine, "pool", None)
                                       and self.engine.pool.free_pages)
+        if self.slo is not None:
+            self.slo.observe(ok=False, shed=True)
 
     def _pool_pressure(self, reason: str) -> None:
         """Flight-record page-pool pressure (rate-limited like ``_shed``):
@@ -362,8 +399,12 @@ class ContinuousBatcher:
         not a failure; the doctor's timeline shows the pressure window."""
         now = time.monotonic()
         with self._shed_lock:
+            # Fixed windows, like _shed: sustained pressure keeps
+            # emitting one record per window (the doctor's DOC007
+            # abrupt-end check reads the pressure TAIL).
             opens = now - self._pressure_last > self._SHED_WINDOW_S
-            self._pressure_last = now
+            if opens:
+                self._pressure_last = now
         if opens:
             obs_recorder.record_event(
                 "pool_pressure", critical=False, reason=reason,
@@ -532,6 +573,25 @@ class ContinuousBatcher:
             try:
                 t_tick = time.monotonic()
                 progressed = self._tick()
+                if progressed:
+                    self._tick_seq += 1
+                    if self._tick_seq % 32 == 1:
+                        # Sampled per-tick engine flight record: occupancy,
+                        # prefill/decode mix, pool utilization, tick wall —
+                        # the serve-side stream the SLO/sentry/doctor layer
+                        # reads (1-in-32 keeps the recorder overhead bound).
+                        obs_recorder.record_step(
+                            surface="serve", event="tick",
+                            tick_wall_s=round(
+                                time.monotonic() - t_tick, 6),
+                            active=getattr(self.engine, "active_slots", 0),
+                            prefilling=getattr(
+                                self.engine, "prefilling_slots", 0),
+                            decoding=getattr(
+                                self.engine, "decoding_slots", 0),
+                            pool_utilization=round(float(getattr(
+                                self.engine, "page_utilization", 0.0)), 4),
+                            queue_depth=len(self._queue))
                 if progressed and self.on_tick is not None:
                     try:
                         self.on_tick(time.monotonic() - t_tick)
@@ -635,7 +695,8 @@ class ContinuousBatcher:
                 dead._finish(RequestState.TIMEOUT, "deadline expired in queue")
                 continue
             t_admit, t_admit_wall = time.monotonic(), time.time()
-            admitted = self.engine.admit(head.prompt, head.max_new_tokens)
+            admitted = self.engine.admit(head.prompt, head.max_new_tokens,
+                                         request_id=head.request_id)
             if isinstance(admitted, AdmissionDenied):
                 if admitted.retryable:
                     # Pages/rows will free on retirement; keep it queued
@@ -655,8 +716,9 @@ class ContinuousBatcher:
             # (submit → admission; the prefill-chunk spans follow on the
             # same timeline, so a request reads wait → prefill → decode).
             wait_s = max(t_admit - head.t_submit, 0.0)
+            head.queue_wait_s = wait_s
             obs_spans.add_span("serve.queue_wait", t_admit_wall - wait_s,
-                               wait_s, request_id=head.id)
+                               wait_s, request_id=head.request_id)
             with self._lock:
                 self._queue.popleft()
                 self._m_depth.set(len(self._queue))
@@ -737,6 +799,20 @@ class ContinuousBatcher:
          else self._m_completed).inc()
         req._finish(state, why)
         self._m_latency.observe(time.monotonic() - req.t_submit)
+        itl = req.itl_s
+        if itl is not None:
+            self._m_itl.observe(itl)
+        # One request-level flight record: the SLO inputs (TTFT, ITL,
+        # queue wait, outcome) survive the process — obs/slo.py's
+        # replay_flight_records recomputes the SLO position postmortem.
+        obs_recorder.record_step(
+            surface="serve", event="request", request_id=req.request_id,
+            state=state.value, n_tokens=len(req.tokens),
+            ttft_s=req.ttft_s, itl_s=itl, queue_wait_s=req.queue_wait_s)
+        if self.slo is not None:
+            self.slo.observe(ttft_s=req.ttft_s, itl_s=itl,
+                             queue_wait_s=req.queue_wait_s,
+                             ok=state is RequestState.DONE)
         with self._wake:
             self._wake.notify()  # pages freed: admission may proceed
 

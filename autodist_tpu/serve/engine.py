@@ -316,6 +316,10 @@ class InferenceEngine(_EngineBase):
         self._lengths = np.zeros(n_slots, np.int32)
         self._last_token = np.zeros(n_slots, np.int32)
         self._prompts: List[Optional[np.ndarray]] = [None] * n_slots
+        # Stable request identity per slot: the serve spans and flight
+        # records tag device work with it, so one chrome trace shows a
+        # request's prefill chunks and decode steps by id (PR 14).
+        self._request_ids: List[str] = [""] * n_slots
         self._prefill_pos = np.zeros(n_slots, np.int32)
         self._prefill_t0 = np.zeros(n_slots, np.float64)
         self._prefill_fn = None
@@ -499,14 +503,16 @@ class InferenceEngine(_EngineBase):
                 f"{max_new_tokens})", retryable=False)
         return None
 
-    def admit(self, prompt: np.ndarray,
-              max_new_tokens: int) -> Union[Slot, AdmissionDenied]:
+    def admit(self, prompt: np.ndarray, max_new_tokens: int,
+              request_id: str = "") -> Union[Slot, AdmissionDenied]:
         """Reserve a decode row + pages for ``prompt`` — host bookkeeping
         only, no device work (prefill runs chunk-by-chunk via
         :meth:`prefill_step`). Returns a :class:`Slot` or a typed
         :class:`AdmissionDenied` (never raises for load/shape reasons):
         over the static ceiling is non-retryable — the request can never
         run; pool/row exhaustion is retryable — retirement recycles pages.
+        ``request_id`` (the batcher's stable id) tags this slot's spans
+        and flight records for request-scoped tracing.
         """
         if self.decode_model is None:
             raise ValueError("engine built without decode_model")
@@ -544,6 +550,7 @@ class InferenceEngine(_EngineBase):
         self._lengths[idx] = 0
         self._last_token[idx] = 0
         self._prompts[idx] = prompt
+        self._request_ids[idx] = str(request_id or "")
         self._prefill_pos[idx] = 0
         self._prefill_t0[idx] = time.perf_counter()
         # Flight-record the admit (non-critical: batched fsync — serve load
@@ -551,7 +558,7 @@ class InferenceEngine(_EngineBase):
         # admission, not token emission.
         obs_recorder.record_step(
             surface="serve", event="admit", prompt_len=len(prompt),
-            pages=len(table.pages),
+            request_id=self._request_ids[idx], pages=len(table.pages),
             pool_used=self.pool.used_pages, pool_free=self.pool.free_pages)
         return Slot(idx)
 
@@ -576,7 +583,8 @@ class InferenceEngine(_EngineBase):
         valid = prompt[start:start + c]
         chunk[0, : len(valid)] = valid
         with obs_spans.span("serve.prefill_chunk", start=start,
-                            prompt_len=len(prompt)):
+                            prompt_len=len(prompt),
+                            request_id=self._request_ids[idx]):
             first, self._cache = self._prefill_fn(
                 self.params, jnp.asarray(chunk), np.int32(start),
                 np.int32(len(prompt)), self._cache,
@@ -617,7 +625,13 @@ class InferenceEngine(_EngineBase):
             return out
         if self._decode_fn is None:
             self._compile()
-        with obs_spans.span("serve.decode_step", active=int(len(decoding))):
+        # The decode step serves every decoding row at once: tag the span
+        # with the request ids riding it (bounded — a trace viewer needs
+        # identity, not an unbounded arg blob).
+        rids = [self._request_ids[int(i)] for i in decoding[:16]
+                if self._request_ids[int(i)]]
+        with obs_spans.span("serve.decode_step", active=int(len(decoding)),
+                            request_ids=rids):
             tokens, self._cache = self._decode_fn(
                 self.params,
                 jnp.asarray(self._last_token),
@@ -659,7 +673,16 @@ class InferenceEngine(_EngineBase):
         self._lengths[idx] = 0
         self._last_token[idx] = 0
         self._prompts[idx] = None
+        self._request_ids[idx] = ""
         self._prefill_pos[idx] = 0
+
+    @property
+    def prefilling_slots(self) -> int:
+        return int((self._phase == _PREFILL).sum())
+
+    @property
+    def decoding_slots(self) -> int:
+        return int((self._phase == _DECODE).sum())
 
     # ------------------------------------------------------------- generation
     def generate(self, prompt: np.ndarray, max_new_tokens: int) -> List[int]:

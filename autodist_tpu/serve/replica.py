@@ -85,6 +85,7 @@ class Replica:
         heartbeat_interval_s: float = 1.0,
         aggregator=None,
         registry: Optional[M.MetricsRegistry] = None,
+        slo=None,
     ):
         self.replica_id = int(replica_id)
         self.engine_factory = engine_factory
@@ -96,6 +97,10 @@ class Replica:
         self.heartbeat_interval_s = float(heartbeat_interval_s)
         self.aggregator = aggregator
         self.registry = registry or M.registry
+        # Optional obs.slo.SLOTracker threaded into the batcher: a
+        # standalone replica (no router in front) measures its own SLO
+        # position and ServeFrontend's GET /slo renders it.
+        self.slo = slo
 
         self.engine = None
         self.batcher: Optional[ContinuousBatcher] = None
@@ -195,7 +200,7 @@ class Replica:
                    if self.aggregator is not None else None)
         self.batcher = ContinuousBatcher(
             self.engine, max_queue=self.max_queue, registry=self.registry,
-            on_tick=on_tick).start()
+            on_tick=on_tick, slo=self.slo).start()
         self.drain_controller = DrainController(
             self.batcher, self.persist_path,
             drain_deadline_s=self.drain_deadline_s, registry=self.registry)

@@ -59,9 +59,11 @@ from __future__ import annotations
 
 import itertools
 import json
+import math
 import os
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
@@ -72,6 +74,9 @@ from autodist_tpu.ft import drain as ft_drain
 from autodist_tpu.ft.config import FTConfig
 from autodist_tpu.ft.heartbeat import HealthMonitor, PeerState
 from autodist_tpu.obs import recorder as obs_recorder
+from autodist_tpu.obs import spans as obs_spans
+from autodist_tpu.obs.sentry import Sentry, SentryConfig
+from autodist_tpu.obs.slo import SLOSpec, SLOTracker
 from autodist_tpu.serve.batcher import (
     Backpressure,
     GenRequest,
@@ -105,6 +110,16 @@ class RouterConfig:
     journal_interval_s: float = 0.05     # dirty-journal flush cadence
     drain_deadline_s: float = 30.0       # rolling upgrade per-replica drain
     ready_timeout_s: float = 120.0       # rolling upgrade restart wait
+    # How long a serve-sentry verdict (SNT007/008/009 attributed to a
+    # replica) holds the replica out of routing. The demotion is the
+    # router's own overlay — a latency-sick replica keeps beating READY,
+    # so the heartbeat path alone would re-admit it immediately.
+    sentry_demote_cooldown_s: float = 30.0
+    # Grace after a rolling upgrade finishes during which sentry
+    # demotions stay suppressed (maintenance-window alert suppression:
+    # an upgrade degrades latency by DESIGN — shrunken fleet, cold
+    # restarts — and demoting survivors for it would slow the recovery).
+    maintenance_grace_s: float = 10.0
 
 
 @dataclass
@@ -119,6 +134,7 @@ class _Flight:
     expect: Optional[int] = None  # bit-identity oracle for the overlap token
     reroutes: int = 0
     t_backend_fail: Optional[float] = None  # failover-latency clock start
+    t_dispatch: Optional[float] = None  # current backend's submission time
 
 
 class Router:
@@ -140,6 +156,8 @@ class Router:
         config: Optional[RouterConfig] = None,
         aggregator=None,
         registry: Optional[M.MetricsRegistry] = None,
+        slo_spec: Optional[SLOSpec] = None,
+        sentry_config: Optional[SentryConfig] = None,
     ):
         self.replicas: Dict[int, Replica] = {
             int(k): v for k, v in replicas.items()}
@@ -189,8 +207,28 @@ class Router:
         self._last_health = -1e9
         self._last_journal = -1e9
         self._journal_dirty = False
+        self._shed_last = -1e9   # router-edge shed flight-event window
+        self._shed_count = 0
 
         reg = registry or M.registry
+        self._reg = reg
+        # Serving SLO position (rolling TTFT/ITL/queue-wait percentiles,
+        # burn rates) measured at the DELIVERY point — the stream clients
+        # actually saw, failovers included — plus the serve-aware sentry
+        # whose SNT007/008/009 verdicts demote the offending replica.
+        self.slo = SLOTracker(spec=slo_spec or SLOSpec(), registry=reg)
+        self.serve_sentry = Sentry(
+            config=sentry_config or SentryConfig(), registry=reg,
+            monitor=self.monitor, recorder=obs_recorder)
+        self._sentry_demoted: Dict[int, float] = {}  # rid -> holdout end
+        self._maintenance_until: Optional[float] = None  # inf while upgrading
+        # Per-replica terminal outcomes (t, good) for SNT009's
+        # per-replica burn rate: a replica failing ITS requests burns the
+        # budget attributably and is demoted like a TTFT/ITL regressor.
+        self._replica_outcomes: Dict[int, deque] = {
+            rid: deque(maxlen=512) for rid in self.replicas}
+        self._h_ttft = reg.histogram("serve_router_ttft_s")
+        self._h_itl = reg.histogram("serve_router_itl_s")
         self._g_ready = reg.gauge("serve_router_replicas_ready")
         self._g_total = reg.gauge("serve_router_replicas_total")
         self._g_depth = reg.gauge("serve_router_queue_depth")
@@ -218,6 +256,7 @@ class Router:
         prompt = np.asarray(prompt, np.int32).ravel()
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        t_admit_wall, t_admit = time.time(), time.perf_counter()
         front = GenRequest(
             prompt=prompt,
             max_new_tokens=int(max_new_tokens),
@@ -239,6 +278,10 @@ class Router:
             front.unservable = True
             front._finish(RequestState.REJECTED,
                           f"admission rejected: {denied.reason}")
+            obs_spans.add_span(
+                "serve.router.admit", t_admit_wall,
+                time.perf_counter() - t_admit,
+                request_id=front.request_id, outcome="unservable")
             return front
         with self._wake:
             if self._stopped:
@@ -257,7 +300,18 @@ class Router:
                 self._wake.notify()
         if reason is not None:
             self._c_rejected.inc()
+            # A shed client got no answer: it burns the SLO error budget.
+            self.slo.observe(ok=False, shed=True)
+            self._record_shed(reason)
+            obs_spans.add_span(
+                "serve.router.admit", t_admit_wall,
+                time.perf_counter() - t_admit,
+                request_id=front.request_id, outcome="shed")
             raise Backpressure(reason)
+        obs_spans.add_span(
+            "serve.router.admit", t_admit_wall,
+            time.perf_counter() - t_admit,
+            request_id=front.request_id, outcome="queued")
         return front
 
     def try_submit(self, prompt, max_new_tokens: int = 32,
@@ -418,6 +472,19 @@ class Router:
             return ReplicaState.DEAD
         if peer is not None and peer.state is PeerState.DEAD:
             return ReplicaState.DEAD
+        # Serve-sentry demotion overlay (SNT007/008/009): the replica is
+        # held out of routing for the cooldown even though it keeps
+        # beating READY — a TTFT-sick replica is sick at the router's
+        # measurement point, which fresh heartbeats cannot clear.
+        until = self._sentry_demoted.get(rid)
+        if until is not None:
+            if time.monotonic() < until:
+                return ReplicaState.SUSPECT
+            del self._sentry_demoted[rid]
+            # Re-arm the episode: while demoted the replica served no
+            # traffic, so no recovery observation could clear it — and a
+            # still-sick replica must be able to fire (and demote) again.
+            self.serve_sentry.reset_serve_episodes(rid)
         if peer is not None and peer.state is PeerState.SUSPECT:
             return ReplicaState.SUSPECT
         try:
@@ -438,6 +505,29 @@ class Router:
             except Exception:  # noqa: BLE001 - scores are advisory
                 logging.warning("router straggler sweep failed",
                                 exc_info=True)
+        # SLO burn-rate sweep rides the health cadence: the serve sentry's
+        # SNT009 watches the fast window — fleet-level (alert only, no
+        # single host to demote) AND per replica (a replica failing ITS
+        # requests is demoted like a latency regressor).
+        try:
+            burn = self.slo.burn_rates()
+            findings = self.serve_sentry.observe_serve(
+                burn_rate=burn["fast"])
+            budget = self.slo.spec.error_budget
+            cutoff = now - self.slo.spec.burn_fast_window_s
+            with self._lock:
+                window = {rid: [g for t, g in evs if t >= cutoff]
+                          for rid, evs in self._replica_outcomes.items()}
+            for rid, outcomes in window.items():
+                if len(outcomes) < 8:
+                    continue  # too few outcomes to call a burn
+                bad = sum(1 for g in outcomes if not g)
+                findings += self.serve_sentry.observe_serve(
+                    burn_rate=(bad / len(outcomes)) / budget,
+                    replica_id=rid)
+            self._apply_sentry_findings(findings)
+        except Exception:  # noqa: BLE001 - SLO accounting is advisory
+            logging.warning("router burn-rate sweep failed", exc_info=True)
         peers = self.monitor.peers()
         newly_dead: List[int] = []
         with self._lock:
@@ -458,6 +548,84 @@ class Router:
         for rid in newly_dead:
             self._c_failovers.inc()
             self._fail_over(rid)
+
+    def _record_shed(self, reason: str) -> None:
+        """Flight-record router-edge sheds, windowed like the batcher's
+        (one event opens each 1s window; ``total_shed`` carries the
+        cumulative count so ``obs.slo.replay_flight_records`` recovers
+        the true shed count from the deltas, not the event count)."""
+        now = time.monotonic()
+        with self._lock:
+            # Fixed windows (advance only when one opens): a sustained
+            # storm keeps emitting one record per window, so the replay
+            # deltas recover the true count (batcher._shed semantics).
+            opens = now - self._shed_last > 1.0
+            if opens:
+                self._shed_last = now
+            self._shed_count += 1
+            n = self._shed_count
+        if opens:
+            # src keys the replay's cumulative-delta arithmetic: router
+            # and batcher counters are independent even in one process.
+            obs_recorder.record_event(
+                "shed", critical=False, src=f"router-{self._instance}",
+                reason=reason[:200], total_shed=n)
+
+    def _observe_serve(self, ttft_s: Optional[float] = None,
+                       itl_s: Optional[float] = None,
+                       replica_id: Optional[int] = None) -> None:
+        """Feed one delivered-stream observation into the serve sentry;
+        apply any fired verdicts to the routing view."""
+        try:
+            self._apply_sentry_findings(self.serve_sentry.observe_serve(
+                ttft_s=ttft_s, itl_s=itl_s, replica_id=replica_id))
+        except Exception:  # noqa: BLE001 - telemetry never fails a request
+            logging.warning("serve sentry observation failed", exc_info=True)
+
+    def _apply_sentry_findings(self, findings) -> None:
+        """SNT007/008/009 attributed to a replica demote it in the
+        router's view for ``sentry_demote_cooldown_s`` — the serving
+        analog of SNT006's host demotion, but held by the router itself
+        because the sick replica keeps beating READY."""
+        for f in findings:
+            rid = f.process_id
+            if (f.code in ("SNT007", "SNT008", "SNT009")
+                    and rid is not None and rid in self.replicas):
+                with self._lock:
+                    until = self._maintenance_until
+                    if until is not None and time.monotonic() >= until:
+                        until = self._maintenance_until = None
+                    if until is not None:
+                        # Maintenance window (rolling upgrade in progress
+                        # or just finished): latency is degraded by
+                        # design — record the verdict, suppress the
+                        # demotion (SRE alert-suppression semantics).
+                        logging.info(
+                            "router: %s on replica %d suppressed "
+                            "(maintenance window)", f.code, rid)
+                        continue
+                    routable_left = sum(
+                        1 for r, s in self._view.items()
+                        if s is ReplicaState.READY
+                        and r != rid and r not in self._sentry_demoted)
+                    if routable_left == 0:
+                        # Never demote the LAST routable replica: a
+                        # degraded fleet beats an unroutable one. The
+                        # finding is still on record for the operator.
+                        logging.warning(
+                            "router: %s on replica %d NOT demoted — it is "
+                            "the last routable replica", f.code, rid)
+                        continue
+                    self._sentry_demoted[rid] = (
+                        time.monotonic()
+                        + self.config.sentry_demote_cooldown_s)
+                logging.warning(
+                    "router: demoting replica %d for %s (cooldown %.0fs)",
+                    rid, f.code, self.config.sentry_demote_cooldown_s)
+                obs_recorder.record_event(
+                    "replica_demoted", replica=rid, code=f.code,
+                    value=f.value,
+                    cooldown_s=self.config.sentry_demote_cooldown_s)
 
     def _fail_over(self, rid: int) -> None:
         """A replica died: every in-flight request assigned to it reroutes
@@ -510,6 +678,21 @@ class Router:
                 continue
             front.tokens.append(tok)
             self._journal_dirty = True
+            if front.t_first_token is None:
+                # First client-visible token: the TTFT the SLO measures
+                # (delivery point — failover re-prefills included).
+                front.t_first_token = time.monotonic()
+                ttft = front.t_first_token - front.t_submit
+                self._h_ttft.observe(ttft)
+                self.slo.observe(ttft_s=ttft)
+                # The SENTRY's TTFT is dispatch-relative — the replica's
+                # own first-token latency. Submit-relative TTFT grows
+                # with router queue depth under load, which would read as
+                # a per-replica regression and demote healthy replicas.
+                if flight.t_dispatch is not None:
+                    self._observe_serve(
+                        ttft_s=front.t_first_token - flight.t_dispatch,
+                        replica_id=flight.replica_id)
             if flight.t_backend_fail is not None:
                 # First client-visible token after a failover: the
                 # failover latency the bench line reports.
@@ -539,11 +722,40 @@ class Router:
             if state is RequestState.DONE:
                 self._ledger[front.request_id] = (
                     self._ledger.get(front.request_id, 0) + 1)
+            # Outcome attribution for the per-replica burn rate. Skipped
+            # for unservable rejections (the client's bug, not the
+            # replica's) — everything else that terminates on a replica
+            # counts for or against it.
+            if flight.replica_id in self._replica_outcomes \
+                    and not front.unservable:
+                self._replica_outcomes[flight.replica_id].append(
+                    (time.monotonic(), state is RequestState.DONE))
             self._journal_dirty = True
         (self._c_completed if state is RequestState.DONE
          else self._c_rejected).inc()
         front._finish(state, error)
-        self._h_latency.observe(time.monotonic() - front.t_submit)
+        dur = time.monotonic() - front.t_submit
+        self._h_latency.observe(dur)
+        itl = front.itl_s
+        if state is RequestState.DONE and itl is not None:
+            self._h_itl.observe(itl)
+            self.slo.observe(itl_s=itl)
+            if flight.reroutes == 0:
+                # Attribute ITL to the replica ONLY for clean flights: a
+                # failed-over request's inter-token gap spans the dead
+                # replica's silence — charging it to the survivor would
+                # demote the replica that saved the request.
+                self._observe_serve(itl_s=itl,
+                                    replica_id=flight.replica_id)
+        self.slo.observe(ok=state is RequestState.DONE)
+        # Delivery span: one "serve.request" per client request closes the
+        # request-scoped trace (admit -> route -> prefill/decode ->
+        # [failover ->] delivery), whatever replicas served it.
+        obs_spans.add_span(
+            "serve.request", time.time() - dur, dur,
+            request_id=front.request_id, state=state.value,
+            replica=flight.replica_id, reroutes=flight.reroutes,
+            tokens=len(front.tokens))
 
     def _requeue(self, flight: _Flight, why: str) -> None:
         """Fail a flight over: back to the queue head (it has waited
@@ -554,6 +766,7 @@ class Router:
             if front.request_id not in self._flights:
                 return  # already finished/requeued (idempotent)
             self._flights.pop(front.request_id)
+            from_replica = flight.replica_id
             flight.backend = None
             flight.replica_id = None
             flight.harvested = 0
@@ -569,7 +782,14 @@ class Router:
                      "(%s)", front.request_id, len(front.tokens), why)
         obs_recorder.record_event(
             "reroute", critical=False, request_id=front.request_id,
+            from_replica=from_replica,
             delivered=len(front.tokens), reason=why[:200])
+        # The failover marker in the request-scoped trace: `delivered` IS
+        # the journal watermark the resume will replay from.
+        obs_spans.add_span(
+            "serve.failover", time.time(), 0.0,
+            request_id=front.request_id, delivered=len(front.tokens),
+            from_replica=from_replica, reason=why[:200])
 
     # ----------------------------------------------------------------- expiry
     def _expire(self) -> None:
@@ -678,10 +898,31 @@ class Router:
             flight.harvested = 0
             flight.skip = skip
             flight.expect = expect
+            flight.t_dispatch = time.monotonic()
             self._flights[front.request_id] = flight
             self._dispatches[rid] = self._dispatches.get(rid, 0) + 1
             if front.state is RequestState.QUEUED:
                 front.state = RequestState.ACTIVE
+            states = {r: s.value for r, s in self._view.items()}
+        if flight.reroutes == 0:
+            wait_s = max(time.monotonic() - front.t_submit, 0.0)
+            front.queue_wait_s = wait_s
+            self.slo.observe(queue_wait_s=wait_s)
+        # Flight-record the routing decision WITH its inputs — loads,
+        # straggler scores, readiness states — so a postmortem can answer
+        # "why did it route there"; the span ties it into the request's
+        # trace (resume_from is the journal watermark on a failover).
+        loads = {r: self.replicas[r].outstanding for r in self.replicas}
+        scores = {r: round(float(self._scores.get(r, 1.0)), 3)
+                  for r in self.replicas}
+        obs_recorder.record_step(
+            surface="serve", event="route", request_id=front.request_id,
+            replica=rid, resume_from=k, reroutes=flight.reroutes,
+            loads=loads, straggler_scores=scores, states=states)
+        obs_spans.add_span(
+            "serve.router.route", time.time(), 0.0,
+            request_id=front.request_id, replica=rid, resume_from=k,
+            reroutes=flight.reroutes)
         backend.add_done_callback(self._notify)
         return True
 
@@ -731,6 +972,48 @@ class Router:
         with self._lock:
             return dict(self._dispatches)
 
+    def slo_report(self) -> dict:
+        """The fleet ``slo_report``: the SLO tracker's position (rolling
+        TTFT/ITL/queue-wait percentiles, burn rates, compliance) plus the
+        router's own state — the JSON the frontend's ``GET /slo`` serves
+        and the selftest's bounded-p99 bar reads."""
+        report = self.slo.report()
+        with self._lock:
+            view = {rid: s.value for rid, s in self._view.items()}
+            demoted = sorted(self._sentry_demoted)
+            outstanding = len(self._queue) + len(self._flights)
+        report["router"] = {
+            "replicas": view,
+            "replicas_ready": sum(1 for s in view.values() if s == "ready"),
+            "sentry_demoted": demoted,
+            "outstanding": outstanding,
+            "dispatches": self.dispatch_counts(),
+            "sentry_codes": self.serve_sentry.codes(),
+        }
+        return report
+
+    def metrics_snapshot(self) -> Dict[str, object]:
+        """The fleet-level metrics snapshot the router frontend renders:
+        the shared registry's snapshot plus per-replica samples labeled
+        ``{replica="<id>"}`` from the same facts the heartbeat payloads
+        and ``/healthz`` carry — rendered through the ONE OpenMetrics
+        exporter, so the fleet surface stays byte-parity-testable against
+        the golden exposition rules."""
+        snap: Dict[str, object] = dict(self._reg.snapshot())
+        with self._lock:
+            view = dict(self._view)
+        for rid in sorted(self.replicas):
+            rep = self.replicas[rid]
+            label = f'{{replica="{rid}"}}'
+            snap[f"serve_replica_up{label}"] = (
+                1.0 if view.get(rid) is ReplicaState.READY else 0.0)
+            snap[f"serve_replica_outstanding{label}"] = float(
+                rep.outstanding)
+            snap[f"serve_replica_page_pool_utilization{label}"] = float(
+                rep.page_utilization)
+            snap[f"serve_replica_restarts{label}"] = float(rep.restarts)
+        return snap
+
     # --------------------------------------------------------------- upgrades
     def rolling_upgrade(self, deadline_s: Optional[float] = None,
                         ready_timeout_s: Optional[float] = None) -> List[dict]:
@@ -744,6 +1027,25 @@ class Router:
                       if deadline_s is None else deadline_s)
         ready_timeout_s = (self.config.ready_timeout_s
                            if ready_timeout_s is None else ready_timeout_s)
+        # Open the maintenance window: an upgrade degrades latency by
+        # design (shrunken fleet, cold restarts) — serve-sentry demotions
+        # are suppressed until maintenance_grace_s after it closes, and
+        # existing demotions are lifted (the upgrade IS the remediation).
+        with self._lock:
+            self._maintenance_until = float("inf")
+            self._sentry_demoted.clear()
+        results = []
+        try:
+            results = self._rolling_upgrade_cycles(
+                deadline_s, ready_timeout_s)
+        finally:
+            with self._lock:
+                self._maintenance_until = (
+                    time.monotonic() + self.config.maintenance_grace_s)
+        return results
+
+    def _rolling_upgrade_cycles(self, deadline_s: float,
+                                    ready_timeout_s: float) -> List[dict]:
         results = []
         for rid in sorted(self.replicas):
             rep = self.replicas[rid]
@@ -852,7 +1154,15 @@ def build_test_fleet(n_replicas: int = 3, n_slots: int = 8,
     router = Router(
         replicas, hb_transport,
         journal_path=os.path.join(journal_dir, "router-journal.json"),
-        config=config, aggregator=router_agg, registry=registry)
+        config=config, aggregator=router_agg, registry=registry,
+        # Generous CPU-sim targets: the selftest's bounded-p99 bar proves
+        # the SLO *plumbing* (percentiles measured, compliance computed),
+        # not chip speed; production deployments pass their own spec.
+        slo_spec=SLOSpec(
+            ttft_p50_s=60.0, ttft_p99_s=120.0, itl_p50_s=10.0,
+            itl_p99_s=30.0, queue_wait_p99_s=120.0, availability=0.99,
+            window_s=600.0, burn_fast_window_s=60.0,
+            burn_slow_window_s=600.0))
     return router, control
 
 
@@ -906,7 +1216,15 @@ def selftest_router(n_requests: int = 64, n_replicas: int = 3,
       control run of the same prompt on a lone engine (greedy
       determinism across the failover's re-prefill);
     - at least one failover and one reroute actually happened;
-    - the fleet view shows ``n_replicas - 1`` READY replicas afterwards.
+    - the fleet view shows ``n_replicas - 1`` READY replicas afterwards;
+    - the ``slo_report`` carries finite, bounded TTFT/ITL p99s and an
+      overall-compliant verdict against the test spec;
+    - ONE stitched chrome trace shows a rerouted request's full life —
+      admit → route(replica A) → queue wait/prefill/decode → failover
+      (journal watermark attached) → route(replica B) → delivery — all
+      under one trace id;
+    - seeded TTFT and ITL regressions trip SNT007/SNT008 exactly once
+      per episode and demote the replica in the router's view.
     """
     import asyncio
     import shutil
@@ -923,6 +1241,10 @@ def selftest_router(n_requests: int = 64, n_replicas: int = 3,
                for i in range(n_requests)]
     # Uninterrupted control streams (greedy, deterministic).
     expected = [control.generate(p, max_new) for p in prompts]
+    # The control runs traced too: clear the ring so the stitched-trace
+    # bar below reads only the routed run (and cannot lose its early
+    # failover span to capacity eviction).
+    obs_spans.get_tracer().clear()
 
     router.start()
     for rep in router.replicas.values():
@@ -977,6 +1299,70 @@ def selftest_router(n_requests: int = 64, n_replicas: int = 3,
         interval_s=0.01)
     ready_after = int(snap.get("serve_router_replicas_ready", 0))
     lat = snap.get("serve_router_request_latency_s", {})
+
+    # ---- SLO report: p99s measured and bounded against the test spec.
+    report = router.slo_report()
+    measured = report["measured"]
+    slo_ok = (
+        math.isfinite(measured["ttft_p99_s"])
+        and math.isfinite(measured["itl_p99_s"])
+        and measured["ttft_p99_s"] > 0
+        and bool(report["compliant"]["overall"])
+    )
+
+    # ---- Stitched failover trace: ONE request's full life across the
+    # killed replica and its survivor, under one trace id.
+    trace = obs_spans.get_tracer().to_chrome_trace()
+    failover_evs = [e for e in trace["traceEvents"]
+                    if e.get("name") == "serve.failover"]
+    trace_ok = False
+    for ev in failover_evs:
+        rid_str = ev["args"].get("request_id")
+        chain = obs_spans.events_for_request(trace, rid_str)
+        names = [e["name"] for e in chain]
+        routes = {e["args"].get("replica") for e in chain
+                  if e["name"] == "serve.router.route"}
+        tids = {e["args"].get("trace_id") for e in chain}
+        watermark = ev["args"].get("delivered")
+        trace_ok = (
+            "serve.router.admit" in names
+            and "serve.request" in names
+            and names.count("serve.failover") >= 1
+            and len(routes) >= 2          # the dead replica AND a survivor
+            and len(tids) == 1            # one stitched trace id
+            and isinstance(watermark, int) and watermark >= 1
+        )
+        if trace_ok:
+            break
+
+    # ---- Seeded serve-sentry regressions: SNT007 (TTFT) and SNT008
+    # (ITL) each trip exactly once per episode and demote the replica in
+    # the router's view (sentry overlay -> SUSPECT -> unroutable).
+    survivor = next(r for r in sorted(router.replicas) if r != kill_replica)
+    # Warm both streams AT their own rolling median (ratio ~= 1): arms the
+    # min-history gate, clears any episode real traffic opened, and resets
+    # the streaks — so the seeded regression below is the only live one.
+    for series in (router.serve_sentry._ttft, router.serve_sentry._itl):
+        hist = series.get(survivor)
+        base = float(np.median(list(hist))) if hist else 0.05
+        for _ in range(10):
+            if series is router.serve_sentry._ttft:
+                router.serve_sentry.observe_serve(ttft_s=base,
+                                                  replica_id=survivor)
+            else:
+                router.serve_sentry.observe_serve(itl_s=base,
+                                                  replica_id=survivor)
+    n0 = len(router.serve_sentry.findings)
+    for _ in range(4):    # way past any rolling median, 4 consecutive
+        router._observe_serve(ttft_s=1000.0, replica_id=survivor)
+        router._observe_serve(itl_s=1000.0, replica_id=survivor)
+    new_codes = [f.code for f in router.serve_sentry.findings[n0:]
+                 if f.process_id == survivor]
+    snt_once = (new_codes.count("SNT007") == 1
+                and new_codes.count("SNT008") == 1)
+    router._sweep_health(force=True)
+    demoted = router.replica_state(survivor) is ReplicaState.SUSPECT
+
     router.stop(drain=False)
     shutil.rmtree(workdir, ignore_errors=True)
 
@@ -990,6 +1376,10 @@ def selftest_router(n_requests: int = 64, n_replicas: int = 3,
         and mismatches == 0
         and journal_empty
         and ready_after == n_replicas - 1
+        and slo_ok
+        and trace_ok
+        and snt_once
+        and demoted
     )
     line = {
         "selftest": "autodist_tpu.serve.router",
@@ -1009,6 +1399,15 @@ def selftest_router(n_requests: int = 64, n_replicas: int = 3,
         "journal_empty": bool(journal_empty),
         "p50_latency_s": round(lat.get("p50", float("nan")), 4),
         "p99_latency_s": round(lat.get("p99", float("nan")), 4),
+        "ttft_p50_s": round(measured["ttft_p50_s"], 4),
+        "ttft_p99_s": round(measured["ttft_p99_s"], 4),
+        "itl_p50_s": round(measured["itl_p50_s"], 4),
+        "itl_p99_s": round(measured["itl_p99_s"], 4),
+        "slo_compliant": bool(report["compliant"]["overall"]),
+        "burn_rate_fast": round(report["burn_rate"]["fast"], 3),
+        "failover_trace_stitched": bool(trace_ok),
+        "snt007_snt008_once_per_episode": bool(snt_once),
+        "sentry_demoted_replica": bool(demoted),
         "wall_s": round(dt, 2),
         "device": __import__("jax").devices()[0].platform,
     }
@@ -1017,8 +1416,9 @@ def selftest_router(n_requests: int = 64, n_replicas: int = 3,
         logging.warning(
             "router selftest failed: states=%s streams_ok=%s "
             "exactly_once=%s failovers=%d rerouted=%d mismatches=%d "
-            "journal_empty=%s ready=%d",
+            "journal_empty=%s ready=%d slo_ok=%s trace_ok=%s snt_once=%s "
+            "demoted=%s",
             {s.value: n for s, n in states.items() if n}, streams_ok,
             exactly_once, failovers, rerouted, mismatches, journal_empty,
-            ready_after)
+            ready_after, slo_ok, trace_ok, snt_once, demoted)
     return 0 if ok else 1

@@ -159,6 +159,8 @@ class ServeFrontend:
                 # (obs/exporter.py) — one rendering path for every surface.
                 self._respond(writer, 200, self.registry.render_text(),
                               content_type="text/plain")
+            elif method == "GET" and path == "/slo":
+                self._slo(writer)
             elif method == "GET" and path == "/healthz":
                 self._healthz(writer)
             elif method == "POST" and path == "/drain":
@@ -176,6 +178,22 @@ class ServeFrontend:
                 pass
         finally:
             writer.close()
+
+    def _slo(self, writer) -> None:
+        """The single-engine ``slo_report`` (docs/serving.md § SLO
+        runbook): rendered from the batcher's SLOTracker when one was
+        wired (``ContinuousBatcher(slo=...)`` / ``Replica(slo=...)``);
+        404 with a pointer otherwise. NaN-safe JSON (json_safe)."""
+        from autodist_tpu.obs.slo import json_safe
+
+        batcher = self.batcher
+        tracker = getattr(batcher, "slo", None) if batcher else None
+        if tracker is None:
+            self._respond(writer, 404, {
+                "error": "no SLO tracker wired; construct the batcher/"
+                         "replica with slo=obs.slo.SLOTracker(spec)"})
+            return
+        self._respond(writer, 200, json_safe(tracker.report()))
 
     def _healthz(self, writer) -> None:
         """Typed readiness probe: 200 only when READY; 503 while
@@ -249,6 +267,129 @@ class ServeFrontend:
             return
         self._respond(writer, 200, {
             "id": req.id,
+            "state": req.state.value,
+            "tokens": req.tokens,
+            "latency_s": req.latency_s,
+        })
+
+
+class RouterFrontend:
+    """HTTP front end for the multi-replica control plane
+    (:class:`~autodist_tpu.serve.router.Router`): the fleet's single
+    client-visible address. Routes:
+
+    - ``POST /generate`` — admitted through the router (journaled,
+      exactly-once, failover-transparent); 429 on router backpressure,
+      400 on an unservable request.
+    - ``GET /metrics`` — the FLEET exposition: the shared registry plus
+      per-replica samples labeled ``{replica="<id>"}``
+      (``Router.metrics_snapshot``), rendered by the ONE OpenMetrics
+      renderer — byte-identical to ``render_openmetrics`` over the same
+      snapshot, parseable by the same golden-test parser.
+    - ``GET /healthz`` — fleet readiness JSON (per-replica states from
+      the router's observer-combined view); 200 while at least one
+      replica is READY, 503 otherwise.
+    - ``GET /slo`` — the JSON ``slo_report`` (measured TTFT/ITL/queue
+      percentiles, burn rates, compliance — docs/serving.md § SLOs).
+    """
+
+    def __init__(self, router, host: str = "127.0.0.1", port: int = 8475):
+        self.router = router
+        self.host, self.port = host, port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> "RouterFrontend":
+        self.router.start()
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        addr = self._server.sockets[0].getsockname()
+        logging.info("router frontend listening on %s:%d", *addr[:2])
+        return self
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        self.router.stop()
+
+    async def _handle(self, reader, writer) -> None:
+        respond = ServeFrontend._respond
+        try:
+            parsed = await ServeFrontend._read_request(reader)
+            if parsed is None:
+                return
+            method, path, _, body = parsed
+            if method == "GET" and path == "/metrics":
+                from autodist_tpu.obs.exporter import render_openmetrics
+
+                respond(writer, 200,
+                        render_openmetrics(
+                            snapshot=self.router.metrics_snapshot()),
+                        content_type="text/plain")
+            elif method == "GET" and path == "/healthz":
+                self._healthz(writer)
+            elif method == "GET" and path == "/slo":
+                from autodist_tpu.obs.slo import json_safe
+
+                # json_safe: an empty-window report carries NaN
+                # percentiles, and bare NaN is not RFC-8259 JSON.
+                respond(writer, 200, json_safe(self.router.slo_report()))
+            elif method == "POST" and path == "/generate":
+                await self._generate(writer, body)
+            else:
+                respond(writer, 404, {"error": f"no route {path}"})
+            await writer.drain()
+        except Exception as e:  # noqa: BLE001 - per-connection isolation
+            try:
+                respond(writer, 500, {"error": str(e)})
+                await writer.drain()
+            except Exception:  # noqa: BLE001
+                pass
+        finally:
+            writer.close()
+
+    def _healthz(self, writer) -> None:
+        states = {rid: self.router.replica_state(rid).value
+                  for rid in sorted(self.router.replicas)}
+        ready = sum(1 for s in states.values() if s == "ready")
+        doc = {
+            "ok": ready >= 1,
+            "replicas": {str(k): v for k, v in states.items()},
+            "replicas_ready": ready,
+            "outstanding": self.router.outstanding,
+        }
+        ServeFrontend._respond(writer, 200 if doc["ok"] else 503, doc)
+
+    async def _generate(self, writer, body: bytes) -> None:
+        respond = ServeFrontend._respond
+        try:
+            payload = json.loads(body.decode() or "{}")
+            tokens = payload["tokens"]
+            max_new = int(payload.get("max_new_tokens", 32))
+        except (ValueError, KeyError) as e:
+            respond(writer, 400, {"error": f"bad request body: {e}"})
+            return
+        try:
+            req = await async_generate(
+                self.router, tokens, max_new,
+                timeout_s=payload.get("timeout_s"))
+        except Backpressure as e:
+            respond(writer, 429, {"error": str(e)})
+            return
+        except ValueError as e:
+            respond(writer, 400, {"error": str(e)})
+            return
+        if req.state is RequestState.REJECTED and req.unservable:
+            respond(writer, 400, {"error": req.error})
+            return
+        respond(writer, 200, {
+            "id": req.request_id,
             "state": req.state.value,
             "tokens": req.tokens,
             "latency_s": req.latency_s,

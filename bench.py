@@ -711,12 +711,18 @@ def _serve_decode_bench(n_requests: int = 48, max_new: int = 10) -> dict:
     completed = sum(1 for r in results if r.state is RequestState.DONE)
     snap = registry.snapshot()
     lat = snap.get("serve_request_latency_s", {})
+    ttft = snap.get("serve_ttft_s", {})
+    itl = snap.get("serve_itl_s", {})
     return {"bench_serve": {
         "decode_tokens_per_sec": round(
             float(snap.get("serve_decode_tokens_per_sec", 0.0)), 1),
         "tokens_per_sec": round(tokens / dt, 1) if dt > 0 else None,
         "p50_latency_s": round(lat.get("p50", float("nan")), 4),
         "p99_latency_s": round(lat.get("p99", float("nan")), 4),
+        "ttft_p50_s": round(ttft.get("p50", float("nan")), 4),
+        "ttft_p99_s": round(ttft.get("p99", float("nan")), 4),
+        "itl_p50_s": round(itl.get("p50", float("nan")), 4),
+        "itl_p99_s": round(itl.get("p99", float("nan")), 4),
         "page_utilization_peak": round(util_peak["v"], 4),
         "n_requests": n_requests,
         "completed": completed,
@@ -776,6 +782,9 @@ def _router_bench(n_requests: int = 24, max_new: int = 6) -> dict:
     ledger = router.ledger()
     snap = registry.snapshot()
     lat = snap.get("serve_router_request_latency_s", {})
+    ttft = snap.get("serve_router_ttft_s", {})
+    itl = snap.get("serve_router_itl_s", {})
+    slo_report = router.slo_report()
     router.stop(drain=False)
     return {"bench_router": {
         "n_requests": n_requests,
@@ -791,6 +800,12 @@ def _router_bench(n_requests: int = 24, max_new: int = 6) -> dict:
             float(snap.get("serve_router_failover_latency_s", 0.0)), 4),
         "p50_latency_s": round(lat.get("p50", float("nan")), 4),
         "p99_latency_s": round(lat.get("p99", float("nan")), 4),
+        "ttft_p50_s": round(ttft.get("p50", float("nan")), 4),
+        "ttft_p99_s": round(ttft.get("p99", float("nan")), 4),
+        "itl_p50_s": round(itl.get("p50", float("nan")), 4),
+        "itl_p99_s": round(itl.get("p99", float("nan")), 4),
+        "slo_compliant": bool(slo_report["compliant"]["overall"]),
+        "burn_rate_fast": round(slo_report["burn_rate"]["fast"], 3),
         "wall_s": round(dt, 2),
         "device": jax.devices()[0].platform,
     }}

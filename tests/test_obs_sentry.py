@@ -271,7 +271,7 @@ def _steps(rec, n=12):
 def test_doctor_verdict_table_is_total():
     assert set(VERDICT_CODES) == {
         "clean", "nan", "oom", "wedge", "preemption", "straggler", "crash",
-        "unknown"}
+        "pool_exhaustion", "failover_storm", "unknown"}
     assert len(set(VERDICT_CODES.values())) == len(VERDICT_CODES)
 
 

@@ -62,7 +62,7 @@ class _StubEngine:
         return None
 
     @staticmethod
-    def admit(prompt, max_new_tokens):
+    def admit(prompt, max_new_tokens, request_id=""):
         return AdmissionDenied("no free row (stub)", retryable=True)
 
     @staticmethod
