@@ -492,6 +492,11 @@ class AutoDist:
         page_len: int = 16,
         n_pages: Optional[int] = None,
         prefill_chunk: Optional[int] = None,
+        draft_params: Any = None,
+        draft_decode_model=None,
+        draft_checkpoint: Optional[str] = None,
+        spec_k: int = 4,
+        draft_n_pages: Optional[int] = None,
     ):
         """Compile a sharded *inference* engine over this AutoDist's mesh —
         the serving counterpart of :meth:`build` (same capture → strategy →
@@ -512,6 +517,15 @@ class AutoDist:
         logical arrays). The strategy comes from this AutoDist's builder
         with the usual chief-builds/workers-receive handoff, so a fleet
         serves one consistent plan.
+
+        ``draft_params`` + ``draft_decode_model`` turn the engine into a
+        :class:`~autodist_tpu.serve.spec.SpecDecodeEngine` — speculative
+        decode with a small draft model (same mesh, its own ShardingPlan
+        compiled through the same builder, its own paged KV pool of
+        ``draft_n_pages``; ``draft_checkpoint`` restores it through the
+        same Saver path), proposing ``spec_k`` tokens per slot per round
+        with lossless greedy verification (docs/serving.md § speculative
+        decode).
         """
         from autodist_tpu.serve.engine import InferenceEngine
 
@@ -522,12 +536,35 @@ class AutoDist:
         logging.debug("inference sharding plan:\n%s", plan.describe())
         if checkpoint is not None:
             params = InferenceEngine.restore_params(checkpoint, params, plan)
-        engine = InferenceEngine(
-            params, plan, apply_fn=apply_fn, decode_model=decode_model,
+        engine_kwargs = dict(
             n_slots=n_slots, page_len=page_len, n_pages=n_pages,
             prefill_chunk=prefill_chunk, max_len=max_len,
             resource_spec=self.resource_spec,
         )
+        if draft_params is not None:
+            from autodist_tpu.serve.spec import (
+                SpecDecodeEngine, build_draft_plan)
+
+            # The draft rides the same builder over the same mesh but
+            # skips the strategy-id handoff: its build is deterministic
+            # per (builder, model, spec), so every process of a fleet
+            # derives the identical draft plan locally while the TARGET
+            # plan still travels the normal chief->worker channel.
+            draft_plan = build_draft_plan(
+                draft_params, self.mesh, resource_spec=self.resource_spec,
+                strategy_builder=self.strategy_builder)
+            if draft_checkpoint is not None:
+                draft_params = InferenceEngine.restore_params(
+                    draft_checkpoint, draft_params, draft_plan)
+            engine = SpecDecodeEngine(
+                params, plan, draft_params, draft_plan,
+                apply_fn=apply_fn, decode_model=decode_model,
+                draft_decode_model=draft_decode_model, spec_k=spec_k,
+                draft_n_pages=draft_n_pages, **engine_kwargs)
+        else:
+            engine = InferenceEngine(
+                params, plan, apply_fn=apply_fn, decode_model=decode_model,
+                **engine_kwargs)
         self._strategy, self._model_item = compiled, model_item
         return engine
 

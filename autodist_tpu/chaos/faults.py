@@ -147,6 +147,17 @@ CATALOG: Dict[str, FaultSpec] = {s.kind: s for s in (
         "partitioned replica delivers exactly once (no duplicate, no "
         "drop, no spurious failover)"),
     FaultSpec(
+        "draft_divergence", hooks.SEAM_SERVE_DRAFT,
+        "garble the speculative-decode draft proposals (a seeded draft "
+        "that proposes garbage) for the whole window",
+        "acceptance collapses toward 0 (spec_stats / "
+        "serve_spec_acceptance_rate); delivered streams stay "
+        "bit-identical to plain greedy; no crash",
+        "the target's verify program rejects every garbled proposal and "
+        "still emits its own correct token each round — cadence degrades "
+        "to ~1 token/round (bounded ITL), correctness and page "
+        "accounting are untouched"),
+    FaultSpec(
         "rolling_upgrade_under_load", "process",
         "drain + restart every replica in turn under sustained traffic "
         "(no hook — the 'fault' is the upgrade itself)",
@@ -349,6 +360,24 @@ def make_handlers(plant) -> Dict[str, Callable]:
                     return "exhaust"
 
         handlers[hooks.SEAM_SERVE_PAGES] = serve_pages
+
+    if hooks.SEAM_SERVE_DRAFT in seams:
+        def serve_draft(host=0, **_):
+            for e in events(hooks.SEAM_SERVE_DRAFT):
+                if (e.fault == "draft_divergence"
+                        and int(e.host) == int(host)):
+                    # record_once: the seam fires every spec round from a
+                    # scheduler thread — one trace entry per window keeps
+                    # replay byte-deterministic (the garbling itself is a
+                    # deterministic offset, no RNG draw needed).
+                    plant.record_once(("draft_divergence", e.at_step,
+                                       int(host)),
+                                      "draft_divergence", host=int(host),
+                                      detail="draft proposals garbled")
+                    return "garbage"
+            return None
+
+        handlers[hooks.SEAM_SERVE_DRAFT] = serve_draft
 
     if hooks.SEAM_SERVE_STEP in seams:
         def serve_step(host=0, **_):

@@ -723,6 +723,108 @@ def scenario_engine_death(base: str) -> SoakResult:
         trace=trace)
 
 
+_SPEC_PAIR = None
+
+
+def _spec_engines():
+    """Compiled-once (spec engine, plain control) pair on one checkpoint
+    and plan, with a different-seed draft — the speculative-decode
+    scenario's substrate. The fault enters per-run through the
+    SEAM_SERVE_DRAFT hook, so sharing is sound (counters are cumulative;
+    the scenario measures deltas)."""
+    global _SPEC_PAIR
+    if _SPEC_PAIR is not None:
+        return _SPEC_PAIR
+    import jax
+    import jax.numpy as jnp
+
+    from autodist_tpu.models.transformer import (
+        TransformerConfig, decode_model, init_params)
+    from autodist_tpu.serve.engine import InferenceEngine
+    from autodist_tpu.serve.spec import SpecDecodeEngine, build_draft_plan
+
+    cfg = TransformerConfig(
+        vocab_size=97, num_layers=1, d_model=32, num_heads=2, d_ff=64,
+        max_seq_len=32, causal=True, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    draft_params = init_params(jax.random.PRNGKey(5), cfg)
+    plain = InferenceEngine.build(
+        params, decode_model=decode_model(cfg),
+        n_slots=4, page_len=8, n_pages=17, prefill_chunk=8, max_len=16)
+    spec = SpecDecodeEngine(
+        params, plain.plan, draft_params,
+        build_draft_plan(draft_params, plain.plan.mesh),
+        decode_model=decode_model(cfg),
+        draft_decode_model=decode_model(cfg),
+        spec_k=4, draft_n_pages=17,
+        n_slots=4, page_len=8, n_pages=17, prefill_chunk=8, max_len=16)
+    _SPEC_PAIR = (spec, plain)
+    return _SPEC_PAIR
+
+
+def scenario_draft_divergence(base: str) -> SoakResult:
+    """Garble every draft proposal for the whole run: the verify program
+    must reject the garbage and keep emitting the target's own greedy
+    tokens — delivered streams bit-identical to plain decode, acceptance
+    collapses toward 0, cadence stays bounded (~1 token/round), page
+    accounting balances, and the run classifies clean (DOC000)."""
+    from autodist_tpu.obs import doctor
+    from autodist_tpu.obs import recorder as obs_recorder
+    from autodist_tpu.serve.batcher import ContinuousBatcher, RequestState
+
+    fault = "draft_divergence"
+    spec, plain = _spec_engines()
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(1, 96, size=rng.randint(3, 7)).astype(np.int32)
+               for _ in range(6)]
+    expected = [plain.generate(p, 6) for p in prompts]
+
+    obs_recorder.enable(obs_recorder.flight_dir(base))
+    batcher = ContinuousBatcher(spec, max_queue=16,
+                                registry=M.MetricsRegistry())
+    acc0, prop0 = spec.accepted_total, spec.proposed_total
+    schedule = ChaosSchedule(seed=31, events=(
+        ChaosEvent(fault, at_step=0),))
+    try:
+        with ChaosPlant(schedule) as plant:
+            batcher.start()
+            reqs = [batcher.submit(p, max_new_tokens=6) for p in prompts]
+            states = [r.wait(60.0).state for r in reqs]
+            _check(all(s is RequestState.DONE for s in states), fault,
+                   f"requests did not complete under garbled drafts: "
+                   f"{states}")
+            _check(plant.injected(fault) > 0, fault,
+                   "draft seam never fired")
+            trace = plant.trace_bytes()
+        batcher.stop()
+    finally:
+        obs_recorder.disable(ok=True)
+
+    _check(all(r.tokens == expected[i] for i, r in enumerate(reqs)),
+           fault, "delivered streams diverged from plain greedy — a "
+                  "garbage draft must never change output")
+    proposed = spec.proposed_total - prop0
+    accepted = spec.accepted_total - acc0
+    _check(proposed > 0, fault, "no spec rounds ran")
+    rate = accepted / proposed
+    _check(rate <= 0.25, fault,
+           f"acceptance {rate:.2f} under garbled drafts (expected ~0)")
+    _check(spec.pool.used_pages == 0 and spec.draft_pool.used_pages == 0,
+           fault, "pages leaked after the divergence window")
+    diag = doctor.diagnose(base)
+    _check(diag.code == "DOC000", fault,
+           f"doctor said {diag.code} after graceful degradation")
+    return SoakResult(
+        fault=fault, ok=True, injected=1,
+        detected=[f"acceptance {rate:.2f} (~0)", "streams bit-identical",
+                  "DOC000"],
+        expected=CATALOG[fault].detects, recovery_steps=0,
+        notes="verify rejected every garbled proposal; output stayed "
+              "plain-greedy bit-identical at ~1 token/round; zero leaked "
+              "pages",
+        trace=trace)
+
+
 # ------------------------------------------------------- router scenarios
 def _router_fleet(base: str, registry=None, config=None):
     """A 3-replica in-process router fleet + lone control engine, rooted
@@ -1063,6 +1165,7 @@ SCENARIOS: Dict[str, Callable[[str], SoakResult]] = {
     "serve_admission": scenario_serve_admission,
     "page_exhaustion": scenario_page_exhaustion,
     "engine_death": scenario_engine_death,
+    "draft_divergence": scenario_draft_divergence,
     "worker_kill": scenario_worker_kill,
     "replica_death": scenario_replica_death,
     "replica_partition": scenario_replica_partition,

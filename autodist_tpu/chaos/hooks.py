@@ -35,6 +35,7 @@ __all__ = [
     "SEAM_HB_PUBLISH",
     "SEAM_HB_SWEEP",
     "SEAM_SERVE_ADMIT",
+    "SEAM_SERVE_DRAFT",
     "SEAM_SERVE_PAGES",
     "SEAM_SERVE_STEP",
     "SEAM_SNAPSHOT_WRITE",
@@ -62,6 +63,7 @@ SEAM_AGG_SWEEP = "obs.aggregate.sweep"             # apply(fleet summaries)
 SEAM_SERVE_ADMIT = "serve.engine.admit"            # fire -> "defer" | raise
 SEAM_SERVE_STEP = "serve.engine.step"              # fire (may raise)
 SEAM_SERVE_PAGES = "serve.pages.alloc"             # fire -> "exhaust"
+SEAM_SERVE_DRAFT = "serve.spec.draft"              # fire -> "garbage"
 
 _lock = threading.Lock()
 _hooks: Dict[str, Callable] = {}

@@ -461,6 +461,103 @@ def forward_paged_decode_step(params, tokens, positions, cache, page_tables,
     return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32), cache
 
 
+def forward_paged_verify(params, tokens, positions, cache, page_tables,
+                         cfg: TransformerConfig):
+    """Speculative-decode verification: the SINGLE compiled target-model
+    program per spec round — the batched generalization of
+    :func:`forward_paged_prefill_chunk` (every decode row at once, each
+    with its own start position) crossed with the decode step's per-row
+    page tables.
+
+    ``tokens [B, K1]`` is each row's pending token followed by its K
+    draft proposals (``K1 == K + 1``); ``positions [B]`` the row's
+    current timeline position (the pending token's write slot);
+    ``page_tables [B, P]`` as in :func:`forward_paged_decode_step`. Each
+    layer scatters all K1 tokens' k/v through the row's table at
+    positions ``positions[b] + j`` and the query at offset ``j`` attends
+    causally over the gathered timeline (``t <= positions[b] + j``) —
+    exactly the context plain greedy decode would have seen token by
+    token, so the per-position argmaxes ARE the plain-greedy stream and
+    greedy acceptance is lossless by construction (docs/serving.md §
+    speculative decode).
+
+    Safety: positions at or past the static table width (a draft window
+    hanging off the timeline ceiling near ``max_new_tokens``) clamp to
+    the scratch page — like pad entries, their garbage is excluded by
+    every position mask (page 0 is the reserved scratch page,
+    ``serve/pages.py``); rows not in decode (idle/prefilling) ride along
+    against all-scratch tables and are ignored by the host.
+
+    Returns ``(accept [B], out_tokens [B, K1], cache)`` — pure on-device
+    accept/reject: ``out_tokens[b, j]`` is the target's greedy token
+    after the prefix through ``tokens[b, j]``, and ``accept[b]`` counts
+    the leading draft proposals that match it (0..K). The engine emits
+    ``out_tokens[b, :accept[b] + 1]`` — the accepted prefix plus the
+    target's own bonus/correction token — which is bit-identical to what
+    plain greedy decode would have produced.
+    """
+    b, k1 = tokens.shape
+    page_len = cache["k"].shape[2]
+    n_tables = page_tables.shape[1]
+    timeline = n_tables * page_len
+    rows_pos = positions[:, None] + jnp.arange(k1)[None, :]       # [B, K1]
+    pidx = rows_pos // page_len
+    # Past the static table width -> the reserved scratch page (0): the
+    # same "finite garbage the masks exclude" contract as pad entries.
+    page_of = jnp.where(
+        pidx < n_tables,
+        jnp.take_along_axis(page_tables, jnp.minimum(pidx, n_tables - 1),
+                            axis=1),
+        0)                                                        # [B, K1]
+    off = rows_pos % page_len
+    emb_pos = jnp.minimum(rows_pos, cfg.max_seq_len - 1)
+    # The draft is a DIFFERENT model: a proposal outside the target's
+    # vocab is legal input here. Clamp the EMBEDDING read only —
+    # jnp.take's out-of-bounds fill is NaN, and one NaN k/v row would
+    # poison every query through 0 * NaN in the masked attention sum.
+    # Acceptance below compares the RAW proposals, so a clamped
+    # out-of-vocab id can never falsely match the target's argmax.
+    emb_ids = jnp.clip(tokens, 0, cfg.vocab_size - 1)
+    x = L.embedding_lookup(params["embed"], emb_ids).astype(cfg.dtype)
+    x = x + L.embedding_lookup(params["pos_embed"], emb_pos).astype(cfg.dtype)
+    mask = jnp.arange(timeline)[None, None, :] <= rows_pos[:, :, None]
+    for i in range(cfg.num_layers):
+        block_params = params[f"layers_{i}"]
+        h = L.layernorm(block_params["ln1"], x)
+        attn_p = block_params["attn"]
+        q = L.dense(attn_p["wq"], h, compute_dtype=cfg.dtype)
+        k = L.dense(attn_p["wk"], h, compute_dtype=cfg.dtype)
+        v = L.dense(attn_p["wv"], h, compute_dtype=cfg.dtype)
+        q = q.reshape(b, k1, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, k1, cfg.num_heads, cfg.head_dim)
+        v = v.reshape(b, k1, cfg.num_heads, cfg.head_dim)
+        cache_dtype = cache["k"].dtype
+        cache["k"] = cache["k"].at[i, page_of, off].set(k.astype(cache_dtype))
+        cache["v"] = cache["v"].at[i, page_of, off].set(v.astype(cache_dtype))
+        ck = _paged_gather(cache["k"][i], page_tables).astype(cfg.dtype)
+        cv = _paged_gather(cache["v"][i], page_tables).astype(cfg.dtype)
+        logits = jnp.einsum("bqhd,bthd->bhqt", q, ck).astype(jnp.float32)
+        logits = logits / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
+        logits = jnp.where(mask[:, None, :, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        o = jnp.einsum("bhqt,bthd->bqhd", probs, cv).reshape(b, k1, cfg.d_model)
+        x = x + L.dense(attn_p["wo"], o, compute_dtype=cfg.dtype)
+        h = L.layernorm(block_params["ln2"], x)
+        h = L.dense(block_params["mlp"]["fc1"], h, compute_dtype=cfg.dtype)
+        h = jax.nn.gelu(h)
+        h = L.dense(block_params["mlp"]["fc2"], h, compute_dtype=cfg.dtype)
+        x = x + h
+    x = L.layernorm(params["ln_f"], x)
+    logits = (x.astype(cfg.dtype)
+              @ params["embed"]["embedding"].T.astype(cfg.dtype))
+    out = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+    # Greedy accept/reject on device: count the leading proposals that
+    # match the target's own argmax at the same position.
+    match = (tokens[:, 1:] == out[:, :-1]).astype(jnp.int32)      # [B, K]
+    accept = jnp.cumprod(match, axis=1).sum(axis=1).astype(jnp.int32)
+    return accept, out, cache
+
+
 def decode_model(cfg: TransformerConfig, eos_id: Optional[int] = None):
     """The transformer's serving adapter — the pure cache functions bound to
     one config, in the shape :class:`autodist_tpu.serve.InferenceEngine`
@@ -482,6 +579,9 @@ def decode_model(cfg: TransformerConfig, eos_id: Optional[int] = None):
                 params, tokens, start, length, cache, table, cfg),
         decode_paged=lambda params, tokens, positions, cache, tables:
             forward_paged_decode_step(
+                params, tokens, positions, cache, tables, cfg),
+        verify_paged=lambda params, tokens, positions, cache, tables:
+            forward_paged_verify(
                 params, tokens, positions, cache, tables, cfg),
         eos_id=eos_id,
         max_len=cfg.max_seq_len,

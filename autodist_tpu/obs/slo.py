@@ -84,17 +84,22 @@ class SLOSpec:
 
 @dataclass
 class _Series:
-    """One rolling (t, value) series bounded by time window and count."""
+    """One rolling (t, value, weight) series bounded by time window and
+    count. Weights attribute a summary sample to the events it stands
+    for — a request's mean ITL carries ``n_tokens - 1`` weight so
+    percentiles are per *token*, not per request (multi-token
+    speculative-decode steps must not let short requests dominate)."""
 
     window_s: float
     points: deque = field(default_factory=lambda: deque(maxlen=4096))
 
-    def add(self, t: float, v: float) -> None:
-        self.points.append((float(t), float(v)))
+    def add(self, t: float, v: float, w: float = 1.0) -> None:
+        self.points.append((float(t), float(v), float(w)))
 
-    def values(self, now: float) -> List[float]:
+    def values(self, now: float) -> List[tuple]:
+        """(value, weight) pairs inside the window."""
         cutoff = now - self.window_s
-        return [v for t, v in self.points if t >= cutoff]
+        return [(v, w) for t, v, w in self.points if t >= cutoff]
 
 
 class SLOTracker:
@@ -120,33 +125,54 @@ class SLOTracker:
         # Terminal outcomes: (t, ok, shed) — the burn-rate stream.
         self._events: deque = deque(maxlen=16384)
         self._totals = {"requests": 0, "errors": 0, "sheds": 0}
+        # Speculative-decode acceptance: rolling (t, accepted, proposed)
+        # — acceptance_rate joins the slo_report so a burn/latency
+        # verdict on a spec-decode replica always comes with its
+        # acceptance context (ISSUE 15).
+        self._spec: deque = deque(maxlen=4096)
+        self._spec_totals = {"proposed": 0, "accepted": 0}
 
         reg = registry or M.registry
         self._reg = reg
         self._g = {k: reg.gauge(f"slo_{k}") for k in (
             "ttft_p50_s", "ttft_p99_s", "itl_p50_s", "itl_p99_s",
             "queue_wait_p99_s", "availability", "error_rate",
-            "burn_rate_fast", "burn_rate_slow", "compliant")}
+            "acceptance_rate", "burn_rate_fast", "burn_rate_slow",
+            "compliant")}
 
     # --------------------------------------------------------------- feeding
     def observe(self, ttft_s: Optional[float] = None,
                 itl_s: Optional[float] = None,
                 queue_wait_s: Optional[float] = None,
                 ok: Optional[bool] = None, shed: bool = False,
+                itl_tokens: int = 1,
+                spec_proposed: Optional[int] = None,
+                spec_accepted: Optional[int] = None,
                 t: Optional[float] = None) -> None:
         """Feed any subset of one request's signals. ``ok`` marks a
         terminal outcome (True = served within contract, False = error);
         ``shed`` marks a typed admission rejection (counts against the
-        budget — a shed client did not get an answer). ``t`` overrides
-        the clock for replay."""
+        budget — a shed client did not get an answer). ``itl_tokens``
+        weights the ITL sample by the inter-token gaps it summarizes
+        (the request's token count minus one): ITL percentiles are
+        computed per emitted TOKEN, so multi-token speculative-decode
+        steps cannot fake latency wins by finishing short requests in
+        one burst. ``spec_proposed``/``spec_accepted`` feed the rolling
+        draft-acceptance window. ``t`` overrides the clock for replay."""
         now = self.clock() if t is None else float(t)
         with self._lock:
             if ttft_s is not None and math.isfinite(float(ttft_s)):
                 self._ttft.add(now, ttft_s)
             if itl_s is not None and math.isfinite(float(itl_s)):
-                self._itl.add(now, itl_s)
+                self._itl.add(now, itl_s, max(int(itl_tokens), 1))
             if queue_wait_s is not None and math.isfinite(float(queue_wait_s)):
                 self._wait.add(now, queue_wait_s)
+            if spec_proposed is not None and int(spec_proposed) > 0:
+                acc = min(max(int(spec_accepted or 0), 0),
+                          int(spec_proposed))
+                self._spec.append((now, acc, int(spec_proposed)))
+                self._spec_totals["proposed"] += int(spec_proposed)
+                self._spec_totals["accepted"] += acc
             if ok is not None or shed:
                 good = bool(ok) and not shed
                 self._events.append((now, good, bool(shed)))
@@ -158,10 +184,31 @@ class SLOTracker:
 
     # --------------------------------------------------------------- reading
     @staticmethod
-    def _pct(values: List[float], p: float) -> float:
+    def _pct(values: List[tuple], p: float) -> float:
+        """Weighted percentile over (value, weight) pairs. With all
+        weights 1 this is EXACTLY ``np.percentile`` (the pre-weighting
+        arithmetic — golden reports unchanged); with real weights each
+        sample counts once per event it summarizes (per-token ITL)."""
         if not values:
             return float("nan")
-        return float(np.percentile(np.asarray(values, np.float64), p))
+        vs = np.asarray([v for v, _ in values], np.float64)
+        ws = np.asarray([w for _, w in values], np.float64)
+        if np.all(ws == 1.0):
+            return float(np.percentile(vs, p))
+        order = np.argsort(vs, kind="stable")
+        vs, ws = vs[order], ws[order]
+        # Identical to np.percentile('linear') over the weight-expanded
+        # array, without materializing it: a sample of weight w is a run
+        # of w repeated unit-rank points [left, right]; within a run the
+        # value is constant, between adjacent runs interpolation is
+        # linear — so each (left, v) and (right, v) pair anchors interp.
+        right = np.cumsum(ws) - 1.0
+        left = right - (ws - 1.0)
+        xs = np.empty(2 * len(vs))
+        xs[0::2], xs[1::2] = left, right
+        ys = np.repeat(vs, 2)
+        rank = (p / 100.0) * (float(np.sum(ws)) - 1.0)
+        return float(np.interp(rank, xs, ys))
 
     def percentile(self, signal: str, p: float,
                    now: Optional[float] = None) -> float:
@@ -203,10 +250,14 @@ class SLOTracker:
             wait = self._wait.values(now)
             events = list(self._events)
             totals = dict(self._totals)
+            spec_win = [(a, p) for t, a, p in self._spec
+                        if t >= now - spec.window_s]
+            spec_totals = dict(self._spec_totals)
         win_events = [(g, s) for t, g, s in events
                       if t >= now - spec.window_s]
         good = sum(1 for g, _ in win_events if g)
         availability = good / len(win_events) if win_events else float("nan")
+        proposed = sum(p for _, p in spec_win)
         measured = {
             "ttft_p50_s": self._pct(ttft, 50.0),
             "ttft_p99_s": self._pct(ttft, 99.0),
@@ -216,6 +267,12 @@ class SLOTracker:
             "availability": availability,
             "error_rate": (1.0 - availability
                            if math.isfinite(availability) else float("nan")),
+            # Speculative-decode acceptance over the window (NaN when no
+            # drafting happened — a plain replica's report says so rather
+            # than claiming 0).
+            "acceptance_rate": (
+                sum(a for a, _ in spec_win) / proposed
+                if proposed else float("nan")),
         }
         burn = self.burn_rates(now)
 
@@ -250,7 +307,9 @@ class SLOTracker:
             "burn_rate": {**burn,
                           "windows_s": [spec.burn_fast_window_s,
                                         spec.burn_slow_window_s]},
-            "counts": {**totals, "window_requests": len(win_events)},
+            "counts": {**totals, "window_requests": len(win_events),
+                       "spec_proposed": spec_totals["proposed"],
+                       "spec_accepted": spec_totals["accepted"]},
             "compliant": compliant,
         }
 
@@ -307,6 +366,7 @@ def replay_flight_records(records: Iterable[Dict[str, Any]],
         elif r.get("kind") == "step" and r.get("event") == "request":
             tracker.observe(
                 ttft_s=r.get("ttft_s"), itl_s=r.get("itl_s"),
+                itl_tokens=max(int(r.get("n_tokens") or 2) - 1, 1),
                 queue_wait_s=r.get("queue_wait_s"),
                 ok=(r.get("state") == "done"), t=t)
         else:

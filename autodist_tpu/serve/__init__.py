@@ -44,6 +44,18 @@ Layers:
   request's full life including a mid-decode failover
   (docs/observability.md § serving).
 
+- :mod:`autodist_tpu.serve.spec` — speculative decode:
+  :class:`SpecDecodeEngine` pairs the target with a small draft model
+  (same Strategy/ShardingPlan pipeline, shared mesh, its own paged pool
+  with incremental extend + rejection rewind) — k proposals per slot per
+  round, ONE compiled target verify program with on-device greedy
+  accept/reject, **lossless by construction** (streams bit-identical to
+  plain greedy for any draft, so failover/journal-replay semantics hold
+  unchanged); ``python -m autodist_tpu.serve --selftest-spec`` proves
+  bit-identity, >=2x fewer target invocations per token, and zero leaked
+  pages over 1k+ accept/reject cycles (docs/serving.md § speculative
+  decode).
+
 Entry point: ``autodist.build_inference(...)`` (api.py) or
 :meth:`InferenceEngine.build` directly.
 """
@@ -65,6 +77,7 @@ from autodist_tpu.serve.pages import PagePool, PageTable, build_pool
 from autodist_tpu.serve.replica import Replica, ReplicaState
 from autodist_tpu.serve.router import Router, RouterConfig
 from autodist_tpu.serve.server import RouterFrontend, ServeFrontend
+from autodist_tpu.serve.spec import SpecDecodeEngine
 
 __all__ = [
     "AdmissionDenied",
@@ -85,5 +98,6 @@ __all__ = [
     "RouterFrontend",
     "ServeFrontend",
     "Slot",
+    "SpecDecodeEngine",
     "build_pool",
 ]

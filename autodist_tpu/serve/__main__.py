@@ -1,6 +1,6 @@
 """CLI: ``python -m autodist_tpu.serve``.
 
-Three modes:
+Four modes:
 
 - ``--selftest``: the zero-hardware single-engine proof (tiny CPU
   transformer; >=2x concurrency vs the bucketed baseline at equal KV HBM,
@@ -12,6 +12,11 @@ Three modes:
   one killed mid-decode under 64 concurrent requests — every request
   completes exactly once (journal-verified), every delivered stream
   bit-identical to an uninterrupted control run.
+- ``--selftest-spec``: the speculative-decode proof (docs/serving.md §
+  speculative decode): spec-decode streams bit-identical to plain greedy
+  across draft qualities and k in {1,2,4,8}, >=2x fewer target-model
+  program invocations per emitted token on the acceptance-friendly
+  workload, zero leaked pages after 1k+ accept/reject cycles.
 - server mode (default): serve a zoo model — optionally restoring a
   checkpoint — over the asyncio HTTP front end. With ``--ft-dir`` the
   process runs as a supervised :class:`~autodist_tpu.serve.replica.
@@ -55,6 +60,12 @@ def main(argv=None) -> int:
                     help="run the multi-replica router proof (3 replicas, "
                          "one killed mid-decode, exactly-once asserted) "
                          "and exit")
+    ap.add_argument("--selftest-spec", action="store_true",
+                    help="run the speculative-decode proof (bit-identical "
+                         "greedy streams across draft qualities and k in "
+                         "{1,2,4,8}, >=2x fewer target-model invocations "
+                         "per token, balanced page accounting after 1k+ "
+                         "accept/reject cycles) and exit")
     ap.add_argument("--ft-dir", default=None,
                     help="server mode: run as a supervised replica, "
                          "publishing typed readiness through the ft "
@@ -91,6 +102,17 @@ def main(argv=None) -> int:
                     help="model config override (repeatable)")
     ap.add_argument("--checkpoint", default=None,
                     help="Saver directory or ckpt-N path to restore")
+    ap.add_argument("--draft-model", default=None,
+                    help="server mode: zoo model name for a speculative-"
+                         "decode draft (same transformer family; enables "
+                         "the SpecDecodeEngine — docs/serving.md § "
+                         "speculative decode)")
+    ap.add_argument("--draft-arg", action="append", metavar="K=V",
+                    help="draft model config override (repeatable)")
+    ap.add_argument("--draft-checkpoint", default=None,
+                    help="Saver directory or ckpt-N path for the draft")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens proposed per slot per round")
     ap.add_argument("--strategy", default="AllReduce",
                     help="strategy builder name (see autodist_tpu.strategy)")
     ap.add_argument("--host", default="127.0.0.1")
@@ -109,6 +131,11 @@ def main(argv=None) -> int:
 
         return selftest_router(n_requests=args.requests,
                                max_new=args.max_new)
+
+    if args.selftest_spec:
+        from autodist_tpu.serve.spec import selftest_spec
+
+        return selftest_spec(max_new=args.max_new)
 
     import os
 
@@ -134,6 +161,16 @@ def main(argv=None) -> int:
     spec = get_model(args.model, **_parse_overrides(args.model_arg))
     params = spec.init(jax.random.PRNGKey(0))
     autodist = AutoDist(strategy_builder=S.from_name(args.strategy))
+    draft_kwargs = {}
+    if args.draft_model:
+        draft_spec = get_model(args.draft_model,
+                               **_parse_overrides(args.draft_arg))
+        draft_kwargs = dict(
+            draft_params=draft_spec.init(jax.random.PRNGKey(1)),
+            draft_decode_model=decode_model(draft_spec.config),
+            draft_checkpoint=args.draft_checkpoint,
+            spec_k=args.spec_k,
+        )
 
     def build_engine():
         return autodist.build_inference(
@@ -146,6 +183,7 @@ def main(argv=None) -> int:
             page_len=args.page_len,
             n_pages=args.pages,
             prefill_chunk=args.prefill_chunk,
+            **draft_kwargs,
         )
 
     # Every server measures its own SLO position (GET /slo renders it;

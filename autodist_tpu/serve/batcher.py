@@ -33,7 +33,12 @@ Metrics (through :mod:`autodist_tpu.metrics`' registry):
 ``serve_tokens_generated_total`` counter, ``serve_tokens_per_sec`` and
 ``serve_decode_tokens_per_sec`` gauges (rolling), and
 ``serve_request_latency_s`` / ``serve_ttft_s`` histograms (p50/p99
-exported by the registry).
+exported by the registry). Engines exposing ``spec_stats()``
+(speculative decode, serve/spec.py) additionally publish
+``serve_spec_acceptance_rate`` / ``serve_spec_tokens_per_step`` and feed
+the SLO tracker's rolling acceptance window; decode rounds then emit
+0..k+1 tokens per slot, truncated at EOS / ``max_new_tokens`` /
+deadline exactly where plain decode would have stopped.
 """
 from __future__ import annotations
 
@@ -245,7 +250,15 @@ class ContinuousBatcher:
         self._shed_src = f"batcher-{next(_ids)}"
         self._tick_seq = 0          # progressing ticks (flight sampling)
 
+        # Speculative-decode accounting (engines exposing spec_stats()):
+        # cumulative snapshot for delta arithmetic + lazily-registered
+        # gauges, so plain engines add no metric families.
+        self._spec_last: Dict[str, int] = {}
+        self._m_spec_accept = None
+        self._m_spec_tps = None
+
         reg = registry or M.registry
+        self._registry = reg
         self._m_depth = reg.gauge("serve_queue_depth")
         self._m_active = reg.gauge("serve_active_slots")
         self._m_pool_util = reg.gauge("serve_page_pool_utilization")
@@ -750,25 +763,77 @@ class ContinuousBatcher:
             self._count_tokens(1)
             self._maybe_retire(slot, req)
 
-        # One decode step over every decoding slot (ONE compiled program).
+        # One decode round over every decoding slot (ONE compiled program
+        # — plain greedy emits one token per slot; a speculative round
+        # emits 1..k+1 greedy-identical tokens per slot). Tokens are
+        # appended one at a time so EOS / max_new_tokens / deadline
+        # truncate a multi-token burst at exactly the token plain decode
+        # would have stopped on — the engine's overshoot is discarded
+        # with the retiring slot.
         with self._lock:
             have_active = bool(self._active)
         if have_active:
-            emitted = self.engine.step()
-            self._count_tokens(len(emitted), decode=True)
+            emitted = self.engine.step_many()
             progress = progress or bool(emitted)
-            for slot, token in emitted.items():
+            n_appended = 0
+            for slot, tokens in emitted.items():
                 with self._lock:
                     req = self._active.get(slot)
                 if req is None:
                     continue
-                req.tokens.append(token)
+                eos = self.engine.decode_model.eos_id
+                for token in tokens:
+                    req.tokens.append(token)
+                    n_appended += 1
+                    if (len(req.tokens) >= req.max_new_tokens
+                            or (eos is not None and token == eos)):
+                        break
+                    # Deadline parity with plain decode: one round past
+                    # an expired deadline still lands its (first) token,
+                    # then the request times out — the burst's remaining
+                    # tokens are exactly the ones plain decode would
+                    # never have computed.
+                    if (req.deadline is not None
+                            and time.monotonic() > req.deadline):
+                        break
                 self._maybe_retire(slot, req)
+            self._count_tokens(n_appended, decode=True)
+        self._update_spec_metrics()
         with self._lock:
             self._m_active.set(len(self._active))
         self._m_pool_util.set(self.engine.page_utilization)
         self._m_frag.set(self.engine.page_fragmentation)
         return progress
+
+    def _update_spec_metrics(self) -> None:
+        """Publish speculative-decode gauges + feed the SLO tracker's
+        acceptance window from the engine's cumulative ``spec_stats()``
+        (delta arithmetic per tick). No-op on plain engines — the
+        ``serve_spec_*`` families exist only where spec decode runs, so a
+        spec-decode replica's ``GET /metrics`` carries its acceptance
+        rate per replica (the router-side context for SNT007-009: a
+        low-acceptance replica legitimately runs at plain-decode cadence,
+        which is load shape, not sickness)."""
+        stats_fn = getattr(self.engine, "spec_stats", None)
+        if not callable(stats_fn):
+            return
+        stats = stats_fn()
+        if self._m_spec_accept is None:
+            self._m_spec_accept = self._registry.gauge(
+                "serve_spec_acceptance_rate")
+            self._m_spec_tps = self._registry.gauge(
+                "serve_spec_tokens_per_step")
+        self._m_spec_accept.set(float(stats.get("acceptance_rate", 0.0)))
+        self._m_spec_tps.set(float(stats.get("tokens_per_round", 0.0)))
+        if self.slo is not None:
+            d_prop = int(stats.get("proposed", 0)) - self._spec_last.get(
+                "proposed", 0)
+            d_acc = int(stats.get("accepted", 0)) - self._spec_last.get(
+                "accepted", 0)
+            if d_prop > 0:
+                self.slo.observe(spec_proposed=d_prop, spec_accepted=d_acc)
+        self._spec_last = {"proposed": int(stats.get("proposed", 0)),
+                           "accepted": int(stats.get("accepted", 0))}
 
     def _maybe_retire(self, slot: Slot, req: GenRequest) -> None:
         """Finish + recycle the slot's pages when the sequence is done.
@@ -810,7 +875,12 @@ class ContinuousBatcher:
             state=state.value, n_tokens=len(req.tokens),
             ttft_s=req.ttft_s, itl_s=itl, queue_wait_s=req.queue_wait_s)
         if self.slo is not None:
+            # itl_tokens weights the sample by the inter-token gaps it
+            # summarizes: a multi-token spec round must not let a long
+            # request count the same as a 2-token one in the ITL
+            # percentiles (per-TOKEN attribution, not per-step/request).
             self.slo.observe(ttft_s=req.ttft_s, itl_s=itl,
+                             itl_tokens=max(len(req.tokens) - 1, 1),
                              queue_wait_s=req.queue_wait_s,
                              ok=state is RequestState.DONE)
         with self._wake:

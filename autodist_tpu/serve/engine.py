@@ -95,6 +95,12 @@ class DecodeModel:
     init_paged_cache: Optional[Callable[[int, int], Any]] = None
     prefill_chunk: Optional[Callable[..., Tuple[Any, Any]]] = None
     decode_paged: Optional[Callable[..., Tuple[Any, Any]]] = None
+    # Speculative-decode verification surface (serve/spec.py): one batched
+    # multi-position forward ``(params, tokens [B, K+1], positions [B],
+    # cache, page_tables [B, P]) -> (accept [B], out_tokens [B, K+1],
+    # cache)`` with the greedy accept/reject computed ON DEVICE. Optional:
+    # only the SpecDecodeEngine requires it.
+    verify_paged: Optional[Callable[..., Tuple[Any, Any, Any]]] = None
     eos_id: Optional[int] = None
     max_len: Optional[int] = None
 
@@ -325,6 +331,11 @@ class InferenceEngine(_EngineBase):
         self._prefill_fn = None
         self._decode_fn = None
         self._decode_step_count = 0
+        # Target-model decode-program invocations: the denominator of the
+        # speculative-decode acceptance bar (>=2x fewer target invocations
+        # per emitted token than plain greedy — serve/spec.py counts its
+        # verify program through the same ledger).
+        self.decode_invocations = 0
         # Replica identity carried into the chaos seams so a schedule can
         # target ONE replica of a fleet (replica_death injects
         # EngineDeadError only where host matches — docs/chaos.md).
@@ -630,6 +641,7 @@ class InferenceEngine(_EngineBase):
         # identity, not an unbounded arg blob).
         rids = [self._request_ids[int(i)] for i in decoding[:16]
                 if self._request_ids[int(i)]]
+        self.decode_invocations += 1
         with obs_spans.span("serve.decode_step", active=int(len(decoding)),
                             request_ids=rids):
             tokens, self._cache = self._decode_fn(
@@ -654,6 +666,16 @@ class InferenceEngine(_EngineBase):
                 decode_steps=self._decode_step_count, active_slots=len(out),
                 pool_utilization=round(self.page_utilization, 4))
         return out
+
+    def step_many(self) -> Dict[Slot, List[int]]:
+        """One decode round, multi-token surface: ``{slot: [token, ...]}``.
+
+        The batcher consumes THIS method so one scheduler loop serves
+        both engines: plain decode emits exactly one token per decoding
+        slot per round; the speculative engine (serve/spec.py) overrides
+        it to emit 0..k+1 greedy-identical tokens per slot per round.
+        """
+        return {slot: [tok] for slot, tok in self.step().items()}
 
     def slot_len(self, slot: Slot) -> int:
         return int(self._lengths[slot.index])
@@ -701,9 +723,17 @@ class InferenceEngine(_EngineBase):
                 first = self.prefill_step(slot)
             tokens = [first]
             eos = self.decode_model.eos_id
+            # step_many so the speculative engine's multi-token rounds
+            # drive single-request generate too (each round emits >= 1
+            # token for a decoding slot — the loop always progresses);
+            # tokens past max_new/EOS are computed-but-discarded, exactly
+            # as the batcher truncates them at retirement.
             while len(tokens) < max_new_tokens and (
                     eos is None or tokens[-1] != eos):
-                tokens.append(self.step()[slot])
+                for tok in self.step_many()[slot]:
+                    tokens.append(tok)
+                    if len(tokens) >= max_new_tokens or tok == eos:
+                        break
         finally:
             self.release(slot)
         return tokens

@@ -74,6 +74,20 @@ class PageTable:
         row[: len(self.pages)] = self.pages
         return row
 
+    def rewind(self, n_tokens: int) -> List[int]:
+        """Truncate to the pages an ``n_tokens`` timeline needs, returning
+        the freed tail page ids (caller hands them to
+        :meth:`PagePool.reclaim` — or use :meth:`PagePool.rewind`, which
+        does both under the pool lock). The speculative-decode rollback
+        path: a rejected draft rewinds the slot's timeline, and the pages
+        reserved past the accepted length go straight back to the pool —
+        a rejection never leaks pages (docs/serving.md § speculative
+        decode). ``n_tokens <= 0`` frees everything."""
+        keep = 0 if n_tokens <= 0 else pages_for_tokens(n_tokens, self.page_len)
+        keep = min(keep, len(self.pages))
+        freed, self.pages = self.pages[keep:], self.pages[:keep]
+        return freed
+
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         return f"PageTable(pages={self.pages}, page_len={self.page_len})"
 
@@ -150,6 +164,49 @@ class PagePool:
             got = [self._free.pop() for _ in range(need)]
             self._allocated.update(got)
         return PageTable(got, self.page_len)
+
+    def extend(self, table: PageTable, n_tokens: int) -> bool:
+        """Grow ``table`` so it covers an ``n_tokens`` timeline.
+
+        All-or-nothing like :meth:`alloc` (and rides the same chaos seam,
+        so ``page_exhaustion`` windows starve extensions too). Returns
+        True when the table already covers ``n_tokens`` or the extension
+        landed; False when the pool cannot supply the extra pages — the
+        caller degrades (speculative drafting shortens or stops) rather
+        than blocks: extension is a *best-effort* growth path, never part
+        of the admission liveness contract."""
+        need = pages_for_tokens(n_tokens, self.page_len) - len(table.pages)
+        if need <= 0:
+            return True
+        if chaos_hooks.fire(chaos_hooks.SEAM_SERVE_PAGES,
+                            need=need, tokens=int(n_tokens)) == "exhaust":
+            return False
+        with self._lock:
+            if need > len(self._free):
+                return False
+            got = [self._free.pop() for _ in range(need)]
+            self._allocated.update(got)
+        table.pages.extend(got)
+        return True
+
+    def reclaim(self, pages: List[int]) -> None:
+        """Return specific page ids to the free list (the
+        :meth:`PageTable.rewind` tail). Validates each was allocated —
+        the same double-free refusal :meth:`release` keeps."""
+        with self._lock:
+            for p in pages:
+                if p not in self._allocated:
+                    raise ValueError(f"reclaim of unallocated page {p}")
+                self._allocated.discard(p)
+                self._free.append(p)
+
+    def rewind(self, table: PageTable, n_tokens: int) -> int:
+        """Truncate ``table`` to an ``n_tokens`` timeline and reclaim the
+        freed tail in one step. Returns how many pages were freed."""
+        freed = table.rewind(n_tokens)
+        if freed:
+            self.reclaim(freed)
+        return len(freed)
 
     def release(self, table: PageTable) -> None:
         """Recycle a table's pages; immediately reallocatable."""

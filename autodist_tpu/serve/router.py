@@ -739,7 +739,12 @@ class Router:
         itl = front.itl_s
         if state is RequestState.DONE and itl is not None:
             self._h_itl.observe(itl)
-            self.slo.observe(itl_s=itl)
+            # Per-TOKEN attribution: the mean-ITL sample carries the
+            # number of inter-token gaps it summarizes, so a multi-token
+            # speculative-decode burst can't fake a latency win by
+            # letting short requests dominate the percentile window.
+            self.slo.observe(itl_s=itl,
+                             itl_tokens=max(len(front.tokens) - 1, 1))
             if flight.reroutes == 0:
                 # Attribute ITL to the replica ONLY for clean flights: a
                 # failed-over request's inter-token gap spans the dead
@@ -1104,12 +1109,20 @@ def build_test_fleet(n_replicas: int = 3, n_slots: int = 8,
                      page_len: int = 8, n_pages: int = 41,
                      journal_dir: Optional[str] = None,
                      registry: Optional[M.MetricsRegistry] = None,
-                     config: Optional[RouterConfig] = None):
+                     config: Optional[RouterConfig] = None,
+                     spec_decode: bool = False, spec_k: int = 4):
     """An in-process CPU fleet for tests/chaos/bench: one plan compiled
     once (the byte-deterministic artifact a production factory would pull
     from ``plan/cache.py``), N replicas whose factories rebuild engine
     state over it, a shared Memory heartbeat transport, a straggler
     aggregator pair, and a control engine for bit-identity oracles.
+
+    ``spec_decode=True`` gives every replica a
+    :class:`~autodist_tpu.serve.spec.SpecDecodeEngine` (different-seed
+    draft — real accept/reject traffic) while the CONTROL engine stays
+    plain: the exactly-once failover bars then also prove the lossless
+    claim through journal replay, since every delivered stream must
+    still match plain greedy bit for bit.
 
     Returns ``(router, control_engine)``; the caller owns ``stop()``.
     """
@@ -1125,13 +1138,34 @@ def build_test_fleet(n_replicas: int = 3, n_slots: int = 8,
     cfg = _tiny_router_cfg()
     params = init_params(jax.random.PRNGKey(0), cfg)
 
-    def make_engine():
-        return InferenceEngine(
-            params, _shared_plan(params), decode_model=decode_model(cfg),
-            n_slots=n_slots, page_len=page_len, n_pages=n_pages,
-            prefill_chunk=page_len)
+    if spec_decode:
+        from autodist_tpu.serve.spec import SpecDecodeEngine, build_draft_plan
 
-    control = make_engine()
+        draft_params = init_params(jax.random.PRNGKey(9), cfg)
+        draft_plan = build_draft_plan(
+            draft_params, _shared_plan(params).mesh)
+
+        def make_engine():
+            return SpecDecodeEngine(
+                params, _shared_plan(params), draft_params, draft_plan,
+                decode_model=decode_model(cfg),
+                draft_decode_model=decode_model(cfg),
+                spec_k=spec_k, n_slots=n_slots, page_len=page_len,
+                n_pages=n_pages, prefill_chunk=page_len)
+    else:
+        def make_engine():
+            return InferenceEngine(
+                params, _shared_plan(params), decode_model=decode_model(cfg),
+                n_slots=n_slots, page_len=page_len, n_pages=n_pages,
+                prefill_chunk=page_len)
+
+    # The control/oracle engine is ALWAYS plain greedy: with a spec fleet
+    # it is the independent decode path every delivered stream must match
+    # bit for bit.
+    control = InferenceEngine(
+        params, _shared_plan(params), decode_model=decode_model(cfg),
+        n_slots=n_slots, page_len=page_len, n_pages=n_pages,
+        prefill_chunk=page_len)
     journal_dir = journal_dir or tempfile.mkdtemp(prefix="router-journal-")
     registry = registry or M.MetricsRegistry()
     hb_transport = MemoryTransport()

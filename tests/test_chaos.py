@@ -273,6 +273,13 @@ class TestFaultClassPins:
         res = _run("engine_death", tmp_path)
         assert res.detected == ["REJECTED(engine died)", "DOC006"]
 
+    def test_draft_divergence_lossless_degradation(self, tmp_path):
+        res = _run("draft_divergence", tmp_path)
+        assert "streams bit-identical" in res.detected
+        assert "DOC000" in res.detected
+        assert any(d.startswith("acceptance") for d in res.detected)
+        assert "zero leaked pages" in res.notes
+
     def test_worker_kill_supervised_restart(self, tmp_path):
         res = _run("worker_kill", tmp_path)
         assert res.injected == 2

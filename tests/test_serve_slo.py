@@ -50,10 +50,12 @@ class TestSLOTracker:
                                "compliant"}
         assert set(report["measured"]) == {
             "ttft_p50_s", "ttft_p99_s", "itl_p50_s", "itl_p99_s",
-            "queue_wait_p99_s", "availability", "error_rate"}
+            "queue_wait_p99_s", "availability", "error_rate",
+            "acceptance_rate"}
         assert set(report["burn_rate"]) == {"fast", "slow", "windows_s"}
         assert set(report["counts"]) == {"requests", "errors", "sheds",
-                                         "window_requests"}
+                                         "window_requests",
+                                         "spec_proposed", "spec_accepted"}
         assert set(report["compliant"]) == {
             "ttft_p50", "ttft_p99", "itl_p50", "itl_p99", "queue_wait_p99",
             "availability", "overall"}
