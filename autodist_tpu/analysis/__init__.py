@@ -88,6 +88,7 @@ def analyze_plan(
     headroom: float = DEFAULT_HEADROOM,
     temp_bytes: float = 0.0,
     serve_pool_bytes: float = 0.0,
+    serve_shared_fraction: float = 0.0,
     program: str = "",
     model_item=None,
 ) -> AnalysisReport:
@@ -95,7 +96,10 @@ def analyze_plan(
     needed): degradation drift vs the shared predicate, and — when a
     ``resource_spec`` is given — the per-chip HBM budget
     (``serve_pool_bytes`` accounts a serving engine's static KV page pool
-    as a named tenant, ``InferenceEngine.page_pool_bytes`` per chip). With
+    as a named tenant, ``InferenceEngine.page_pool_bytes`` per chip;
+    ``serve_shared_fraction`` — the engine's ``shared_fraction`` — rides
+    the memory summary so the report shows how much of the pool's
+    logical footprint COW prefix sharing deduplicates). With
     ``model_item`` (and ``strategy``), the pure-arithmetic schedule screen
     (``sched.screen_schedule``: degenerate bucketing SLO001, bucket
     zero-embed transient SLM003) joins in. This is the validation the
@@ -105,7 +109,8 @@ def analyze_plan(
     mem_findings, mem_summary = hbm_budget(
         plan, resource_spec=resource_spec, optimizer=optimizer,
         headroom=headroom, temp_bytes=temp_bytes,
-        serve_pool_bytes=serve_pool_bytes)
+        serve_pool_bytes=serve_pool_bytes,
+        serve_shared_fraction=serve_shared_fraction)
     report.extend(mem_findings)
     report.tables["memory"] = mem_summary
     if strategy is not None and model_item is not None:
@@ -124,6 +129,7 @@ def analyze_program(
     headroom: float = DEFAULT_HEADROOM,
     temp_bytes: float = 0.0,
     serve_pool_bytes: float = 0.0,
+    serve_shared_fraction: float = 0.0,
     batch=None,
     batch_elements: Optional[int] = None,
     program: str = "",
@@ -140,6 +146,7 @@ def analyze_program(
         plan, strategy=strategy, resource_spec=resource_spec,
         optimizer=optimizer, headroom=headroom, temp_bytes=temp_bytes,
         serve_pool_bytes=serve_pool_bytes,
+        serve_shared_fraction=serve_shared_fraction,
         program=program, model_item=model_item)
     if batch_elements is None and batch is not None:
         batch_elements = batch_element_count(batch)

@@ -250,6 +250,7 @@ def hbm_budget(
     headroom: float = DEFAULT_HEADROOM,
     temp_bytes: float = 0.0,
     serve_pool_bytes: float = 0.0,
+    serve_shared_fraction: float = 0.0,
 ) -> Tuple[List[Finding], Dict]:
     """Static per-chip HBM budget from the lowered plan.
 
@@ -265,6 +266,16 @@ def hbm_budget(
     a training plan's: the pool is a named tenant (``serve.page_pool``)
     that can head the overcommit blame line. Host-offloaded vars live in
     pinned host memory and are excluded from the HBM sum.
+
+    ``serve_shared_fraction`` (0..1) annotates the pool tenant with how
+    much of its LOGICAL footprint is deduplicated by COW prefix sharing
+    (``serve/prefix.py``; ``1 - physical/logical`` — the engine's
+    ``shared_fraction``). The pool tenant's bytes are the pool's STATIC
+    physical allocation, so shared bytes are already counted exactly
+    once and the number never changes the SLM001/002 verdict — it rides
+    the summary so an overcommit report shows how hard sharing is
+    already working (a 0.6 shared fraction means re-sharding, not a
+    bigger pool, is the fix).
     """
     from autodist_tpu.strategy.cost_model import OPTIMIZER_SLOT_FACTOR
 
@@ -305,6 +316,8 @@ def hbm_budget(
         "state_gb_per_chip": state / 1e9,
         "temp_gb_per_chip": float(temp_bytes) / 1e9,
         "serve_pool_gb_per_chip": float(serve_pool_bytes) / 1e9,
+        "serve_shared_fraction": min(max(
+            float(serve_shared_fraction), 0.0), 1.0),
         "capacity_gb_per_chip": capacity / 1e9,
         "usable_gb_per_chip": usable / 1e9,
         "headroom": headroom,

@@ -497,6 +497,7 @@ class AutoDist:
         draft_checkpoint: Optional[str] = None,
         spec_k: int = 4,
         draft_n_pages: Optional[int] = None,
+        prefix_cache: bool = False,
     ):
         """Compile a sharded *inference* engine over this AutoDist's mesh —
         the serving counterpart of :meth:`build` (same capture → strategy →
@@ -526,6 +527,13 @@ class AutoDist:
         same Saver path), proposing ``spec_k`` tokens per slot per round
         with lossless greedy verification (docs/serving.md § speculative
         decode).
+
+        ``prefix_cache=True`` enables copy-on-write prefix sharing over
+        the page pool (``serve/prefix.py``): admissions whose prompts
+        share cached block prefixes map onto the same physical pages and
+        prefill only their suffix (docs/serving.md § prefix sharing); a
+        spec-decode engine shares one tree across its target and draft
+        pools.
         """
         from autodist_tpu.serve.engine import InferenceEngine
 
@@ -540,6 +548,7 @@ class AutoDist:
             n_slots=n_slots, page_len=page_len, n_pages=n_pages,
             prefill_chunk=prefill_chunk, max_len=max_len,
             resource_spec=self.resource_spec,
+            prefix_cache=prefix_cache,
         )
         if draft_params is not None:
             from autodist_tpu.serve.spec import (

@@ -112,6 +112,20 @@ CATALOG: Dict[str, FaultSpec] = {s.kind: s for s in (
         "pages recycle when the window closes: queued work completes, "
         "overflow was shed at the edge — no hang, no OOM"),
     FaultSpec(
+        "eviction_storm", hooks.SEAM_SERVE_PAGES,
+        "report the pool exhausted to every allocation while the window "
+        "is open, against a prefix-cache engine holding a warm radix "
+        "tree: sustained pressure forces eviction churn down to an "
+        "empty tree before admission degrades",
+        "admissions evict cold refcount-0 prefixes (prefix_stats "
+        "evictions) then degrade typed (requests stay QUEUED); eviction "
+        "never touches a live request's pages and no request ever reads "
+        "another's KV (streams bit-identical)",
+        "after the window admissions recompute the evicted prefixes and "
+        "re-insert them; refcounts balance to zero at drain, pages "
+        "leak-check to zero — eviction costs recompute, never "
+        "correctness"),
+    FaultSpec(
         "engine_death", hooks.SEAM_SERVE_STEP,
         "raise EngineDeadError from the decode step mid-batch",
         "every in-flight/queued request finished typed REJECTED with an "
@@ -357,6 +371,14 @@ def make_handlers(plant) -> Dict[str, Callable]:
                     plant.record_once(("page_exhaustion", e.at_step),
                                       "page_exhaustion",
                                       detail="pool reported exhausted")
+                    return "exhaust"
+                if e.fault == "eviction_storm":
+                    # Same directive, different victim: against a
+                    # prefix-cache engine the evict-retry loop drains the
+                    # radix tree (churn) before the typed None lands.
+                    plant.record_once(("eviction_storm", e.at_step),
+                                      "eviction_storm",
+                                      detail="sustained pool pressure")
                     return "exhaust"
 
         handlers[hooks.SEAM_SERVE_PAGES] = serve_pages

@@ -674,6 +674,143 @@ def scenario_page_exhaustion(base: str) -> SoakResult:
         trace=trace)
 
 
+_PREFIX_ENGINE = None
+
+
+def _prefix_engine():
+    """A prefix-cache engine for the eviction_storm scenario, compiled
+    once. SEPARATE from :func:`_serve_engine` on purpose: the
+    page_exhaustion scenario asserts ``used_pages == 0`` during its
+    window, and a radix cache legitimately keeps cold pages allocated —
+    the shared engine must stay cache-free."""
+    global _PREFIX_ENGINE
+    if _PREFIX_ENGINE is not None:
+        return _PREFIX_ENGINE
+    import jax
+    import jax.numpy as jnp
+
+    from autodist_tpu.api import AutoDist
+    from autodist_tpu.models.transformer import (
+        TransformerConfig, decode_model, init_params)
+    from autodist_tpu.strategy import AllReduce
+
+    cfg = TransformerConfig(
+        vocab_size=97, num_layers=1, d_model=32, num_heads=2, d_ff=64,
+        max_seq_len=32, causal=True, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    AutoDist.reset_default()
+    try:
+        autodist = AutoDist(strategy_builder=AllReduce())
+        _PREFIX_ENGINE = autodist.build_inference(
+            params, decode_model=decode_model(cfg),
+            n_slots=4, page_len=8, n_pages=17, prefill_chunk=8,
+            max_len=24, prefix_cache=True)
+    finally:
+        AutoDist.reset_default()
+    return _PREFIX_ENGINE
+
+
+def scenario_eviction_storm(base: str) -> SoakResult:
+    """Sustained pool pressure against a WARM prefix cache: every
+    allocation in the window reports exhausted, so the engine's
+    evict-retry loop churns the radix tree down to empty (cold
+    refcount-0 leaves reclaimed, LRU-first) before admission degrades to
+    typed QUEUED — eviction never touches a live request's pages. When
+    the window closes, the queued work recomputes the evicted prefixes
+    (bit-identical streams — no request ever read another's KV),
+    re-populates the tree, and every page leak-checks back to the pool
+    (docs/chaos.md)."""
+    from autodist_tpu.obs import doctor
+    from autodist_tpu.obs import recorder as obs_recorder
+    from autodist_tpu.serve.batcher import ContinuousBatcher, RequestState
+
+    fault = "eviction_storm"
+    obs_recorder.enable(obs_recorder.flight_dir(base))
+    engine = _prefix_engine()
+    cache = engine.prefix_cache
+    free_before = engine.pool.free_pages + cache.cached_pages
+    # System-prompt-heavy workload, TWO prefix families of 16 shared
+    # tokens (2 full blocks) + unique 4-token suffixes: the storm
+    # requests lead with family A (whose leased blocks eviction must
+    # never touch), so the pressure loop can only reclaim family B's
+    # cold chain — which the trailing B requests then have to RECOMPUTE.
+    rng = np.random.default_rng(5)
+    fam_a, fam_b = (rng.integers(1, 97, size=16) for _ in range(2))
+    prompts = [np.concatenate([fam, rng.integers(1, 97, size=4)])
+               .astype(np.int32)
+               for fam in (fam_a, fam_a, fam_a, fam_a, fam_b, fam_b)]
+    # Warm phase (no chaos): expected streams AND a populated tree.
+    expected = [engine.generate(p, 4) for p in prompts]
+    warm = engine.prefix_stats()
+    _check(warm["inserts"] > 0 and cache.cached_pages > 0, fault,
+           "warm-up did not populate the radix tree")
+
+    batcher = ContinuousBatcher(engine, max_queue=8,
+                                registry=M.MetricsRegistry())
+    schedule = ChaosSchedule(seed=43, events=(
+        ChaosEvent(fault, at_step=0),))
+    try:
+        with ChaosPlant(schedule) as plant:
+            reqs = [batcher.submit(p, max_new_tokens=4) for p in prompts]
+            batcher.start()
+            retry.wait_until(lambda: plant.injected(fault) > 0, 5.0)
+            _check(plant.injected(fault) > 0, fault,
+                   "page-pool seam never fired")
+            retry.wait_until(
+                lambda: engine.prefix_stats()["evictions"]
+                > warm["evictions"], 5.0)
+            storm = engine.prefix_stats()
+            _check(storm["evictions"] > warm["evictions"], fault,
+                   "sustained pressure forced no evictions")
+            _check(all(r.state is RequestState.QUEUED for r in reqs),
+                   fault, "admissions did not degrade to typed QUEUED "
+                   "once the evictable tree was drained")
+            _check(engine.pool.used_pages == cache.cached_pages, fault,
+                   "pages used beyond the surviving cache during the "
+                   "storm — evicted pages were not reclaimed")
+            plant.advance(1)                              # window closes
+            done = [r.wait(30.0).state for r in reqs]
+            _check(all(s is RequestState.DONE for s in done), fault,
+                   f"queued work did not complete after the window: {done}")
+            _check([r.tokens for r in reqs] == expected, fault,
+                   "post-eviction recompute streams diverged from the "
+                   "warm-cache streams (cross-request KV or COW bug)")
+            after = engine.prefix_stats()
+            _check(after["inserts"] > storm["inserts"], fault,
+                   "the evicted family-B prefix was not recomputed and "
+                   "re-inserted")
+            trace = plant.trace_bytes()
+        batcher.stop()
+    finally:
+        obs_recorder.disable(ok=True)
+
+    _check(cache.live_refcount == 0, fault,
+           f"refcounts unbalanced at drain: {cache.live_refcount}")
+    cache.purge()
+    _check(engine.pool.used_pages == 0
+           and engine.pool.free_pages == free_before, fault,
+           f"pages leaked: {engine.pool.free_pages} free after purge, "
+           f"expected {free_before}")
+    records = obs_recorder.read_records(obs_recorder.flight_dir(base))
+    pressure = [r for r in records if r.get("kind") == "pool_pressure"]
+    _check(len(pressure) >= 1, fault,
+           "no pool_pressure flight event — the doctor timeline cannot "
+           "show the eviction-storm window")
+    diag = doctor.diagnose(base)
+    _check(diag.code == "DOC000", fault,
+           f"doctor said {diag.code} after graceful recovery")
+    return SoakResult(
+        fault=fault, ok=True, injected=1,
+        detected=[f"evictions={storm['evictions']}", "QUEUED(deferred)",
+                  "bit-identical recompute", "pool_pressure event",
+                  "DOC000"],
+        expected=CATALOG[fault].detects, recovery_steps=0,
+        notes="pressure evicted only the cold refcount-0 family; leased "
+              "blocks survived, admissions degraded typed and the evicted "
+              "family recomputed bit-identically; zero leaked pages",
+        trace=trace)
+
+
 def scenario_engine_death(base: str) -> SoakResult:
     from autodist_tpu.obs import doctor
     from autodist_tpu.obs import recorder as obs_recorder
@@ -1164,6 +1301,7 @@ SCENARIOS: Dict[str, Callable[[str], SoakResult]] = {
     "snapshot_unwritable": scenario_snapshot_unwritable,
     "serve_admission": scenario_serve_admission,
     "page_exhaustion": scenario_page_exhaustion,
+    "eviction_storm": scenario_eviction_storm,
     "engine_death": scenario_engine_death,
     "draft_divergence": scenario_draft_divergence,
     "worker_kill": scenario_worker_kill,

@@ -120,6 +120,12 @@ class SLOTracker:
         self._lock = threading.Lock()
         w = self.spec.window_s
         self._ttft = _Series(w)
+        # Cached/uncached TTFT split (prefix sharing, serve/prefix.py):
+        # a hit-rate shift moves the blended percentile, so the report
+        # carries both populations — an uncached (real-prefill)
+        # regression stays visible even at a 95% hit rate.
+        self._ttft_cached = _Series(w)
+        self._ttft_uncached = _Series(w)
         self._itl = _Series(w)
         self._wait = _Series(w)
         # Terminal outcomes: (t, ok, shed) — the burn-rate stream.
@@ -137,7 +143,8 @@ class SLOTracker:
         self._g = {k: reg.gauge(f"slo_{k}") for k in (
             "ttft_p50_s", "ttft_p99_s", "itl_p50_s", "itl_p99_s",
             "queue_wait_p99_s", "availability", "error_rate",
-            "acceptance_rate", "burn_rate_fast", "burn_rate_slow",
+            "acceptance_rate", "prefix_hit_rate",
+            "burn_rate_fast", "burn_rate_slow",
             "compliant")}
 
     # --------------------------------------------------------------- feeding
@@ -148,6 +155,7 @@ class SLOTracker:
                 itl_tokens: int = 1,
                 spec_proposed: Optional[int] = None,
                 spec_accepted: Optional[int] = None,
+                cached: Optional[bool] = None,
                 t: Optional[float] = None) -> None:
         """Feed any subset of one request's signals. ``ok`` marks a
         terminal outcome (True = served within contract, False = error);
@@ -158,11 +166,18 @@ class SLOTracker:
         computed per emitted TOKEN, so multi-token speculative-decode
         steps cannot fake latency wins by finishing short requests in
         one burst. ``spec_proposed``/``spec_accepted`` feed the rolling
-        draft-acceptance window. ``t`` overrides the clock for replay."""
+        draft-acceptance window. ``cached`` attributes a TTFT sample to
+        the cached-prefix or uncached (full-prefill) population — the
+        split percentiles + ``prefix_hit_rate`` in the report; None
+        (deployments without a prefix cache) feeds the blended series
+        only. ``t`` overrides the clock for replay."""
         now = self.clock() if t is None else float(t)
         with self._lock:
             if ttft_s is not None and math.isfinite(float(ttft_s)):
                 self._ttft.add(now, ttft_s)
+                if cached is not None:
+                    (self._ttft_cached if cached
+                     else self._ttft_uncached).add(now, ttft_s)
             if itl_s is not None and math.isfinite(float(itl_s)):
                 self._itl.add(now, itl_s, max(int(itl_tokens), 1))
             if queue_wait_s is not None and math.isfinite(float(queue_wait_s)):
@@ -246,6 +261,8 @@ class SLOTracker:
         spec = self.spec
         with self._lock:
             ttft = self._ttft.values(now)
+            ttft_cached = self._ttft_cached.values(now)
+            ttft_uncached = self._ttft_uncached.values(now)
             itl = self._itl.values(now)
             wait = self._wait.values(now)
             events = list(self._events)
@@ -258,9 +275,16 @@ class SLOTracker:
         good = sum(1 for g, _ in win_events if g)
         availability = good / len(win_events) if win_events else float("nan")
         proposed = sum(p for _, p in spec_win)
+        n_split = len(ttft_cached) + len(ttft_uncached)
         measured = {
             "ttft_p50_s": self._pct(ttft, 50.0),
             "ttft_p99_s": self._pct(ttft, 99.0),
+            # Prefix-sharing split (NaN without attributed samples — a
+            # deployment without a prefix cache says so, not 0).
+            "ttft_cached_p50_s": self._pct(ttft_cached, 50.0),
+            "ttft_uncached_p50_s": self._pct(ttft_uncached, 50.0),
+            "prefix_hit_rate": (len(ttft_cached) / n_split
+                                if n_split else float("nan")),
             "itl_p50_s": self._pct(itl, 50.0),
             "itl_p99_s": self._pct(itl, 99.0),
             "queue_wait_p99_s": self._pct(wait, 99.0),
@@ -364,11 +388,13 @@ def replay_flight_records(records: Iterable[Dict[str, Any]],
             for _ in range(n):
                 tracker.observe(ok=False, shed=True, t=t)
         elif r.get("kind") == "step" and r.get("event") == "request":
+            cached = r.get("cached")
             tracker.observe(
                 ttft_s=r.get("ttft_s"), itl_s=r.get("itl_s"),
                 itl_tokens=max(int(r.get("n_tokens") or 2) - 1, 1),
                 queue_wait_s=r.get("queue_wait_s"),
-                ok=(r.get("state") == "done"), t=t)
+                ok=(r.get("state") == "done"),
+                cached=None if cached is None else bool(cached), t=t)
         else:
             continue
         last_t = max(last_t, t)

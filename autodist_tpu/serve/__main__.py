@@ -1,6 +1,6 @@
 """CLI: ``python -m autodist_tpu.serve``.
 
-Four modes:
+Five modes:
 
 - ``--selftest``: the zero-hardware single-engine proof (tiny CPU
   transformer; >=2x concurrency vs the bucketed baseline at equal KV HBM,
@@ -17,6 +17,11 @@ Four modes:
   across draft qualities and k in {1,2,4,8}, >=2x fewer target-model
   program invocations per emitted token on the acceptance-friendly
   workload, zero leaked pages after 1k+ accept/reject cycles.
+- ``--selftest-prefix``: the COW prefix-sharing proof (docs/serving.md §
+  prefix sharing): on a system-prompt-heavy workload at equal pool
+  bytes, >=5x cached TTFT p50 and >=2x admitted concurrency vs the
+  sharing-off control, every stream bit-identical, refcounts drained to
+  zero with zero leaked pages, program pins unchanged (2 plain / 5 spec).
 - server mode (default): serve a zoo model — optionally restoring a
   checkpoint — over the asyncio HTTP front end. With ``--ft-dir`` the
   process runs as a supervised :class:`~autodist_tpu.serve.replica.
@@ -66,6 +71,12 @@ def main(argv=None) -> int:
                          "{1,2,4,8}, >=2x fewer target-model invocations "
                          "per token, balanced page accounting after 1k+ "
                          "accept/reject cycles) and exit")
+    ap.add_argument("--selftest-prefix", action="store_true",
+                    help="run the COW prefix-sharing proof (>=5x cached "
+                         "TTFT p50 and >=2x admitted concurrency vs "
+                         "sharing-off at equal pool bytes, bit-identical "
+                         "streams, zero leaked pages, 2/5 program pins) "
+                         "and exit")
     ap.add_argument("--ft-dir", default=None,
                     help="server mode: run as a supervised replica, "
                          "publishing typed readiness through the ft "
@@ -136,6 +147,11 @@ def main(argv=None) -> int:
         from autodist_tpu.serve.spec import selftest_spec
 
         return selftest_spec(max_new=args.max_new)
+
+    if args.selftest_prefix:
+        from autodist_tpu.serve.prefix import selftest_prefix
+
+        return selftest_prefix()
 
     import os
 

@@ -98,9 +98,14 @@ class GenRequest:
     # journals recognize the same failed-over request.
     request_id: str = ""
     t_submit: float = field(default_factory=time.monotonic)
+    t_admit: Optional[float] = None        # engine admission (slot granted)
     t_first_token: Optional[float] = None
     t_done: Optional[float] = None
     queue_wait_s: Optional[float] = None   # submit -> engine admission
+    # True when admission mapped a cached prefix onto shared pages
+    # (serve/prefix.py): the batcher splits TTFT attribution on it, so a
+    # hit-rate shift can't silently mask a prefill regression.
+    cached: bool = False
     tokens: List[int] = field(default_factory=list)
     state: RequestState = RequestState.QUEUED
     error: str = ""
@@ -134,9 +139,18 @@ class GenRequest:
 
     @property
     def ttft_s(self) -> Optional[float]:
-        """Time to first token (None until one was delivered)."""
-        return (None if self.t_first_token is None
-                else self.t_first_token - self.t_submit)
+        """Time from engine ADMISSION to first token (None until one was
+        delivered). Admission-relative, not submit-relative: queue wait
+        is reported separately (``queue_wait_s``), so a cached-prefix
+        admission whose prefill collapses to one chunk reports its true
+        prefill latency instead of inheriting the queue backlog — and a
+        0/1-chunk path can no longer record a degenerate
+        queue_wait/prefill split (ISSUE 16). Falls back to submit when
+        the request never went through ``admit`` (direct construction)."""
+        if self.t_first_token is None:
+            return None
+        base = self.t_admit if self.t_admit is not None else self.t_submit
+        return self.t_first_token - base
 
     @property
     def itl_s(self) -> Optional[float]:
@@ -256,6 +270,16 @@ class ContinuousBatcher:
         self._spec_last: Dict[str, int] = {}
         self._m_spec_accept = None
         self._m_spec_tps = None
+
+        # Prefix-cache accounting (engines built with prefix_cache=...,
+        # serve/prefix.py): cached/uncached TTFT split + hit-rate /
+        # shared-pages / sharing-ratio gauges, lazily registered so plain
+        # engines add no metric families.
+        self._m_ttft_cached = None
+        self._m_ttft_uncached = None
+        self._m_prefix_hit = None
+        self._m_prefix_shared = None
+        self._m_sharing_ratio = None
 
         reg = registry or M.registry
         self._registry = reg
@@ -730,6 +754,7 @@ class ContinuousBatcher:
             # same timeline, so a request reads wait → prefill → decode).
             wait_s = max(t_admit - head.t_submit, 0.0)
             head.queue_wait_s = wait_s
+            head.t_admit = t_admit
             obs_spans.add_span("serve.queue_wait", t_admit_wall - wait_s,
                                wait_s, request_id=head.request_id)
             with self._lock:
@@ -759,7 +784,21 @@ class ContinuousBatcher:
                 continue
             req.t_first_token = time.monotonic()
             req.tokens.append(first)
-            self._m_ttft.observe(req.t_first_token - req.t_submit)
+            # cached flag is read BEFORE release resets the slot arrays;
+            # it rides the request for the retire-time flight record/SLO.
+            slot_cached = getattr(self.engine, "slot_cached", None)
+            req.cached = (bool(slot_cached(slot))
+                          if callable(slot_cached) else False)
+            ttft = req.ttft_s
+            self._m_ttft.observe(ttft)
+            if getattr(self.engine, "prefix_cache", None) is not None:
+                if self._m_ttft_cached is None:
+                    self._m_ttft_cached = self._registry.histogram(
+                        "serve_ttft_cached_s")
+                    self._m_ttft_uncached = self._registry.histogram(
+                        "serve_ttft_uncached_s")
+                (self._m_ttft_cached if req.cached
+                 else self._m_ttft_uncached).observe(ttft)
             self._count_tokens(1)
             self._maybe_retire(slot, req)
 
@@ -799,6 +838,7 @@ class ContinuousBatcher:
                 self._maybe_retire(slot, req)
             self._count_tokens(n_appended, decode=True)
         self._update_spec_metrics()
+        self._update_prefix_metrics()
         with self._lock:
             self._m_active.set(len(self._active))
         self._m_pool_util.set(self.engine.page_utilization)
@@ -834,6 +874,31 @@ class ContinuousBatcher:
                 self.slo.observe(spec_proposed=d_prop, spec_accepted=d_acc)
         self._spec_last = {"proposed": int(stats.get("proposed", 0)),
                            "accepted": int(stats.get("accepted", 0))}
+
+    def _update_prefix_metrics(self) -> None:
+        """Publish prefix-sharing gauges from the engine's cumulative
+        ``prefix_stats()`` (serve/prefix.py). No-op on engines without a
+        prefix cache — the ``serve_prefix_*`` / sharing-ratio families
+        exist only where sharing runs. ``serve_page_pool_utilization``
+        already reports PHYSICAL (deduped) pages — the pool allocates
+        each shared page once and the tree owns it — so the sharing
+        ratio (logical/physical) is the one extra gauge the accounting
+        needs for SLM001/002 agreement."""
+        cache = getattr(self.engine, "prefix_cache", None)
+        if cache is None:
+            return
+        if self._m_prefix_hit is None:
+            self._m_prefix_hit = self._registry.gauge(
+                "serve_prefix_hit_rate")
+            self._m_prefix_shared = self._registry.gauge(
+                "serve_prefix_shared_pages")
+            self._m_sharing_ratio = self._registry.gauge(
+                "serve_page_pool_sharing_ratio")
+        stats = self.engine.prefix_stats()
+        self._m_prefix_hit.set(float(stats.get("hit_rate", 0.0)))
+        self._m_prefix_shared.set(float(stats.get("shared_pages", 0)))
+        self._m_sharing_ratio.set(
+            float(getattr(self.engine, "sharing_ratio", 1.0)))
 
     def _maybe_retire(self, slot: Slot, req: GenRequest) -> None:
         """Finish + recycle the slot's pages when the sequence is done.
@@ -873,7 +938,8 @@ class ContinuousBatcher:
         obs_recorder.record_step(
             surface="serve", event="request", request_id=req.request_id,
             state=state.value, n_tokens=len(req.tokens),
-            ttft_s=req.ttft_s, itl_s=itl, queue_wait_s=req.queue_wait_s)
+            ttft_s=req.ttft_s, itl_s=itl, queue_wait_s=req.queue_wait_s,
+            cached=req.cached)
         if self.slo is not None:
             # itl_tokens weights the sample by the inter-token gaps it
             # summarizes: a multi-token spec round must not let a long
@@ -882,7 +948,8 @@ class ContinuousBatcher:
             self.slo.observe(ttft_s=req.ttft_s, itl_s=itl,
                              itl_tokens=max(len(req.tokens) - 1, 1),
                              queue_wait_s=req.queue_wait_s,
-                             ok=state is RequestState.DONE)
+                             ok=state is RequestState.DONE,
+                             cached=req.cached)
         with self._wake:
             self._wake.notify()  # pages freed: admission may proceed
 

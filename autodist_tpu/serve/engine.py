@@ -46,6 +46,7 @@ from autodist_tpu.model_item import ModelItem
 from autodist_tpu.obs import recorder as obs_recorder
 from autodist_tpu.obs import spans as obs_spans
 from autodist_tpu.serve import pages as serve_pages
+from autodist_tpu.serve import prefix as serve_prefix
 
 DEFAULT_BUCKET_LENS = (32, 64, 128, 256, 512, 1024)
 
@@ -236,6 +237,7 @@ class InferenceEngine(_EngineBase):
         max_len: Optional[int] = None,
         resource_spec: Any = None,
         serve_hbm_frac: float = 0.5,
+        prefix_cache: Union[bool, "serve_prefix.PrefixCache", None] = None,
     ):
         if apply_fn is None and decode_model is None:
             raise ValueError(
@@ -285,6 +287,12 @@ class InferenceEngine(_EngineBase):
                 lambda: decode_model.init_paged_cache(1, self.page_len))))
         self.page_bytes = page_bytes
         max_useful = self.n_slots * self.max_pages
+        # Under prefix sharing, pages beyond every-row-at-max-timeline
+        # are still useful: they hold COLD cached prefixes that turn
+        # future admissions into page-table copies, and live tables
+        # double-count shared pages — 1 table is no longer exclusive
+        # pages. pool_size_from_spec owns the cap arithmetic.
+        sharing_factor = 2.0 if prefix_cache else 1.0
         if n_pages is None:
             if resource_spec is not None:
                 params_bytes = sum(
@@ -296,9 +304,10 @@ class InferenceEngine(_EngineBase):
                     serve_frac=serve_hbm_frac,
                     shard_degree=self._data_degree,
                     max_useful_pages=max_useful,
-                    min_useful_pages=self.max_pages)
+                    min_useful_pages=self.max_pages,
+                    sharing_factor=sharing_factor)
             else:
-                n_pages = max_useful + 1
+                n_pages = int(max_useful * sharing_factor) + 1
         n_pages = max(int(n_pages), self.max_pages + 1)
         if n_pages % self._data_degree:
             n_pages += self._data_degree - n_pages % self._data_degree
@@ -308,6 +317,20 @@ class InferenceEngine(_EngineBase):
         self._cache = jax.device_put(
             decode_model.init_paged_cache(n_pages, self.page_len),
             self._cache_sh)
+        # Copy-on-write prefix sharing (serve/prefix.py): pass True to
+        # build the refcounted radix cache over this engine's pool, or an
+        # already-built PrefixCache (the spec engine hands one spanning
+        # both its pools). None/False = sharing off (every admission
+        # prefills its whole prompt — the selftest's control arm).
+        if isinstance(prefix_cache, serve_prefix.PrefixCache):
+            self._prefix_cache: Optional[serve_prefix.PrefixCache] = \
+                prefix_cache
+        elif prefix_cache:
+            self._prefix_cache = serve_prefix.build_prefix_cache(
+                self.pool, self.page_len)
+        else:
+            self._prefix_cache = None
+        self._copy_fn = None     # the COW page copy, compiled lazily
 
         # Host-side slot tables (single scheduler-thread writer).
         self._phase = np.full(n_slots, _FREE, np.int8)
@@ -327,7 +350,13 @@ class InferenceEngine(_EngineBase):
         # request's prefill chunks and decode steps by id (PR 14).
         self._request_ids: List[str] = [""] * n_slots
         self._prefill_pos = np.zeros(n_slots, np.int32)
+        self._prefill_start = np.zeros(n_slots, np.int32)
         self._prefill_t0 = np.zeros(n_slots, np.float64)
+        # Prefix-sharing bookkeeping: the slot's Lease on tree pages and
+        # whether its admission matched any cached prefix (the cached/
+        # uncached TTFT split keys off this flag).
+        self._leases: List[Optional[serve_prefix.Lease]] = [None] * n_slots
+        self._cached = np.zeros(n_slots, bool)
         self._prefill_fn = None
         self._decode_fn = None
         self._decode_step_count = 0
@@ -492,8 +521,60 @@ class InferenceEngine(_EngineBase):
     def page_pool_bytes(self) -> int:
         """Device bytes of the static page pool (whole pool; divide by the
         data degree for per-chip when sharded) — the figure the analyzer's
-        SLM passes account (``hbm_budget(serve_pool_bytes=...)``)."""
+        SLM passes account (``hbm_budget(serve_pool_bytes=...)``). The
+        pool is a fixed physical tenant, so shared (refcounted) pages are
+        inherently counted once; :attr:`shared_fraction` tells the SLM
+        report how much logical timeline that physical footprint is
+        actually carrying."""
         return int(self.page_bytes) * self.pool.n_pages
+
+    @property
+    def prefix_cache(self) -> Optional["serve_prefix.PrefixCache"]:
+        return self._prefix_cache
+
+    def slot_cached(self, slot: Slot) -> bool:
+        """Whether this slot's admission matched a cached prefix (>= 1
+        token mapped instead of prefilled) — the cached/uncached TTFT
+        split keys off this."""
+        return bool(self._cached[slot.index])
+
+    def _logical_physical_pages(self) -> Tuple[int, int]:
+        """(logical, physical) page counts across live tables: logical
+        counts every table entry, physical counts distinct pages — they
+        differ exactly by sharing."""
+        logical, phys = 0, set()
+        for t in self._tables:
+            if t is None:
+                continue
+            logical += len(t.pages)
+            phys.update(t.pages)
+        return logical, len(phys)
+
+    @property
+    def sharing_ratio(self) -> float:
+        """``logical_bytes / physical_bytes`` across live page tables —
+        1.0 with sharing off (or idle), above 1.0 when admissions map
+        onto the same physical pages (the
+        ``serve_page_pool_sharing_ratio`` gauge)."""
+        logical, phys = self._logical_physical_pages()
+        return logical / phys if phys else 1.0
+
+    @property
+    def shared_fraction(self) -> float:
+        """Fraction of the live logical timeline served by deduplicated
+        pages, 0..1 — the analyzer's shared-pool accounting figure
+        (``hbm_budget(serve_shared_fraction=...)``)."""
+        logical, phys = self._logical_physical_pages()
+        return 1.0 - phys / logical if logical else 0.0
+
+    def prefix_stats(self) -> Dict[str, float]:
+        """The prefix tree's counters (zeros when sharing is off) — the
+        ``serve_prefix_*`` gauges and the selftest bars read these."""
+        if self._prefix_cache is None:
+            return {"hit_rate": 0.0, "hits": 0, "lookups": 0,
+                    "cached_pages": 0, "shared_pages": 0, "evictions": 0,
+                    "inserts": 0, "cow_copies": 0, "live_refcount": 0}
+        return self._prefix_cache.stats()
 
     # --------------------------------------------------------------- admission
     def check_admissible(self, prompt_len: int,
@@ -546,7 +627,35 @@ class InferenceEngine(_EngineBase):
             return AdmissionDenied(
                 f"no free decode row ({self.n_slots} active)",
                 retryable=True)
-        table = self.pool.alloc(total)
+        lease: Optional[serve_prefix.Lease] = None
+        start_pos = 0
+        if self._prefix_cache is None:
+            table = self.pool.alloc(total)
+        else:
+            # Prefix sharing: matched full blocks ride the SAME physical
+            # pages (refcount++ under the lease); only the unmatched
+            # suffix reserves fresh pages — under pressure, cold cached
+            # prefixes evict (LRU leaves) before the admission defers.
+            m = self._prefix_cache.match(prompt)
+            lease = self._prefix_cache.acquire(m)
+            suffix_tokens = total - m.n_full * self.page_len
+            table = self._alloc_with_evict(suffix_tokens)
+            if table is None:
+                self._prefix_cache.cancel(lease)
+            else:
+                start_pos = m.n_full * self.page_len
+                if m.tail_len:
+                    # COW frontier: copy the partially-matched page into
+                    # this request's FIRST exclusive page, then resume
+                    # prefill mid-page — a shared page is never written.
+                    # The source node stays pinned on the lease until
+                    # release: the spec engine's draft-side COW reads it
+                    # after this call, and eviction must not race it.
+                    self._cow_page(m.tail_node.page, table.pages[0])
+                    start_pos += m.tail_len
+                else:
+                    self._prefix_cache.unpin_tail(lease)
+                table.pages[:0] = [nd.page for nd in lease.nodes]
         if table is None:
             return AdmissionDenied(
                 f"page pool exhausted ({self.pool.free_pages} of "
@@ -562,7 +671,10 @@ class InferenceEngine(_EngineBase):
         self._last_token[idx] = 0
         self._prompts[idx] = prompt
         self._request_ids[idx] = str(request_id or "")
-        self._prefill_pos[idx] = 0
+        self._prefill_pos[idx] = start_pos
+        self._prefill_start[idx] = start_pos
+        self._leases[idx] = lease
+        self._cached[idx] = start_pos > 0
         self._prefill_t0[idx] = time.perf_counter()
         # Flight-record the admit (non-critical: batched fsync — serve load
         # must not turn into an fsync storm). Rate is bounded by request
@@ -570,8 +682,55 @@ class InferenceEngine(_EngineBase):
         obs_recorder.record_step(
             surface="serve", event="admit", prompt_len=len(prompt),
             request_id=self._request_ids[idx], pages=len(table.pages),
+            cached_tokens=start_pos,
             pool_used=self.pool.used_pages, pool_free=self.pool.free_pages)
         return Slot(idx)
+
+    def _alloc_with_evict(
+            self, n_tokens: int) -> Optional[serve_pages.PageTable]:
+        """Pool allocation with eviction retry: when the pool cannot cover
+        the suffix, reclaim cold cached prefixes (LRU refcount-0 leaves)
+        and try again — pressure degrades FUTURE admissions to recompute,
+        never a live request's pages. Returns None only once the tree has
+        nothing left to give (or a chaos exhaustion window is open)."""
+        table = self.pool.alloc(n_tokens)
+        need = serve_pages.pages_for_tokens(n_tokens, self.page_len)
+        while table is None and self._prefix_cache is not None:
+            if self._prefix_cache.evict(need) == 0:
+                return None
+            table = self.pool.alloc(n_tokens)
+        return table
+
+    @staticmethod
+    def _make_page_copy_fn(n_pages: int, cache_sh):
+        """Compile the COW page copy for one pool: every cache leaf's
+        ``src`` page row duplicated into ``dst``, donated in place with
+        the pool's canonical sharding. Page ids are traced scalars, so
+        ONE program serves every copy — a data-movement program over the
+        pool, not a serving program (the exactly-2/exactly-5 pins count
+        the per-token decode/prefill/verify programs)."""
+
+        def copy(cache, src, dst):
+            return jax.tree_util.tree_map(
+                lambda leaf: (leaf.at[:, dst].set(leaf[:, src])
+                              if leaf.ndim >= 2
+                              and leaf.shape[1] == n_pages else leaf),
+                cache)
+
+        return jax.jit(copy, donate_argnums=(0,), out_shardings=cache_sh)
+
+    def _cow_page(self, src_page: int, dst_page: int) -> None:
+        """Device copy of one KV page — the copy-on-write at the
+        divergence frontier (never a shared write)."""
+        if self._copy_fn is None:
+            self._copy_fn = self._make_page_copy_fn(
+                self.pool.n_pages, self._cache_sh)
+        with obs_spans.span("serve.cow_copy", src=int(src_page),
+                            dst=int(dst_page)):
+            self._cache = self._copy_fn(
+                self._cache, jnp.int32(src_page), jnp.int32(dst_page))
+        if self._prefix_cache is not None:
+            self._prefix_cache.cow_copies += 1
 
     def prefill_pending(self) -> List[Slot]:
         """Slots mid-prefill, in row order — the batcher advances each by
@@ -609,11 +768,23 @@ class InferenceEngine(_EngineBase):
         self._lengths[idx] = len(prompt)
         self._last_token[idx] = first
         self._decode_table_np[idx] = self._table_np[idx]
+        if self._leases[idx] is not None:
+            # Adopt this prompt's novel full blocks into the prefix tree:
+            # the NEXT admission sharing them becomes a page-table copy.
+            self._insert_prefix(idx, prompt)
+        prefilled = len(prompt) - int(self._prefill_start[idx])
         obs_recorder.record_step(
             surface="serve", event="prefilled", prompt_len=len(prompt),
-            chunks=-(-len(prompt) // c),
+            chunks=-(-prefilled // c), cached=bool(self._cached[idx]),
             prefill_s=round(time.perf_counter() - self._prefill_t0[idx], 6))
         return first
+
+    def _insert_prefix(self, idx: int, prompt: np.ndarray) -> None:
+        """Hook for the prefix-tree adoption at prefill completion (the
+        spec engine overrides it to adopt target + draft pages as ONE
+        node per block)."""
+        self._prefix_cache.insert(
+            prompt, self._tables[idx].pages, self._leases[idx])
 
     def step(self) -> Dict[Slot, int]:
         """One decode step over the full slot batch (ONE compiled program).
@@ -686,8 +857,23 @@ class InferenceEngine(_EngineBase):
         overwritten before any mask can admit them)."""
         idx = slot.index
         table = self._tables[idx]
+        lease = self._leases[idx]
         if table is not None:
-            self.pool.release(table)
+            if lease is not None:
+                # Shared (tree-owned) pages only drop a refcount — they
+                # stay cached for the next admission; exclusive pages
+                # recycle immediately, exactly like the unshared path.
+                shared = set(lease.pages)
+                exclusive = [p for p in table.pages if p not in shared]
+                self._prefix_cache.release(lease)
+                if exclusive:
+                    self.pool.reclaim(exclusive)
+                table.pages = []
+            else:
+                self.pool.release(table)
+        self._leases[idx] = None
+        self._cached[idx] = False
+        self._prefill_start[idx] = 0
         self._tables[idx] = None
         self._phase[idx] = _FREE
         self._table_np[idx] = serve_pages.SCRATCH_PAGE

@@ -234,6 +234,7 @@ def pool_size_from_spec(
     shard_degree: int = 1,
     max_useful_pages: Optional[int] = None,
     min_useful_pages: int = 1,
+    sharing_factor: float = 1.0,
 ) -> int:
     """Page count (INCLUDING the scratch page) from per-chip HBM headroom.
 
@@ -251,12 +252,23 @@ def pool_size_from_spec(
     decode row at the full ``max_len`` timeline); ``min_useful_pages``
     floors at a functioning pool — an overcommit is the analyzer's SLM
     finding to report, not a constructor crash.
+
+    ``sharing_factor`` relaxes that cap for COW prefix sharing
+    (``serve/prefix.py``): the every-row-at-max-timeline bound assumes
+    1 table = exclusive pages, but a refcounted pool also earns from
+    pages holding COLD cached prefixes (each turns a future admission
+    into a page-table copy instead of a prefill) and live tables
+    double-count shared pages — so "more pages cannot help" moves out
+    by the expected logical/physical sharing ratio. 1.0 (default)
+    keeps the exclusive-pages arithmetic; the engine passes 2.0 when a
+    prefix cache is attached.
     """
     capacity = float(resource_spec.tpu.hbm_bytes) if resource_spec else 0.0
     budget = max(0.0, capacity * headroom - float(params_bytes)) * serve_frac
     budget *= max(int(shard_degree), 1)
     n = int(budget // max(float(bytes_per_page), 1.0))
     if max_useful_pages is not None:
-        n = min(n, int(max_useful_pages))
+        n = min(n, int(int(max_useful_pages)
+                       * max(float(sharing_factor), 1.0)))
     n = max(n, int(min_useful_pages))
     return n + 1  # + the reserved scratch page

@@ -1,0 +1,595 @@
+"""Copy-on-write prefix sharing over the paged KV pool: the ONE radix home.
+
+Million-user traffic is dominated by shared prefixes — system prompts,
+few-shot templates, multi-turn history — and the paged KV-cache
+(``serve/pages.py``) is exactly the representation for sharing them: many
+page tables can point at the same physical pages. This module is the ONE
+home of the **refcounted radix/prefix tree** that makes that safe
+(``tools/check_patterns.py`` rule 9 bans radix construction anywhere
+else, the same single-home pattern as the page allocator itself):
+
+- **Blocks.** The tree is keyed by token-*block* hash, where a block is
+  one page's worth of tokens (``page_len``). Hashes chain parent→child
+  (a node's key commits to the whole prefix, not just its own block), and
+  every node stores its block's tokens so a hash collision can never
+  alias two different prefixes onto one page.
+- **Match + lease.** On admit the engine walks the prompt down the tree;
+  every matched block maps onto the SAME physical page (refcount++ via a
+  :class:`Lease`), and fresh pages are reserved only for the unmatched
+  suffix — a cached admission prefills O(suffix), not O(prompt). Matching
+  is capped at ``(prompt_len - 1) // page_len`` full blocks so at least
+  the final prompt token always prefills: the first generated token is
+  always produced by the engine's own prefill program, and a live
+  request's writes (prefill chunks, decode steps, draft/verify scatter)
+  land strictly AFTER the shared region — shared pages are never
+  shared-written.
+- **COW frontier.** Per-request writes are append-only, so the
+  divergence frontier is at most ONE partially-matched page: when the
+  prompt's next partial block shares a leading run with a cached child's
+  block, the engine copies that child's page into the request's first
+  exclusive page (a device page copy — never a shared write) and resumes
+  prefill mid-page. :meth:`PrefixCache.acquire` pins the frontier node
+  for the duration of the admit so eviction triggered by the suffix
+  allocation cannot reclaim the copy source mid-flight.
+- **Insert.** When a prefill completes, the request's fully-prompt-
+  covered exclusive pages are adopted into the tree (refcount 1, held by
+  the inserting request's lease). Pages that ever take decode writes —
+  any page whose span extends past the prompt — are never adopted.
+- **Release + eviction.** ``release`` decrements refcounts; pages return
+  to the pool only at refcount zero *and* eviction. Cold refcount-0
+  leaves stay cached (that is the whole point) until pool pressure calls
+  :meth:`evict`, which reclaims LRU leaves — eviction degrades a future
+  admission to recompute, it NEVER touches a live request's pages
+  (refcount > 0 and interior nodes are untouchable). The ``eviction_storm``
+  chaos class soaks exactly this contract (docs/chaos.md).
+
+A speculative-decode engine shares ONE tree across its target and draft
+pools: each node carries a target page and (optionally) a draft page, so
+a cached prefix skips both the target prefill *and* the draft shadow
+prefill in lockstep, and eviction reclaims both pools' pages together.
+
+:func:`block_hashes` exposes the same chained block hashing for the
+router's prefix-affinity tiebreak (``serve/router.py``) without leaking
+radix construction out of this module.
+
+Thread contract: like the engine's slot tables, the tree mutates only on
+the scheduler thread (single-writer); the integer stats the gauges read
+are safe to sample from other threads. docs/serving.md § prefix sharing.
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "PrefixCache",
+    "PrefixMatch",
+    "Lease",
+    "build_prefix_cache",
+    "block_hashes",
+    "selftest_prefix",
+]
+
+_DIGEST_SIZE = 16
+
+
+def _chain(parent_digest: bytes, block: np.ndarray) -> bytes:
+    """Chained block hash: commits to the whole prefix up to this block."""
+    return hashlib.blake2b(parent_digest + block.tobytes(),
+                           digest_size=_DIGEST_SIZE).digest()
+
+
+def block_hashes(tokens, page_len: int,
+                 limit: Optional[int] = None) -> List[str]:
+    """Chained hashes of the full token blocks in ``tokens`` — the same
+    key space the radix tree indexes, exported so the router can score
+    prefix affinity without building trees of its own (check_patterns
+    rule 9). ``limit`` caps the number of blocks hashed."""
+    toks = np.asarray(tokens, np.int32).ravel()
+    n_blocks = len(toks) // int(page_len)
+    if limit is not None:
+        n_blocks = min(n_blocks, int(limit))
+    out: List[str] = []
+    digest = b""
+    for j in range(n_blocks):
+        digest = _chain(digest, toks[j * page_len:(j + 1) * page_len])
+        out.append(digest.hex())
+    return out
+
+
+class _RadixNode:
+    """One cached block: a full page of KV for one token block.
+
+    ``page`` (and ``draft_page`` when the tree spans a draft pool) stay
+    in the pool's allocated set while the node lives — the tree owns
+    them; ``refcount`` counts live requests leasing the page, and
+    ``stamp`` is a logical LRU clock (deterministic — no wall time, so
+    chaos replay stays byte-identical)."""
+
+    __slots__ = ("digest", "tokens", "page", "draft_page", "parent",
+                 "children", "refcount", "stamp")
+
+    def __init__(self, digest: bytes, tokens: np.ndarray, page: int,
+                 parent: "_RadixNode", draft_page: Optional[int] = None):
+        self.digest = digest
+        self.tokens = tokens
+        self.page = int(page)
+        self.draft_page = draft_page if draft_page is None else int(draft_page)
+        self.parent = parent
+        self.children: Dict[bytes, "_RadixNode"] = {}
+        self.refcount = 0
+        self.stamp = 0
+
+
+@dataclass
+class PrefixMatch:
+    """Result of one prompt walk: what can be leased instead of computed.
+
+    ``nodes`` are the matched full blocks root-down; ``tail_node`` /
+    ``tail_len`` describe the COW frontier (the first ``tail_len`` tokens
+    of ``tail_node``'s block match the prompt's next partial block);
+    ``lookups`` is how many full blocks the walk attempted (the hit-rate
+    denominator)."""
+
+    nodes: List[_RadixNode] = field(default_factory=list)
+    tail_node: Optional[_RadixNode] = None
+    tail_len: int = 0
+    lookups: int = 0
+
+    @property
+    def n_full(self) -> int:
+        return len(self.nodes)
+
+    @property
+    def hit(self) -> bool:
+        return bool(self.nodes) or self.tail_len > 0
+
+
+@dataclass
+class Lease:
+    """A live request's claim on tree pages (matched at admit + adopted
+    at insert). ``tail_node`` is the temporarily-pinned COW source —
+    dropped via :meth:`PrefixCache.unpin_tail` once the copy landed."""
+
+    nodes: List[_RadixNode] = field(default_factory=list)
+    tail_node: Optional[_RadixNode] = None
+
+    @property
+    def pages(self) -> List[int]:
+        return [nd.page for nd in self.nodes]
+
+    @property
+    def draft_pages(self) -> List[int]:
+        return [nd.draft_page for nd in self.nodes
+                if nd.draft_page is not None]
+
+
+class PrefixCache:
+    """Refcounted radix tree mapping token-block prefixes to pool pages.
+
+    Owns no device arrays — like the page pool it is pure host
+    bookkeeping; the engine performs the actual page-table prepends and
+    the COW device copy. ``pool`` (and the optional paired ``draft_pool``)
+    must be the same allocators the engine's tables draw from: adopted
+    pages stay in the pool's allocated set until :meth:`evict` reclaims
+    them, so physical utilization keeps counting shared pages exactly
+    once.
+    """
+
+    def __init__(self, pool, page_len: int, draft_pool=None):
+        self.pool = pool
+        self.page_len = int(page_len)
+        self.draft_pool = draft_pool
+        self._root = _RadixNode(b"", np.zeros(0, np.int32), -1, None)
+        #: page id -> owning node (every tree-owned target-pool page).
+        self._owned: Dict[int, _RadixNode] = {}
+        self._clock = 0
+        # Stats (read by gauges from other threads; ints only).
+        self.hits = 0            # full blocks served from the tree
+        self.lookups = 0         # full blocks attempted
+        self.evictions = 0       # nodes reclaimed under pressure
+        self.inserts = 0         # pages adopted into the tree
+        self.cow_copies = 0      # engine-reported frontier copies
+
+    # ---------------------------------------------------------------- match
+    def match(self, tokens) -> PrefixMatch:
+        """Walk ``tokens`` down the tree. Caps full-block matching at
+        ``(len - 1) // page_len`` so the final prompt token (at least)
+        always prefills; then probes the divergence block for the longest
+        partially-matching child (the COW frontier). Pure lookup — no
+        refcounts move until :meth:`acquire`."""
+        toks = np.asarray(tokens, np.int32).ravel()
+        L = self.page_len
+        limit = max(0, (len(toks) - 1) // L)
+        m = PrefixMatch()
+        node, digest = self._root, b""
+        j = 0
+        while j < limit:
+            block = toks[j * L:(j + 1) * L]
+            digest = _chain(digest, block)
+            child = node.children.get(digest)
+            if child is None or not np.array_equal(child.tokens, block):
+                break
+            m.nodes.append(child)
+            node, j = child, j + 1
+        m.lookups = min(limit, j + 1) if limit else 0
+        self.lookups += m.lookups
+        self.hits += len(m.nodes)
+        # COW frontier: longest leading run of the next (partial) block
+        # shared with a cached child. Never a full block — a full match
+        # would have hash-matched above.
+        t_max = min(L - 1, len(toks) - 1 - j * L)
+        if t_max > 0:
+            want = toks[j * L:j * L + t_max]
+            best, best_len = None, 0
+            for child in node.children.values():
+                common = int(np.argmin(np.concatenate(
+                    (child.tokens[:t_max] == want, [False]))))
+                if common > best_len:
+                    best, best_len = child, common
+            if best is not None:
+                m.tail_node, m.tail_len = best, best_len
+        return m
+
+    # ------------------------------------------------------------- leasing
+    def acquire(self, m: PrefixMatch) -> Lease:
+        """Refcount++ every matched node (and pin the COW frontier node so
+        eviction during this admit's suffix allocation cannot reclaim the
+        copy source). Pair with :meth:`release` (retire) or
+        :meth:`cancel` (admission failed after match)."""
+        self._clock += 1
+        for nd in m.nodes:
+            nd.refcount += 1
+            nd.stamp = self._clock
+        if m.tail_node is not None:
+            m.tail_node.refcount += 1
+            m.tail_node.stamp = self._clock
+        return Lease(nodes=list(m.nodes), tail_node=m.tail_node)
+
+    def unpin_tail(self, lease: Lease) -> None:
+        """Drop the COW-source pin once the frontier copy landed (or was
+        skipped)."""
+        if lease.tail_node is not None:
+            lease.tail_node.refcount -= 1
+            lease.tail_node = None
+
+    def cancel(self, lease: Lease) -> None:
+        """Admission fell through after :meth:`acquire`: roll every
+        refcount back (including the tail pin)."""
+        self.unpin_tail(lease)
+        for nd in lease.nodes:
+            nd.refcount -= 1
+        lease.nodes = []
+
+    def release(self, lease: Lease) -> None:
+        """Retire a request's claim. Pages stay tree-owned (cached) at
+        refcount zero — only :meth:`evict` returns them to the pool."""
+        self.unpin_tail(lease)
+        for nd in lease.nodes:
+            nd.refcount -= 1
+            if nd.refcount < 0:
+                raise ValueError(
+                    f"prefix refcount underflow on page {nd.page}")
+        lease.nodes = []
+
+    # -------------------------------------------------------------- insert
+    def insert(self, tokens, pages: List[int], lease: Lease,
+               draft_pages: Optional[List[int]] = None) -> int:
+        """Adopt the request's novel fully-prompt-covered blocks into the
+        tree (called once, when its prefill completes). ``pages`` is the
+        request's page list in timeline order; block ``j`` is adoptable
+        only when the whole page holds prompt KV (``(j+1) * page_len <=
+        len(tokens)``) — pages that will take decode/verify writes are
+        never shared. Already-present blocks are skipped (the request
+        keeps its exclusive page; a concurrent duplicate prefill loses
+        the adoption race harmlessly). Adopted nodes join ``lease`` at
+        refcount 1. Returns pages adopted."""
+        toks = np.asarray(tokens, np.int32).ravel()
+        L = self.page_len
+        n_full = len(toks) // L
+        self._clock += 1
+        node, digest, adopted = self._root, b"", 0
+        for j in range(n_full):
+            block = toks[j * L:(j + 1) * L]
+            digest = _chain(digest, block)
+            child = node.children.get(digest)
+            if child is not None and np.array_equal(child.tokens, block):
+                child.stamp = self._clock
+                node = child
+                continue
+            page = pages[j]
+            if page in self._owned:      # defensive: never double-own
+                break  # pragma: no cover - unreachable by contract
+            draft_page = (draft_pages[j] if draft_pages is not None
+                          and j < len(draft_pages) else None)
+            child = _RadixNode(digest, block.copy(), page, node,
+                               draft_page=draft_page)
+            child.refcount = 1
+            child.stamp = self._clock
+            node.children[digest] = child
+            self._owned[page] = child
+            lease.nodes.append(child)
+            node = child
+            adopted += 1
+        self.inserts += adopted
+        return adopted
+
+    # ------------------------------------------------------------- eviction
+    def evict(self, n_pages: int) -> int:
+        """Reclaim up to ``n_pages`` target-pool pages from cold leaves,
+        LRU-first. Only refcount-0 LEAVES are candidates — a live
+        request's pages (refcount > 0) and interior nodes (a child still
+        commits to them) are untouchable, so eviction can only ever cost
+        a future admission a recompute. Returns target pages reclaimed."""
+        freed = 0
+        while freed < n_pages:
+            victim = None
+            for nd in self._owned.values():
+                if nd.refcount == 0 and not nd.children and (
+                        victim is None or nd.stamp < victim.stamp):
+                    victim = nd
+            if victim is None:
+                break
+            self._drop(victim)
+            freed += 1
+        return freed
+
+    def _drop(self, nd: _RadixNode) -> None:
+        del nd.parent.children[nd.digest]
+        del self._owned[nd.page]
+        self.pool.reclaim([nd.page])
+        if nd.draft_page is not None and self.draft_pool is not None:
+            self.draft_pool.reclaim([nd.draft_page])
+        self.evictions += 1
+
+    def purge(self) -> int:
+        """Evict EVERY refcount-0 block (leaves first, repeatedly) — the
+        drain-time leak check: after purge, a balanced system's pools are
+        back to empty. Returns pages reclaimed."""
+        total = 0
+        while True:
+            freed = self.evict(len(self._owned) or 1)
+            total += freed
+            if freed == 0:
+                return total
+
+    # ------------------------------------------------------------ accounting
+    @property
+    def cached_pages(self) -> int:
+        """Tree-owned target-pool pages (shared + cold)."""
+        return len(self._owned)
+
+    @property
+    def shared_pages(self) -> int:
+        """Tree pages currently leased by at least one live request —
+        the ``serve_prefix_shared_pages`` gauge."""
+        return sum(1 for nd in self._owned.values() if nd.refcount > 0)
+
+    @property
+    def live_refcount(self) -> int:
+        """Sum of refcounts — zero at drain when every lease balanced."""
+        return sum(nd.refcount for nd in self._owned.values())
+
+    @property
+    def hit_rate(self) -> float:
+        """Block-level hit rate since construction, 0..1."""
+        return self.hits / self.lookups if self.lookups else 0.0
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "hit_rate": self.hit_rate,
+            "hits": self.hits,
+            "lookups": self.lookups,
+            "cached_pages": self.cached_pages,
+            "shared_pages": self.shared_pages,
+            "evictions": self.evictions,
+            "inserts": self.inserts,
+            "cow_copies": self.cow_copies,
+            "live_refcount": self.live_refcount,
+        }
+
+
+def build_prefix_cache(pool, page_len: int, draft_pool=None) -> PrefixCache:
+    """The one constructor call sites use (check_patterns rule 9 bans
+    radix construction outside this module, exactly like rule 8 for the
+    page allocator)."""
+    return PrefixCache(pool, page_len, draft_pool=draft_pool)
+
+
+def selftest_prefix(max_new: int = 8, seed: int = 0) -> int:
+    """The ``--selftest-prefix`` acceptance proof; returns an exit code.
+
+    Bars (ISSUE 16), on a system-prompt-heavy workload — 96 shared tokens
+    (12 full blocks) + an 8-token unique suffix per request — at EQUAL
+    pool bytes (both engines: 43 pages of 8):
+
+    - **>= 5x TTFT p50** for cached admissions vs the sharing-off
+      control: a warm admission prefills 1 chunk (the suffix) instead of
+      13 (the whole prompt);
+    - **>= 2x admitted concurrency** vs sharing-off: 12 shared pages map
+      once, so each extra request costs 2 exclusive pages instead of 14;
+    - **bit-identical streams** to the sharing-off control on every
+      path: cold insert, warm match, mid-page COW divergence, and
+      mid-batch joins through the continuous batcher;
+    - **balanced accounting at drain**: live refcounts return to zero,
+      and ``purge()`` returns every cached page — zero leaked pages;
+    - **compiled-programs pin unchanged**: 2 on the plain engine (the
+      COW page copy is data movement, not a counted program), 5 on the
+      speculative engine whose draft pool shares the same tree.
+    """
+    import json
+    import time
+
+    import jax
+
+    from autodist_tpu.models.transformer import (
+        TransformerConfig, decode_model, init_params)
+    from autodist_tpu.serve.batcher import ContinuousBatcher, RequestState
+    from autodist_tpu.serve.engine import AdmissionDenied, InferenceEngine
+
+    t_start = time.monotonic()
+    rng = np.random.default_rng(seed)
+    import jax.numpy as jnp
+    # fp32 so CPU argmaxes are exact — the bit-identity bars compare
+    # greedy streams, not probabilities.
+    cfg = TransformerConfig(
+        vocab_size=128, num_layers=2, d_model=32, num_heads=2, d_ff=64,
+        max_seq_len=128, causal=True, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    dm = decode_model(cfg)
+    build = dict(n_slots=16, page_len=8, n_pages=43, prefill_chunk=8,
+                 max_len=112)
+    base = InferenceEngine.build(params, decode_model=dm, **build)
+    # Same params, same plan, same pool bytes — the ONLY delta is the tree.
+    shared = InferenceEngine(params, base.plan, decode_model=dm,
+                             prefix_cache=True, **build)
+    cache = shared.prefix_cache
+
+    system = rng.integers(1, 128, size=96).astype(np.int32)
+
+    def prompt_with_suffix():
+        return np.concatenate(
+            [system, rng.integers(1, 128, size=8)]).astype(np.int32)
+
+    prompts = [prompt_with_suffix() for _ in range(12)]
+
+    # ---- bit-identity: sharing-off control, then cold (insert) and warm
+    # (match) passes through the sharing engine.
+    expected = [base.generate(p, max_new) for p in prompts]
+    parity_cold = [shared.generate(p, max_new) for p in prompts] == expected
+    hits_after_cold = cache.hits
+    parity_warm = [shared.generate(p, max_new) for p in prompts] == expected
+    warm_hit = cache.hits > hits_after_cold
+
+    # ---- COW frontier: diverge MID-page (first 4 suffix tokens shared
+    # with a cached block, then different) — the engine must copy exactly
+    # that one frontier page, never write the shared one.
+    cow_before = cache.cow_copies
+    cow_prompt = np.concatenate(
+        [prompts[0][:100], rng.integers(1, 128, size=4)]).astype(np.int32)
+    cow_parity = (shared.generate(cow_prompt, max_new)
+                  == base.generate(cow_prompt, max_new))
+    cow_seen = cache.cow_copies > cow_before
+
+    # ---- TTFT: admit -> first token, timed on the scheduler path both
+    # engines share (admit + prefill_step loop). Both engines are already
+    # JIT-warm from the parity passes, so this times chunks, not compiles.
+    def ttft_samples(engine, n=9):
+        out = []
+        for _ in range(n):
+            p = prompt_with_suffix()
+            t0 = time.perf_counter()
+            slot = engine.admit(p, max_new)
+            if isinstance(slot, AdmissionDenied):
+                raise RuntimeError(f"selftest admit denied: {slot.reason}")
+            first = None
+            while first is None:
+                first = engine.prefill_step(slot)
+            out.append(time.perf_counter() - t0)
+            engine.release(slot)
+        return sorted(out)
+
+    def p50(xs):
+        return xs[len(xs) // 2]
+
+    ttft_off = p50(ttft_samples(base))
+    ttft_on = p50(ttft_samples(shared))
+    ttft_x = ttft_off / max(ttft_on, 1e-9)
+
+    # ---- admitted concurrency at equal pool bytes: admit until the pool
+    # says no (no stepping — this measures reservation capacity). Under
+    # sharing, pressure first evicts cold refcount-0 leaves; the leased
+    # shared chain is untouchable.
+    def admitted_concurrency(engine):
+        slots = []
+        while True:
+            s = engine.admit(prompt_with_suffix(), max_new)
+            if isinstance(s, AdmissionDenied):
+                break
+            slots.append(s)
+        n = len(slots)
+        for s in slots:
+            engine.release(s)
+        return n
+
+    conc_off = admitted_concurrency(base)
+    conc_on = admitted_concurrency(shared)
+    conc_x = conc_on / max(conc_off, 1)
+
+    # ---- mid-batch joins: concurrent mixed load through the batcher,
+    # every stream bit-identical, cached admissions visibly flagged.
+    batcher = ContinuousBatcher(shared, max_queue=32).start()
+    reqs = [batcher.submit(prompts[i % len(prompts)], max_new)
+            for i in range(24)]
+    states = [r.wait(120.0).state for r in reqs]
+    batcher.stop(drain=False)
+    batch_done = all(s is RequestState.DONE for s in states)
+    batch_parity = all(r.tokens == expected[i % len(prompts)]
+                       for i, r in enumerate(reqs))
+    cached_seen = any(r.cached for r in reqs)
+
+    # ---- drain accounting: refcounts to zero, purge returns every page.
+    drained = (cache.live_refcount == 0
+               and shared.pool.used_pages == cache.cached_pages)
+    cache.purge()
+    leak_free = (shared.pool.used_pages == 0
+                 and shared.pool.free_pages == shared.pool.usable_pages)
+    base_clean = base.pool.used_pages == 0
+
+    # ---- speculative rider: ONE tree spans target + draft pools; warm
+    # re-admission skips both prefills; the 5-program pin holds.
+    from autodist_tpu.serve.spec import SpecDecodeEngine
+    spec = SpecDecodeEngine(
+        params, base.plan, params, base.plan, decode_model=dm,
+        draft_decode_model=dm, spec_k=4, draft_n_pages=43,
+        prefix_cache=True, **build)
+    spec_cold = [spec.generate(p, max_new) for p in prompts[:4]]
+    spec_warm = [spec.generate(p, max_new) for p in prompts[:4]]
+    spec_parity = (spec_cold == expected[:4] and spec_warm == expected[:4])
+    spec_hits = spec.prefix_cache.hits > 0
+    spec.prefix_cache.purge()
+    spec_balanced = (spec.pool.used_pages == 0
+                     and spec.draft_pool.used_pages == 0)
+
+    ok = (
+        parity_cold and parity_warm and warm_hit
+        and cow_parity and cow_seen
+        and ttft_x >= 5.0
+        and conc_x >= 2.0
+        and batch_done and batch_parity and cached_seen
+        and drained and leak_free and base_clean
+        and base.compiled_programs == 2
+        and shared.compiled_programs == 2
+        and spec_parity and spec_hits and spec_balanced
+        and spec.compiled_programs == 5
+    )
+    line = {
+        "selftest": "autodist_tpu.serve.prefix",
+        "ok": bool(ok),
+        "ttft_uncached_p50_s": round(ttft_off, 6),
+        "ttft_cached_p50_s": round(ttft_on, 6),
+        "ttft_speedup_x": round(ttft_x, 2),
+        "admitted_sharing_off": conc_off,
+        "admitted_sharing_on": conc_on,
+        "concurrency_x": round(conc_x, 2),
+        "parity_cold": bool(parity_cold),
+        "parity_warm": bool(parity_warm),
+        "cow_parity": bool(cow_parity),
+        "cow_copies": cache.cow_copies,
+        "batch_done": bool(batch_done),
+        "batch_parity": bool(batch_parity),
+        "cached_requests_seen": bool(cached_seen),
+        "hit_rate": round(cache.hit_rate, 4),
+        "refcounts_drained": bool(drained),
+        "pages_leak_free": bool(leak_free),
+        "programs_plain": shared.compiled_programs,
+        "programs_spec": spec.compiled_programs,
+        "spec_parity": bool(spec_parity),
+        "duration_s": round(time.monotonic() - t_start, 1),
+        "device": jax.devices()[0].platform,
+    }
+    print(json.dumps(line))
+    return 0 if ok else 1

@@ -63,7 +63,7 @@ import math
 import os
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
@@ -77,6 +77,7 @@ from autodist_tpu.obs import recorder as obs_recorder
 from autodist_tpu.obs import spans as obs_spans
 from autodist_tpu.obs.sentry import Sentry, SentryConfig
 from autodist_tpu.obs.slo import SLOSpec, SLOTracker
+from autodist_tpu.serve import prefix as serve_prefix
 from autodist_tpu.serve.batcher import (
     Backpressure,
     GenRequest,
@@ -89,6 +90,12 @@ from autodist_tpu.utils import logging, retry
 __all__ = ["Router", "RouterConfig", "selftest_router"]
 
 _router_ids = itertools.count()
+
+# Prefix-affinity bounds: hash at most this many leading blocks per
+# prompt (system prompts live in the first pages; hashing a 1M-token
+# prompt buys no routing signal) and cap each replica's warm set (LRU).
+_AFFINITY_BLOCKS = 32
+_WARM_CAP = 4096
 
 
 @dataclass(frozen=True)
@@ -201,6 +208,14 @@ class Router:
         self._admin_draining: set = set()        # rolling-upgrade holdout
         self._scores: Dict[int, float] = {}
         self._dispatches: Dict[int, int] = {rid: 0 for rid in self.replicas}
+        # Prefix-affinity warm sets: per-replica bounded LRU of the
+        # token-block hashes (serve/prefix.py chained digests) recently
+        # dispatched there — the routing-side mirror of each replica's
+        # radix cache. Purely advisory: affinity is a TIEBREAK under the
+        # least-outstanding x straggler weight, so a cold replica still
+        # gets work and a warm one never absorbs an overload.
+        self._warm: Dict[int, "OrderedDict[str, None]"] = {}
+        self._affinity_page_len: Optional[int] = 0   # 0 = not probed yet
         self._running = False
         self._stopped = False
         self._thread: Optional[threading.Thread] = None
@@ -631,6 +646,10 @@ class Router:
         """A replica died: every in-flight request assigned to it reroutes
         to a survivor (harvest first — tokens its batcher delivered before
         dying are client-visible and anchor the resume watermark)."""
+        # Its radix cache died with it: forget the warm set (the failover
+        # re-prefills repopulate the SURVIVOR's tree, and _record_warm
+        # tracks those dispatches like any other).
+        self._warm.pop(rid, None)
         with self._lock:
             victims = [f for f in self._flights.values()
                        if f.replica_id == rid]
@@ -685,14 +704,22 @@ class Router:
                 ttft = front.t_first_token - front.t_submit
                 self._h_ttft.observe(ttft)
                 self.slo.observe(ttft_s=ttft)
-                # The SENTRY's TTFT is dispatch-relative — the replica's
-                # own first-token latency. Submit-relative TTFT grows
-                # with router queue depth under load, which would read as
-                # a per-replica regression and demote healthy replicas.
-                if flight.t_dispatch is not None:
-                    self._observe_serve(
-                        ttft_s=front.t_first_token - flight.t_dispatch,
-                        replica_id=flight.replica_id)
+                # The SENTRY's TTFT is the replica's own admit-to-first-
+                # token latency (GenRequest.ttft_s, admission-relative) —
+                # never submit- or dispatch-relative: those grow with the
+                # router's / backend's queue depth under load, which
+                # would read as a per-replica regression and demote
+                # healthy replicas, and a cached-prefix admission whose
+                # prefill collapses to one chunk would otherwise inherit
+                # the queue wait (ISSUE 16 TTFT attribution).
+                backend_ttft = getattr(backend, "ttft_s", None)
+                if backend_ttft is None and flight.t_dispatch is not None:
+                    # Remote stubs without a ttft_s surface: dispatch-
+                    # relative is the closest per-replica measure left.
+                    backend_ttft = front.t_first_token - flight.t_dispatch
+                if backend_ttft is not None:
+                    self._observe_serve(ttft_s=backend_ttft,
+                                        replica_id=flight.replica_id)
             if flight.t_backend_fail is not None:
                 # First client-visible token after a failover: the
                 # failover latency the bench line reports.
@@ -819,16 +846,71 @@ class Router:
                     if s is ReplicaState.READY
                     and self.replicas[rid].batcher is not None]
 
-    def _rank(self, candidates: List[int]) -> List[int]:
+    def _rank(self, candidates: List[int],
+              hashes: tuple = ()) -> List[int]:
         """Least outstanding work, weighted by straggler score (a 2x-slow
-        replica counts as twice as loaded); ties break to the lowest id
-        for determinism."""
+        replica counts as twice as loaded); among equally-loaded
+        replicas, the one holding the WARMEST prefix (deepest leading
+        run of ``hashes`` in its warm set — a cached-prefix admission
+        there skips that much prefill) wins; remaining ties break to
+        the lowest id for determinism."""
         def weight(rid: int) -> float:
             load = self.replicas[rid].outstanding + 1
             score = max(1.0, float(self._scores.get(rid, 1.0)))
             return load * score
 
-        return sorted(candidates, key=lambda rid: (weight(rid), rid))
+        return sorted(candidates, key=lambda rid: (
+            weight(rid), -self._affinity(rid, hashes), rid))
+
+    # ------------------------------------------------------ prefix affinity
+    def _block_page_len(self) -> Optional[int]:
+        """The fleet's KV page length (block size of the prefix hashes),
+        probed once from any in-process replica engine; None when no
+        replica exposes one — affinity then degrades to a no-op and
+        routing is exactly the pre-affinity ordering."""
+        if self._affinity_page_len == 0:
+            page_len = None
+            for rep in self.replicas.values():
+                engine = getattr(getattr(rep, "batcher", None),
+                                 "engine", None)
+                if engine is not None and getattr(engine, "page_len", 0):
+                    page_len = int(engine.page_len)
+                    break
+            self._affinity_page_len = page_len
+        return self._affinity_page_len
+
+    def _affinity_hashes(self, prompt) -> tuple:
+        page_len = self._block_page_len()
+        if not page_len or len(prompt) < page_len:
+            return ()
+        return tuple(serve_prefix.block_hashes(
+            np.asarray(prompt, np.int32), page_len,
+            limit=_AFFINITY_BLOCKS))
+
+    def _affinity(self, rid: int, hashes: tuple) -> int:
+        """Warm-prefix depth: leading blocks of ``hashes`` this replica
+        has recently prefilled (its radix cache plausibly still holds
+        them — eviction over there only costs recompute, never
+        correctness, so stale advice is safe)."""
+        warm = self._warm.get(rid)
+        if not warm or not hashes:
+            return 0
+        depth = 0
+        for h in hashes:
+            if h not in warm:
+                break
+            depth += 1
+        return depth
+
+    def _record_warm(self, rid: int, hashes: tuple) -> None:
+        if not hashes:
+            return
+        warm = self._warm.setdefault(rid, OrderedDict())
+        for h in hashes:
+            warm.pop(h, None)
+            warm[h] = None
+        while len(warm) > _WARM_CAP:
+            warm.popitem(last=False)
 
     def _dispatch(self) -> None:
         saturated: set = set()
@@ -840,10 +922,19 @@ class Router:
             candidates = [r for r in self._routable() if r not in saturated]
             if not candidates:
                 return  # nothing routable: stay queued (bounded at submit)
+            # Affinity keys off the ORIGINAL prompt (front.prompt): on a
+            # failover resume the delivered tokens re-prefill on the
+            # survivor anyway, repopulating its tree — the shared system
+            # prefix is what affinity can actually reuse.
+            hashes = self._affinity_hashes(flight.front.prompt)
             dispatched = False
-            for rid in self._rank(candidates):
+            for rid in self._rank(candidates, hashes):
                 if self._dispatch_one(flight, rid):
                     dispatched = True
+                    if not flight.front.done:
+                        # Really dispatched (not a queue-expiry/terminal
+                        # rejection): these blocks are now warming there.
+                        self._record_warm(rid, hashes)
                     break
                 saturated.add(rid)
             if not dispatched:
@@ -1066,6 +1157,7 @@ class Router:
                 # local drain journal would re-serve them on a naive
                 # fleet recover — consume it now.
                 self._consume_replica_journal(rep)
+                self._warm.pop(rid, None)   # fresh engine = cold radix tree
                 rep.restart()
                 ready = rep.wait_ready(ready_timeout_s)
             finally:
@@ -1110,7 +1202,8 @@ def build_test_fleet(n_replicas: int = 3, n_slots: int = 8,
                      journal_dir: Optional[str] = None,
                      registry: Optional[M.MetricsRegistry] = None,
                      config: Optional[RouterConfig] = None,
-                     spec_decode: bool = False, spec_k: int = 4):
+                     spec_decode: bool = False, spec_k: int = 4,
+                     prefix_cache: bool = False):
     """An in-process CPU fleet for tests/chaos/bench: one plan compiled
     once (the byte-deterministic artifact a production factory would pull
     from ``plan/cache.py``), N replicas whose factories rebuild engine
@@ -1151,13 +1244,17 @@ def build_test_fleet(n_replicas: int = 3, n_slots: int = 8,
                 decode_model=decode_model(cfg),
                 draft_decode_model=decode_model(cfg),
                 spec_k=spec_k, n_slots=n_slots, page_len=page_len,
-                n_pages=n_pages, prefill_chunk=page_len)
+                n_pages=n_pages, prefill_chunk=page_len,
+                prefix_cache=prefix_cache)
     else:
         def make_engine():
+            # prefix_cache=True gives every replica its OWN radix tree
+            # (trees are per-engine state, like slot tables): failover
+            # re-prefill then repopulates the survivor's tree organically.
             return InferenceEngine(
                 params, _shared_plan(params), decode_model=decode_model(cfg),
                 n_slots=n_slots, page_len=page_len, n_pages=n_pages,
-                prefill_chunk=page_len)
+                prefill_chunk=page_len, prefix_cache=prefix_cache)
 
     # The control/oracle engine is ALWAYS plain greedy: with a spec fleet
     # it is the independent decode path every delivered stream must match

@@ -418,7 +418,8 @@ def _tiny_cfg():
 
 
 def _tiny_engine(n_slots: int = 32, page_len: int = _PAGE_LEN,
-                 n_pages: Optional[int] = _N_PAGES):
+                 n_pages: Optional[int] = _N_PAGES,
+                 prefix_cache: bool = False):
     """CPU-sim paged engine: a tiny fp32 transformer through the full
     ``AutoDist.build_inference`` path (strategy → plan → engine).
     Returns ``(engine, params, cfg)`` so callers can stand a bucketed
@@ -439,6 +440,7 @@ def _tiny_engine(n_slots: int = 32, page_len: int = _PAGE_LEN,
         page_len=page_len,
         n_pages=n_pages,
         prefill_chunk=page_len,
+        prefix_cache=prefix_cache,
     )
     AutoDist.reset_default()
     return engine, params, cfg

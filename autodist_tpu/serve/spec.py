@@ -73,6 +73,7 @@ from autodist_tpu.chaos import hooks as chaos_hooks
 from autodist_tpu.obs import recorder as obs_recorder
 from autodist_tpu.obs import spans as obs_spans
 from autodist_tpu.serve import pages as serve_pages
+from autodist_tpu.serve import prefix as serve_prefix
 from autodist_tpu.serve.engine import (
     _DECODE,
     _PREFILL,
@@ -204,6 +205,15 @@ class SpecDecodeEngine(InferenceEngine):
         self._draft_prefill_fn = None
         self._draft_decode_fn = None
         self._verify_fn = None
+        self._draft_copy_fn = None
+        # Prefix sharing spans BOTH pools through ONE tree: each cached
+        # block carries a target page and a draft page, so a cached
+        # prefix skips the target prefill AND the draft shadow prefill
+        # in lockstep (serve/prefix.py). Rebuild the cache the base
+        # constructor made (target-only, still empty) over both pools.
+        if self._prefix_cache is not None:
+            self._prefix_cache = serve_prefix.build_prefix_cache(
+                self.pool, self.page_len, draft_pool=self.draft_pool)
         # Spec accounting (cumulative; the batcher computes deltas for the
         # acceptance-rate gauges and the SLO tracker).
         self.verify_invocations = 0
@@ -331,7 +341,7 @@ class SpecDecodeEngine(InferenceEngine):
         # starved draft never blocks admission — the slot just serves at
         # plain-decode cadence (acceptance 0 against an all-scratch draft
         # timeline).
-        table = self.draft_pool.alloc(prompt_len + 1)
+        table = self._build_draft_table(idx, prompt_len)
         if table is None:
             self._draft_tables[idx] = None
             self._draft_table_np[idx] = serve_pages.SCRATCH_PAGE
@@ -341,6 +351,79 @@ class SpecDecodeEngine(InferenceEngine):
             self._draft_table_np[idx] = table.padded(self.max_pages)
         self._draft_decode_np[idx] = serve_pages.SCRATCH_PAGE
         return got
+
+    def _build_draft_table(
+            self, idx: int, prompt_len: int
+    ) -> Optional[serve_pages.PageTable]:
+        """The draft-side page reservation for a freshly admitted slot.
+
+        With sharing off: one best-effort ``prompt_len + 1`` allocation.
+        With sharing on, the tree's leased nodes carry draft pages too —
+        the draft table maps the SAME shared prefix and allocates only
+        the suffix, with the draft-side COW mirroring the target's
+        frontier copy. The draft shadow prefill starts at the target's
+        ``_prefill_start``, so when any leased block lacks a draft page
+        (its inserter was draft-starved) the draft timeline cannot be
+        made whole — the slot degrades to plain cadence (starved), never
+        to garbage-KV proposals being silently trusted (verification
+        would catch them anyway; this just keeps acceptance honest)."""
+        lease = self._leases[idx]
+        if lease is None:
+            return self.draft_pool.alloc(prompt_len + 1)
+        n_full = len(lease.nodes)
+        start = int(self._prefill_start[idx])
+        tail_len = start - n_full * self.page_len
+        draft_shared = [nd.draft_page for nd in lease.nodes]
+        tail = lease.tail_node
+        sharable = all(p is not None for p in draft_shared) and (
+            tail_len == 0 or (tail is not None
+                              and tail.draft_page is not None))
+        if not sharable:
+            return None
+        table = self._draft_alloc_with_evict(
+            prompt_len + 1 - n_full * self.page_len)
+        if table is None:
+            return None
+        if tail_len:
+            self._cow_draft_page(tail.draft_page, table.pages[0])
+        table.pages[:0] = draft_shared
+        return table
+
+    def _draft_alloc_with_evict(
+            self, n_tokens: int) -> Optional[serve_pages.PageTable]:
+        """Draft-pool allocation with tree eviction retry: cold cached
+        prefixes hold draft pages too, so draft pressure reclaims LRU
+        leaves (freeing BOTH pools' pages) before starving the draft."""
+        table = self.draft_pool.alloc(n_tokens)
+        need = serve_pages.pages_for_tokens(n_tokens, self.page_len)
+        while table is None and self._prefix_cache is not None:
+            if self._prefix_cache.evict(need) == 0:
+                return None
+            table = self.draft_pool.alloc(n_tokens)
+        return table
+
+    def _cow_draft_page(self, src_page: int, dst_page: int) -> None:
+        """The draft cache's copy-on-write frontier copy — same program
+        shape as the target's (engine._make_page_copy_fn), over the
+        draft pool's arrays."""
+        if self._draft_copy_fn is None:
+            self._draft_copy_fn = self._make_page_copy_fn(
+                self.draft_pool.n_pages, self._draft_cache_sh)
+        with obs_spans.span("serve.cow_copy_draft", src=int(src_page),
+                            dst=int(dst_page)):
+            self._draft_cache = self._draft_copy_fn(
+                self._draft_cache, jnp.int32(src_page), jnp.int32(dst_page))
+
+    def _insert_prefix(self, idx: int, prompt: np.ndarray) -> None:
+        """Adopt target AND draft pages as one node per novel block —
+        the draft side only when this slot's draft table actually holds
+        the prompt's KV (a starved draft adopts target-only nodes, which
+        later admissions then cannot draft-share)."""
+        draft_table = self._draft_tables[idx]
+        self._prefix_cache.insert(
+            prompt, self._tables[idx].pages, self._leases[idx],
+            draft_pages=(draft_table.pages if draft_table is not None
+                         else None))
 
     def _sync_draft_row(self, idx: int) -> None:
         """Refresh both table views after the slot's draft table changed
@@ -356,8 +439,20 @@ class SpecDecodeEngine(InferenceEngine):
     def release(self, slot: Slot) -> None:
         idx = slot.index
         table = self._draft_tables[idx]
+        lease = self._leases[idx]
         if table is not None:
-            self.draft_pool.release(table)
+            if lease is not None:
+                # Tree-owned draft pages only drop their (shared) node
+                # refcount — super().release() decrements it once for
+                # both pools; exclusive draft pages recycle now.
+                shared = {nd.draft_page for nd in lease.nodes
+                          if nd.draft_page is not None}
+                exclusive = [p for p in table.pages if p not in shared]
+                if exclusive:
+                    self.draft_pool.reclaim(exclusive)
+                table.pages = []
+            else:
+                self.draft_pool.release(table)
         self._draft_tables[idx] = None
         self._draft_table_np[idx] = serve_pages.SCRATCH_PAGE
         self._draft_decode_np[idx] = serve_pages.SCRATCH_PAGE

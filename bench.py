@@ -672,20 +672,25 @@ def _serve_decode_bench(n_requests: int = 48, max_new: int = 10) -> dict:
 
     registry = M.MetricsRegistry()
     rng = np.random.default_rng(0)
-    engine, _params, _cfg = _tiny_engine(n_slots=32)
+    engine, _params, _cfg = _tiny_engine(n_slots=32, prefix_cache=True)
     engine.generate(rng.integers(1, 127, size=6), max_new)  # warm compiles
 
     batcher = ContinuousBatcher(engine, max_queue=max(n_requests, 64),
                                 registry=registry)
     util_peak = {"v": 0.0}
+    # The selftest's canonical mixed load (mock_load_prompt), with the
+    # second half of the request stream repeating the first half's
+    # prompts — the repeat traffic is what exercises the COW prefix
+    # cache, so the bench line carries a real prefix_hit_rate and a
+    # cached-TTFT percentile next to the uncached one.
+    base_prompts = [mock_load_prompt(rng, i)
+                    for i in range(max(n_requests // 2, 1))]
 
     async def run():
         async def client(i):
             await asyncio.sleep(0.001 * (i % 8))
-            # The selftest's canonical mixed load (mock_load_prompt): the
-            # bench measures the same workload the acceptance bar proves.
             return await async_generate(
-                batcher, mock_load_prompt(rng, i), max_new)
+                batcher, base_prompts[i % len(base_prompts)], max_new)
 
         async def sampler():
             while True:
@@ -713,6 +718,10 @@ def _serve_decode_bench(n_requests: int = 48, max_new: int = 10) -> dict:
     lat = snap.get("serve_request_latency_s", {})
     ttft = snap.get("serve_ttft_s", {})
     itl = snap.get("serve_itl_s", {})
+    ttft_cached = snap.get("serve_ttft_cached_s", {})
+    if not isinstance(ttft_cached, dict):
+        ttft_cached = {}
+    hit_rate = snap.get("serve_prefix_hit_rate", float("nan"))
     return {"bench_serve": {
         "decode_tokens_per_sec": round(
             float(snap.get("serve_decode_tokens_per_sec", 0.0)), 1),
@@ -723,6 +732,9 @@ def _serve_decode_bench(n_requests: int = 48, max_new: int = 10) -> dict:
         "ttft_p99_s": round(ttft.get("p99", float("nan")), 4),
         "itl_p50_s": round(itl.get("p50", float("nan")), 4),
         "itl_p99_s": round(itl.get("p99", float("nan")), 4),
+        "ttft_cached_p50_s": round(
+            ttft_cached.get("p50", float("nan")), 4),
+        "prefix_hit_rate": round(float(hit_rate), 4),
         "page_utilization_peak": round(util_peak["v"], 4),
         "n_requests": n_requests,
         "completed": completed,

@@ -49,8 +49,9 @@ class TestSLOTracker:
         assert set(report) == {"slo", "measured", "burn_rate", "counts",
                                "compliant"}
         assert set(report["measured"]) == {
-            "ttft_p50_s", "ttft_p99_s", "itl_p50_s", "itl_p99_s",
-            "queue_wait_p99_s", "availability", "error_rate",
+            "ttft_p50_s", "ttft_p99_s", "ttft_cached_p50_s",
+            "ttft_uncached_p50_s", "prefix_hit_rate", "itl_p50_s",
+            "itl_p99_s", "queue_wait_p99_s", "availability", "error_rate",
             "acceptance_rate"}
         assert set(report["burn_rate"]) == {"fast", "slow", "windows_s"}
         assert set(report["counts"]) == {"requests", "errors", "sheds",
@@ -147,6 +148,43 @@ class TestSLOTracker:
         assert report["counts"]["requests"] == 21
         assert report["counts"]["sheds"] == 1
         assert report["measured"]["ttft_p50_s"] == pytest.approx(0.3)
+
+
+# ------------------------------------------------------ TTFT attribution
+class TestTTFTAttribution:
+    """ISSUE 16 satellite: TTFT measures engine ADMISSION -> first token,
+    regardless of how many prefill chunks (or how long a queue wait)
+    precede it — queue time is ``queue_wait_s``, its own series."""
+
+    def test_ttft_is_admit_relative(self):
+        from autodist_tpu.serve.batcher import GenRequest
+
+        req = GenRequest(request_id="r0", prompt=np.zeros(4, np.int32),
+                         max_new_tokens=4, t_submit=100.0)
+        req.t_admit = 103.0              # 3s queued behind a full pool
+        req.t_first_token = 103.5
+        assert req.ttft_s == pytest.approx(0.5)      # NOT 3.5
+
+    def test_ttft_falls_back_to_submit(self):
+        # A stub front (or an old flight record) may never stamp t_admit:
+        # submit-relative is the conservative fallback, not a crash.
+        from autodist_tpu.serve.batcher import GenRequest
+
+        req = GenRequest(request_id="r1", prompt=np.zeros(4, np.int32),
+                         max_new_tokens=4, t_submit=100.0)
+        req.t_first_token = 100.25
+        assert req.ttft_s == pytest.approx(0.25)
+
+    def test_cached_split_percentiles_and_hit_rate(self):
+        tracker = SLOTracker(spec=SLOSpec(), registry=M.MetricsRegistry())
+        for _ in range(30):
+            tracker.observe(ttft_s=0.01, ok=True, cached=True)
+        for _ in range(10):
+            tracker.observe(ttft_s=0.10, ok=True, cached=False)
+        m = tracker.report()["measured"]
+        assert m["ttft_cached_p50_s"] == pytest.approx(0.01)
+        assert m["ttft_uncached_p50_s"] == pytest.approx(0.10)
+        assert m["prefix_hit_rate"] == pytest.approx(0.75)
 
 
 # ------------------------------------------------------- serve sentry codes

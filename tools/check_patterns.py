@@ -79,6 +79,18 @@ exits 1 listing ``file:line`` offenders. Rules:
    by the one allocator. Build pools via ``serve.pages.build_pool``;
    tables only ever come out of ``PagePool.alloc`` (docs/serving.md).
 
+9. **ONE radix-tree home** — constructing a prefix cache or radix node
+   (``PrefixCache(`` / ``_RadixNode(``) anywhere outside
+   ``autodist_tpu/serve/prefix.py`` is banned (same single-home policy
+   as rule 8): the COW sharing contract — refcounted leases, at-most-one
+   frontier copy, eviction that never touches a live request's pages —
+   only holds because every engine (plain and speculative), the router's
+   affinity tiebreak and the chaos eviction-storm injector share the one
+   tree implementation. Build caches via
+   ``serve.prefix.build_prefix_cache`` (or ``prefix_cache=True`` on the
+   engine); hash blocks via ``serve.prefix.block_hashes``
+   (docs/serving.md § prefix sharing).
+
 Pure stdlib, no third-party deps — runs anywhere Python runs.
 """
 from __future__ import annotations
@@ -107,6 +119,8 @@ TIME_SLEEP_RE = re.compile(r"\btime\.sleep\s*\(")
 AS_TEXT_RE = re.compile(r"\.as_text\s*\(")
 # Rule 8: page-pool/page-table construction outside serve/pages.py.
 PAGES_RE = re.compile(r"\bPagePool\s*\(|\bPageTable\s*\(")
+# Rule 9: radix-tree construction outside serve/prefix.py.
+PREFIX_RE = re.compile(r"\bPrefixCache\s*\(|\b_RadixNode\s*\(")
 
 
 def _py_files(*roots):
@@ -251,6 +265,21 @@ def main() -> int:
                         f"pools via serve.pages.build_pool and get tables "
                         f"from PagePool.alloc (the ONE allocator home; "
                         f"docs/serving.md)")
+
+    prefix_allowed = {os.path.join("autodist_tpu", "serve", "prefix.py")}
+    for rel in _py_files("autodist_tpu", "tests", "examples", "bench.py"):
+        if rel in prefix_allowed:
+            continue
+        with open(os.path.join(REPO, rel), "r", encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                code = line.split("#", 1)[0]
+                if PREFIX_RE.search(code):
+                    errors.append(
+                        f"{rel}:{i}: radix-tree construction outside "
+                        f"autodist_tpu/serve/prefix.py — build via "
+                        f"serve.prefix.build_prefix_cache (the ONE COW "
+                        f"prefix-sharing home; docs/serving.md § prefix "
+                        f"sharing)")
 
     if errors:
         print("banned-pattern lint FAILED:", file=sys.stderr)
