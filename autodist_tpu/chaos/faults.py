@@ -152,6 +152,18 @@ CATALOG: Dict[str, FaultSpec] = {s.kind: s for s in (
         "overlap token re-derived and asserted bit-equal); no duplicate "
         "delivery, no drop"),
     FaultSpec(
+        "kill_mid_stochastic_stream", hooks.SEAM_SERVE_STEP,
+        "raise EngineDeadError from ONE replica's decode step while it "
+        "serves STOCHASTIC (temperature > 0) streams behind the router",
+        "router failover resumes every sampled stream on a survivor with "
+        "delivered tokens bit-identical to an uninterrupted control — "
+        "the counter-based draws (serve/sampling.py) depend only on "
+        "(request_id, seed, position), never on which replica, slot, or "
+        "cache state produced them; error event -> DOC006",
+        "journaled prefix resume re-derives the overlap token's draw "
+        "from the same counter and asserts it bit-equal; exactly-once "
+        "delivery holds for sampled streams exactly as for greedy"),
+    FaultSpec(
         "replica_partition", hooks.SEAM_HB_PUBLISH,
         "drop ONE replica's control-plane beats for the window (the "
         "replica itself keeps serving — a partition, not a death)",
@@ -422,6 +434,19 @@ def make_handlers(plant) -> Dict[str, Callable]:
                                       detail="decode step raised")
                     raise EngineDeadError(
                         f"chaos: injected replica {host} death mid-decode")
+                if (e.fault == "kill_mid_stochastic_stream"
+                        and int(e.host) == int(host)):
+                    from autodist_tpu.serve.engine import EngineDeadError
+
+                    plant.record_once(("kill_mid_stochastic_stream",
+                                       e.at_step, int(host)),
+                                      "kill_mid_stochastic_stream",
+                                      host=int(host),
+                                      detail="decode step raised mid-"
+                                             "stochastic-stream")
+                    raise EngineDeadError(
+                        f"chaos: injected replica {host} death mid-"
+                        f"stochastic-stream")
 
         handlers[hooks.SEAM_SERVE_STEP] = serve_step
 

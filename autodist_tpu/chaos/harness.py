@@ -1042,6 +1042,91 @@ def scenario_replica_death(base: str) -> SoakResult:
         trace=trace)
 
 
+def scenario_kill_mid_stochastic_stream(base: str) -> SoakResult:
+    """Kill one of 3 replicas mid-decode while the fleet serves
+    STOCHASTIC streams (mixed temperatures/top-p, per-request seeds):
+    the router fails the sampled streams over to survivors and every
+    delivered stream is bit-identical to an uninterrupted control run —
+    the counter-based draws (serve/sampling.py) depend only on
+    (request_id, seed, position), so failover resume re-derives the
+    identical randomness on whichever replica picks the work up."""
+    from autodist_tpu.obs import doctor
+    from autodist_tpu.obs import recorder as obs_recorder
+    from autodist_tpu.serve.batcher import RequestState
+    from autodist_tpu.serve.replica import ReplicaState
+    from autodist_tpu.serve.sampling import SamplingParams
+
+    fault = "kill_mid_stochastic_stream"
+    obs_recorder.enable(obs_recorder.flight_dir(base))
+    reg = M.MetricsRegistry()
+    router, control = _router_fleet(base, registry=reg)
+    rng = np.random.default_rng(211)
+    temps = (0.5, 0.8, 1.3)
+    jobs = []
+    for i in range(12):
+        p = (rng.integers(1, 127, size=int(rng.integers(3, 10)))
+             .astype(np.int32))
+        sp = SamplingParams(temperature=temps[i % len(temps)], top_k=24,
+                            top_p=0.95, seed=i)
+        jobs.append((f"stoch-{i}", p, sp))
+    expected = [control.generate(p, 6, request_id=rid, sampling=sp)
+                for rid, p, sp in jobs]
+    greedy = [control.generate(p, 6) for _, p, _ in jobs]
+    _check(any(e != g for e, g in zip(expected, greedy)), fault,
+           "every sampled control stream equals greedy — sampling never "
+           "engaged, the scenario would prove nothing")
+
+    schedule = ChaosSchedule(seed=53, events=(
+        ChaosEvent(fault, at_step=0, host=1),))
+    try:
+        with ChaosPlant(schedule) as plant:
+            router.start()
+            for rep in router.replicas.values():
+                rep.wait_ready(120.0)
+            fronts = [router.submit(p, max_new_tokens=6, request_id=rid,
+                                    sampling=sp)
+                      for rid, p, sp in jobs]
+            states = [f.wait(120.0).state for f in fronts]
+            _check(all(s is RequestState.DONE for s in states), fault,
+                   f"not every sampled request completed on the "
+                   f"survivors: {[s.value for s in states]}")
+            _check(plant.injected(fault) == 1, fault,
+                   "the targeted decode-step seam never fired")
+            _check(retry.wait_until(
+                lambda: router.replica_state(1) is ReplicaState.DEAD, 10.0),
+                fault, "router never classified the killed replica DEAD")
+            trace = plant.trace_bytes()
+        streams_ok = all(f.tokens == expected[i]
+                         for i, f in enumerate(fronts))
+        _check(streams_ok, fault,
+               "a failed-over SAMPLED stream diverged from the "
+               "uninterrupted control run — the counter-based draws "
+               "leaked replica/slot/cache state into the randomness")
+        ledger = router.ledger()
+        _check(len(ledger) == len(jobs)
+               and all(v == 1 for v in ledger.values()), fault,
+               f"exactly-once violated: ledger {ledger}")
+        rerouted = int(reg.counter(
+            "serve_router_requests_rerouted_total").value)
+        _check(rerouted >= 1, fault,
+               "no request was actually in flight on the killed replica")
+        router.stop(drain=False)
+    finally:
+        obs_recorder.disable(ok=True)
+
+    diag = doctor.diagnose(base)
+    _check(diag.code == "DOC006", fault,
+           f"doctor said {diag.code}, expected DOC006 (crash)")
+    return SoakResult(
+        fault=fault, ok=True, injected=1,
+        detected=["DEAD", "sampled_bit_identity", "DOC006"],
+        expected=CATALOG[fault].detects, recovery_steps=0,
+        notes=f"{rerouted} in-flight sampled stream(s) rerouted to "
+              f"survivors; every delivered stream bit-identical to its "
+              f"uninterrupted control; exactly-once held",
+        trace=trace)
+
+
 def scenario_replica_partition(base: str) -> SoakResult:
     """Drop one replica's control-plane beats (the replica keeps
     serving): the router marks it SUSPECT and routes new work around it,
@@ -1306,6 +1391,7 @@ SCENARIOS: Dict[str, Callable[[str], SoakResult]] = {
     "draft_divergence": scenario_draft_divergence,
     "worker_kill": scenario_worker_kill,
     "replica_death": scenario_replica_death,
+    "kill_mid_stochastic_stream": scenario_kill_mid_stochastic_stream,
     "replica_partition": scenario_replica_partition,
     "rolling_upgrade_under_load": scenario_rolling_upgrade_under_load,
 }

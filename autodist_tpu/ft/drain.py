@@ -73,6 +73,14 @@ def persist_requests(path: str, requests) -> int:
         if tokens:
             entry["delivered"] = len(tokens)
             entry["tokens"] = [int(t) for t in tokens]
+        samp = getattr(r, "sampling", None)
+        if samp is not None:
+            # Stochastic params survive the restart with the request: a
+            # replayed stream re-derives its counter-based draws from
+            # (request_id, seed, position) alone (serve/sampling.py), so
+            # the resumed tail is bit-identical to the stream the dead
+            # process would have produced.
+            entry["sampling"] = samp.to_dict()
         entries.append(entry)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tmp = f"{path}.tmp-{os.getpid()}"
@@ -157,6 +165,7 @@ def replay_requests(path: Union[str, Sequence[str]], batcher) -> List:
       survive for the next drain cycle (no loss).
     """
     from autodist_tpu.serve.batcher import Backpressure
+    from autodist_tpu.serve.sampling import SamplingParams
 
     paths = [path] if isinstance(path, str) else list(path)
     entries = merge_journal_entries(paths)
@@ -167,7 +176,8 @@ def replay_requests(path: Union[str, Sequence[str]], batcher) -> List:
             req = batcher.submit(
                 e["prompt"], max_new_tokens=e["max_new_tokens"],
                 timeout_s=e.get("timeout_s"),
-                request_id=e.get("request_id") or None)
+                request_id=e.get("request_id") or None,
+                sampling=SamplingParams.from_dict(e.get("sampling")))
             if req.unservable:
                 # Typed unservable (e.g. over the restarted engine's
                 # max_len ceiling): dropping it is the only move that

@@ -336,7 +336,8 @@ def _paged_gather(cache_layer, page_tables):
 
 
 def forward_paged_prefill_chunk(params, tokens, start, length, cache,
-                                page_table, cfg: TransformerConfig):
+                                page_table, cfg: TransformerConfig,
+                                samp=None):
     """One chunk of a paged prefill: the SINGLE compiled prefill program.
 
     ``tokens [1, C]`` are prompt positions ``[start, start + C)`` (padded
@@ -356,7 +357,11 @@ def forward_paged_prefill_chunk(params, tokens, start, length, cache,
     Returns ``(next_token [1], cache)``; the token is the argmax at
     position ``length - 1``, meaningful only on the chunk containing it
     (the host uses the final chunk's value — prefill emits the first
-    generated token, exactly like the unpaged prefill).
+    generated token, exactly like the unpaged prefill). With ``samp``
+    (the per-slot sampling arrays, serve/sampling.py) the token is the
+    counter-keyed sample at absolute position ``length`` instead —
+    identical on every chunk, so the host's final-chunk read is
+    unchanged; ``temperature<=0`` rows still return the argmax bit-exact.
     """
     b, c = tokens.shape
     page_len = cache["k"].shape[2]
@@ -401,11 +406,19 @@ def forward_paged_prefill_chunk(params, tokens, start, length, cache,
     last = x[jnp.arange(b), frontier]                             # [1, D]
     logits = (last.astype(cfg.dtype)
               @ params["embed"]["embedding"].T.astype(cfg.dtype))
-    return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32), cache
+    if samp is None:
+        return (jnp.argmax(logits.astype(jnp.float32), axis=-1)
+                .astype(jnp.int32), cache)
+    from autodist_tpu.serve.sampling import sample_tokens
+
+    # The emitted token's absolute position is `length` (prompt occupies
+    # 0..length-1) — the same counter on every chunk of this prompt.
+    counters = jnp.broadcast_to(jnp.asarray(length, jnp.int32), (b,))
+    return sample_tokens(logits, counters, samp), cache
 
 
 def forward_paged_decode_step(params, tokens, positions, cache, page_tables,
-                              cfg: TransformerConfig):
+                              cfg: TransformerConfig, samp=None):
     """One incremental decode step over every decode row: the SINGLE
     compiled decode program for all active requests.
 
@@ -458,11 +471,19 @@ def forward_paged_decode_step(params, tokens, positions, cache, page_tables,
     x = L.layernorm(params["ln_f"], x)
     logits = (x.astype(cfg.dtype)
               @ params["embed"]["embedding"].T.astype(cfg.dtype))
-    return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32), cache
+    if samp is None:
+        return (jnp.argmax(logits.astype(jnp.float32), axis=-1)
+                .astype(jnp.int32), cache)
+    from autodist_tpu.serve.sampling import sample_tokens
+
+    # The incoming token sits at `positions`; the emitted token's
+    # absolute position — the draw counter — is `positions + 1`.
+    return sample_tokens(logits, positions.astype(jnp.int32) + 1,
+                         samp), cache
 
 
 def forward_paged_verify(params, tokens, positions, cache, page_tables,
-                         cfg: TransformerConfig):
+                         cfg: TransformerConfig, samp=None):
     """Speculative-decode verification: the SINGLE compiled target-model
     program per spec round — the batched generalization of
     :func:`forward_paged_prefill_chunk` (every decode row at once, each
@@ -550,9 +571,21 @@ def forward_paged_verify(params, tokens, positions, cache, page_tables,
     x = L.layernorm(params["ln_f"], x)
     logits = (x.astype(cfg.dtype)
               @ params["embed"]["embedding"].T.astype(cfg.dtype))
-    out = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
-    # Greedy accept/reject on device: count the leading proposals that
-    # match the target's own argmax at the same position.
+    if samp is None:
+        out = jnp.argmax(logits.astype(jnp.float32),
+                         axis=-1).astype(jnp.int32)
+    else:
+        from autodist_tpu.serve.sampling import sample_tokens
+
+        # out[b, j] is the token emitted after the prefix through
+        # tokens[b, j] — absolute position rows_pos + 1, the same
+        # counter plain decode uses for that position, so the coupled
+        # sample here IS the plain stochastic stream's token and the
+        # accept count below stays lossless for any draft
+        # (serve/sampling.py § coupling).
+        out = sample_tokens(logits, rows_pos.astype(jnp.int32) + 1, samp)
+    # Accept/reject on device: count the leading proposals that match
+    # the target's own (argmax or coupled-sample) token per position.
     match = (tokens[:, 1:] == out[:, :-1]).astype(jnp.int32)      # [B, K]
     accept = jnp.cumprod(match, axis=1).sum(axis=1).astype(jnp.int32)
     return accept, out, cache
@@ -574,15 +607,15 @@ def decode_model(cfg: TransformerConfig, eos_id: Optional[int] = None):
             params, tokens, positions, cache, cfg),
         init_paged_cache=lambda n_pages, page_len: init_paged_kv_cache(
             cfg, n_pages, page_len),
-        prefill_chunk=lambda params, tokens, start, length, cache, table:
-            forward_paged_prefill_chunk(
-                params, tokens, start, length, cache, table, cfg),
-        decode_paged=lambda params, tokens, positions, cache, tables:
-            forward_paged_decode_step(
-                params, tokens, positions, cache, tables, cfg),
-        verify_paged=lambda params, tokens, positions, cache, tables:
-            forward_paged_verify(
-                params, tokens, positions, cache, tables, cfg),
+        prefill_chunk=lambda params, tokens, start, length, cache, table,
+            samp=None: forward_paged_prefill_chunk(
+                params, tokens, start, length, cache, table, cfg, samp=samp),
+        decode_paged=lambda params, tokens, positions, cache, tables,
+            samp=None: forward_paged_decode_step(
+                params, tokens, positions, cache, tables, cfg, samp=samp),
+        verify_paged=lambda params, tokens, positions, cache, tables,
+            samp=None: forward_paged_verify(
+                params, tokens, positions, cache, tables, cfg, samp=samp),
         eos_id=eos_id,
         max_len=cfg.max_seq_len,
     )

@@ -137,6 +137,16 @@ class SLOTracker:
         # acceptance context (ISSUE 15).
         self._spec: deque = deque(maxlen=4096)
         self._spec_totals = {"proposed": 0, "accepted": 0}
+        # Acceptance split by temperature bucket (serve/sampling.py's
+        # fixed bucket names): stochastic streams legitimately accept
+        # fewer draft tokens than greedy ones, so a blended acceptance
+        # dip must be attributable to traffic mix before the sentry
+        # calls it sickness. Keyed by caller-supplied bucket string —
+        # this module never imports the serve layer.
+        self._spec_bucket: Dict[str, deque] = {}
+        # Terminal-outcome stream mix: sampled (temperature > 0) vs
+        # greedy requests, cumulative.
+        self._stream_counts = {"sampled": 0, "greedy": 0}
 
         reg = registry or M.registry
         self._reg = reg
@@ -146,6 +156,7 @@ class SLOTracker:
             "acceptance_rate", "prefix_hit_rate",
             "burn_rate_fast", "burn_rate_slow",
             "compliant")}
+        self._g_bucket: Dict[str, Any] = {}
 
     # --------------------------------------------------------------- feeding
     def observe(self, ttft_s: Optional[float] = None,
@@ -155,7 +166,9 @@ class SLOTracker:
                 itl_tokens: int = 1,
                 spec_proposed: Optional[int] = None,
                 spec_accepted: Optional[int] = None,
+                spec_bucket: Optional[str] = None,
                 cached: Optional[bool] = None,
+                temperature: Optional[float] = None,
                 t: Optional[float] = None) -> None:
         """Feed any subset of one request's signals. ``ok`` marks a
         terminal outcome (True = served within contract, False = error);
@@ -166,11 +179,16 @@ class SLOTracker:
         computed per emitted TOKEN, so multi-token speculative-decode
         steps cannot fake latency wins by finishing short requests in
         one burst. ``spec_proposed``/``spec_accepted`` feed the rolling
-        draft-acceptance window. ``cached`` attributes a TTFT sample to
+        draft-acceptance window; with ``spec_bucket`` set the sample
+        feeds ONLY that temperature bucket's window (callers feed the
+        blended window with a separate un-bucketed call, so one round is
+        never double-counted). ``cached`` attributes a TTFT sample to
         the cached-prefix or uncached (full-prefill) population — the
         split percentiles + ``prefix_hit_rate`` in the report; None
         (deployments without a prefix cache) feeds the blended series
-        only. ``t`` overrides the clock for replay."""
+        only. ``temperature`` attributes a terminal outcome to the
+        sampled (> 0) or greedy stream population. ``t`` overrides the
+        clock for replay."""
         now = self.clock() if t is None else float(t)
         with self._lock:
             if ttft_s is not None and math.isfinite(float(ttft_s)):
@@ -185,11 +203,20 @@ class SLOTracker:
             if spec_proposed is not None and int(spec_proposed) > 0:
                 acc = min(max(int(spec_accepted or 0), 0),
                           int(spec_proposed))
-                self._spec.append((now, acc, int(spec_proposed)))
-                self._spec_totals["proposed"] += int(spec_proposed)
-                self._spec_totals["accepted"] += acc
+                if spec_bucket:
+                    self._spec_bucket.setdefault(
+                        str(spec_bucket), deque(maxlen=4096)).append(
+                            (now, acc, int(spec_proposed)))
+                else:
+                    self._spec.append((now, acc, int(spec_proposed)))
+                    self._spec_totals["proposed"] += int(spec_proposed)
+                    self._spec_totals["accepted"] += acc
             if ok is not None or shed:
                 good = bool(ok) and not shed
+                if temperature is not None:
+                    self._stream_counts[
+                        "sampled" if float(temperature) > 0.0
+                        else "greedy"] += 1
                 self._events.append((now, good, bool(shed)))
                 self._totals["requests"] += 1
                 if shed:
@@ -270,6 +297,10 @@ class SLOTracker:
             spec_win = [(a, p) for t, a, p in self._spec
                         if t >= now - spec.window_s]
             spec_totals = dict(self._spec_totals)
+            bucket_win = {b: [(a, p) for t, a, p in dq
+                              if t >= now - spec.window_s]
+                          for b, dq in self._spec_bucket.items()}
+            stream_counts = dict(self._stream_counts)
         win_events = [(g, s) for t, g, s in events
                       if t >= now - spec.window_s]
         good = sum(1 for g, _ in win_events if g)
@@ -297,6 +328,13 @@ class SLOTracker:
             "acceptance_rate": (
                 sum(a for a, _ in spec_win) / proposed
                 if proposed else float("nan")),
+            # Acceptance split by temperature bucket over the window —
+            # only buckets that actually proposed appear (a replica that
+            # never saw high-temperature traffic doesn't claim NaN rows).
+            "acceptance_by_temperature": {
+                b: (sum(a for a, _ in win) / sum(p for _, p in win)
+                    if sum(p for _, p in win) else float("nan"))
+                for b, win in sorted(bucket_win.items())},
         }
         burn = self.burn_rates(now)
 
@@ -325,6 +363,12 @@ class SLOTracker:
             else:
                 v = measured[key]
                 g.set(v if math.isfinite(v) else 0.0)
+        for b, rate in measured["acceptance_by_temperature"].items():
+            gb = self._g_bucket.get(b)
+            if gb is None:
+                gb = self._reg.gauge(f"slo_acceptance_rate_{b}")
+                self._g_bucket[b] = gb
+            gb.set(rate if math.isfinite(rate) else 0.0)
         return {
             "slo": spec.to_dict(),
             "measured": measured,
@@ -333,7 +377,9 @@ class SLOTracker:
                                         spec.burn_slow_window_s]},
             "counts": {**totals, "window_requests": len(win_events),
                        "spec_proposed": spec_totals["proposed"],
-                       "spec_accepted": spec_totals["accepted"]},
+                       "spec_accepted": spec_totals["accepted"],
+                       "sampled_streams": stream_counts["sampled"],
+                       "greedy_streams": stream_counts["greedy"]},
             "compliant": compliant,
         }
 
@@ -389,12 +435,14 @@ def replay_flight_records(records: Iterable[Dict[str, Any]],
                 tracker.observe(ok=False, shed=True, t=t)
         elif r.get("kind") == "step" and r.get("event") == "request":
             cached = r.get("cached")
+            temp = r.get("temperature")
             tracker.observe(
                 ttft_s=r.get("ttft_s"), itl_s=r.get("itl_s"),
                 itl_tokens=max(int(r.get("n_tokens") or 2) - 1, 1),
                 queue_wait_s=r.get("queue_wait_s"),
                 ok=(r.get("state") == "done"),
-                cached=None if cached is None else bool(cached), t=t)
+                cached=None if cached is None else bool(cached),
+                temperature=None if temp is None else float(temp), t=t)
         else:
             continue
         last_t = max(last_t, t)

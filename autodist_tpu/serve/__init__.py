@@ -71,6 +71,20 @@ Layers:
   admitted concurrency at equal pool bytes, bit-identical streams, zero
   leaked pages — docs/serving.md § prefix sharing).
 
+- :mod:`autodist_tpu.serve.sampling` — the ONE stochastic-sampling home
+  (``tools/check_patterns.py`` rule 10): :class:`SamplingParams`
+  (temperature / top_k / top_p / seed; temperature=0 IS greedy) ride each
+  request from the HTTP edge through admission, slot state, the router
+  journal and per-tenant defaults; every draw is a stateless
+  counter-based function of ``(request_id, seed, position)`` — a shared
+  Gumbel argmax over the temperature-scaled, top-k/top-p-filtered target
+  distribution — so failover replay, prefix-cache hits and speculative
+  decode (the draft proposes under the SAME noise; verify keeps the
+  matching prefix) all reproduce the identical stream bit for bit.
+  ``python -m autodist_tpu.serve --selftest-sampling`` is the CPU proof
+  (chi-square calibration, seeded replay, spec/prefix/failover
+  bit-identity, greedy reduction, 2/5 program pins).
+
 Entry point: ``autodist.build_inference(...)`` (api.py) or
 :meth:`InferenceEngine.build` directly.
 """
@@ -96,6 +110,7 @@ from autodist_tpu.serve.prefix import (
 )
 from autodist_tpu.serve.replica import Replica, ReplicaState
 from autodist_tpu.serve.router import Router, RouterConfig
+from autodist_tpu.serve.sampling import InvalidSamplingParams, SamplingParams
 from autodist_tpu.serve.server import RouterFrontend, ServeFrontend
 from autodist_tpu.serve.spec import SpecDecodeEngine
 
@@ -108,6 +123,7 @@ __all__ = [
     "EngineDeadError",
     "GenRequest",
     "InferenceEngine",
+    "InvalidSamplingParams",
     "PagePool",
     "PageTable",
     "PrefixCache",
@@ -117,6 +133,7 @@ __all__ = [
     "Router",
     "RouterConfig",
     "RouterFrontend",
+    "SamplingParams",
     "ServeFrontend",
     "Slot",
     "SpecDecodeEngine",

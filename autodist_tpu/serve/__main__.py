@@ -1,6 +1,6 @@
 """CLI: ``python -m autodist_tpu.serve``.
 
-Five modes:
+Six modes:
 
 - ``--selftest``: the zero-hardware single-engine proof (tiny CPU
   transformer; >=2x concurrency vs the bucketed baseline at equal KV HBM,
@@ -22,6 +22,15 @@ Five modes:
   bytes, >=5x cached TTFT p50 and >=2x admitted concurrency vs the
   sharing-off control, every stream bit-identical, refcounts drained to
   zero with zero leaked pages, program pins unchanged (2 plain / 5 spec).
+- ``--selftest-sampling``: the stochastic-sampling proof (docs/serving.md
+  § stochastic sampling): counter-based draws chi-square-calibrated
+  against the filtered softmax, the same ``(request_id, seed)`` replays
+  bit-identically, spec-decode streams bit-identical to the plain
+  stochastic control across temperature x top_p x k (same-weights,
+  divergent AND chaos-garbled drafts), temperature=0 reduces bit-exactly
+  to greedy, prefix-cache hit vs cold start bit-identical, mid-decode
+  replica kills resume every sampled stream bit-identically, program
+  pins unchanged (2 plain / 5 spec).
 - server mode (default): serve a zoo model — optionally restoring a
   checkpoint — over the asyncio HTTP front end. With ``--ft-dir`` the
   process runs as a supervised :class:`~autodist_tpu.serve.replica.
@@ -77,6 +86,12 @@ def main(argv=None) -> int:
                          "sharing-off at equal pool bytes, bit-identical "
                          "streams, zero leaked pages, 2/5 program pins) "
                          "and exit")
+    ap.add_argument("--selftest-sampling", action="store_true",
+                    help="run the stochastic-sampling proof (counter-based "
+                         "draws calibrated by chi-square, seeded replay "
+                         "and spec/prefix/failover bit-identity across "
+                         "temperature x top_p x k, greedy reduction at "
+                         "temperature=0, 2/5 program pins) and exit")
     ap.add_argument("--ft-dir", default=None,
                     help="server mode: run as a supervised replica, "
                          "publishing typed readiness through the ft "
@@ -152,6 +167,11 @@ def main(argv=None) -> int:
         from autodist_tpu.serve.prefix import selftest_prefix
 
         return selftest_prefix()
+
+    if args.selftest_sampling:
+        from autodist_tpu.serve.sampling import selftest_sampling
+
+        return selftest_sampling()
 
     import os
 

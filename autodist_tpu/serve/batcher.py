@@ -56,6 +56,7 @@ import numpy as np
 from autodist_tpu import metrics as M
 from autodist_tpu.obs import recorder as obs_recorder
 from autodist_tpu.obs import spans as obs_spans
+from autodist_tpu.serve import sampling as serve_sampling
 from autodist_tpu.serve.engine import (
     AdmissionDenied,
     EngineDeadError,
@@ -106,6 +107,11 @@ class GenRequest:
     # (serve/prefix.py): the batcher splits TTFT attribution on it, so a
     # hit-rate shift can't silently mask a prefill regression.
     cached: bool = False
+    # Stochastic sampling params (serve/sampling.py); None means greedy.
+    # Rides the request into engine admission (per-slot arrays), the
+    # router journal and the drain journal — a replayed stream re-derives
+    # the identical draws from (request_id, seed, position) alone.
+    sampling: Optional[serve_sampling.SamplingParams] = None
     tokens: List[int] = field(default_factory=list)
     state: RequestState = RequestState.QUEUED
     error: str = ""
@@ -198,7 +204,9 @@ class GenRequest:
 
 
 def make_rejected(prompt, max_new_tokens: int, error: str,
-                  request_id: Optional[str] = None) -> GenRequest:
+                  request_id: Optional[str] = None,
+                  sampling: Optional[serve_sampling.SamplingParams] = None,
+                  ) -> GenRequest:
     """Build an already-terminal typed-``REJECTED`` request — the ONE
     rendering of the typed-shed fallback (``try_submit`` here and on the
     router), so the contract's prose and coercion rules cannot drift."""
@@ -207,7 +215,7 @@ def make_rejected(prompt, max_new_tokens: int, error: str,
     except (TypeError, ValueError):
         arr = np.zeros(0, np.int32)
     req = GenRequest(prompt=arr, max_new_tokens=max_new_tokens,
-                     request_id=request_id or "")
+                     request_id=request_id or "", sampling=sampling)
     req._finish(RequestState.REJECTED, f"admission rejected: {error}")
     return req
 
@@ -268,6 +276,9 @@ class ContinuousBatcher:
         # cumulative snapshot for delta arithmetic + lazily-registered
         # gauges, so plain engines add no metric families.
         self._spec_last: Dict[str, int] = {}
+        # Per-temperature-bucket cumulative high-water marks mirroring
+        # _spec_last: the SLO tracker wants per-tick deltas per bucket.
+        self._spec_last_bucket: Dict[str, Dict[str, int]] = {}
         self._m_spec_accept = None
         self._m_spec_tps = None
 
@@ -305,6 +316,7 @@ class ContinuousBatcher:
         max_new_tokens: int = 32,
         timeout_s: Optional[float] = None,
         request_id: Optional[str] = None,
+        sampling: Optional[serve_sampling.SamplingParams] = None,
     ) -> GenRequest:
         """Enqueue a request. Raises :class:`Backpressure` when the queue
         is at ``max_queue`` (or the batcher is stopped/draining). A
@@ -314,15 +326,21 @@ class ContinuousBatcher:
         admission rejection at the edge, not an exception and never a
         stuck queue head. ``timeout_s`` sets the request deadline
         relative to now; ``request_id`` carries a caller-assigned stable
-        identity (router journaling, drain replay dedupe)."""
+        identity (router journaling, drain replay dedupe); ``sampling``
+        carries stochastic params (validated HERE, at the edge — invalid
+        params raise :class:`~autodist_tpu.serve.sampling.
+        InvalidSamplingParams`, a ValueError, never a scheduler crash)."""
         prompt = np.asarray(prompt, np.int32).ravel()
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if sampling is not None:
+            sampling.validate()
         req = GenRequest(
             prompt=prompt,
             max_new_tokens=max_new_tokens,
             deadline=(time.monotonic() + timeout_s) if timeout_s else None,
             request_id=request_id or "",
+            sampling=sampling,
         )
         denied = self.engine.check_admissible(len(prompt), max_new_tokens)
         if denied is not None:
@@ -363,6 +381,7 @@ class ContinuousBatcher:
         max_new_tokens: int = 32,
         timeout_s: Optional[float] = None,
         request_id: Optional[str] = None,
+        sampling: Optional[serve_sampling.SamplingParams] = None,
     ) -> GenRequest:
         """Admission that degrades *typed* instead of raising: always
         returns a :class:`GenRequest`. A shed request comes back already
@@ -370,13 +389,14 @@ class ContinuousBatcher:
         ``.error`` — so load-shedding under chaos (engine death, admission
         stalls, page-pool bursts, queue overflow) is a value the caller
         can route on, never a hang and never an anonymous exception
-        (docs/chaos.md)."""
+        (docs/chaos.md). Invalid sampling params land here too — a typed
+        REJECTED, which the HTTP edge maps to a 4xx."""
         try:
             return self.submit(prompt, max_new_tokens, timeout_s=timeout_s,
-                               request_id=request_id)
+                               request_id=request_id, sampling=sampling)
         except (Backpressure, ValueError) as e:
             return make_rejected(prompt, max_new_tokens, str(e),
-                                 request_id=request_id)
+                                 request_id=request_id, sampling=sampling)
 
     def submit_with_retry(
         self,
@@ -733,7 +753,8 @@ class ContinuousBatcher:
                 continue
             t_admit, t_admit_wall = time.monotonic(), time.time()
             admitted = self.engine.admit(head.prompt, head.max_new_tokens,
-                                         request_id=head.request_id)
+                                         request_id=head.request_id,
+                                         sampling=head.sampling)
             if isinstance(admitted, AdmissionDenied):
                 if admitted.retryable:
                     # Pages/rows will free on retirement; keep it queued
@@ -872,6 +893,19 @@ class ContinuousBatcher:
                 "accepted", 0)
             if d_prop > 0:
                 self.slo.observe(spec_proposed=d_prop, spec_accepted=d_acc)
+            # Same delta arithmetic per temperature bucket: a bucketed
+            # observe feeds ONLY that bucket's window (the blended call
+            # above already counted these proposals once).
+            for b, bs in (stats.get("by_temperature") or {}).items():
+                last = self._spec_last_bucket.get(
+                    b, {"proposed": 0, "accepted": 0})
+                bp = int(bs.get("proposed", 0))
+                ba = int(bs.get("accepted", 0))
+                if bp - last["proposed"] > 0:
+                    self.slo.observe(spec_proposed=bp - last["proposed"],
+                                     spec_accepted=ba - last["accepted"],
+                                     spec_bucket=b)
+                self._spec_last_bucket[b] = {"proposed": bp, "accepted": ba}
         self._spec_last = {"proposed": int(stats.get("proposed", 0)),
                            "accepted": int(stats.get("accepted", 0))}
 
@@ -935,11 +969,13 @@ class ContinuousBatcher:
         # One request-level flight record: the SLO inputs (TTFT, ITL,
         # queue wait, outcome) survive the process — obs/slo.py's
         # replay_flight_records recomputes the SLO position postmortem.
+        temp = (float(req.sampling.temperature)
+                if req.sampling is not None else 0.0)
         obs_recorder.record_step(
             surface="serve", event="request", request_id=req.request_id,
             state=state.value, n_tokens=len(req.tokens),
             ttft_s=req.ttft_s, itl_s=itl, queue_wait_s=req.queue_wait_s,
-            cached=req.cached)
+            cached=req.cached, temperature=temp)
         if self.slo is not None:
             # itl_tokens weights the sample by the inter-token gaps it
             # summarizes: a multi-token spec round must not let a long
@@ -949,7 +985,7 @@ class ContinuousBatcher:
                              itl_tokens=max(len(req.tokens) - 1, 1),
                              queue_wait_s=req.queue_wait_s,
                              ok=state is RequestState.DONE,
-                             cached=req.cached)
+                             cached=req.cached, temperature=temp)
         with self._wake:
             self._wake.notify()  # pages freed: admission may proceed
 

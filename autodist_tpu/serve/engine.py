@@ -47,6 +47,7 @@ from autodist_tpu.obs import recorder as obs_recorder
 from autodist_tpu.obs import spans as obs_spans
 from autodist_tpu.serve import pages as serve_pages
 from autodist_tpu.serve import prefix as serve_prefix
+from autodist_tpu.serve import sampling as serve_sampling
 
 DEFAULT_BUCKET_LENS = (32, 64, 128, 256, 512, 1024)
 
@@ -357,6 +358,12 @@ class InferenceEngine(_EngineBase):
         # uncached TTFT split keys off this flag).
         self._leases: List[Optional[serve_prefix.Lease]] = [None] * n_slots
         self._cached = np.zeros(n_slots, bool)
+        # Per-slot sampling params (serve/sampling.py — the ONE sampling
+        # home): greedy defaults (temperature 0) make an all-greedy batch
+        # bit-identical to the pre-sampling engine. These ride the
+        # compiled programs as traced per-slot ARRAYS, so per-request
+        # params never recompile anything and the program pins hold.
+        self._samp = serve_sampling.slot_arrays(n_slots)
         self._prefill_fn = None
         self._decode_fn = None
         self._decode_step_count = 0
@@ -445,14 +452,16 @@ class InferenceEngine(_EngineBase):
         # serving program (the exactly-2 acceptance pin).
         token_sh = NamedSharding(self.mesh, P())
         self._prefill_fn = jax.jit(
-            lambda p, tokens, start, length, cache, table: dm.prefill_chunk(
+            lambda p, tokens, start, length, cache, table, samp:
+            dm.prefill_chunk(
                 self.plan.unpad_params(p), tokens, start, length, cache,
-                table),
+                table, samp=samp),
             donate_argnums=(4,),
             out_shardings=(token_sh, self._cache_sh))
         self._decode_fn = jax.jit(
-            lambda p, tokens, positions, cache, tables: dm.decode_paged(
-                self.plan.unpad_params(p), tokens, positions, cache, tables),
+            lambda p, tokens, positions, cache, tables, samp: dm.decode_paged(
+                self.plan.unpad_params(p), tokens, positions, cache, tables,
+                samp=samp),
             donate_argnums=(3,),
             out_shardings=(token_sh, self._cache_sh))
 
@@ -595,8 +604,20 @@ class InferenceEngine(_EngineBase):
                 f"{max_new_tokens})", retryable=False)
         return None
 
+    def _samp_dev(self, idx: Optional[int] = None):
+        """The per-slot sampling arrays as the device 5-tuple the compiled
+        programs consume — one row for a prefill call, the full batch for
+        decode/verify. Always passed (greedy rows are temperature 0), so
+        sampling params never change a program's signature."""
+        s = self._samp
+        pick = (lambda a: a) if idx is None else (lambda a: a[idx:idx + 1])
+        return tuple(jnp.asarray(pick(s[k])) for k in
+                     ("temperature", "top_k", "top_p", "key_hi", "key_lo"))
+
     def admit(self, prompt: np.ndarray, max_new_tokens: int,
-              request_id: str = "") -> Union[Slot, AdmissionDenied]:
+              request_id: str = "",
+              sampling: Optional["serve_sampling.SamplingParams"] = None,
+              ) -> Union[Slot, AdmissionDenied]:
         """Reserve a decode row + pages for ``prompt`` — host bookkeeping
         only, no device work (prefill runs chunk-by-chunk via
         :meth:`prefill_step`). Returns a :class:`Slot` or a typed
@@ -604,7 +625,11 @@ class InferenceEngine(_EngineBase):
         over the static ceiling is non-retryable — the request can never
         run; pool/row exhaustion is retryable — retirement recycles pages.
         ``request_id`` (the batcher's stable id) tags this slot's spans
-        and flight records for request-scoped tracing.
+        and flight records for request-scoped tracing — and, with
+        ``sampling``, keys the counter-based RNG: the stream is a pure
+        function of ``(request_id, seed, position)``, so re-admitting the
+        same identity (failover resume, journal replay, prefix-cache hit
+        or miss) reproduces it bit-identically.
         """
         if self.decode_model is None:
             raise ValueError("engine built without decode_model")
@@ -675,6 +700,13 @@ class InferenceEngine(_EngineBase):
         self._prefill_start[idx] = start_pos
         self._leases[idx] = lease
         self._cached[idx] = start_pos > 0
+        sp = sampling or serve_sampling.SamplingParams()
+        hi, lo = serve_sampling.request_key(self._request_ids[idx], sp.seed)
+        self._samp["temperature"][idx] = sp.temperature
+        self._samp["top_k"][idx] = sp.top_k
+        self._samp["top_p"][idx] = sp.top_p
+        self._samp["key_hi"][idx] = hi
+        self._samp["key_lo"][idx] = lo
         self._prefill_t0[idx] = time.perf_counter()
         # Flight-record the admit (non-critical: batched fsync — serve load
         # must not turn into an fsync storm). Rate is bounded by request
@@ -758,7 +790,7 @@ class InferenceEngine(_EngineBase):
             first, self._cache = self._prefill_fn(
                 self.params, jnp.asarray(chunk), np.int32(start),
                 np.int32(len(prompt)), self._cache,
-                jnp.asarray(self._table_np[idx]))
+                jnp.asarray(self._table_np[idx]), self._samp_dev(idx))
         start += c
         self._prefill_pos[idx] = start
         if start < len(prompt):
@@ -820,7 +852,8 @@ class InferenceEngine(_EngineBase):
                 jnp.asarray(self._last_token),
                 jnp.asarray(self._lengths),
                 self._cache,
-                jnp.asarray(self._decode_table_np))
+                jnp.asarray(self._decode_table_np),
+                self._samp_dev())
             tokens = np.asarray(jax.device_get(tokens))
         for idx in decoding:
             idx = int(idx)
@@ -883,6 +916,11 @@ class InferenceEngine(_EngineBase):
         self._prompts[idx] = None
         self._request_ids[idx] = ""
         self._prefill_pos[idx] = 0
+        self._samp["temperature"][idx] = 0.0
+        self._samp["top_k"][idx] = 0
+        self._samp["top_p"][idx] = 1.0
+        self._samp["key_hi"][idx] = 0
+        self._samp["key_lo"][idx] = 0
 
     @property
     def prefilling_slots(self) -> int:
@@ -893,12 +931,18 @@ class InferenceEngine(_EngineBase):
         return int((self._phase == _DECODE).sum())
 
     # ------------------------------------------------------------- generation
-    def generate(self, prompt: np.ndarray, max_new_tokens: int) -> List[int]:
-        """Single-request greedy decode — the sequential baseline (and the
-        correctness oracle's cached side). Production traffic should go
-        through the batcher; this admits one request and steps it alone.
+    def generate(self, prompt: np.ndarray, max_new_tokens: int,
+                 request_id: str = "",
+                 sampling: Optional["serve_sampling.SamplingParams"] = None,
+                 ) -> List[int]:
+        """Single-request decode — the sequential baseline (and the
+        correctness oracle's cached side; greedy unless ``sampling`` is
+        given, in which case ``request_id`` keys the counter-based
+        stream). Production traffic should go through the batcher; this
+        admits one request and steps it alone.
         """
-        admitted = self.admit(prompt, max_new_tokens)
+        admitted = self.admit(prompt, max_new_tokens,
+                              request_id=request_id, sampling=sampling)
         if isinstance(admitted, AdmissionDenied):
             raise RuntimeError(
                 f"single-request generate() not admitted: {admitted.reason}")

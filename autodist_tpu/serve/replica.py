@@ -209,17 +209,23 @@ class Replica:
 
     def submit(self, prompt, max_new_tokens: int = 32,
                timeout_s: Optional[float] = None,
-               request_id: Optional[str] = None) -> GenRequest:
+               request_id: Optional[str] = None,
+               sampling=None) -> GenRequest:
         """Admission passthrough (raises
         :class:`~autodist_tpu.serve.batcher.Backpressure` when saturated —
-        the router's signal to try the next replica)."""
+        the router's signal to try the next replica). ``sampling`` is a
+        :class:`~autodist_tpu.serve.sampling.SamplingParams` (or None for
+        greedy), forwarded untouched — the counter-based draws depend
+        only on ``(request_id, seed, position)``, so a failover re-submit
+        on a different replica reproduces the identical stream."""
         if self.batcher is None:
             from autodist_tpu.serve.batcher import Backpressure
 
             raise Backpressure(f"replica {self.replica_id} is not started")
         return self.batcher.submit(prompt, max_new_tokens,
                                    timeout_s=timeout_s,
-                                   request_id=request_id)
+                                   request_id=request_id,
+                                   sampling=sampling)
 
     def quiesce(self) -> None:
         """Stop admitting; active decodes keep stepping (rolling-upgrade

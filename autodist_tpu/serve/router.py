@@ -260,17 +260,25 @@ class Router:
     # ---------------------------------------------------------------- clients
     def submit(self, prompt, max_new_tokens: int = 32,
                timeout_s: Optional[float] = None,
-               request_id: Optional[str] = None) -> GenRequest:
+               request_id: Optional[str] = None,
+               sampling=None) -> GenRequest:
         """Admit one request; returns the client-visible
         :class:`GenRequest` (its ``tokens``/``state`` are the delivered,
         exactly-once stream). Raises :class:`Backpressure` when the
         router queue is at ``max_queue`` or the router is stopped —
         overload is typed at the edge, never a hang. A statically
         unservable request (over every replica's ceiling) comes back
-        already terminal ``REJECTED`` via the backend's typed check."""
+        already terminal ``REJECTED`` via the backend's typed check.
+        ``sampling`` (:class:`~autodist_tpu.serve.sampling.SamplingParams`
+        or None for greedy) is validated here, journaled with the
+        request, and re-submitted verbatim on failover — the stream's
+        draws depend only on ``(request_id, seed, position)``, so the
+        bit-identity overlap assertion holds for stochastic streams."""
         prompt = np.asarray(prompt, np.int32).ravel()
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if sampling is not None:
+            sampling.validate()
         t_admit_wall, t_admit = time.time(), time.perf_counter()
         front = GenRequest(
             prompt=prompt,
@@ -278,6 +286,7 @@ class Router:
             deadline=(time.monotonic() + timeout_s) if timeout_s else None,
             request_id=request_id
             or f"rt{self._instance}-{os.getpid()}-{next(self._rid_counter)}",
+            sampling=sampling,
         )
         # Static shape check against any live engine: typed, immediate,
         # and identical prose to the single-engine edge (ONE home:
@@ -331,15 +340,17 @@ class Router:
 
     def try_submit(self, prompt, max_new_tokens: int = 32,
                    timeout_s: Optional[float] = None,
-                   request_id: Optional[str] = None) -> GenRequest:
+                   request_id: Optional[str] = None,
+                   sampling=None) -> GenRequest:
         """Typed admission: a shed request comes back already terminal
-        ``REJECTED`` (the batcher's ``try_submit`` contract, fleet-wide)."""
+        ``REJECTED`` (the batcher's ``try_submit`` contract, fleet-wide).
+        Invalid sampling params land here as a typed REJECTED too."""
         try:
             return self.submit(prompt, max_new_tokens, timeout_s=timeout_s,
-                               request_id=request_id)
+                               request_id=request_id, sampling=sampling)
         except (Backpressure, ValueError) as e:
             return make_rejected(prompt, max_new_tokens, str(e),
-                                 request_id=request_id)
+                                 request_id=request_id, sampling=sampling)
 
     # -------------------------------------------------------------- lifecycle
     def start(self) -> "Router":
@@ -430,12 +441,15 @@ class Router:
             except OSError:
                 pass
         fronts: List[GenRequest] = []
+        from autodist_tpu.serve.sampling import SamplingParams
+
         for e in entries:
             try:
                 front = self.submit(
                     e["prompt"], max_new_tokens=int(e["max_new_tokens"]),
                     timeout_s=e.get("timeout_s"),
-                    request_id=e.get("request_id") or None)
+                    request_id=e.get("request_id") or None,
+                    sampling=SamplingParams.from_dict(e.get("sampling")))
             except (Backpressure, ValueError, KeyError) as err:
                 logging.warning("dropping unrecoverable journal entry %r "
                                 "(%s)", e, err)
@@ -969,7 +983,8 @@ class Router:
         try:
             backend = self.replicas[rid].submit(
                 prompt, max_new, timeout_s=timeout_s,
-                request_id=front.request_id)
+                request_id=front.request_id,
+                sampling=front.sampling)
         except (Backpressure, ValueError):
             return False
         if backend.done and backend.state is RequestState.REJECTED:

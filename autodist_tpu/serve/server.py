@@ -7,8 +7,14 @@ The front end is deliberately thin: a dependency-free HTTP/1.1 listener on
 — the event loop never blocks on the device). Routes:
 
 - ``POST /generate`` ``{"tokens": [...], "max_new_tokens": N,
-  "timeout_s": T?}`` → ``{"tokens": [...], "state": "done"}``;
-  429 on backpressure, 400 on an unservable request.
+  "timeout_s": T?, "temperature": t?, "top_k": k?, "top_p": p?,
+  "seed": s?, "tenant": name?, "request_id": id?}`` →
+  ``{"tokens": [...], "state": "done"}``; 429 on backpressure, 400 on an
+  unservable request or invalid sampling params (typed
+  ``invalid_sampling_params`` — temperature < 0, top_p outside (0, 1],
+  top_k < 0 are the client's bug, never a 500). Explicit sampling
+  fields override the tenant's defaults (``tenant_defaults``); absent
+  both, decode is greedy (serve/sampling.py).
 - ``GET /metrics`` → the metrics registry as OpenMetrics text, rendered by
   the one shared exporter (``autodist_tpu.obs.exporter`` — byte-identical
   to the headless file exporter's output on the same snapshot).
@@ -37,6 +43,7 @@ import numpy as np
 
 from autodist_tpu import metrics as M
 from autodist_tpu.serve.batcher import Backpressure, ContinuousBatcher, RequestState
+from autodist_tpu.serve.sampling import InvalidSamplingParams, SamplingParams
 from autodist_tpu.utils import logging
 
 
@@ -45,16 +52,48 @@ async def async_generate(
     tokens,
     max_new_tokens: int = 32,
     timeout_s: Optional[float] = None,
+    request_id: Optional[str] = None,
+    sampling: Optional[SamplingParams] = None,
 ):
     """Submit + await one request from the event loop (shared by the HTTP
-    handler and the selftest's mock clients)."""
+    handler and the selftest's mock clients). ``batcher`` is anything
+    with the ``submit`` contract (batcher or router); ``request_id`` /
+    ``sampling`` forward to it."""
     loop = asyncio.get_running_loop()
     fut: asyncio.Future = loop.create_future()
-    req = batcher.submit(tokens, max_new_tokens, timeout_s=timeout_s)
+    req = batcher.submit(tokens, max_new_tokens, timeout_s=timeout_s,
+                         request_id=request_id, sampling=sampling)
     req.add_done_callback(
         lambda r: loop.call_soon_threadsafe(
             lambda: fut.done() or fut.set_result(r)))
     return await fut
+
+
+def parse_sampling(payload: Dict[str, Any],
+                   tenant_defaults: Optional[Dict[str, SamplingParams]] = None,
+                   ) -> Optional[SamplingParams]:
+    """Resolve one request's sampling params at the HTTP edge: explicit
+    body fields (``temperature`` / ``top_k`` / ``top_p`` / ``seed``)
+    override the ``tenant``'s defaults, which override greedy. Returns
+    None (pure greedy) when neither the body nor the tenant says
+    anything. Raises :class:`InvalidSamplingParams` on out-of-range or
+    non-numeric values — the ONE typed 400, never a 500."""
+    tenant = payload.get("tenant")
+    base = (tenant_defaults or {}).get(tenant) if tenant else None
+    fields = {k: payload[k] for k in ("temperature", "top_k", "top_p", "seed")
+              if k in payload}
+    if base is None and not fields:
+        return None
+    doc = (base or SamplingParams()).to_dict()
+    doc.update(fields)
+    try:
+        params = SamplingParams(
+            temperature=float(doc["temperature"]), top_k=int(doc["top_k"]),
+            top_p=float(doc["top_p"]), seed=int(doc["seed"]))
+    except (TypeError, ValueError) as e:
+        raise InvalidSamplingParams(f"bad sampling params: {e}") from e
+    params.validate()
+    return params
 
 
 class ServeFrontend:
@@ -64,11 +103,15 @@ class ServeFrontend:
 
     def __init__(self, batcher: ContinuousBatcher, host: str = "127.0.0.1",
                  port: int = 8476, registry: Optional[M.MetricsRegistry] = None,
-                 replica=None):
+                 replica=None,
+                 tenant_defaults: Optional[Dict[str, SamplingParams]] = None):
         self._batcher = batcher
         self.host, self.port = host, port
         self.registry = registry or M.registry
         self.replica = replica
+        # tenant name -> default SamplingParams; a request's explicit
+        # body fields override these (parse_sampling).
+        self.tenant_defaults = dict(tenant_defaults or {})
         self._server: Optional[asyncio.AbstractServer] = None
 
     @property
@@ -240,6 +283,14 @@ class ServeFrontend:
             payload = json.loads(body.decode() or "{}")
             tokens = payload["tokens"]
             max_new = int(payload.get("max_new_tokens", 32))
+            sampling = parse_sampling(payload, self.tenant_defaults)
+        except InvalidSamplingParams as e:
+            # Typed 4xx: invalid sampling params are the client's bug
+            # (temperature < 0, top_p outside (0,1], top_k < 0) — never
+            # a 500 from deep inside the scheduler.
+            self._respond(writer, 400, {
+                "error": str(e), "type": "invalid_sampling_params"})
+            return
         except (ValueError, KeyError) as e:
             self._respond(writer, 400, {"error": f"bad request body: {e}"})
             return
@@ -252,7 +303,9 @@ class ServeFrontend:
         try:
             req = await async_generate(
                 batcher, tokens, max_new,
-                timeout_s=payload.get("timeout_s"))
+                timeout_s=payload.get("timeout_s"),
+                request_id=payload.get("request_id") or None,
+                sampling=sampling)
         except Backpressure as e:
             self._respond(writer, 429, {"error": str(e)})
             return
@@ -293,9 +346,11 @@ class RouterFrontend:
       percentiles, burn rates, compliance — docs/serving.md § SLOs).
     """
 
-    def __init__(self, router, host: str = "127.0.0.1", port: int = 8475):
+    def __init__(self, router, host: str = "127.0.0.1", port: int = 8475,
+                 tenant_defaults: Optional[Dict[str, SamplingParams]] = None):
         self.router = router
         self.host, self.port = host, port
+        self.tenant_defaults = dict(tenant_defaults or {})
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> "RouterFrontend":
@@ -372,13 +427,20 @@ class RouterFrontend:
             payload = json.loads(body.decode() or "{}")
             tokens = payload["tokens"]
             max_new = int(payload.get("max_new_tokens", 32))
+            sampling = parse_sampling(payload, self.tenant_defaults)
+        except InvalidSamplingParams as e:
+            respond(writer, 400, {
+                "error": str(e), "type": "invalid_sampling_params"})
+            return
         except (ValueError, KeyError) as e:
             respond(writer, 400, {"error": f"bad request body: {e}"})
             return
         try:
             req = await async_generate(
                 self.router, tokens, max_new,
-                timeout_s=payload.get("timeout_s"))
+                timeout_s=payload.get("timeout_s"),
+                request_id=payload.get("request_id") or None,
+                sampling=sampling)
         except Backpressure as e:
             respond(writer, 429, {"error": str(e)})
             return

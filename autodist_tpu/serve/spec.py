@@ -74,6 +74,7 @@ from autodist_tpu.obs import recorder as obs_recorder
 from autodist_tpu.obs import spans as obs_spans
 from autodist_tpu.serve import pages as serve_pages
 from autodist_tpu.serve import prefix as serve_prefix
+from autodist_tpu.serve import sampling as serve_sampling
 from autodist_tpu.serve.engine import (
     _DECODE,
     _PREFILL,
@@ -223,6 +224,11 @@ class SpecDecodeEngine(InferenceEngine):
         self.accepted_total = 0
         self.spec_tokens_emitted = 0
         self.draft_starved_total = 0
+        # Per-temperature-bucket accept/propose counters (cumulative —
+        # the batcher deltas them into the SLO tracker's per-bucket
+        # acceptance windows; serve/sampling.py names the buckets).
+        self.bucket_proposed: Dict[str, int] = {}
+        self.bucket_accepted: Dict[str, int] = {}
 
     # ------------------------------------------------------------ construction
     @classmethod
@@ -284,8 +290,9 @@ class SpecDecodeEngine(InferenceEngine):
         # its output sharding pinned to the canonical pool sharding, the
         # same drift-proofing the plain decode/prefill programs keep.
         self._verify_fn = jax.jit(
-            lambda p, toks, pos, cache, tables: dm.verify_paged(
-                self.plan.unpad_params(p), toks, pos, cache, tables),
+            lambda p, toks, pos, cache, tables, samp: dm.verify_paged(
+                self.plan.unpad_params(p), toks, pos, cache, tables,
+                samp=samp),
             donate_argnums=(3,),
             out_shardings=(token_sh, token_sh, self._cache_sh))
         self._draft_prefill_fn = jax.jit(
@@ -294,10 +301,16 @@ class SpecDecodeEngine(InferenceEngine):
                 cache, table),
             donate_argnums=(4,),
             out_shardings=(token_sh, self._draft_cache_sh))
+        # The draft decode takes the SAME per-slot sampling arrays as the
+        # target: proposing with the target's (request key, position)
+        # Gumbel noise over its own distribution is the coupling that
+        # keeps stochastic spec decode lossless AND high-acceptance
+        # (serve/sampling.py — when draft == target the draws coincide).
         self._draft_decode_fn = jax.jit(
-            lambda p, tokens, positions, cache, tables: ddm.decode_paged(
+            lambda p, tokens, positions, cache, tables, samp:
+            ddm.decode_paged(
                 self.draft_plan.unpad_params(p), tokens, positions, cache,
-                tables),
+                tables, samp=samp),
             donate_argnums=(3,),
             out_shardings=(token_sh, self._draft_cache_sh))
 
@@ -330,8 +343,10 @@ class SpecDecodeEngine(InferenceEngine):
 
     # --------------------------------------------------------------- admission
     def admit(self, prompt: np.ndarray, max_new_tokens: int,
-              request_id: str = ""):
-        got = super().admit(prompt, max_new_tokens, request_id=request_id)
+              request_id: str = "",
+              sampling: Optional[serve_sampling.SamplingParams] = None):
+        got = super().admit(prompt, max_new_tokens, request_id=request_id,
+                            sampling=sampling)
         if isinstance(got, AdmissionDenied):
             return got
         idx = got.index
@@ -532,16 +547,20 @@ class SpecDecodeEngine(InferenceEngine):
         pos_dev = jnp.asarray(positions)
         draft_tables = jnp.asarray(self._draft_decode_np)
         cur = jnp.asarray(self._last_token)
+        samp = self._samp_dev()
         proposals = []
         for j in range(k + 1):
             # k+1 invocations of the ONE draft decode program: feed j
             # writes its token's KV at pos+j and proposes the next; the
             # last feed only completes the draft cache for the
-            # all-accepted case (its proposal is discarded).
+            # all-accepted case (its proposal is discarded). The draft
+            # samples with the target's per-slot keys at the same
+            # counters — the coupling that makes stochastic acceptance
+            # track draft quality.
             self.draft_invocations += 1
             cur, self._draft_cache = self._draft_decode_fn(
                 self.draft_params, cur, pos_dev + j, self._draft_cache,
-                draft_tables)
+                draft_tables, samp)
             if j < k:
                 proposals.append(cur)
         # Chaos seam: a draft_divergence window garbles the PROPOSALS the
@@ -561,7 +580,7 @@ class SpecDecodeEngine(InferenceEngine):
                             k=k, request_ids=rids):
             acc, out_tok, self._cache = self._verify_fn(
                 self.params, tokens_mat, pos_dev, self._cache,
-                jnp.asarray(self._decode_table_np))
+                jnp.asarray(self._decode_table_np), samp)
             acc = np.asarray(jax.device_get(acc))
             out_tok = np.asarray(jax.device_get(out_tok))
         self.spec_rounds += 1
@@ -579,6 +598,12 @@ class SpecDecodeEngine(InferenceEngine):
             self.proposed_total += k
             self.accepted_total += m
             self.spec_tokens_emitted += len(emit)
+            bucket = serve_sampling.temperature_bucket(
+                float(self._samp["temperature"][idx]))
+            self.bucket_proposed[bucket] = (
+                self.bucket_proposed.get(bucket, 0) + k)
+            self.bucket_accepted[bucket] = (
+                self.bucket_accepted.get(bucket, 0) + m)
             # Rollback: rewind the draft reservation to the accepted
             # timeline (+1 pending slot). A rejection at a page boundary
             # frees pages back to the pool immediately — speculation
@@ -631,6 +656,16 @@ class SpecDecodeEngine(InferenceEngine):
             "draft_starved": self.draft_starved_total,
             "draft_pool_free_pages": self.draft_pool.free_pages,
             "draft_pool_used_pages": self.draft_pool.used_pages,
+            # Acceptance split by temperature bucket (serve/sampling.py):
+            # stochastic rounds accept differently than greedy ones, and
+            # the SLO report attributes the split.
+            "by_temperature": {
+                b: {"proposed": self.bucket_proposed.get(b, 0),
+                    "accepted": self.bucket_accepted.get(b, 0),
+                    "acceptance_rate": (
+                        self.bucket_accepted.get(b, 0)
+                        / max(self.bucket_proposed.get(b, 0), 1))}
+                for b in sorted(self.bucket_proposed)},
         }
 
 

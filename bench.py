@@ -666,7 +666,9 @@ def _serve_decode_bench(n_requests: int = 48, max_new: int = 10) -> dict:
     import numpy as np
 
     from autodist_tpu import metrics as M
+    from autodist_tpu.obs.slo import SLOTracker
     from autodist_tpu.serve.batcher import ContinuousBatcher, RequestState
+    from autodist_tpu.serve.sampling import SamplingParams
     from autodist_tpu.serve.server import (
         _tiny_engine, async_generate, mock_load_prompt)
 
@@ -675,8 +677,20 @@ def _serve_decode_bench(n_requests: int = 48, max_new: int = 10) -> dict:
     engine, _params, _cfg = _tiny_engine(n_slots=32, prefix_cache=True)
     engine.generate(rng.integers(1, 127, size=6), max_new)  # warm compiles
 
+    slo = SLOTracker()
     batcher = ContinuousBatcher(engine, max_queue=max(n_requests, 64),
-                                registry=registry)
+                                registry=registry, slo=slo)
+    # Every other request is stochastic (a low/mid/high temperature mix,
+    # counter-based draws — serve/sampling.py), the rest greedy: the
+    # bench line then carries real sampled-vs-greedy stream counts and,
+    # on spec fleets, per-temperature-bucket acceptance.
+    temp_mix = (0.0, 0.7, 1.0, 1.4)
+
+    def sampling_for(i: int):
+        t = temp_mix[i % len(temp_mix)]
+        if t <= 0.0:
+            return None
+        return SamplingParams(temperature=t, top_p=0.95, seed=i)
     util_peak = {"v": 0.0}
     # The selftest's canonical mixed load (mock_load_prompt), with the
     # second half of the request stream repeating the first half's
@@ -690,7 +704,8 @@ def _serve_decode_bench(n_requests: int = 48, max_new: int = 10) -> dict:
         async def client(i):
             await asyncio.sleep(0.001 * (i % 8))
             return await async_generate(
-                batcher, base_prompts[i % len(base_prompts)], max_new)
+                batcher, base_prompts[i % len(base_prompts)], max_new,
+                request_id=f"bench-{i}", sampling=sampling_for(i))
 
         async def sampler():
             while True:
@@ -722,6 +737,7 @@ def _serve_decode_bench(n_requests: int = 48, max_new: int = 10) -> dict:
     if not isinstance(ttft_cached, dict):
         ttft_cached = {}
     hit_rate = snap.get("serve_prefix_hit_rate", float("nan"))
+    slo_report = slo.report()
     return {"bench_serve": {
         "decode_tokens_per_sec": round(
             float(snap.get("serve_decode_tokens_per_sec", 0.0)), 1),
@@ -735,6 +751,14 @@ def _serve_decode_bench(n_requests: int = 48, max_new: int = 10) -> dict:
         "ttft_cached_p50_s": round(
             ttft_cached.get("p50", float("nan")), 4),
         "prefix_hit_rate": round(float(hit_rate), 4),
+        "temperature_mix": list(temp_mix),
+        "sampled_streams": int(
+            slo_report["counts"].get("sampled_streams", 0)),
+        "greedy_streams": int(
+            slo_report["counts"].get("greedy_streams", 0)),
+        "acceptance_by_temperature": {
+            b: round(float(r), 4) for b, r in slo_report["measured"].get(
+                "acceptance_by_temperature", {}).items()},
         "page_utilization_peak": round(util_peak["v"], 4),
         "n_requests": n_requests,
         "completed": completed,

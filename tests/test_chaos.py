@@ -291,6 +291,12 @@ class TestFaultClassPins:
         assert res.injected == 1
         assert "bit-identical to control" in res.notes
 
+    def test_kill_mid_stochastic_stream_bit_identity(self, tmp_path):
+        res = _run("kill_mid_stochastic_stream", tmp_path)
+        assert res.detected == ["DEAD", "sampled_bit_identity", "DOC006"]
+        assert res.injected == 1
+        assert "bit-identical" in res.notes
+
     def test_replica_partition_suspect_routed_around(self, tmp_path):
         res = _run("replica_partition", tmp_path)
         assert res.detected == ["SUSPECT", "routed around", "rejoined"]

@@ -62,7 +62,7 @@ class _StubEngine:
         return None
 
     @staticmethod
-    def admit(prompt, max_new_tokens, request_id=""):
+    def admit(prompt, max_new_tokens, request_id="", sampling=None):
         return AdmissionDenied("no free row (stub)", retryable=True)
 
     @staticmethod
@@ -373,7 +373,7 @@ class TestJournalMerge:
         class _Batcher:
             @staticmethod
             def submit(prompt, max_new_tokens, timeout_s=None,
-                       request_id=None):
+                       request_id=None, sampling=None):
                 submitted.append(request_id)
                 return SimpleNamespace(unservable=False)
 

@@ -52,11 +52,13 @@ class TestSLOTracker:
             "ttft_p50_s", "ttft_p99_s", "ttft_cached_p50_s",
             "ttft_uncached_p50_s", "prefix_hit_rate", "itl_p50_s",
             "itl_p99_s", "queue_wait_p99_s", "availability", "error_rate",
-            "acceptance_rate"}
+            "acceptance_rate", "acceptance_by_temperature"}
         assert set(report["burn_rate"]) == {"fast", "slow", "windows_s"}
         assert set(report["counts"]) == {"requests", "errors", "sheds",
                                          "window_requests",
-                                         "spec_proposed", "spec_accepted"}
+                                         "spec_proposed", "spec_accepted",
+                                         "sampled_streams",
+                                         "greedy_streams"}
         assert set(report["compliant"]) == {
             "ttft_p50", "ttft_p99", "itl_p50", "itl_p99", "queue_wait_p99",
             "availability", "overall"}
@@ -440,7 +442,7 @@ class _StubEngine:
         return None
 
     @staticmethod
-    def admit(prompt, max_new_tokens, request_id=""):
+    def admit(prompt, max_new_tokens, request_id="", sampling=None):
         return AdmissionDenied("no free row (stub)", retryable=True)
 
     @staticmethod

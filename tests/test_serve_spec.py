@@ -380,7 +380,7 @@ class _StubSpecEngine:
     def check_admissible(self, prompt_len, max_new_tokens):
         return None
 
-    def admit(self, prompt, max_new_tokens, request_id=""):
+    def admit(self, prompt, max_new_tokens, request_id="", sampling=None):
         from autodist_tpu.serve.engine import Slot
 
         self._slot = Slot(0)

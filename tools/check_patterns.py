@@ -91,6 +91,20 @@ exits 1 listing ``file:line`` offenders. Rules:
    engine); hash blocks via ``serve.prefix.block_hashes``
    (docs/serving.md § prefix sharing).
 
+10. **ONE sampling/RNG home for serving** — drawing serving randomness
+    (``jax.random.categorical`` / ``gumbel`` / ``fold_in`` /
+    ``bernoulli``) anywhere in ``autodist_tpu/serve/`` or
+    ``autodist_tpu/models/`` outside ``serve/sampling.py`` is banned
+    (same single-home policy as rules 8–9): the replayable-stream
+    contract — every draw a pure function of ``(request_id, seed,
+    position)`` — only holds because the counter-based key derivation
+    and the temperature/top-k/top-p transform live in exactly one
+    place. A second sampler would silently fork the failover
+    bit-identity story (docs/serving.md § stochastic sampling).
+    ``models/layers.py``'s ``jax.random.uniform/normal`` parameter init
+    is untouched by design: the rule bans the *sampling* draw family,
+    not weight init.
+
 Pure stdlib, no third-party deps — runs anywhere Python runs.
 """
 from __future__ import annotations
@@ -121,6 +135,9 @@ AS_TEXT_RE = re.compile(r"\.as_text\s*\(")
 PAGES_RE = re.compile(r"\bPagePool\s*\(|\bPageTable\s*\(")
 # Rule 9: radix-tree construction outside serve/prefix.py.
 PREFIX_RE = re.compile(r"\bPrefixCache\s*\(|\b_RadixNode\s*\(")
+# Rule 10: serving-randomness draws outside serve/sampling.py.
+SAMPLING_RE = re.compile(
+    r"\bjax\.random\.(categorical|gumbel|fold_in|bernoulli)\s*\(")
 
 
 def _py_files(*roots):
@@ -280,6 +297,23 @@ def main() -> int:
                         f"serve.prefix.build_prefix_cache (the ONE COW "
                         f"prefix-sharing home; docs/serving.md § prefix "
                         f"sharing)")
+
+    sampling_allowed = {os.path.join("autodist_tpu", "serve", "sampling.py")}
+    for rel in _py_files(os.path.join("autodist_tpu", "serve"),
+                         os.path.join("autodist_tpu", "models")):
+        if rel in sampling_allowed:
+            continue
+        with open(os.path.join(REPO, rel), "r", encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                code = line.split("#", 1)[0]
+                if SAMPLING_RE.search(code):
+                    errors.append(
+                        f"{rel}:{i}: serving randomness drawn outside "
+                        f"autodist_tpu/serve/sampling.py — sample through "
+                        f"sampling.sample_tokens / request_key (the ONE "
+                        f"counter-based RNG home; a second sampler forks "
+                        f"the replay bit-identity contract; "
+                        f"docs/serving.md § stochastic sampling)")
 
     if errors:
         print("banned-pattern lint FAILED:", file=sys.stderr)
