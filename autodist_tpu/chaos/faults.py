@@ -184,6 +184,19 @@ CATALOG: Dict[str, FaultSpec] = {s.kind: s for s in (
         "to ~1 token/round (bounded ITL), correctness and page "
         "accounting are untouched"),
     FaultSpec(
+        "poisoned_calibration", hooks.SEAM_PILOT_REFIT,
+        "corrupt one live calibration record at the pilot's refit intake "
+        "(measured_s scaled by an adversarial factor) before the fit "
+        "runs",
+        "the pilot's fit-error regression gate (candidate graded on the "
+        "TRUSTED records vs the pre-refit coefficients) rejects the "
+        "refit; decision journal shows trigger -> rejected; the run "
+        "stays DOC000",
+        "persisted calibration coefficients unchanged (bit-equal) — the "
+        "poisoned fit is never deployed; a subsequent clean refit "
+        "proceeds normally (keep-best in plan/calibrate.py is the "
+        "second, independent guard)"),
+    FaultSpec(
         "rolling_upgrade_under_load", "process",
         "drain + restart every replica in turn under sustained traffic "
         "(no hook — the 'fault' is the upgrade itself)",
@@ -412,6 +425,25 @@ def make_handlers(plant) -> Dict[str, Callable]:
             return None
 
         handlers[hooks.SEAM_SERVE_DRAFT] = serve_draft
+
+    if hooks.SEAM_PILOT_REFIT in seams:
+        def pilot_refit(records, **_):
+            from dataclasses import replace as _replace
+
+            for e in events(hooks.SEAM_PILOT_REFIT):
+                if e.fault != "poisoned_calibration" or not records:
+                    continue
+                scale = float(e.param("scale", 1000.0))
+                idx = plant.rng.randrange(len(records))
+                records = list(records)
+                records[idx] = _replace(
+                    records[idx],
+                    measured_s=float(records[idx].measured_s) * scale)
+                plant.record("poisoned_calibration", index=idx,
+                             detail=f"measured_s x{scale:g}")
+            return records
+
+        handlers[hooks.SEAM_PILOT_REFIT] = pilot_refit
 
     if hooks.SEAM_SERVE_STEP in seams:
         def serve_step(host=0, **_):

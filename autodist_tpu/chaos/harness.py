@@ -1299,6 +1299,118 @@ def scenario_rolling_upgrade_under_load(base: str) -> SoakResult:
         trace=plant.trace_bytes())
 
 
+def scenario_poisoned_calibration(base: str) -> SoakResult:
+    """An adversarial live window at the pilot's refit intake: the plant
+    scales one record's ``measured_s`` x1000 before the fit runs. The
+    pilot's trusted-set fit-error gate must reject the refit (decision
+    journal shows trigger -> rejected), the rollout path must never run,
+    the persisted calibration must stay byte-identical — and the
+    keep-best guard inside ``plan/calibrate.py`` must independently
+    refuse the same poisoned window when handed it directly (two belts,
+    either alone stops the deploy)."""
+    from dataclasses import replace as _dc_replace
+
+    from autodist_tpu.pilot import (
+        Controller,
+        ControllerConfig,
+        DecisionJournal,
+        FunctionRollout,
+        PilotContext,
+        PilotState,
+        PilotStateStore,
+        build_actions,
+    )
+    from autodist_tpu.plan.calibrate import (
+        CalibrationRecord,
+        TopologyCalibration,
+        calibrate_from_records,
+        topology_key,
+    )
+    from autodist_tpu.resource_spec import ResourceSpec
+
+    fault = "poisoned_calibration"
+    spec = ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+    calib_dir = os.path.join(base, "calib")
+    # Replayed profile: a fixed linear world (wire at 50% efficiency, a
+    # 2 ms compute floor) over enough points for the component fit.
+    rng = np.random.default_rng(13)
+    records = []
+    for i in range(10):
+        comm, upd, lat, act = (float(x) for x in rng.uniform(1e-4, 5e-3, 4))
+        measured = 2e-3 + 2.0 * comm + 1.25 * upd + 1.5 * lat + 1.0 * act
+        records.append(CalibrationRecord(
+            comm_s=comm, update_s=upd, latency_s=lat, act_sync_s=act,
+            measured_s=measured, name=f"rec{i}"))
+    calibrate_from_records(records, spec, device_kind="cpu",
+                           directory=calib_dir)
+    key = topology_key(spec, "cpu")
+    calib_path = os.path.join(calib_dir, f"calibration-{key}.json")
+    with open(calib_path, "rb") as f:
+        bytes_before = f.read()
+
+    pdir = os.path.join(base, "pilot")
+    store = PilotStateStore(os.path.join(pdir, "state.json"))
+    store.save(PilotState())
+    journal = DecisionJournal(os.path.join(pdir, "decisions.jsonl"))
+    deploys = [0]
+    ctrl = Controller(
+        store, journal,
+        build_actions(PilotContext(
+            resource_spec=spec, device_kind="cpu",
+            calibration_dir=calib_dir, pilot_dir=pdir,
+            live_records=lambda: list(records))),
+        FunctionRollout(
+            lambda old, new: deploys.__setitem__(0, deploys[0] + 1),
+            lambda n: {}),
+        config=ControllerConfig(cooldown_s=0.0))
+
+    schedule = ChaosSchedule(seed=29, events=(ChaosEvent(fault, at_step=0),))
+    plant = ChaosPlant(schedule)
+    with plant:
+        rec = ctrl.ingest_measured_wire(measured_s=1.0, priced_s=0.5)
+    _check(plant.injected(fault) == 1, fault,
+           "the plant never corrupted a live record")
+    _check(rec is not None and rec.verdict == "rejected", fault,
+           f"poisoned refit not rejected "
+           f"(verdict {rec.verdict if rec else None!r})")
+    _check(rec is not None and "poisoned_calibration" in rec.note, fault,
+           f"rejection not attributed to the fit-error gate: "
+           f"{rec.note if rec else None!r}")
+    _check(deploys[0] == 0, fault,
+           "a rejected refit still reached the rollout path")
+    with open(calib_path, "rb") as f:
+        _check(f.read() == bytes_before, fault,
+               "persisted calibration changed under a rejected refit")
+
+    # Second belt: hand the poisoned window straight to
+    # calibrate_from_records — keep-best must keep the prior coefficients
+    # and record the losing fit in the file's rejected_fits provenance.
+    poisoned = list(records)
+    poisoned[3] = _dc_replace(poisoned[3],
+                              measured_s=poisoned[3].measured_s * 1000.0)
+    prior = TopologyCalibration.load(calib_path)
+    kept = calibrate_from_records(poisoned, spec, device_kind="cpu",
+                                  directory=calib_dir)
+    _check(kept.coefficients == prior.coefficients
+           and kept.base_s == prior.base_s, fault,
+           "keep-best persisted a fit that regressed the merged set")
+    with open(calib_path, encoding="utf-8") as f:
+        doc = json.load(f)
+    _check(bool(doc.get("rejected_fits")), fault,
+           "the rejected fit left no rejected_fits provenance")
+    return SoakResult(
+        fault=fault, ok=True, injected=plant.injected(fault),
+        detected=["refit rejected", "journal trigger -> rejected",
+                  "keep-best held"],
+        expected=CATALOG[fault].detects, recovery_steps=0,
+        notes="poisoned live window rejected by the trusted-set gate "
+              "(coefficients byte-identical, rollout never ran); direct "
+              "calibrate_from_records refused the same window via "
+              "keep-best with rejected_fits provenance",
+        trace=plant.trace_bytes())
+
+
 # -------------------------------------------------------- supervised kill
 _KILL_CHILD = """\
 import json, os, signal, sys
@@ -1394,6 +1506,7 @@ SCENARIOS: Dict[str, Callable[[str], SoakResult]] = {
     "kill_mid_stochastic_stream": scenario_kill_mid_stochastic_stream,
     "replica_partition": scenario_replica_partition,
     "rolling_upgrade_under_load": scenario_rolling_upgrade_under_load,
+    "poisoned_calibration": scenario_poisoned_calibration,
 }
 
 

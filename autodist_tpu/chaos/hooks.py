@@ -34,6 +34,7 @@ __all__ = [
     "SEAM_AGG_SWEEP",
     "SEAM_HB_PUBLISH",
     "SEAM_HB_SWEEP",
+    "SEAM_PILOT_REFIT",
     "SEAM_SERVE_ADMIT",
     "SEAM_SERVE_DRAFT",
     "SEAM_SERVE_PAGES",
@@ -64,6 +65,7 @@ SEAM_SERVE_ADMIT = "serve.engine.admit"            # fire -> "defer" | raise
 SEAM_SERVE_STEP = "serve.engine.step"              # fire (may raise)
 SEAM_SERVE_PAGES = "serve.pages.alloc"             # fire -> "exhaust"
 SEAM_SERVE_DRAFT = "serve.spec.draft"              # fire -> "garbage"
+SEAM_PILOT_REFIT = "pilot.calibrate.refit"         # apply(live records)
 
 _lock = threading.Lock()
 _hooks: Dict[str, Callable] = {}

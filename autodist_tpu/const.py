@@ -111,6 +111,11 @@ class ENV:
     # flight when an ft base is exported, disabled otherwise;
     # AUTODIST_NO_FLIGHT=1 (read raw, not via this enum) opts out entirely.
     AUTODIST_FLIGHT_DIR = _EnvVar("")
+    # Autopilot control plane (docs/autopilot.md): dir for the deployed
+    # PilotState + decision journal. Empty = <AUTODIST_FT_DIR>/pilot (the
+    # launcher exports it next to AUTODIST_FT_DIR so the doctor and a
+    # restarted controller find the same decisions.jsonl).
+    AUTODIST_PILOT_DIR = _EnvVar("")
     SYS_DATA_PATH = _EnvVar("")
     SYS_RESOURCE_PATH = _EnvVar("")
 

@@ -226,6 +226,29 @@ def _read_spans(trace_dir: str, limit: int = 200) -> List[Dict[str, Any]]:
     return out[-limit:]
 
 
+def _read_pilot_decisions(base_dir: str) -> List[Dict[str, Any]]:
+    """Autopilot decision journal (``<base>/pilot/decisions.jsonl``) —
+    every retune the controller attempted, with its trigger evidence and
+    canary verdict, so a postmortem reads knob changes next to the sentry
+    findings that caused them (docs/autopilot.md). Read-only: the pilot
+    package is the ONE writer (check_patterns rule 11)."""
+    from autodist_tpu.pilot.journal import decisions_path, read_decisions
+
+    out: List[Dict[str, Any]] = []
+    for rec in read_decisions(decisions_path(base_dir)):
+        entry: Dict[str, Any] = {
+            "t": rec.t, "source": "pilot", "kind": "decision",
+            "decision_id": rec.decision_id, "trigger": rec.trigger,
+            "action": rec.action, "verdict": rec.verdict,
+        }
+        if rec.code:
+            entry["code"] = rec.code
+        if rec.note:
+            entry["note"] = rec.note
+        out.append(entry)
+    return out
+
+
 # ------------------------------------------------------------ classification
 def diagnose(base_dir: str, trace_out: str = "",
              tail_steps: int = 16) -> Diagnosis:
@@ -238,9 +261,10 @@ def diagnose(base_dir: str, trace_out: str = "",
     snapshots = _read_snapshots(os.path.join(base_dir, _SNAPSHOT_SUBDIR))
     bundles = _read_bundles(os.path.join(base_dir, _BUNDLE_SUBDIR))
     spans = _read_spans(trace_out or os.path.join(base_dir, _TRACE_SUBDIR))
+    pilot = _read_pilot_decisions(base_dir)
 
     timeline = sorted(
-        flight + heartbeats + snapshots + bundles + spans,
+        flight + heartbeats + snapshots + bundles + spans + pilot,
         key=lambda e: float(e.get("t", 0.0)))
     stats: Dict[str, Any] = {
         "flight_records": len(flight),
@@ -248,6 +272,7 @@ def diagnose(base_dir: str, trace_out: str = "",
         "snapshots": len(snapshots),
         "bundles": len(bundles),
         "spans": len(spans),
+        "pilot_decisions": len(pilot),
     }
     steps = [r for r in records if r.get("kind") == "step"]
     if steps:

@@ -1,0 +1,73 @@
+"""pilot: the feedback-directed control plane (docs/autopilot.md).
+
+Closes planned -> priced -> measured -> replan in production: the
+:class:`Controller` subscribes to the obs sensor surfaces (sentry
+findings, SLO burn rates + acceptance buckets, measured-wire
+attribution, flight-record replay), maps them through a declarative
+:class:`PolicyTable`, and deploys knob changes ONLY through guarded
+rollout paths (train: drain -> ``ft/elastic`` rebuild; serve: the
+router's ``rolling_upgrade()``) with a canary window, automatic rollback
+to the last-good :class:`PilotState`, an append-only fsync'd decision
+journal the doctor stitches into its timeline, and episode/cooldown/rate
+guards so the controller can never flap.
+
+This package is the ONE actuator over plan/serve knobs (check_patterns
+rule 11). ``python -m autodist_tpu.pilot --selftest`` is the
+zero-hardware closed-loop proof.
+"""
+from autodist_tpu.pilot.actions import (
+    ActionResult,
+    PilotContext,
+    build_actions,
+    load_plan_artifact,
+    save_plan_artifact,
+)
+from autodist_tpu.pilot.controller import Controller, ControllerConfig
+from autodist_tpu.pilot.journal import (
+    DecisionJournal,
+    DecisionRecord,
+    decisions_path,
+    latest_decisions,
+    pilot_dir,
+    read_decisions,
+)
+from autodist_tpu.pilot.policy import (
+    PolicyRule,
+    PolicyTable,
+    Trigger,
+    default_policy_table,
+)
+from autodist_tpu.pilot.rollout import (
+    FunctionRollout,
+    Rollout,
+    ServeRollout,
+    TrainRollout,
+)
+from autodist_tpu.pilot.state import KNOBS, PilotState, PilotStateStore
+
+__all__ = [
+    "ActionResult",
+    "Controller",
+    "ControllerConfig",
+    "DecisionJournal",
+    "DecisionRecord",
+    "FunctionRollout",
+    "KNOBS",
+    "PilotContext",
+    "PilotState",
+    "PilotStateStore",
+    "PolicyRule",
+    "PolicyTable",
+    "Rollout",
+    "ServeRollout",
+    "TrainRollout",
+    "Trigger",
+    "build_actions",
+    "decisions_path",
+    "default_policy_table",
+    "latest_decisions",
+    "load_plan_artifact",
+    "pilot_dir",
+    "read_decisions",
+    "save_plan_artifact",
+]

@@ -350,9 +350,20 @@ class TopologyCalibration:
         return os.path.join(d, f"calibration-{self.topology or 'default'}.json")
 
     def save(self, path: Optional[str] = None,
-             records: Sequence[CalibrationRecord] = ()) -> str:
+             records: Sequence[CalibrationRecord] = (),
+             rejected_fits: Sequence[dict] = ()) -> str:
         path = path or self.path_for()
         os.makedirs(os.path.dirname(path), exist_ok=True)
+        # Rejected refits (the keep-best guard in calibrate_from_records)
+        # ride the file as provenance: the existing history is carried
+        # forward on every save, newest-capped, so "why didn't the refit
+        # land" is answerable from the artifact alone.
+        prior_rejected: List[dict] = []
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                prior_rejected = list(json.load(f).get("rejected_fits", []))
+        except (OSError, ValueError, KeyError, TypeError):
+            prior_rejected = []
         doc = {
             "coefficients": self.coefficients,
             "base_s": self.base_s,
@@ -362,6 +373,7 @@ class TopologyCalibration:
             "error_before": self.error_before,
             "error_after": self.error_after,
             "records": [r.to_json() for r in records],
+            "rejected_fits": (prior_rejected + list(rejected_fits))[-32:],
         }
         tmp = f"{path}.tmp-{os.getpid()}"
         with open(tmp, "w", encoding="utf-8") as f:
@@ -462,14 +474,47 @@ def calibrate_from_records(
 ) -> TopologyCalibration:
     """Fit + (optionally) persist the per-topology calibration, merging the
     new records with any the existing file already holds (exact duplicates
-    collapsed, capped to the newest :data:`MAX_PERSISTED_RECORDS`)."""
+    collapsed, capped to the newest :data:`MAX_PERSISTED_RECORDS`).
+
+    Refits are KEEP-BEST: when the fresh fit predicts the merged record
+    set *worse* than the already-persisted coefficients do (a degenerate
+    live window, an adversarial record the pilot gate let through, a
+    regression to the scalar fallback), the persisted coefficients are
+    kept and the rejected fit is recorded in the file's ``rejected_fits``
+    provenance — live refits are monotone in fit error, so a production
+    replan loop can only sharpen the simulator, never degrade it. The
+    merged records still persist either way: evidence accumulates even
+    when a fit loses."""
     key = topology_key(resource_spec, device_kind)
     d = directory or default_calibration_dir()
     path = os.path.join(d, f"calibration-{key}.json")
     merged = _merge_records(load_records(path), records)
     calib = TopologyCalibration.fit(merged, device=device_kind, topology=key)
+    rejected_fits: List[dict] = []
+    prior = TopologyCalibration.load(path)
+    if prior is not None:
+        prior_err = prediction_error(merged, prior)
+        if (np.isfinite(prior_err) and np.isfinite(calib.error_after)
+                and calib.error_after > prior_err + 1e-12):
+            rejected_fits.append({
+                "coefficients": dict(calib.coefficients),
+                "base_s": calib.base_s,
+                "n_points": calib.n_points,
+                "error_after": calib.error_after,
+                "error_best": prior_err,
+            })
+            logging.warning(
+                "plan calibration (%s): refit rejected — error %.4f over "
+                "the merged records vs %.4f for the persisted fit; "
+                "keeping the previous coefficients (keep-best)",
+                key, calib.error_after, prior_err)
+            calib = TopologyCalibration(
+                coefficients=dict(prior.coefficients), base_s=prior.base_s,
+                device=device_kind or prior.device, topology=key,
+                n_points=len(merged), error_before=calib.error_before,
+                error_after=prior_err)
     if persist:
-        calib.save(path, records=merged)
+        calib.save(path, records=merged, rejected_fits=rejected_fits)
         logging.info(
             "plan calibration (%s): %d points, mean |rel err| %.1f%% -> "
             "%.1f%% -> %s", key, calib.n_points,

@@ -97,8 +97,14 @@ class _FleetWatch:
 
     def env(self) -> dict:
         """Role env every fleet process needs to heartbeat into the same
-        base dir the watchdog sweeps."""
-        return {ENV.AUTODIST_FT_DIR.name: self.config.base_dir}
+        base dir the watchdog sweeps. The pilot dir rides along so a
+        controller (and the doctor stitching its decision journal) agree
+        on one ``<base>/pilot`` across the fleet (docs/autopilot.md)."""
+        return {
+            ENV.AUTODIST_FT_DIR.name: self.config.base_dir,
+            ENV.AUTODIST_PILOT_DIR.name: os.path.join(
+                self.config.base_dir, "pilot"),
+        }
 
     def write_bundle(self, reason: str = "fleet_hung") -> Optional[str]:
         """Persist a doctor bundle — last heartbeats (per-peer state +

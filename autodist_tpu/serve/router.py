@@ -65,7 +65,7 @@ import threading
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -1218,7 +1218,8 @@ def build_test_fleet(n_replicas: int = 3, n_slots: int = 8,
                      registry: Optional[M.MetricsRegistry] = None,
                      config: Optional[RouterConfig] = None,
                      spec_decode: bool = False, spec_k: int = 4,
-                     prefix_cache: bool = False):
+                     prefix_cache: bool = False,
+                     engine_kwargs: Optional[Callable[[], dict]] = None):
     """An in-process CPU fleet for tests/chaos/bench: one plan compiled
     once (the byte-deterministic artifact a production factory would pull
     from ``plan/cache.py``), N replicas whose factories rebuild engine
@@ -1231,6 +1232,13 @@ def build_test_fleet(n_replicas: int = 3, n_slots: int = 8,
     plain: the exactly-once failover bars then also prove the lossless
     claim through journal replay, since every delivered stream must
     still match plain greedy bit for bit.
+
+    ``engine_kwargs`` (a zero-arg callable returning a kwargs dict) is
+    re-read every time a replica factory BUILDS — i.e. at start and at
+    every ``rolling_upgrade()`` restart. It is the knob seam the pilot's
+    serve rollout uses: the callable reads the deployed
+    ``PilotState`` store, so a rolling upgrade brings each replica up on
+    the new knobs while untouched replicas keep the complete old set.
 
     Returns ``(router, control_engine)``; the caller owns ``stop()``.
     """
@@ -1254,22 +1262,27 @@ def build_test_fleet(n_replicas: int = 3, n_slots: int = 8,
             draft_params, _shared_plan(params).mesh)
 
         def make_engine():
+            kw = dict(spec_k=spec_k, n_slots=n_slots, page_len=page_len,
+                      n_pages=n_pages, prefill_chunk=page_len,
+                      prefix_cache=prefix_cache)
+            if engine_kwargs is not None:
+                kw.update(engine_kwargs())
             return SpecDecodeEngine(
                 params, _shared_plan(params), draft_params, draft_plan,
                 decode_model=decode_model(cfg),
-                draft_decode_model=decode_model(cfg),
-                spec_k=spec_k, n_slots=n_slots, page_len=page_len,
-                n_pages=n_pages, prefill_chunk=page_len,
-                prefix_cache=prefix_cache)
+                draft_decode_model=decode_model(cfg), **kw)
     else:
         def make_engine():
             # prefix_cache=True gives every replica its OWN radix tree
             # (trees are per-engine state, like slot tables): failover
             # re-prefill then repopulates the survivor's tree organically.
+            kw = dict(n_slots=n_slots, page_len=page_len, n_pages=n_pages,
+                      prefill_chunk=page_len, prefix_cache=prefix_cache)
+            if engine_kwargs is not None:
+                kw.update(engine_kwargs())
             return InferenceEngine(
                 params, _shared_plan(params), decode_model=decode_model(cfg),
-                n_slots=n_slots, page_len=page_len, n_pages=n_pages,
-                prefill_chunk=page_len, prefix_cache=prefix_cache)
+                **kw)
 
     # The control/oracle engine is ALWAYS plain greedy: with a spec fleet
     # it is the independent decode path every delivered stream must match

@@ -550,6 +550,10 @@ def _format_result(measured: dict, errors: dict) -> tuple:
         # Which measured compiler-flag set (docs/measured/xla_flags.json)
         # was active — so rounds before/after a flag change stay comparable.
         result["xla_flag_set"] = os.environ["AUTODIST_BENCH_XLA_FLAG_SET"]
+        if os.environ.get("AUTODIST_BENCH_XLA_FLAG_STALE"):
+            # The pinned set was never measured in a session-stable A/B
+            # round — flag the line so nobody treats it as a baseline.
+            result["xla_flag_set_stale"] = True
     if head_name != "resnet":
         result["seq_len"] = head["seq"]
     # The non-head workload rides along as extras in BOTH directions —
@@ -1048,10 +1052,19 @@ def _apply_measured_xla_flags() -> str:
                         "docs", "measured", "xla_flags.json")
     try:
         with open(path, "r", encoding="utf-8") as f:
-            chosen = json.load(f).get("chosen", {})
+            doc = json.load(f)
     except (OSError, ValueError):
         return ""
+    chosen = doc.get("chosen", {})
     name = str(chosen.get("name", ""))
+    # Staleness guard: a pinned set whose ms/step was never measured in a
+    # session-stable A/B round is a tuning CANDIDATE, not a trusted
+    # baseline. The result line carries xla_flag_set_stale so rounds are
+    # never silently compared across an unproven flag change, and the
+    # autopilot round-robins such sets through its canary instead of
+    # trusting them (docs/autopilot.md).
+    if name and not (doc.get("measured") and doc.get("session_stable")):
+        os.environ["AUTODIST_BENCH_XLA_FLAG_STALE"] = "1"
     for env_key, doc_key in (("XLA_FLAGS", "xla_flags"),
                              ("LIBTPU_INIT_ARGS", "libtpu_init_args")):
         extra = str(chosen.get(doc_key, "") or "").strip()

@@ -307,6 +307,14 @@ class TestFaultClassPins:
         assert res.detected == ["zero drops", "exactly_once", "p99 bounded"]
         assert res.injected == 3    # one drain/restart cycle per replica
 
+    def test_poisoned_calibration_rejected_never_deployed(self, tmp_path):
+        res = _run("poisoned_calibration", tmp_path)
+        assert res.detected == ["refit rejected",
+                                "journal trigger -> rejected",
+                                "keep-best held"]
+        assert res.injected == 1
+        assert "byte-identical" in res.notes
+
 
 # ------------------------------------------------------ replay determinism
 class TestReplayDeterminism:

@@ -1,0 +1,698 @@
+"""Autopilot control-plane tests (docs/autopilot.md).
+
+The ISSUE-19 acceptance bars, as unit tests over fakes (the end-to-end
+closed loop against the REAL search/elastic/router machinery is
+``python -m autodist_tpu.pilot --selftest``):
+
+- **policy**: the default table maps every evidence code to exactly one
+  trigger class and one implemented action; duplicate claims are refused.
+- **state/journal**: knob changes are new versions, unknown knobs are
+  loud, the store round-trips atomically, the journal round-trips and
+  tolerates a torn tail.
+- **controller matrix**: each trigger fires its action exactly once per
+  episode (re-arm re-enables), cooldown + rate limiter stop flapping, a
+  canary regression rolls back bit-exactly, typed/raising rejections
+  never reach the rollout.
+- **crash consistency**: a controller death mid-rollout leaves the
+  write-ahead ``pending`` line; ``recover()`` lands the fleet on the
+  complete old state — old or new, never a torn mix.
+- **actions**: the knob-proposal functions honor their bounds, and an
+  UNMEASURED ``docs/measured/xla_flags.json`` is only ever a canary
+  candidate, never a baseline.
+- **refit gates**: the trusted-set fit-error gate rejects a poisoned
+  live window before any search runs, and ``plan/calibrate.py``'s
+  keep-best refit independently refuses a fit that regresses the merged
+  records (rejected_fits provenance, coefficients unchanged).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from autodist_tpu.pilot import (
+    KNOBS,
+    ActionResult,
+    Controller,
+    ControllerConfig,
+    DecisionJournal,
+    DecisionRecord,
+    FunctionRollout,
+    PilotContext,
+    PilotState,
+    PilotStateStore,
+    PolicyRule,
+    PolicyTable,
+    build_actions,
+    default_policy_table,
+    latest_decisions,
+    read_decisions,
+)
+from autodist_tpu.pilot.actions import (
+    FALLBACK_FLAG_SETS,
+    refit_replan,
+    tune_pool,
+    tune_serve_latency,
+    tune_spec_k,
+    tune_xla_flags,
+)
+from autodist_tpu.pilot.policy import ACTIONS
+
+
+def _spec():
+    from autodist_tpu.resource_spec import ResourceSpec
+
+    return ResourceSpec(resource_dict={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}]})
+
+
+def _linear_records(n=10, seed=13):
+    """A fixed linear world (wire at 50% efficiency, 2 ms floor) — enough
+    points for the component fit, same shape the chaos soak replays."""
+    from autodist_tpu.plan.calibrate import CalibrationRecord
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        comm, upd, lat, act = (float(x) for x in rng.uniform(1e-4, 5e-3, 4))
+        measured = 2e-3 + 2.0 * comm + 1.25 * upd + 1.5 * lat + 1.0 * act
+        out.append(CalibrationRecord(
+            comm_s=comm, update_s=upd, latency_s=lat, act_sync_s=act,
+            measured_s=measured, name=f"rec{i}"))
+    return out
+
+
+# ------------------------------------------------------------------ policy
+class TestPolicy:
+    def test_default_table_routes_every_code(self):
+        table = default_policy_table()
+        expect = {
+            "SLT001": "refit_replan", "wire_drift": "refit_replan",
+            "SNT004": "tune_bucket_bytes", "SNT005": "tune_xla_flags",
+            "SNT007": "tune_serve_latency", "SNT008": "tune_serve_latency",
+            "SNT009": "tune_pool", "burn_rate": "tune_pool",
+            "acceptance_drift": "tune_spec_k",
+        }
+        for code, action in expect.items():
+            rule = table.rule_for_code(code)
+            assert rule is not None and rule.action == action, code
+
+    def test_every_rule_action_is_implemented(self):
+        table = default_policy_table()
+        wired = set(build_actions(PilotContext()))
+        for rule in table.rules:
+            assert rule.action in ACTIONS
+            assert rule.action in wired
+
+    def test_duplicate_code_claim_refused(self):
+        with pytest.raises(ValueError, match="claimed by two"):
+            PolicyTable([
+                PolicyRule("a", ("SNT004",), "tune_bucket_bytes"),
+                PolicyRule("b", ("SNT004",), "tune_pool"),
+            ])
+        with pytest.raises(ValueError, match="duplicate trigger"):
+            PolicyTable([
+                PolicyRule("a", ("x",), "tune_pool"),
+                PolicyRule("a", ("y",), "tune_pool"),
+            ])
+
+    def test_describe_renders_the_whole_table(self):
+        rows = default_policy_table().describe()
+        assert [r["trigger"] for r in rows] == [
+            "wire_drift", "step_time_regression", "hbm_regression",
+            "serve_latency", "slo_burn", "acceptance_drift"]
+        assert all(r["description"] for r in rows)
+
+
+# ----------------------------------------------------------- state + store
+class TestPilotState:
+    def test_with_knobs_is_a_new_version(self):
+        s0 = PilotState()
+        s1 = s0.with_knobs(spec_k=6, n_pages=64)
+        assert (s1.version, s1.spec_k, s1.n_pages) == (1, 6, 64)
+        assert (s0.version, s0.spec_k, s0.n_pages) == (0, 4, 0)  # frozen
+
+    def test_unknown_knob_is_loud(self):
+        with pytest.raises(ValueError, match="unknown pilot knob"):
+            PilotState().with_knobs(spec_kk=5)
+
+    def test_version_is_not_a_knob(self):
+        with pytest.raises(ValueError):
+            PilotState().with_knobs(version=9)
+        assert "version" not in KNOBS
+        assert "version" not in PilotState().knobs()
+
+    def test_json_round_trip(self):
+        s = PilotState().with_knobs(
+            plan_id="abc123", bucket_bytes=1 << 20, xla_flag_set="base",
+            spec_k=2, prefill_chunk=16, n_pages=128)
+        assert PilotState.from_json(s.to_json()) == s
+
+    def test_store_round_trip_and_missing(self, tmp_path):
+        store = PilotStateStore(str(tmp_path / "pilot" / "state.json"))
+        assert store.load() is None
+        s = PilotState().with_knobs(plan_id="p1", n_pages=41)
+        store.save(s)
+        assert store.load() == s
+        # a torn file degrades to None, never raises
+        with open(store.path, "w", encoding="utf-8") as f:
+            f.write('{"version": 1, "plan_')
+        assert store.load() is None
+
+
+# ----------------------------------------------------------------- journal
+class TestJournal:
+    def test_record_round_trip(self):
+        rec = DecisionRecord(
+            decision_id="d1-1", trigger="wire_drift", code="wire_drift",
+            action="refit_replan", verdict="committed", t=12.5,
+            evidence={"drift": 0.4}, knobs_before={"version": 0},
+            knobs_after={"version": 1}, expected={"plan_id": "abc"},
+            measured={"baseline": {"step_s": 1.0}}, note="n")
+        assert DecisionRecord.from_json(rec.to_json()) == rec
+
+    def test_sparse_serialization(self):
+        d = DecisionRecord(decision_id="d1", trigger="t").to_json()
+        # empty fields stay off the wire; the journal is dense history
+        assert set(d) == {"decision_id", "trigger", "verdict", "t"}
+
+    def test_append_read_and_torn_tail(self, tmp_path):
+        path = str(tmp_path / "decisions.jsonl")
+        j = DecisionJournal(path, now=lambda: 7.0)
+        j.append(DecisionRecord(decision_id="a", trigger="x"))
+        j.append(DecisionRecord(decision_id="a", trigger="x",
+                                verdict="committed"))
+        with open(path, "a", encoding="utf-8") as f:
+            f.write('{"decision_id": "b", "trigg')  # crash mid-append
+        recs = read_decisions(path)
+        assert [r.verdict for r in recs] == ["pending", "committed"]
+        assert all(r.t == 7.0 for r in recs)
+
+    def test_latest_folds_to_newest_per_id(self, tmp_path):
+        path = str(tmp_path / "decisions.jsonl")
+        j = DecisionJournal(path)
+        j.append(DecisionRecord(decision_id="a", trigger="x"))
+        j.append(DecisionRecord(decision_id="b", trigger="y"))
+        j.append(DecisionRecord(decision_id="a", trigger="x",
+                                verdict="rolled_back"))
+        latest = latest_decisions(path)
+        assert latest["a"].verdict == "rolled_back"
+        assert latest["b"].verdict == "pending"
+
+    def test_ids_are_unique(self, tmp_path):
+        j = DecisionJournal(str(tmp_path / "d.jsonl"))
+        ids = {j.next_id() for _ in range(10)}
+        assert len(ids) == 10
+
+
+# ------------------------------------------------- controller decision flow
+class _Harness:
+    """A controller over fakes: a recording rollout, a scripted canary,
+    an injected clock, and one-knob actions for every policy action."""
+
+    def __init__(self, tmp_path, config=None, canary=None, actions=None,
+                 state=None):
+        self.store = PilotStateStore(str(tmp_path / "state.json"))
+        self.store.save(state or PilotState().with_knobs(
+            bucket_bytes=1 << 20, spec_k=4, prefill_chunk=64, n_pages=8))
+        self.journal = DecisionJournal(str(tmp_path / "decisions.jsonl"))
+        self.applies = []          # (old.version, new.version) per apply
+        self.canaries = list(canary or [])
+        self.clk = [0.0]
+
+        def _apply(old, new):
+            self.store.save(new)
+            self.applies.append((old.version, new.version))
+
+        def _canary(n):
+            return self.canaries.pop(0) if self.canaries else {"step_s": 1.0}
+
+        def _nudge(knob, delta):
+            def fn(state, ev):
+                return ActionResult(
+                    knobs={knob: getattr(state, knob) + delta},
+                    expected={knob: getattr(state, knob) + delta})
+            return fn
+
+        self.ctrl = Controller(
+            self.store, self.journal,
+            actions if actions is not None else {
+                "refit_replan": _nudge("bucket_bytes", 1),
+                "tune_bucket_bytes": _nudge("bucket_bytes", 1),
+                "tune_xla_flags": lambda s, e: ActionResult(
+                    knobs={"xla_flag_set": "base"}),
+                "tune_serve_latency": _nudge("spec_k", -1),
+                "tune_pool": _nudge("n_pages", 2),
+                "tune_spec_k": _nudge("spec_k", -1),
+            },
+            FunctionRollout(_apply, _canary),
+            config=config or ControllerConfig(
+                cooldown_s=0.0, canary_window=1),
+            clock=lambda: self.clk[0])
+
+    def verdicts(self):
+        return [r.verdict for r in self.journal.read()]
+
+
+class TestControllerMatrix:
+    def test_trigger_fires_exactly_once_per_episode(self, tmp_path):
+        h = _Harness(tmp_path)
+        rec = h.ctrl.ingest_finding({"code": "SNT008", "value": 0.9})
+        assert rec is not None and rec.verdict == "committed"
+        assert h.ctrl.state.spec_k == 3
+        # same excursion again: latched, no decision, no journal growth
+        for _ in range(3):
+            assert h.ctrl.ingest_finding({"code": "SNT008"}) is None
+        assert h.ctrl.stats["episode_gated"] == 3
+        assert h.verdicts() == ["pending", "committed"]
+        # recovery re-arms; the NEXT excursion acts again
+        h.ctrl.rearm("serve_latency")
+        rec2 = h.ctrl.ingest_finding({"code": "SNT008"})
+        assert rec2 is not None and rec2.verdict == "committed"
+        assert h.ctrl.state.spec_k == 2
+
+    def test_every_default_rule_fires_its_action(self, tmp_path):
+        h = _Harness(tmp_path)
+        for code, action in [
+                ("wire_drift", "refit_replan"),
+                ("SNT004", "tune_bucket_bytes"),
+                ("SNT005", "tune_xla_flags"),
+                ("SNT007", "tune_serve_latency"),
+                ("SNT009", "tune_pool"),
+                ("acceptance_drift", "tune_spec_k")]:
+            rec = h.ctrl.ingest_finding({"code": code})
+            assert rec is not None and rec.action == action, code
+            assert rec.verdict == "committed"
+        assert h.ctrl.stats["committed"] == 6
+
+    def test_cooldown_suppresses_flapping(self, tmp_path):
+        h = _Harness(tmp_path, config=ControllerConfig(
+            cooldown_s=50.0, canary_window=1))
+        assert h.ctrl.ingest_finding({"code": "SNT008"}).verdict == "committed"
+        lines = len(h.journal.read())
+        # the metric oscillates: recover -> excursion inside the cooldown
+        for t in (10.0, 20.0, 30.0):
+            h.clk[0] = t
+            h.ctrl.rearm("serve_latency")
+            assert h.ctrl.ingest_finding({"code": "SNT008"}) is None
+        assert h.ctrl.stats["cooldown_suppressed"] == 3
+        assert len(h.journal.read()) == lines  # suppressed = not journaled
+        assert len(h.applies) == 1
+        h.clk[0] = 100.0
+        h.ctrl.rearm("serve_latency")
+        assert h.ctrl.ingest_finding({"code": "SNT008"}).verdict == "committed"
+
+    def test_global_rate_limiter(self, tmp_path):
+        h = _Harness(tmp_path, config=ControllerConfig(
+            cooldown_s=0.0, max_actions_per_window=2, rate_window_s=100.0,
+            canary_window=1))
+        assert h.ctrl.ingest_finding({"code": "SNT004"}).verdict == "committed"
+        assert h.ctrl.ingest_finding({"code": "SNT005"}).verdict == "committed"
+        assert h.ctrl.ingest_finding({"code": "SNT009"}) is None
+        assert h.ctrl.stats["rate_limited"] == 1
+        # the window slides: past it, the suppressed trigger may act
+        h.clk[0] = 200.0
+        h.ctrl.rearm("slo_burn")
+        assert h.ctrl.ingest_finding({"code": "SNT009"}).verdict == "committed"
+
+    def test_canary_regression_rolls_back_bit_exact(self, tmp_path):
+        h = _Harness(tmp_path, canary=[{"step_s": 1.0, "hbm": 3.0},
+                                       {"step_s": 2.0, "hbm": 3.0}])
+        before = h.ctrl.state.to_json()
+        rec = h.ctrl.ingest_finding({"code": "SNT008"})
+        assert rec.verdict == "rolled_back"
+        assert rec.measured["regressed_on"] == ["step_s"]
+        # bit-exact restore: state object AND the store file
+        assert h.ctrl.state.to_json() == before
+        assert h.store.load().to_json() == before
+        # rollback is the same guarded path: forward apply then reverse
+        assert h.applies == [(1, 2), (2, 1)]
+        assert h.verdicts() == ["pending", "rolled_back"]
+
+    def test_nan_canary_metric_never_regresses(self, tmp_path):
+        h = _Harness(tmp_path, canary=[{"step_s": float("nan")},
+                                       {"step_s": 5.0}])
+        rec = h.ctrl.ingest_finding({"code": "SNT008"})
+        assert rec.verdict == "committed"  # NaN baseline = no evidence
+
+    def test_apply_failure_rolls_back(self, tmp_path):
+        calls = []
+
+        def controller(h):
+            def _apply(old, new):
+                calls.append((old.version, new.version))
+                if len(calls) == 1:
+                    raise RuntimeError("drain timed out")
+            h.ctrl.rollout = FunctionRollout(_apply, lambda n: {"m": 1.0})
+            return h.ctrl
+
+        h = _Harness(tmp_path)
+        rec = controller(h).ingest_finding({"code": "SNT008"})
+        assert rec.verdict == "rolled_back" and "drain timed out" in rec.note
+        assert calls == [(1, 2), (2, 1)]
+        assert h.ctrl.state.version == 1
+
+    def test_typed_rejection_never_reaches_rollout(self, tmp_path):
+        h = _Harness(tmp_path, actions={
+            "tune_pool": lambda s, e: ActionResult(rejected="pool at bound"),
+        })
+        rec = h.ctrl.ingest_finding({"code": "SNT009"})
+        assert rec.verdict == "rejected" and rec.note == "pool at bound"
+        assert h.applies == [] and h.ctrl.stats["rejected"] == 1
+        assert h.verdicts() == ["rejected"]  # no pending line either
+
+    def test_raising_action_is_a_typed_rejection(self, tmp_path):
+        def boom(s, e):
+            raise ValueError("bad evidence")
+
+        h = _Harness(tmp_path, actions={"tune_pool": boom})
+        rec = h.ctrl.ingest_finding({"code": "SNT009"})
+        assert rec.verdict == "rejected"
+        assert "action raised: ValueError" in rec.note
+        assert h.applies == []
+
+    def test_unwired_action_is_rejected(self, tmp_path):
+        h = _Harness(tmp_path, actions={})
+        rec = h.ctrl.ingest_finding({"code": "SNT004"})
+        assert rec.verdict == "rejected" and "no implementation" in rec.note
+
+    def test_write_ahead_pending_precedes_deploy(self, tmp_path):
+        seen = []
+        h = _Harness(tmp_path)
+        real_apply = h.ctrl.rollout._apply
+
+        def spying(old, new):
+            seen.append([r.verdict for r in h.journal.read()])
+            real_apply(old, new)
+
+        h.ctrl.rollout = FunctionRollout(spying, lambda n: {"m": 1.0})
+        h.ctrl.ingest_finding({"code": "SNT008"})
+        # at apply time the pending line was already on disk
+        assert seen == [["pending"]]
+
+    def test_measured_wire_gates_on_drift_bound(self, tmp_path):
+        h = _Harness(tmp_path)
+        assert h.ctrl.ingest_measured_wire(1.1, 1.0) is None  # 10% < bound
+        rec = h.ctrl.ingest_measured_wire(2.0, 1.0)
+        assert rec is not None and rec.trigger == "wire_drift"
+        # the write-ahead pending line carries the trigger evidence
+        pending = h.journal.read()[-2]
+        assert pending.verdict == "pending"
+        assert pending.evidence["drift"] == pytest.approx(1.0)
+        # in-bound measurement re-arms the episode
+        assert h.ctrl.ingest_measured_wire(2.0, 1.0) is None  # latched
+        assert h.ctrl.ingest_measured_wire(1.0, 1.0) is None  # re-arms
+        assert h.ctrl.ingest_measured_wire(2.0, 1.0) is not None
+        assert h.ctrl.ingest_measured_wire(1.0, 0.0) is None  # unpriced
+
+    def test_slo_report_burn_and_acceptance(self, tmp_path):
+        h = _Harness(tmp_path)
+        recs = h.ctrl.ingest_slo_report({
+            "burn_rate": {"fast": 3.2, "slow": 0.4, "windows_s": [300, 3600]},
+            "measured": {"acceptance_by_temperature": {
+                "0.0": 0.10, "0.7": 0.80, "nan": float("nan")}},
+        })
+        assert [r.trigger for r in recs] == ["slo_burn", "acceptance_drift"]
+        assert all(r.verdict == "committed" for r in recs)
+        # a healthy report re-arms both triggers
+        assert h.ctrl.ingest_slo_report({
+            "burn_rate": {"fast": 0.2, "slow": 0.1},
+            "measured": {"acceptance_by_temperature": {"0.0": 0.5}},
+        }) == []
+        assert h.ctrl.ingest_slo_report({
+            "burn_rate": {"fast": 3.2}, "measured": {}})[0].trigger == \
+            "slo_burn"
+
+    def test_flight_record_replay_only_reads_sentry(self, tmp_path):
+        h = _Harness(tmp_path)
+        recs = h.ctrl.ingest_flight_records([
+            {"kind": "step", "step": 1},
+            {"kind": "sentry", "code": "SNT004", "value": 1.3},
+            {"kind": "sentry", "code": "SNT004", "value": 1.4},  # latched
+            {"kind": "error", "error": "x"},
+        ])
+        assert len(recs) == 1 and recs[0].code == "SNT004"
+
+
+# ------------------------------------------------------- crash consistency
+class TestCrashRecovery:
+    """A dead controller mid-rollout must leave the fleet on a complete
+    state — old or new, never a torn mix — and ``recover()`` must finish
+    the interrupted decision as a journaled rollback."""
+
+    def _fleet_rollout(self, store, fleet, die_on=None):
+        def _apply(old, new):
+            store.save(new)  # store lands first (the atomic truth)
+            if die_on and die_on[0]:
+                die_on[0] = False
+                raise KeyboardInterrupt  # the controller process dies here
+            fleet["state"] = new
+        return FunctionRollout(_apply, lambda n: {"m": 1.0})
+
+    def test_dead_controller_mid_rollout_recovers_consistent(self, tmp_path):
+        store = PilotStateStore(str(tmp_path / "state.json"))
+        old = PilotState().with_knobs(n_pages=8)
+        store.save(old)
+        journal = DecisionJournal(str(tmp_path / "decisions.jsonl"))
+        fleet = {"state": old}
+        die = [True]
+        actions = {"tune_pool": lambda s, e: ActionResult(
+            knobs={"n_pages": s.n_pages + 2})}
+        ctrl = Controller(store, journal, actions,
+                          self._fleet_rollout(store, fleet, die_on=die),
+                          config=ControllerConfig(cooldown_s=0.0,
+                                                  canary_window=1))
+        # BaseException tears through the controller — nothing terminal
+        # is journaled, exactly like a process death after the store write
+        with pytest.raises(KeyboardInterrupt):
+            ctrl.ingest_finding({"code": "SNT009"})
+        assert [r.verdict for r in journal.read()] == ["pending"]
+        # torn moment: store has new, the fleet still runs old — but each
+        # is a COMPLETE state (the store file is atomic, the fleet object
+        # is whichever whole state was last deployed)
+        assert store.load().n_pages == 10 and fleet["state"].n_pages == 8
+
+        # next boot: a fresh controller recovers before ingesting
+        ctrl2 = Controller(store, journal, actions,
+                           self._fleet_rollout(store, fleet))
+        done = ctrl2.recover()
+        assert [r.verdict for r in done] == ["rolled_back"]
+        assert ctrl2.stats["recovered"] == 1
+        # fleet and store agree on the complete OLD state, bit-exactly
+        assert store.load().to_json() == old.to_json()
+        assert fleet["state"].to_json() == old.to_json()
+        assert ctrl2.state == old
+        # nothing pending remains; recover is idempotent
+        pend = [r for r in latest_decisions(journal.path).values()
+                if r.verdict == "pending"]
+        assert pend == [] and ctrl2.recover() == []
+
+    def test_recover_noop_on_clean_journal(self, tmp_path):
+        store = PilotStateStore(str(tmp_path / "state.json"))
+        store.save(PilotState())
+        journal = DecisionJournal(str(tmp_path / "decisions.jsonl"))
+        applies = []
+        ctrl = Controller(store, journal, {},
+                          FunctionRollout(lambda o, n: applies.append(1),
+                                          lambda n: {}))
+        assert ctrl.recover() == [] and applies == []
+
+
+# ------------------------------------------------------------ knob actions
+class TestActions:
+    def _ctx(self, tmp_path, **kw):
+        return PilotContext(pilot_dir=str(tmp_path / "pilot"),
+                            xla_flags_path=str(tmp_path / "xla_flags.json"),
+                            **kw)
+
+    def _write_flags(self, tmp_path, doc):
+        with open(tmp_path / "xla_flags.json", "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+
+    def test_xla_unmeasured_doc_is_candidate_not_baseline(self, tmp_path):
+        # the wedged-queue shape: a chosen set pinned without measurement
+        self._write_flags(tmp_path, {
+            "chosen": {"name": "overlap_all"}, "measured": False,
+            "session_stable": False, "results_ms_per_step": {}})
+        res = tune_xla_flags(self._ctx(tmp_path), PilotState(), {})
+        assert not res.is_rejected
+        # never re-trusts the pin: advances past it to the next candidate
+        assert res.knobs["xla_flag_set"] == "vmem128m"
+        assert res.expected["stale"] is True
+        assert res.expected["candidate_of"] == list(FALLBACK_FLAG_SETS)
+
+    def test_xla_measured_doc_picks_best(self, tmp_path):
+        self._write_flags(tmp_path, {
+            "measured": True, "session_stable": True,
+            "results_ms_per_step": {"base": 3.0, "lhs_on": 2.5}})
+        res = tune_xla_flags(self._ctx(tmp_path), PilotState(), {})
+        assert res.knobs == {"xla_flag_set": "lhs_on"}
+        assert res.expected["stale"] is False
+        # already deployed -> nothing to do
+        res2 = tune_xla_flags(self._ctx(tmp_path),
+                              PilotState().with_knobs(xla_flag_set="lhs_on"),
+                              {})
+        assert res2.is_rejected
+
+    def test_xla_measured_but_unstable_stays_candidate(self, tmp_path):
+        # measured without session_stable is NOT trustworthy (the A/B ran
+        # on a drifting session) — round-robin over its result names
+        self._write_flags(tmp_path, {
+            "measured": True, "session_stable": False,
+            "results_ms_per_step": {"base": 3.0, "lhs_on": 2.5}})
+        res = tune_xla_flags(self._ctx(tmp_path),
+                             PilotState().with_knobs(xla_flag_set="base"),
+                             {})
+        assert res.knobs == {"xla_flag_set": "lhs_on"}
+        assert res.expected["stale"] is True
+
+    def test_pool_grows_within_bound(self, tmp_path):
+        ctx = self._ctx(tmp_path, max_pages=64)
+        res = tune_pool(ctx, PilotState().with_knobs(n_pages=8), {})
+        assert res.knobs == {"n_pages": 10}  # +25%
+        assert tune_pool(ctx, PilotState(), {}).is_rejected  # unknown size
+        at_max = PilotState().with_knobs(n_pages=64)
+        assert tune_pool(ctx, at_max, {}).is_rejected
+
+    def test_spec_k_steps_toward_acceptance(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        low = {"acceptance_by_temperature": {"0.0": 0.1, "0.7": 0.8}}
+        high = {"acceptance_by_temperature": {"0.0": 0.95, "0.7": 0.93}}
+        band = {"acceptance_by_temperature": {"0.0": 0.5}}
+        s4 = PilotState()
+        assert tune_spec_k(ctx, s4, low).knobs == {"spec_k": 3}
+        assert tune_spec_k(ctx, s4, high).knobs == {"spec_k": 5}
+        assert tune_spec_k(ctx, s4, band).is_rejected
+        assert tune_spec_k(ctx, s4, {}).is_rejected  # no buckets
+        # bounds hold at both ends
+        assert tune_spec_k(ctx, PilotState().with_knobs(spec_k=1),
+                           low).is_rejected
+        assert tune_spec_k(ctx, PilotState().with_knobs(spec_k=8),
+                           high).is_rejected
+
+    def test_serve_latency_by_code(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        chunked = PilotState().with_knobs(prefill_chunk=64)
+        res = tune_serve_latency(ctx, chunked, {"code": "SNT007"})
+        assert res.knobs == {"prefill_chunk": 32}  # TTFT: halve the chunk
+        small = PilotState().with_knobs(prefill_chunk=5)
+        assert tune_serve_latency(ctx, small, {"code": "SNT007"}).knobs == \
+            {"prefill_chunk": 4}  # clamped at the floor
+        floor = PilotState().with_knobs(prefill_chunk=4)
+        assert tune_serve_latency(ctx, floor, {"code": "SNT007"}).is_rejected
+        # ITL: shed a unit of speculative k
+        res2 = tune_serve_latency(ctx, PilotState(), {"code": "SNT008"})
+        assert res2.knobs == {"spec_k": 3}
+        k1 = PilotState().with_knobs(spec_k=1)
+        assert tune_serve_latency(ctx, k1, {"code": "SNT008"}).is_rejected
+
+
+# ----------------------------------------------------- refit gates (belts)
+class TestRefitGates:
+    """The two independent belts against a poisoned live window. Neither
+    needs a model or a mesh: the trusted-set gate rejects BEFORE any
+    search runs, and keep-best lives entirely in plan/calibrate.py."""
+
+    def _seed_calibration(self, tmp_path):
+        from autodist_tpu.plan.calibrate import (
+            calibrate_from_records,
+            topology_key,
+        )
+
+        spec = _spec()
+        records = _linear_records()
+        calib_dir = str(tmp_path / "calib")
+        calibrate_from_records(records, spec, device_kind="cpu",
+                               directory=calib_dir)
+        key = topology_key(spec, "cpu")
+        return spec, records, calib_dir, os.path.join(
+            calib_dir, f"calibration-{key}.json")
+
+    def test_trusted_set_gate_rejects_poisoned_window(self, tmp_path):
+        from dataclasses import replace
+
+        spec, records, calib_dir, path = self._seed_calibration(tmp_path)
+        with open(path, "rb") as f:
+            before = f.read()
+        poisoned = [replace(r, measured_s=r.measured_s * 1000.0,
+                            name=f"live{i}")
+                    for i, r in enumerate(records[:4])]
+        ctx = PilotContext(resource_spec=spec, device_kind="cpu",
+                           calibration_dir=calib_dir,
+                           pilot_dir=str(tmp_path / "pilot"),
+                           live_records=lambda: poisoned)
+        res = refit_replan(ctx, PilotState(), {})
+        assert res.is_rejected and "poisoned_calibration" in res.rejected
+        # the journal-bound expected claim carries the gate's numbers
+        assert res.expected["err_trusted_after"] > \
+            res.expected["err_trusted_before"]
+        # nothing persisted, no plan artifact, file byte-identical
+        with open(path, "rb") as f:
+            assert f.read() == before
+        assert not os.path.isdir(os.path.join(str(tmp_path / "pilot"),
+                                              "plans"))
+
+    def test_refit_rejects_empty_live_window(self, tmp_path):
+        spec, _, calib_dir, _ = self._seed_calibration(tmp_path)
+        ctx = PilotContext(resource_spec=spec, device_kind="cpu",
+                           calibration_dir=calib_dir,
+                           live_records=lambda: [])
+        res = refit_replan(ctx, PilotState(), {})
+        assert res.is_rejected and "no live" in res.rejected
+
+    def test_keep_best_holds_against_regressing_fit(self, tmp_path):
+        from dataclasses import replace
+
+        from autodist_tpu.plan.calibrate import (
+            TopologyCalibration,
+            calibrate_from_records,
+            load_records,
+        )
+
+        spec, records, calib_dir, path = self._seed_calibration(tmp_path)
+        prior = TopologyCalibration.load(path)
+        n_before = len(load_records(path))
+        poisoned = [replace(records[3], measured_s=records[3].measured_s
+                            * 1000.0, name="poison")]
+        kept = calibrate_from_records(poisoned, spec, device_kind="cpu",
+                                      directory=calib_dir)
+        # coefficients held; the losing fit is provenance, not truth
+        assert kept.coefficients == prior.coefficients
+        assert kept.base_s == prior.base_s
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        assert len(doc["rejected_fits"]) == 1
+        assert doc["rejected_fits"][0]["error_after"] > \
+            doc["rejected_fits"][0]["error_best"]
+        # evidence still accumulates: the merged records persisted
+        assert len(load_records(path)) == n_before + 1
+
+    def test_keep_best_accepts_a_better_fit(self, tmp_path):
+        from autodist_tpu.plan.calibrate import calibrate_from_records
+
+        spec, _, calib_dir, path = self._seed_calibration(tmp_path)
+        # more points from the SAME linear world sharpen the fit
+        more = _linear_records(n=12, seed=29)
+        calib = calibrate_from_records(more, spec, device_kind="cpu",
+                                       directory=calib_dir)
+        assert np.isfinite(calib.error_after)
+        assert calib.error_after < 0.05  # the clean world fits tightly
+        with open(path, encoding="utf-8") as f:
+            assert json.load(f)["rejected_fits"] == []
+
+
+# -------------------------------------------------------- doctor stitching
+class TestDoctorStitch:
+    def test_decisions_land_in_the_doctor_timeline(self, tmp_path):
+        from autodist_tpu.obs.doctor import diagnose
+        from autodist_tpu.pilot.journal import decisions_path
+
+        j = DecisionJournal(decisions_path(str(tmp_path)), now=lambda: 5.0)
+        j.append(DecisionRecord(
+            decision_id="d9-1", trigger="wire_drift", code="wire_drift",
+            action="refit_replan", verdict="committed"))
+        diag = diagnose(str(tmp_path))
+        assert diag.stats["pilot_decisions"] == 1
+        pilot = [e for e in diag.timeline if e.get("source") == "pilot"]
+        assert pilot and pilot[0]["action"] == "refit_replan"
+        assert pilot[0]["verdict"] == "committed"

@@ -105,6 +105,19 @@ exits 1 listing ``file:line`` offenders. Rules:
     is untouched by design: the rule bans the *sampling* draw family,
     not weight init.
 
+11. **ONE actuator over plan/serve knobs** — constructing the autopilot's
+    deployed-state or decision-journal writers (``PilotState(`` /
+    ``PilotStateStore(`` / ``DecisionJournal(``) anywhere in
+    ``autodist_tpu/`` outside ``pilot/`` is banned (same single-home
+    policy as rules 8–10): the closed-loop retuning story — episode
+    gating, cooldown/rate limits, write-ahead journal, canary/rollback,
+    crash recovery to old-or-new-never-mixed — only holds because every
+    knob deploy flows through the one controller. A second actuator
+    writing ``plan``/``serve`` knobs would race the canary window and
+    corrupt the recovery contract (docs/autopilot.md). Read-side access
+    (``pilot_dir()`` / ``read_decisions``) is open to everyone — the
+    doctor stitches the journal into its timeline that way.
+
 Pure stdlib, no third-party deps — runs anywhere Python runs.
 """
 from __future__ import annotations
@@ -138,6 +151,9 @@ PREFIX_RE = re.compile(r"\bPrefixCache\s*\(|\b_RadixNode\s*\(")
 # Rule 10: serving-randomness draws outside serve/sampling.py.
 SAMPLING_RE = re.compile(
     r"\bjax\.random\.(categorical|gumbel|fold_in|bernoulli)\s*\(")
+# Rule 11: pilot actuator construction outside pilot/.
+PILOT_RE = re.compile(
+    r"\bPilotState\s*\(|\bPilotStateStore\s*\(|\bDecisionJournal\s*\(")
 
 
 def _py_files(*roots):
@@ -314,6 +330,24 @@ def main() -> int:
                         f"counter-based RNG home; a second sampler forks "
                         f"the replay bit-identity contract; "
                         f"docs/serving.md § stochastic sampling)")
+
+    # The chaos soak harness provisions a scratch controller in order to
+    # ATTACK it (poisoned_calibration) — a driver, not a second actuator.
+    pilot_allowed = {os.path.join("autodist_tpu", "chaos", "harness.py")}
+    for rel in _py_files("autodist_tpu"):
+        if rel in pilot_allowed or rel.startswith(
+                os.path.join("autodist_tpu", "pilot") + os.sep):
+            continue
+        with open(os.path.join(REPO, rel), "r", encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                code = line.split("#", 1)[0]
+                if PILOT_RE.search(code):
+                    errors.append(
+                        f"{rel}:{i}: pilot state/journal construction "
+                        f"outside autodist_tpu/pilot/ — the autopilot is "
+                        f"the ONE actuator over plan/serve knobs; deploy "
+                        f"through its Controller, read via "
+                        f"pilot.read_decisions (docs/autopilot.md)")
 
     if errors:
         print("banned-pattern lint FAILED:", file=sys.stderr)
