@@ -89,6 +89,7 @@ def analyze_plan(
     temp_bytes: float = 0.0,
     serve_pool_bytes: float = 0.0,
     serve_shared_fraction: float = 0.0,
+    serve_quant_capacity_x: float = 1.0,
     program: str = "",
     model_item=None,
 ) -> AnalysisReport:
@@ -110,7 +111,8 @@ def analyze_plan(
         plan, resource_spec=resource_spec, optimizer=optimizer,
         headroom=headroom, temp_bytes=temp_bytes,
         serve_pool_bytes=serve_pool_bytes,
-        serve_shared_fraction=serve_shared_fraction)
+        serve_shared_fraction=serve_shared_fraction,
+        serve_quant_capacity_x=serve_quant_capacity_x)
     report.extend(mem_findings)
     report.tables["memory"] = mem_summary
     if strategy is not None and model_item is not None:
@@ -130,6 +132,7 @@ def analyze_program(
     temp_bytes: float = 0.0,
     serve_pool_bytes: float = 0.0,
     serve_shared_fraction: float = 0.0,
+    serve_quant_capacity_x: float = 1.0,
     batch=None,
     batch_elements: Optional[int] = None,
     program: str = "",
@@ -147,6 +150,7 @@ def analyze_program(
         optimizer=optimizer, headroom=headroom, temp_bytes=temp_bytes,
         serve_pool_bytes=serve_pool_bytes,
         serve_shared_fraction=serve_shared_fraction,
+        serve_quant_capacity_x=serve_quant_capacity_x,
         program=program, model_item=model_item)
     if batch_elements is None and batch is not None:
         batch_elements = batch_element_count(batch)

@@ -251,6 +251,7 @@ def hbm_budget(
     temp_bytes: float = 0.0,
     serve_pool_bytes: float = 0.0,
     serve_shared_fraction: float = 0.0,
+    serve_quant_capacity_x: float = 1.0,
 ) -> Tuple[List[Finding], Dict]:
     """Static per-chip HBM budget from the lowered plan.
 
@@ -276,6 +277,18 @@ def hbm_budget(
     the summary so an overcommit report shows how hard sharing is
     already working (a 0.6 shared fraction means re-sharding, not a
     bigger pool, is the fix).
+
+    ``serve_quant_capacity_x`` (>= 1) annotates the pool tenant with the
+    int8-KV effective-capacity multiplier (the engine's
+    ``quant_capacity_x``: fp-equivalent bytes per physical pool byte —
+    ~3.76x for fp32 models at head_dim 64, including the f32 scale
+    planes). ``serve_pool_bytes`` stays the PHYSICAL quantized
+    allocation — that is what SLM001 must account, and it is how the
+    analyzer "sees" the real capacity win: at equal fp-equivalent KV
+    capacity a quantized pool contributes capacity_x fewer bytes to the
+    overcommit sum. The multiplier rides the summary so a report reader
+    can tell a small-because-quantized pool from a small-because-starved
+    one.
     """
     from autodist_tpu.strategy.cost_model import OPTIMIZER_SLOT_FACTOR
 
@@ -318,6 +331,10 @@ def hbm_budget(
         "serve_pool_gb_per_chip": float(serve_pool_bytes) / 1e9,
         "serve_shared_fraction": min(max(
             float(serve_shared_fraction), 0.0), 1.0),
+        "serve_quant_capacity_x": max(float(serve_quant_capacity_x), 1.0),
+        "serve_pool_fp_equiv_gb_per_chip": (
+            float(serve_pool_bytes)
+            * max(float(serve_quant_capacity_x), 1.0) / 1e9),
         "capacity_gb_per_chip": capacity / 1e9,
         "usable_gb_per_chip": usable / 1e9,
         "headroom": headroom,

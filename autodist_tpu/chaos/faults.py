@@ -164,6 +164,19 @@ CATALOG: Dict[str, FaultSpec] = {s.kind: s for s in (
         "from the same counter and asserts it bit-equal; exactly-once "
         "delivery holds for sampled streams exactly as for greedy"),
     FaultSpec(
+        "kill_mid_quantized_stream", hooks.SEAM_SERVE_STEP,
+        "raise EngineDeadError from ONE replica's decode step while it "
+        "serves from int8 QUANTIZED KV pages behind the router",
+        "router failover resumes every stream on a survivor with "
+        "delivered tokens bit-identical to an uninterrupted quantized "
+        "control — quantize-on-scatter is deterministic (amax/127 per "
+        "(position, head)), so the survivor's re-prefill reproduces the "
+        "dead replica's pages bit-exactly and the documented drift bound "
+        "holds trivially across the failover; error event -> DOC006",
+        "journaled prefix resume re-derives the overlap token against "
+        "freshly quantized pages and asserts it bit-equal; exactly-once "
+        "delivery holds for quantized serving exactly as for fp pages"),
+    FaultSpec(
         "replica_partition", hooks.SEAM_HB_PUBLISH,
         "drop ONE replica's control-plane beats for the window (the "
         "replica itself keeps serving — a partition, not a death)",
@@ -479,6 +492,19 @@ def make_handlers(plant) -> Dict[str, Callable]:
                     raise EngineDeadError(
                         f"chaos: injected replica {host} death mid-"
                         f"stochastic-stream")
+                if (e.fault == "kill_mid_quantized_stream"
+                        and int(e.host) == int(host)):
+                    from autodist_tpu.serve.engine import EngineDeadError
+
+                    plant.record_once(("kill_mid_quantized_stream",
+                                       e.at_step, int(host)),
+                                      "kill_mid_quantized_stream",
+                                      host=int(host),
+                                      detail="decode step raised mid-"
+                                             "quantized-stream")
+                    raise EngineDeadError(
+                        f"chaos: injected replica {host} death mid-"
+                        f"quantized-stream")
 
         handlers[hooks.SEAM_SERVE_STEP] = serve_step
 

@@ -963,16 +963,19 @@ def scenario_draft_divergence(base: str) -> SoakResult:
 
 
 # ------------------------------------------------------- router scenarios
-def _router_fleet(base: str, registry=None, config=None):
+def _router_fleet(base: str, registry=None, config=None,
+                  kv_quant: bool = False):
     """A 3-replica in-process router fleet + lone control engine, rooted
     at ``base`` (journals under ``base/journals``). Shares the
     byte-identical plan across replicas the way a production factory
-    shares the persistent plan cache."""
+    shares the persistent plan cache. ``kv_quant=True`` serves the whole
+    fleet (control included) from int8 quantized KV pages."""
     from autodist_tpu.serve.router import build_test_fleet
 
     return build_test_fleet(
         n_replicas=3, journal_dir=os.path.join(base, "journals"),
-        registry=registry or M.MetricsRegistry(), config=config)
+        registry=registry or M.MetricsRegistry(), config=config,
+        kv_quant=kv_quant)
 
 
 def scenario_replica_death(base: str) -> SoakResult:
@@ -1124,6 +1127,84 @@ def scenario_kill_mid_stochastic_stream(base: str) -> SoakResult:
         notes=f"{rerouted} in-flight sampled stream(s) rerouted to "
               f"survivors; every delivered stream bit-identical to its "
               f"uninterrupted control; exactly-once held",
+        trace=trace)
+
+
+def scenario_kill_mid_quantized_stream(base: str) -> SoakResult:
+    """Kill one of 3 replicas mid-decode while the whole fleet (control
+    included) serves from int8 QUANTIZED KV pages: the router fails the
+    streams over to survivors and every delivered stream is bit-identical
+    to the uninterrupted quantized control — quantize-on-scatter is
+    deterministic (amax/127 per (position, head)), so the survivor's
+    journal-replay re-prefill reproduces the dead replica's pages
+    bit-exactly, and the documented logit-drift bound (vs the fp oracle)
+    holds trivially across the failover because both sides of it ran the
+    same quantized math."""
+    from autodist_tpu.obs import doctor
+    from autodist_tpu.obs import recorder as obs_recorder
+    from autodist_tpu.serve.batcher import RequestState
+    from autodist_tpu.serve.replica import ReplicaState
+
+    fault = "kill_mid_quantized_stream"
+    obs_recorder.enable(obs_recorder.flight_dir(base))
+    reg = M.MetricsRegistry()
+    router, control = _router_fleet(base, registry=reg, kv_quant=True)
+    _check(getattr(control, "kv_quant", False), fault,
+           "the control engine is not serving quantized pages — the "
+           "scenario would compare fp to fp and prove nothing")
+    rng = np.random.default_rng(223)
+    prompts = [rng.integers(1, 127, size=int(rng.integers(3, 10)))
+               .astype(np.int32) for _ in range(12)]
+    expected = [control.generate(p, 6) for p in prompts]
+
+    schedule = ChaosSchedule(seed=59, events=(
+        ChaosEvent(fault, at_step=0, host=1),))
+    try:
+        with ChaosPlant(schedule) as plant:
+            router.start()
+            for rep in router.replicas.values():
+                rep.wait_ready(120.0)
+            fronts = [router.submit(p, max_new_tokens=6,
+                                    request_id=f"quant-{i}")
+                      for i, p in enumerate(prompts)]
+            states = [f.wait(120.0).state for f in fronts]
+            _check(all(s is RequestState.DONE for s in states), fault,
+                   f"not every quantized-stream request completed on the "
+                   f"survivors: {[s.value for s in states]}")
+            _check(plant.injected(fault) == 1, fault,
+                   "the targeted decode-step seam never fired")
+            _check(retry.wait_until(
+                lambda: router.replica_state(1) is ReplicaState.DEAD, 10.0),
+                fault, "router never classified the killed replica DEAD")
+            trace = plant.trace_bytes()
+        streams_ok = all(f.tokens == expected[i]
+                         for i, f in enumerate(fronts))
+        _check(streams_ok, fault,
+               "a failed-over QUANTIZED stream diverged from the "
+               "uninterrupted quantized control — quantize-on-scatter "
+               "re-prefill was not deterministic")
+        ledger = router.ledger()
+        _check(len(ledger) == len(prompts)
+               and all(v == 1 for v in ledger.values()), fault,
+               f"exactly-once violated: ledger {ledger}")
+        rerouted = int(reg.counter(
+            "serve_router_requests_rerouted_total").value)
+        _check(rerouted >= 1, fault,
+               "no request was actually in flight on the killed replica")
+        router.stop(drain=False)
+    finally:
+        obs_recorder.disable(ok=True)
+
+    diag = doctor.diagnose(base)
+    _check(diag.code == "DOC006", fault,
+           f"doctor said {diag.code}, expected DOC006 (crash)")
+    return SoakResult(
+        fault=fault, ok=True, injected=1,
+        detected=["DEAD", "quantized_bit_identity", "DOC006"],
+        expected=CATALOG[fault].detects, recovery_steps=0,
+        notes=f"{rerouted} in-flight quantized stream(s) rerouted to "
+              f"survivors; every delivered stream bit-identical to its "
+              f"uninterrupted quantized control; exactly-once held",
         trace=trace)
 
 
@@ -1504,6 +1585,7 @@ SCENARIOS: Dict[str, Callable[[str], SoakResult]] = {
     "worker_kill": scenario_worker_kill,
     "replica_death": scenario_replica_death,
     "kill_mid_stochastic_stream": scenario_kill_mid_stochastic_stream,
+    "kill_mid_quantized_stream": scenario_kill_mid_quantized_stream,
     "replica_partition": scenario_replica_partition,
     "rolling_upgrade_under_load": scenario_rolling_upgrade_under_load,
     "poisoned_calibration": scenario_poisoned_calibration,

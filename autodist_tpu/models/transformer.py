@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from autodist_tpu.models import layers as L
 from autodist_tpu.models.spec import ModelSpec, register_model
+from autodist_tpu.ops import paged_attention as pa_ops
 
 
 @dataclass
@@ -44,6 +45,17 @@ class TransformerConfig:
     # seq length recorded in docs/measured/flash_crossover.json); explicit
     # dot | flash | ring | ulysses always honored.
     attention_impl: str = "auto"
+    # Serving-path attention over the paged KV pool: gather (materialize the
+    # timeline, XLA-fused attend — the pre-PR-20 programs, bit-preserved) |
+    # kernel (pallas page-walking online softmax, ops/paged_attention.py) |
+    # auto (measured crossover per shape, docs/measured/paged_crossover.json
+    # via ops/crossover.py; always gather off-TPU).
+    paged_attention_impl: str = "auto"
+    # int8 KV pages with per-position/per-head f32 scales: quantize on
+    # scatter, dequantize in the gather/kernel. ~3.76x effective pool
+    # capacity at fp32/D=64 (68 bytes vs 256 per head-row); streams drift
+    # within the documented logit bound (docs/serving.md § quantized pages).
+    kv_quant: bool = False
     remat: bool = False
     mlm_mask_token: int = 0             # [MASK] id for the MLM objective
 
@@ -102,7 +114,7 @@ def _dot_attention(q, k, v, causal: bool):
     if causal:
         sq, sk = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((sq, sk), bool))
-        logits = jnp.where(mask, logits, -1e30)
+        logits = pa_ops.apply_mask(logits, mask)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -271,7 +283,7 @@ def forward_decode_step(params, tokens, positions, cache, cfg: TransformerConfig
     rows = jnp.arange(b)
     x = L.embedding_lookup(params["embed"], tokens).astype(cfg.dtype)
     x = x + L.embedding_lookup(params["pos_embed"], positions).astype(cfg.dtype)
-    mask = (jnp.arange(max_len)[None, :] <= positions[:, None])  # [B, L]
+    mask = pa_ops.position_mask(max_len, positions)              # [B, L]
     for i in range(cfg.num_layers):
         block_params = params[f"layers_{i}"]
         h = L.layernorm(block_params["ln1"], x)
@@ -289,7 +301,7 @@ def forward_decode_step(params, tokens, positions, cache, cfg: TransformerConfig
         cv = cache["v"][i].astype(cfg.dtype)
         logits = jnp.einsum("bhd,blhd->bhl", q, ck).astype(jnp.float32)
         logits = logits / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
-        logits = jnp.where(mask[:, None, :], logits, -1e30)
+        logits = pa_ops.apply_mask(logits, mask[:, None, :])
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         o = jnp.einsum("bhl,blhd->bhd", probs, cv).reshape(b, cfg.d_model)
         x = x + L.dense(attn_p["wo"], o, compute_dtype=cfg.dtype)
@@ -306,7 +318,8 @@ def forward_decode_step(params, tokens, positions, cache, cfg: TransformerConfig
 
 # --------------------------------------------------------- paged KV decode
 def init_paged_kv_cache(cfg: TransformerConfig, n_pages: int, page_len: int,
-                        dtype: Any = None) -> Dict[str, Any]:
+                        dtype: Any = None,
+                        quantized: Optional[bool] = None) -> Dict[str, Any]:
     """Paged decode cache: ONE pool of fixed-size KV pages shared by every
     concurrent request — ``[num_layers, n_pages, page_len, heads,
     head_dim]`` per projection. Which pages hold which request's timeline
@@ -315,24 +328,60 @@ def init_paged_kv_cache(cfg: TransformerConfig, n_pages: int, page_len: int,
     place, so steady-state serving allocates nothing and slot utilization
     no longer depends on guessing a length distribution (the vLLM
     rendering of GSPMD's static-annotation premise, docs/serving.md).
+
+    With ``cfg.kv_quant`` (or ``quantized=True``) the pages hold int8 with
+    f32 per-(page, position, head) scale planes alongside — same leading
+    dims, so the engine's dim1-keyed sharding, COW page copy, and byte
+    pricing all pick the scales up without special cases.
     """
-    dtype = dtype or cfg.dtype
+    if quantized is None:
+        quantized = bool(getattr(cfg, "kv_quant", False))
     shape = (cfg.num_layers, n_pages, page_len, cfg.num_heads, cfg.head_dim)
+    if quantized:
+        sshape = shape[:-1]
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(sshape, jnp.float32),
+                "v_scale": jnp.zeros(sshape, jnp.float32)}
+    dtype = dtype or cfg.dtype
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def _paged_gather(cache_layer, page_tables):
-    """Gather one layer's KV timeline(s) by page index.
+def _resolve_paged_impl(cfg: TransformerConfig, batch: int,
+                        table_pages: int, page_len: int) -> str:
+    """Trace-time kernel-vs-gather choice for one paged program — static,
+    so the engine's compiled-program pins (2 serve + 1 verify) never fork
+    on it. The math itself lives in ops/paged_attention.py only."""
+    from autodist_tpu.ops.crossover import resolve_paged_impl
 
-    ``cache_layer [n_pages, page_len, H, D]``; ``page_tables`` is ``[P]``
-    (one request) or ``[B, P]`` (the decode batch). Returns the gathered
-    timeline ``[..., P * page_len, H, D]``. Pad entries point at the
-    scratch page — finite garbage the caller's position mask excludes.
-    """
-    page_len, h, d = cache_layer.shape[1:]
-    gathered = cache_layer[page_tables]          # [..., P, page_len, H, D]
-    return gathered.reshape(
-        page_tables.shape[:-1] + (page_tables.shape[-1] * page_len, h, d))
+    return resolve_paged_impl(cfg.paged_attention_impl, batch, table_pages,
+                              page_len, cfg.num_heads)
+
+
+def _paged_scatter(cache, layer, page_of, off, k, v):
+    """Write one program's k/v rows through the page table indices —
+    quantize-on-scatter when the cache carries int8 pages (scales land in
+    the matching ``*_scale`` planes), plain dtype cast otherwise."""
+    if "k_scale" in cache:
+        kq, ks = pa_ops.quantize_kv(k)
+        vq, vs = pa_ops.quantize_kv(v)
+        cache["k"] = cache["k"].at[layer, page_of, off].set(kq)
+        cache["v"] = cache["v"].at[layer, page_of, off].set(vq)
+        cache["k_scale"] = cache["k_scale"].at[layer, page_of, off].set(ks)
+        cache["v_scale"] = cache["v_scale"].at[layer, page_of, off].set(vs)
+    else:
+        cache_dtype = cache["k"].dtype
+        cache["k"] = cache["k"].at[layer, page_of, off].set(
+            k.astype(cache_dtype))
+        cache["v"] = cache["v"].at[layer, page_of, off].set(
+            v.astype(cache_dtype))
+    return cache
+
+
+def _layer_scales(cache, layer):
+    if "k_scale" in cache:
+        return cache["k_scale"][layer], cache["v_scale"][layer]
+    return None, None
 
 
 def forward_paged_prefill_chunk(params, tokens, start, length, cache,
@@ -365,16 +414,15 @@ def forward_paged_prefill_chunk(params, tokens, start, length, cache,
     """
     b, c = tokens.shape
     page_len = cache["k"].shape[2]
-    timeline = page_table.shape[0] * page_len
     pos = start + jnp.arange(c)                                   # [C] absolute
     page_of = page_table[pos // page_len]                         # [C]
     off = pos % page_len
+    impl = _resolve_paged_impl(cfg, 1, page_table.shape[0], page_len)
     # Clamp the positional-embedding lookup only: pad positions may sit past
     # the table (their k/v land in scratch) but must still embed in-range.
     emb_pos = jnp.minimum(pos, cfg.max_seq_len - 1)
     x = L.embedding_lookup(params["embed"], tokens).astype(cfg.dtype)
     x = x + L.embedding_lookup(params["pos_embed"], emb_pos).astype(cfg.dtype)
-    mask = jnp.arange(timeline)[None, :] <= pos[:, None]          # [C, T]
     for i in range(cfg.num_layers):
         block_params = params[f"layers_{i}"]
         h = L.layernorm(block_params["ln1"], x)
@@ -385,16 +433,12 @@ def forward_paged_prefill_chunk(params, tokens, start, length, cache,
         q = q.reshape(c, cfg.num_heads, cfg.head_dim)
         k = k.reshape(c, cfg.num_heads, cfg.head_dim)
         v = v.reshape(c, cfg.num_heads, cfg.head_dim)
-        cache_dtype = cache["k"].dtype
-        cache["k"] = cache["k"].at[i, page_of, off].set(k.astype(cache_dtype))
-        cache["v"] = cache["v"].at[i, page_of, off].set(v.astype(cache_dtype))
-        ck = _paged_gather(cache["k"][i], page_table).astype(cfg.dtype)
-        cv = _paged_gather(cache["v"][i], page_table).astype(cfg.dtype)
-        logits = jnp.einsum("chd,thd->hct", q, ck).astype(jnp.float32)
-        logits = logits / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
-        logits = jnp.where(mask[None, :, :], logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-        o = jnp.einsum("hct,thd->chd", probs, cv).reshape(b, c, cfg.d_model)
+        cache = _paged_scatter(cache, i, page_of, off, k, v)
+        ks, vs = _layer_scales(cache, i)
+        o = pa_ops.paged_prefill_attention(
+            q, cache["k"][i], cache["v"][i], page_table, pos,
+            k_scale=ks, v_scale=vs, impl=impl,
+            compute_dtype=cfg.dtype).reshape(b, c, cfg.d_model)
         x = x + L.dense(attn_p["wo"], o, compute_dtype=cfg.dtype)
         h = L.layernorm(block_params["ln2"], x)
         h = L.dense(block_params["mlp"]["fc1"], h, compute_dtype=cfg.dtype)
@@ -418,7 +462,8 @@ def forward_paged_prefill_chunk(params, tokens, start, length, cache,
 
 
 def forward_paged_decode_step(params, tokens, positions, cache, page_tables,
-                              cfg: TransformerConfig, samp=None):
+                              cfg: TransformerConfig, samp=None,
+                              return_logits: bool = False):
     """One incremental decode step over every decode row: the SINGLE
     compiled decode program for all active requests.
 
@@ -434,14 +479,13 @@ def forward_paged_decode_step(params, tokens, positions, cache, page_tables,
     """
     b = tokens.shape[0]
     page_len = cache["k"].shape[2]
-    timeline = page_tables.shape[1] * page_len
     rows = jnp.arange(b)
     page_of = page_tables[rows, positions // page_len]            # [B]
     off = positions % page_len
+    impl = _resolve_paged_impl(cfg, b, page_tables.shape[1], page_len)
     emb_pos = jnp.minimum(positions, cfg.max_seq_len - 1)
     x = L.embedding_lookup(params["embed"], tokens).astype(cfg.dtype)
     x = x + L.embedding_lookup(params["pos_embed"], emb_pos).astype(cfg.dtype)
-    mask = jnp.arange(timeline)[None, :] <= positions[:, None]    # [B, T]
     for i in range(cfg.num_layers):
         block_params = params[f"layers_{i}"]
         h = L.layernorm(block_params["ln1"], x)
@@ -452,16 +496,12 @@ def forward_paged_decode_step(params, tokens, positions, cache, page_tables,
         q = q.reshape(b, cfg.num_heads, cfg.head_dim)
         k = k.reshape(b, cfg.num_heads, cfg.head_dim)
         v = v.reshape(b, cfg.num_heads, cfg.head_dim)
-        cache_dtype = cache["k"].dtype
-        cache["k"] = cache["k"].at[i, page_of, off].set(k.astype(cache_dtype))
-        cache["v"] = cache["v"].at[i, page_of, off].set(v.astype(cache_dtype))
-        ck = _paged_gather(cache["k"][i], page_tables).astype(cfg.dtype)
-        cv = _paged_gather(cache["v"][i], page_tables).astype(cfg.dtype)
-        logits = jnp.einsum("bhd,bthd->bht", q, ck).astype(jnp.float32)
-        logits = logits / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
-        logits = jnp.where(mask[:, None, :], logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-        o = jnp.einsum("bht,bthd->bhd", probs, cv).reshape(b, cfg.d_model)
+        cache = _paged_scatter(cache, i, page_of, off, k, v)
+        ks, vs = _layer_scales(cache, i)
+        o = pa_ops.paged_decode_attention(
+            q, cache["k"][i], cache["v"][i], page_tables, positions,
+            k_scale=ks, v_scale=vs, impl=impl,
+            compute_dtype=cfg.dtype).reshape(b, cfg.d_model)
         x = x + L.dense(attn_p["wo"], o, compute_dtype=cfg.dtype)
         h = L.layernorm(block_params["ln2"], x)
         h = L.dense(block_params["mlp"]["fc1"], h, compute_dtype=cfg.dtype)
@@ -471,6 +511,12 @@ def forward_paged_decode_step(params, tokens, positions, cache, page_tables,
     x = L.layernorm(params["ln_f"], x)
     logits = (x.astype(cfg.dtype)
               @ params["embed"]["embedding"].T.astype(cfg.dtype))
+    if return_logits:
+        # Drift-probe path (tests / selftest only — never compiled by the
+        # engine, so the program pins don't see it): expose the fp32
+        # logits next to the token for quant-vs-fp oracle comparison.
+        return (jnp.argmax(logits.astype(jnp.float32), axis=-1)
+                .astype(jnp.int32), logits.astype(jnp.float32), cache)
     if samp is None:
         return (jnp.argmax(logits.astype(jnp.float32), axis=-1)
                 .astype(jnp.int32), cache)
@@ -520,7 +566,7 @@ def forward_paged_verify(params, tokens, positions, cache, page_tables,
     b, k1 = tokens.shape
     page_len = cache["k"].shape[2]
     n_tables = page_tables.shape[1]
-    timeline = n_tables * page_len
+    impl = _resolve_paged_impl(cfg, b, n_tables, page_len)
     rows_pos = positions[:, None] + jnp.arange(k1)[None, :]       # [B, K1]
     pidx = rows_pos // page_len
     # Past the static table width -> the reserved scratch page (0): the
@@ -541,7 +587,6 @@ def forward_paged_verify(params, tokens, positions, cache, page_tables,
     emb_ids = jnp.clip(tokens, 0, cfg.vocab_size - 1)
     x = L.embedding_lookup(params["embed"], emb_ids).astype(cfg.dtype)
     x = x + L.embedding_lookup(params["pos_embed"], emb_pos).astype(cfg.dtype)
-    mask = jnp.arange(timeline)[None, None, :] <= rows_pos[:, :, None]
     for i in range(cfg.num_layers):
         block_params = params[f"layers_{i}"]
         h = L.layernorm(block_params["ln1"], x)
@@ -552,16 +597,12 @@ def forward_paged_verify(params, tokens, positions, cache, page_tables,
         q = q.reshape(b, k1, cfg.num_heads, cfg.head_dim)
         k = k.reshape(b, k1, cfg.num_heads, cfg.head_dim)
         v = v.reshape(b, k1, cfg.num_heads, cfg.head_dim)
-        cache_dtype = cache["k"].dtype
-        cache["k"] = cache["k"].at[i, page_of, off].set(k.astype(cache_dtype))
-        cache["v"] = cache["v"].at[i, page_of, off].set(v.astype(cache_dtype))
-        ck = _paged_gather(cache["k"][i], page_tables).astype(cfg.dtype)
-        cv = _paged_gather(cache["v"][i], page_tables).astype(cfg.dtype)
-        logits = jnp.einsum("bqhd,bthd->bhqt", q, ck).astype(jnp.float32)
-        logits = logits / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
-        logits = jnp.where(mask[:, None, :, :], logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-        o = jnp.einsum("bhqt,bthd->bqhd", probs, cv).reshape(b, k1, cfg.d_model)
+        cache = _paged_scatter(cache, i, page_of, off, k, v)
+        ks, vs = _layer_scales(cache, i)
+        o = pa_ops.paged_verify_attention(
+            q, cache["k"][i], cache["v"][i], page_tables, rows_pos,
+            k_scale=ks, v_scale=vs, impl=impl,
+            compute_dtype=cfg.dtype).reshape(b, k1, cfg.d_model)
         x = x + L.dense(attn_p["wo"], o, compute_dtype=cfg.dtype)
         h = L.layernorm(block_params["ln2"], x)
         h = L.dense(block_params["mlp"]["fc1"], h, compute_dtype=cfg.dtype)

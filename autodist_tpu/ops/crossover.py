@@ -1,4 +1,4 @@
-"""Measured flash-attention crossover: when "auto" picks the Pallas kernel.
+"""Measured attention crossovers: when "auto" picks a Pallas kernel.
 
 ``examples/benchmark/flash_crossover.py`` sweeps the transformer step with
 ``attention_impl`` "dot" vs "flash" over sequence lengths on the real
@@ -13,6 +13,15 @@ This module turns the table into the ONE decision rule the transformer's
 which flash never loses to dot again. Below it, or when the sequence is not
 block-aligned (the kernel would fall back to the jnp reference anyway),
 "auto" resolves to "dot".
+
+The serving stack's ``paged_attention_impl="auto"`` gets the same treatment:
+``examples/benchmark/paged_crossover.py`` sweeps decode steps with the
+paged-attention gather vs the page-walking pallas kernel
+(ops/paged_attention.py) over (batch, table width, heads) shapes and records
+``docs/measured/paged_crossover.json``; :func:`resolve_paged_impl` picks
+"kernel" from the smallest timeline at which the kernel never loses for the
+nearest recorded (batch, heads) bucket. Off-TPU, "auto" always resolves to
+"gather" — interpret-mode pallas is a correctness vehicle, not a fast path.
 """
 from __future__ import annotations
 
@@ -77,3 +86,81 @@ def resolve_attention_impl(impl: str, seq_len: int) -> str:
     if seq_len >= flash_crossover_seq() and seq_len % _FLASH_BLOCK == 0:
         return "flash"
     return "dot"
+
+
+# ------------------------------------------------------- paged kernel-vs-gather
+#: Fallback paged crossover when no measured table is readable: the timeline
+#: width (table pages * page_len) from which the page-walking kernel beats
+#: the materialize-then-attend gather (docs/measured/paged_crossover.json).
+DEFAULT_PAGED_CROSSOVER_TIMELINE = 1024
+
+
+def _paged_measured_path() -> str:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, "docs", "measured", "paged_crossover.json")
+
+
+def paged_crossover_timeline(batch: Optional[int] = None,
+                             heads: Optional[int] = None,
+                             path: Optional[str] = None) -> int:
+    """Smallest measured timeline width from which "kernel" never loses to
+    "gather" (tokens/sec) for the nearest recorded (batch, heads) bucket;
+    the packaged default when the file is missing, unreadable, or records
+    no crossover. Cached per (path, batch, heads) — the resolution runs
+    inside the serving programs' tracing."""
+    key = ("paged", path or "__default__", batch, heads)
+    if key in _cache:
+        return _cache[key]
+    out = DEFAULT_PAGED_CROSSOVER_TIMELINE
+    try:
+        with open(path or _paged_measured_path(), "r",
+                  encoding="utf-8") as f:
+            rows = json.load(f).get("rows", [])
+        # Nearest recorded (batch, heads) bucket: the sweep records a few
+        # decode-shaped points, not the full cross product.
+        def _dist(r):
+            d = 0.0
+            if batch is not None and "batch" in r:
+                d += abs(float(r["batch"]) - batch)
+            if heads is not None and "heads" in r:
+                d += abs(float(r["heads"]) - heads)
+            return d
+        if rows and (batch is not None or heads is not None):
+            best = min(_dist(r) for r in rows)
+            rows = [r for r in rows if _dist(r) == best]
+        by_tl: dict = {}
+        for r in rows:
+            tl = int(r["table_pages"]) * int(r["page_len"])
+            by_tl.setdefault(tl, {})[str(r["impl"])] = float(
+                r["tokens_per_sec"])
+        tls = sorted(t for t, v in by_tl.items()
+                     if "gather" in v and "kernel" in v)
+        for i, t in enumerate(tls):
+            if all(by_tl[u]["kernel"] >= by_tl[u]["gather"]
+                   for u in tls[i:]):
+                out = t
+                break
+    except (OSError, ValueError, KeyError, TypeError):
+        pass  # unmeasured installs use the packaged default
+    _cache[key] = out
+    return out
+
+
+def resolve_paged_impl(impl: str, batch: int, table_pages: int,
+                       page_len: int, heads: int) -> str:
+    """The ``paged_attention_impl="auto"`` rule: "kernel" at and above the
+    measured timeline crossover for the nearest recorded (batch, heads)
+    shape — on TPU only; off-TPU "auto" is always "gather" (interpret-mode
+    pallas is the tier-1 correctness vehicle, ~100x slower than the XLA
+    gather). Explicit impls pass through untouched, so tests force the
+    kernel on CPU and devices force the gather for A/B sweeps."""
+    if impl != "auto":
+        return impl
+    import jax  # lazy: keep module import free of a backend query
+
+    if jax.default_backend() != "tpu":
+        return "gather"
+    if table_pages * page_len >= paged_crossover_timeline(batch, heads):
+        return "kernel"
+    return "gather"

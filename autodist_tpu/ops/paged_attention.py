@@ -1,0 +1,343 @@
+"""Paged attention for TPU — the ONE home for softmax-over-pages math.
+
+Every serving-path attention over the paged KV pool lives here (enforced by
+``tools/check_patterns.py`` rule 12): the gather reference implementations the
+compiled programs shipped with since PR 12, and the pallas kernel that walks
+each row's page table block-by-block directly in HBM — online softmax per
+page (Dao et al., arXiv 2205.14135, rendered over pages instead of contiguous
+K blocks), the position mask folded into the block loop, no materialized
+``[B, P * page_len, H, D]`` timeline. Three entry points match the engine's
+compiled programs: decode step (one query per row), spec verify (K+1 queries
+per row), and prefill-chunk (one row, C queries).
+
+Underneath either impl sits optional int8 KV quantization with per-position
+per-head scales (``quantize_kv`` / ``dequantize_kv``): pages store int8 plus
+an f32 scale row, quantize-on-scatter happens in the model forwards,
+dequantize happens on gather or inside the kernel block loop. At
+``head_dim=64`` a KV position costs 68 bytes/head (64 int8 + 4 scale) vs 256
+f32 (3.76x) or 128 bf16 (1.88x) — the effective-capacity math the analyzer
+and selftest assert.
+
+Correctness contract (tests/test_paged_kernel.py, serve --selftest):
+- quant OFF: kernel token streams bit-identical to the gather path (the
+  gather path itself is bit-identical to the pre-kernel programs — the
+  einsum spellings below are verbatim);
+- quant ON: logit drift vs the fp oracle bounded (documented in
+  docs/serving.md), draft and verify run against the SAME quantized pages so
+  spec-decode losslessness is preserved.
+
+Impl selection is measured, not assumed: ``autodist_tpu.ops.crossover.
+resolve_paged_impl`` picks kernel-vs-gather per (batch, table width, heads)
+shape from the recorded sweep in ``docs/measured/paged_crossover.json``.
+
+On CPU the kernel runs in pallas interpret mode (the tier-1 parity suite
+exercises the same kernel logic the TPU compiles).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# The masking constant every forward path shares. -1e30 is kept verbatim for
+# f32 logits (bit-identity with the pre-hoist programs); non-f32 logits get a
+# finite value well inside the dtype's range — a literal -1e30 overflows
+# float16 to -inf and makes fully-masked rows NaN (inf - inf) instead of
+# uniform, which is the footgun this helper retires.
+NEG_INF = -1e30
+
+
+def mask_value(dtype: Any = jnp.float32) -> float:
+    """The additive-mask fill value for logits of ``dtype``."""
+    dtype = jnp.dtype(dtype)
+    if dtype in (jnp.dtype(jnp.float32), jnp.dtype(jnp.float64)):
+        return NEG_INF
+    # Half of the finite minimum: representable, and far enough below any
+    # real logit that softmax still zeroes the masked entries.
+    return float(jnp.finfo(dtype).min) / 2.0
+
+
+def position_mask(timeline: int, positions):
+    """``True`` where timeline slot ``t <= positions[...]``.
+
+    ``positions`` is ``[B]`` (decode), ``[C]`` (prefill-chunk absolute
+    positions) or ``[B, K1]`` (verify rows); the mask gains a trailing
+    timeline axis: ``positions.shape + (timeline,)``. Pad/scratch timeline
+    slots always sit at or past a request's capacity — strictly above any
+    live position — so this one comparison is the whole safety story for
+    garbage pages (serve/pages.py SCRATCH_PAGE).
+    """
+    return jnp.arange(timeline) <= positions[..., None]
+
+
+def apply_mask(logits, mask):
+    """Fill ``~mask`` with the dtype-safe mask value (mask pre-broadcast)."""
+    return jnp.where(mask, logits, mask_value(logits.dtype))
+
+
+# ------------------------------------------------------------ quantization
+def quantize_kv(x):
+    """Symmetric int8 quantization over the head_dim axis.
+
+    ``x [..., H, D]`` -> ``(int8 [..., H, D], f32 scale [..., H])`` with
+    ``scale = amax(|x|) / 127`` per (position, head) row. All-zero rows keep
+    scale 0 (dequantizes to exact zeros). Pure function of the input —
+    deterministic, so failover re-prefill reproduces identical pages and the
+    journal-replay bit-identity contract survives quantization.
+    """
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = amax / 127.0
+    safe = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(x32 / safe[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale, dtype: Any = jnp.float32):
+    """Inverse of :func:`quantize_kv`: ``int8 * scale`` cast to ``dtype``."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+# ------------------------------------------------------- gather reference
+def _paged_gather(cache_layer, page_tables):
+    """Gather one layer's KV timeline(s) by page index.
+
+    ``cache_layer [n_pages, page_len, H, D]`` (or ``[n_pages, page_len, H]``
+    for a scale plane); ``page_tables`` is ``[P]`` (one request) or ``[B, P]``
+    (the decode batch). Returns the gathered timeline
+    ``[..., P * page_len, ...]``. Pad entries point at the scratch page —
+    finite garbage the caller's position mask excludes.
+    """
+    page_len = cache_layer.shape[1]
+    tail = cache_layer.shape[2:]
+    gathered = cache_layer[page_tables]          # [..., P, page_len, ...]
+    return gathered.reshape(
+        page_tables.shape[:-1] + (page_tables.shape[-1] * page_len,) + tail)
+
+
+def _gather_timeline(pages, scale, page_tables, compute_dtype):
+    """Materialize the timeline in ``compute_dtype``, dequantizing if
+    ``scale`` is present. The fp branch is the verbatim pre-kernel gather."""
+    if scale is None:
+        return _paged_gather(pages, page_tables).astype(compute_dtype)
+    g = _paged_gather(pages, page_tables)
+    s = _paged_gather(scale, page_tables)
+    return dequantize_kv(g, s, compute_dtype)
+
+
+# ------------------------------------------------------------ pallas kernel
+def _should_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _paged_kernel(tables_ref, qpos_ref, q_ref, *rest, page_len: int,
+                  n_tables: int, quantized: bool, scale: float):
+    """One (row, page) program: stream the row's pages, online softmax.
+
+    Grid is ``(B, P)`` with the page dimension minor — for a fixed row the
+    pages run sequentially, carrying fp32 (m, l, acc) stats in VMEM scratch
+    across iterations (init at p == 0, finalize at p == P - 1). The k/v
+    BlockSpec index maps read ``tables_ref`` (scalar-prefetch) so each step
+    DMAs exactly one page out of HBM: traffic scales with the live table,
+    never with a materialized ``[B, P * page_len, H, D]`` timeline.
+
+    The position mask is folded into the block loop via the absolute slot
+    index ``t = p * page_len + offset``; fully-masked pages contribute
+    exp(NEG_INF - m) == 0 because slot 0 (always admitted: positions >= 0)
+    seeds ``m`` with a finite logit on the first page.
+    """
+    if quantized:
+        k_ref, v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]                                   # [Q, H, D]
+    n_q = q.shape[0]
+    qh = jnp.transpose(q, (1, 0, 2)).astype(jnp.float32)   # [H, Q, D]
+    kblk = k_ref[0]                                # [page_len, H, D]
+    vblk = v_ref[0]
+    if quantized:
+        kf = kblk.astype(jnp.float32) * ks_ref[0][..., None]
+        vf = vblk.astype(jnp.float32) * vs_ref[0][..., None]
+    else:
+        kf = kblk.astype(jnp.float32)
+        vf = vblk.astype(jnp.float32)
+    kh = jnp.transpose(kf, (1, 0, 2))              # [H, T, D]
+    vh = jnp.transpose(vf, (1, 0, 2))
+    s = jax.lax.dot_general(
+        qh, kh, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale                                      # [H, Q, T] fp32
+    t_abs = p * page_len + jax.lax.broadcasted_iota(
+        jnp.int32, (n_q, page_len), 1)
+    qpos = qpos_ref[0]                             # [Q] int32
+    admit = t_abs <= qpos[:, None]                 # [Q, T]
+    s = jnp.where(admit[None, :, :], s, NEG_INF)
+
+    m = m_ref[...]                                 # [H, Q, 1]
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    pexp = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_ref[...] = alpha * l_ref[...] + pexp.sum(axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        pexp, vh, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )                                              # [H, Q, D]
+    m_ref[...] = m_new
+
+    @pl.when(p == n_tables - 1)
+    def _finalize():
+        l = l_ref[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out = acc_ref[...] / l_safe                # [H, Q, D]
+        o_ref[0] = jnp.transpose(out, (1, 0, 2)).astype(o_ref.dtype)
+
+
+def _kernel_attention(q4, k_pages, v_pages, page_tables, q_positions,
+                      k_scale, v_scale, interpret: Optional[bool]):
+    """Dispatch the unified kernel: ``q4 [B, Q, H, D]``, ``page_tables
+    [B, P]``, ``q_positions [B, Q]`` absolute positions per query. Returns
+    ``[B, Q, H, D]`` in the query dtype."""
+    if interpret is None:
+        interpret = _should_interpret()
+    b, n_q, h, d = q4.shape
+    page_len = k_pages.shape[1]
+    n_tables = page_tables.shape[1]
+    quantized = k_scale is not None
+    scale = 1.0 / (d ** 0.5)
+    tables = page_tables.astype(jnp.int32)
+    qpos = q_positions.astype(jnp.int32)
+
+    page_spec = pl.BlockSpec(
+        (1, page_len, h, d), lambda bi, pi, t: (t[bi, pi], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, n_q), lambda bi, pi, t: (bi, 0)),          # qpos
+        pl.BlockSpec((1, n_q, h, d), lambda bi, pi, t: (bi, 0, 0, 0)),
+        page_spec,                                                  # k page
+        page_spec,                                                  # v page
+    ]
+    operands = [qpos, q4, k_pages, v_pages]
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (1, page_len, h), lambda bi, pi, t: (t[bi, pi], 0, 0))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, n_tables),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, n_q, h, d),
+                               lambda bi, pi, t: (bi, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, n_q, 1), jnp.float32),   # m
+            pltpu.VMEM((h, n_q, 1), jnp.float32),   # l
+            pltpu.VMEM((h, n_q, d), jnp.float32),   # acc
+        ],
+    )
+    kernel = functools.partial(
+        _paged_kernel, page_len=page_len, n_tables=n_tables,
+        quantized=quantized, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n_q, h, d), q4.dtype),
+        interpret=interpret,
+    )(tables, *operands)
+
+
+def _check_impl(impl: str) -> None:
+    if impl not in ("gather", "kernel"):
+        raise ValueError(
+            f"unknown paged attention impl {impl!r} (gather|kernel; resolve "
+            "'auto' via autodist_tpu.ops.crossover.resolve_paged_impl first)")
+
+
+# ------------------------------------------------------------- entry points
+def paged_decode_attention(q, k_pages, v_pages, page_tables, positions, *,
+                           k_scale=None, v_scale=None, impl: str = "gather",
+                           compute_dtype: Any = None,
+                           interpret: Optional[bool] = None):
+    """Decode-step attention: ``q [B, H, D]`` (one query per row),
+    ``page_tables [B, P]``, ``positions [B]``. Returns ``[B, H, D]``.
+
+    ``impl='gather'`` is the verbatim pre-kernel program (einsum spellings
+    preserved so pre-existing streams stay bit-identical); ``'kernel'``
+    streams pages through the pallas block loop.
+    """
+    _check_impl(impl)
+    compute_dtype = compute_dtype or q.dtype
+    if impl == "kernel":
+        out = _kernel_attention(q[:, None], k_pages, v_pages, page_tables,
+                                positions[:, None], k_scale, v_scale,
+                                interpret)
+        return out[:, 0]
+    head_dim = q.shape[-1]
+    timeline = page_tables.shape[1] * k_pages.shape[1]
+    ck = _gather_timeline(k_pages, k_scale, page_tables, compute_dtype)
+    cv = _gather_timeline(v_pages, v_scale, page_tables, compute_dtype)
+    mask = position_mask(timeline, positions)                     # [B, T]
+    logits = jnp.einsum("bhd,bthd->bht", q, ck).astype(jnp.float32)
+    logits = logits / jnp.sqrt(head_dim).astype(jnp.float32)
+    logits = apply_mask(logits, mask[:, None, :])
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bht,bthd->bhd", probs, cv)
+
+
+def paged_prefill_attention(q, k_pages, v_pages, page_table, positions, *,
+                            k_scale=None, v_scale=None, impl: str = "gather",
+                            compute_dtype: Any = None,
+                            interpret: Optional[bool] = None):
+    """Prefill-chunk attention: ``q [C, H, D]`` (one row's chunk),
+    ``page_table [P]``, ``positions [C]`` absolute. Returns ``[C, H, D]``."""
+    _check_impl(impl)
+    compute_dtype = compute_dtype or q.dtype
+    if impl == "kernel":
+        out = _kernel_attention(q[None], k_pages, v_pages, page_table[None],
+                                positions[None], k_scale, v_scale, interpret)
+        return out[0]
+    head_dim = q.shape[-1]
+    timeline = page_table.shape[0] * k_pages.shape[1]
+    ck = _gather_timeline(k_pages, k_scale, page_table, compute_dtype)
+    cv = _gather_timeline(v_pages, v_scale, page_table, compute_dtype)
+    mask = position_mask(timeline, positions)                     # [C, T]
+    logits = jnp.einsum("chd,thd->hct", q, ck).astype(jnp.float32)
+    logits = logits / jnp.sqrt(head_dim).astype(jnp.float32)
+    logits = apply_mask(logits, mask[None, :, :])
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("hct,thd->chd", probs, cv)
+
+
+def paged_verify_attention(q, k_pages, v_pages, page_tables, rows_pos, *,
+                           k_scale=None, v_scale=None, impl: str = "gather",
+                           compute_dtype: Any = None,
+                           interpret: Optional[bool] = None):
+    """Spec-verify attention: ``q [B, K1, H, D]`` (pending token + K drafts
+    per row), ``page_tables [B, P]``, ``rows_pos [B, K1]`` absolute query
+    positions. Returns ``[B, K1, H, D]``."""
+    _check_impl(impl)
+    compute_dtype = compute_dtype or q.dtype
+    if impl == "kernel":
+        return _kernel_attention(q, k_pages, v_pages, page_tables, rows_pos,
+                                 k_scale, v_scale, interpret)
+    head_dim = q.shape[-1]
+    timeline = page_tables.shape[1] * k_pages.shape[1]
+    ck = _gather_timeline(k_pages, k_scale, page_tables, compute_dtype)
+    cv = _gather_timeline(v_pages, v_scale, page_tables, compute_dtype)
+    mask = position_mask(timeline, rows_pos)                      # [B, K1, T]
+    logits = jnp.einsum("bqhd,bthd->bhqt", q, ck).astype(jnp.float32)
+    logits = logits / jnp.sqrt(head_dim).astype(jnp.float32)
+    logits = apply_mask(logits, mask[:, None, :, :])
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqt,bthd->bqhd", probs, cv)

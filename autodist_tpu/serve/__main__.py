@@ -70,6 +70,13 @@ def main(argv=None) -> int:
                                  description=__doc__)
     ap.add_argument("--selftest", action="store_true",
                     help="run the CPU-sim serving proof and exit")
+    ap.add_argument("--kv-quant", action="store_true",
+                    help="with --selftest: serve from int8 KV pages and "
+                         "prove the quantized bars instead (>=2x admitted "
+                         "concurrency at equal pool bytes vs fp pages, "
+                         "zero dropped, logit drift within the documented "
+                         "bound, kernel-vs-gather bit-identity, analyzer "
+                         "pricing of quantized bytes)")
     ap.add_argument("--selftest-router", action="store_true",
                     help="run the multi-replica router proof (3 replicas, "
                          "one killed mid-decode, exactly-once asserted) "
@@ -150,7 +157,8 @@ def main(argv=None) -> int:
 
         return selftest(n_requests=args.requests,
                         n_slots=args.slots or 32,
-                        max_new=args.max_new)
+                        max_new=args.max_new,
+                        kv_quant=args.kv_quant)
 
     if args.selftest_router:
         from autodist_tpu.serve.router import selftest_router

@@ -292,6 +292,13 @@ class ContinuousBatcher:
         self._m_prefix_shared = None
         self._m_sharing_ratio = None
 
+        # Quantized-pool accounting (int8 KV pages, ops/paged_attention.py):
+        # physical vs fp-equivalent byte split, lazily registered so fp
+        # engines add no metric families.
+        self._m_quant_capacity = None
+        self._m_quant_physical = None
+        self._m_quant_fp_equiv = None
+
         reg = registry or M.registry
         self._registry = reg
         self._m_depth = reg.gauge("serve_queue_depth")
@@ -860,6 +867,7 @@ class ContinuousBatcher:
             self._count_tokens(n_appended, decode=True)
         self._update_spec_metrics()
         self._update_prefix_metrics()
+        self._update_quant_metrics()
         with self._lock:
             self._m_active.set(len(self._active))
         self._m_pool_util.set(self.engine.page_utilization)
@@ -933,6 +941,29 @@ class ContinuousBatcher:
         self._m_prefix_shared.set(float(stats.get("shared_pages", 0)))
         self._m_sharing_ratio.set(
             float(getattr(self.engine, "sharing_ratio", 1.0)))
+
+    def _update_quant_metrics(self) -> None:
+        """Publish the physical-vs-quantized pool byte split. No-op on fp
+        engines — the ``serve_page_pool_physical_bytes`` /
+        ``..._fp_equiv_bytes`` / ``..._quant_capacity_x`` families exist
+        only where int8 KV pages run, mirroring the spec/prefix gauge
+        pattern. Physical bytes are what the chip actually holds (and what
+        SLM001 accounts); fp-equiv is the same KV capacity priced at the
+        model's fp cache dtype, so capacity_x = fp_equiv / physical is the
+        quantization win the admission headroom actually gained."""
+        if not bool(getattr(self.engine, "kv_quant", False)):
+            return
+        if self._m_quant_capacity is None:
+            self._m_quant_capacity = self._registry.gauge(
+                "serve_page_pool_quant_capacity_x")
+            self._m_quant_physical = self._registry.gauge(
+                "serve_page_pool_physical_bytes")
+            self._m_quant_fp_equiv = self._registry.gauge(
+                "serve_page_pool_fp_equiv_bytes")
+        self._m_quant_capacity.set(float(self.engine.quant_capacity_x))
+        self._m_quant_physical.set(float(self.engine.page_pool_bytes))
+        self._m_quant_fp_equiv.set(
+            float(self.engine.page_pool_fp_equiv_bytes))
 
     def _maybe_retire(self, slot: Slot, req: GenRequest) -> None:
         """Finish + recycle the slot's pages when the sequence is done.

@@ -282,11 +282,32 @@ class InferenceEngine(_EngineBase):
         # every row at the full timeline). Per-page bytes from an abstract
         # eval of the model's own cache shape, so any DecodeModel prices
         # correctly.
+        page_shaped = jax.eval_shape(
+            lambda: decode_model.init_paged_cache(1, self.page_len))
         page_bytes = sum(
             int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
-            for leaf in jax.tree_util.tree_leaves(jax.eval_shape(
-                lambda: decode_model.init_paged_cache(1, self.page_len))))
+            for leaf in jax.tree_util.tree_leaves(page_shaped))
         self.page_bytes = page_bytes
+        # Quantized pool mode (int8 pages + f32 scale planes, PR 20):
+        # detected from the model's own cache pytree, so the engine needs
+        # no config plumbing — the scale planes share the page dim and ride
+        # the dim1-keyed sharding/COW/pricing below unchanged. fp-equiv
+        # bytes reprice the int8 value planes at the model's fp cache dtype
+        # (from the stacked cache's leaf dtype) and drop the scale planes
+        # (which would not exist in fp mode): the "what would these pages
+        # cost unquantized" figure the capacity-x metrics divide by.
+        self.kv_quant = isinstance(page_shaped, dict) and \
+            "k_scale" in page_shaped
+        if self.kv_quant:
+            fp_itemsize = np.dtype(jax.tree_util.tree_leaves(jax.eval_shape(
+                lambda: decode_model.init_cache(1, self.page_len)
+            ))[0].dtype).itemsize
+            self.page_fp_equiv_bytes = sum(
+                int(np.prod(leaf.shape)) * fp_itemsize
+                for name, leaf in page_shaped.items()
+                if not name.endswith("_scale"))
+        else:
+            self.page_fp_equiv_bytes = page_bytes
         max_useful = self.n_slots * self.max_pages
         # Under prefix sharing, pages beyond every-row-at-max-timeline
         # are still useful: they hold COLD cached prefixes that turn
@@ -312,7 +333,10 @@ class InferenceEngine(_EngineBase):
         n_pages = max(int(n_pages), self.max_pages + 1)
         if n_pages % self._data_degree:
             n_pages += self._data_degree - n_pages % self._data_degree
-        self.pool = serve_pages.build_pool(n_pages, self.page_len)
+        self.pool = serve_pages.build_pool(
+            n_pages, self.page_len, quantized=self.kv_quant,
+            bytes_per_page=float(page_bytes),
+            fp_equiv_bytes_per_page=float(self.page_fp_equiv_bytes))
         self._cache_sh = self._cache_shardings(
             decode_model.init_paged_cache, n_pages)
         self._cache = jax.device_put(
@@ -536,6 +560,22 @@ class InferenceEngine(_EngineBase):
         report how much logical timeline that physical footprint is
         actually carrying."""
         return int(self.page_bytes) * self.pool.n_pages
+
+    @property
+    def page_pool_fp_equiv_bytes(self) -> int:
+        """What the pool's KV capacity would cost in fp pages — equal to
+        :attr:`page_pool_bytes` unless the cache is quantized, in which
+        case the ratio is the quantization capacity win
+        (:attr:`quant_capacity_x`)."""
+        return int(self.page_fp_equiv_bytes) * self.pool.n_pages
+
+    @property
+    def quant_capacity_x(self) -> float:
+        """Effective-capacity multiplier from int8 KV pages (1.0 fp):
+        fp-equivalent bytes per physical pool byte."""
+        if not self.kv_quant or self.page_bytes <= 0:
+            return 1.0
+        return float(self.page_fp_equiv_bytes) / float(self.page_bytes)
 
     @property
     def prefix_cache(self) -> Optional["serve_prefix.PrefixCache"]:

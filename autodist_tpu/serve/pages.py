@@ -102,18 +102,49 @@ class PagePool:
     pages). Page 0 (scratch) is never handed out.
     """
 
-    def __init__(self, n_pages: int, page_len: int):
+    def __init__(self, n_pages: int, page_len: int,
+                 quantized: bool = False,
+                 bytes_per_page: float = 0.0,
+                 fp_equiv_bytes_per_page: float = 0.0):
         if n_pages < 2:
             raise ValueError(f"pool needs >=2 pages (1 scratch + >=1 "
                              f"allocatable), got {n_pages}")
         self.n_pages = int(n_pages)
         self.page_len = int(page_len)
+        # Quantized pool mode (int8 pages + f32 scale planes, PR 20): the
+        # device arrays hold the scales; the pool carries the byte split so
+        # the obs gauges and the analyzer can account physical vs
+        # fp-equivalent capacity from one place. bytes_per_page is the
+        # PHYSICAL page (int8 + scales when quantized); fp_equiv is what
+        # the same page would cost at the model's fp cache dtype.
+        self.quantized = bool(quantized)
+        self.bytes_per_page = float(bytes_per_page)
+        self.fp_equiv_bytes_per_page = float(fp_equiv_bytes_per_page)
         self._lock = threading.Lock()
         # LIFO free list: recycled pages are reused first (warm HBM rows).
         self._free = list(range(self.n_pages - 1, SCRATCH_PAGE, -1))
         self._allocated: set = set()
 
     # ------------------------------------------------------------- accounting
+    @property
+    def physical_bytes(self) -> float:
+        """Pool HBM footprint as allocated (0 when bytes not stamped)."""
+        return self.bytes_per_page * self.n_pages
+
+    @property
+    def fp_equiv_bytes(self) -> float:
+        """What the pool's KV capacity would cost in fp pages — the
+        quantization win's numerator (== physical when not quantized)."""
+        return self.fp_equiv_bytes_per_page * self.n_pages
+
+    @property
+    def quant_capacity_x(self) -> float:
+        """Effective-capacity multiplier from quantization: fp-equivalent
+        bytes per physical byte (1.0 when fp or bytes unstamped)."""
+        if self.bytes_per_page <= 0.0 or not self.quantized:
+            return 1.0
+        return self.fp_equiv_bytes_per_page / self.bytes_per_page
+
     @property
     def usable_pages(self) -> int:
         """Allocatable pages (total minus the scratch page)."""
@@ -219,10 +250,15 @@ class PagePool:
         table.pages = []
 
 
-def build_pool(n_pages: int, page_len: int = DEFAULT_PAGE_LEN) -> PagePool:
+def build_pool(n_pages: int, page_len: int = DEFAULT_PAGE_LEN,
+               quantized: bool = False,
+               bytes_per_page: float = 0.0,
+               fp_equiv_bytes_per_page: float = 0.0) -> PagePool:
     """The one constructor call sites use (check_patterns rule 8 bans
     direct pool/table construction outside this module)."""
-    return PagePool(n_pages, page_len)
+    return PagePool(n_pages, page_len, quantized=quantized,
+                    bytes_per_page=bytes_per_page,
+                    fp_equiv_bytes_per_page=fp_equiv_bytes_per_page)
 
 
 def pool_size_from_spec(

@@ -1218,7 +1218,7 @@ def build_test_fleet(n_replicas: int = 3, n_slots: int = 8,
                      registry: Optional[M.MetricsRegistry] = None,
                      config: Optional[RouterConfig] = None,
                      spec_decode: bool = False, spec_k: int = 4,
-                     prefix_cache: bool = False,
+                     prefix_cache: bool = False, kv_quant: bool = False,
                      engine_kwargs: Optional[Callable[[], dict]] = None):
     """An in-process CPU fleet for tests/chaos/bench: one plan compiled
     once (the byte-deterministic artifact a production factory would pull
@@ -1240,8 +1240,15 @@ def build_test_fleet(n_replicas: int = 3, n_slots: int = 8,
     ``PilotState`` store, so a rolling upgrade brings each replica up on
     the new knobs while untouched replicas keep the complete old set.
 
+    ``kv_quant=True`` serves every replica AND the control engine from
+    int8 quantized KV pages (models/transformer.py quantize-on-scatter),
+    so the bit-identity oracle compares quantized to quantized — the
+    failover bar then also proves that re-prefill on a survivor
+    reproduces the dead replica's quantized pages deterministically.
+
     Returns ``(router, control_engine)``; the caller owns ``stop()``.
     """
+    import dataclasses
     import tempfile
 
     import jax
@@ -1252,6 +1259,8 @@ def build_test_fleet(n_replicas: int = 3, n_slots: int = 8,
     from autodist_tpu.serve.engine import InferenceEngine
 
     cfg = _tiny_router_cfg()
+    if kv_quant:
+        cfg = dataclasses.replace(cfg, kv_quant=True)
     params = init_params(jax.random.PRNGKey(0), cfg)
 
     if spec_decode:

@@ -469,29 +469,40 @@ _PAGE_LEN = 8
 _N_PAGES = _BASELINE_SLOTS * sum(_BASELINE_BUCKETS) // _PAGE_LEN
 
 
-def _tiny_cfg():
+def _tiny_cfg(**overrides):
     import jax.numpy as jnp
 
     from autodist_tpu.models.transformer import TransformerConfig
 
-    return TransformerConfig(
+    kw = dict(
         vocab_size=128, num_layers=2, d_model=32, num_heads=2, d_ff=64,
         max_seq_len=64, causal=True, dtype=jnp.float32)
+    kw.update(overrides)
+    return TransformerConfig(**kw)
 
 
 def _tiny_engine(n_slots: int = 32, page_len: int = _PAGE_LEN,
                  n_pages: Optional[int] = _N_PAGES,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 kv_quant: bool = False,
+                 paged_impl: Optional[str] = None):
     """CPU-sim paged engine: a tiny fp32 transformer through the full
     ``AutoDist.build_inference`` path (strategy → plan → engine).
     Returns ``(engine, params, cfg)`` so callers can stand a bucketed
-    baseline on the same checkpoint + plan."""
+    baseline on the same checkpoint + plan. ``kv_quant`` serves from int8
+    KV pages; ``paged_impl`` forces gather/kernel (default: the config's
+    measured "auto" — gather on CPU)."""
     import jax
 
     from autodist_tpu.api import AutoDist
     from autodist_tpu.models.transformer import decode_model, init_params
 
-    cfg = _tiny_cfg()
+    overrides = {}
+    if kv_quant:
+        overrides["kv_quant"] = True
+    if paged_impl is not None:
+        overrides["paged_attention_impl"] = paged_impl
+    cfg = _tiny_cfg(**overrides)
     params = init_params(jax.random.PRNGKey(0), cfg)
     AutoDist.reset_default()
     autodist = AutoDist()
@@ -540,8 +551,58 @@ def _admission_capacity(engine, prompt_len: int, max_new: int,
     return len(held)
 
 
+#: Documented logit-drift bound for int8 KV pages vs the fp oracle
+#: (teacher-forced max |Δlogit| on the tiny selftest model; docs/serving.md
+#: § quantized pages). tests/test_paged_kernel.py asserts the same bound.
+QUANT_LOGIT_DRIFT_BOUND = 0.05
+
+
+def _quant_logit_drift(params, cfg, page_len: int = _PAGE_LEN,
+                       steps: int = 6) -> float:
+    """Teacher-forced max |logit| drift of int8 KV pages vs the fp oracle.
+
+    Both caches replay the SAME token history (the fp oracle's stream), so
+    the number is pure quantization error, not divergence compounding. The
+    probe runs the model functions directly — never the engine's compiled
+    programs, so the 2-program pin is untouched.
+    """
+    import jax.numpy as jnp
+
+    from autodist_tpu.models.transformer import (
+        forward_paged_decode_step, forward_paged_prefill_chunk,
+        init_paged_kv_cache)
+
+    prompt = np.arange(1, page_len + 1, dtype=np.int32)  # one full page
+    table_row = jnp.asarray(np.array([1, 2, 3, 4], np.int32))
+    caches = [init_paged_kv_cache(cfg, 6, page_len, quantized=q)
+              for q in (False, True)]
+    tok = jnp.asarray(prompt[None, :], jnp.int32)
+    token = None
+    for i in range(2):
+        nt, caches[i] = forward_paged_prefill_chunk(
+            params, tok, 0, len(prompt), caches[i], table_row, cfg)
+        if i == 0:
+            token = nt                       # the fp oracle drives both
+    tables = table_row[None, :]
+    pos = len(prompt)
+    drift = 0.0
+    for _ in range(steps):
+        step_logits = []
+        for i in range(2):
+            nt, lg, caches[i] = forward_paged_decode_step(
+                params, token, jnp.asarray([pos], jnp.int32), caches[i],
+                tables, cfg, return_logits=True)
+            step_logits.append(lg)
+            if i == 0:
+                next_token = nt
+        drift = max(drift, float(jnp.max(jnp.abs(
+            step_logits[0] - step_logits[1]))))
+        token, pos = next_token, pos + 1
+    return drift
+
+
 def selftest(n_requests: int = 64, n_slots: int = 32, max_new: int = 12,
-             seed: int = 0) -> int:
+             seed: int = 0, kv_quant: bool = False) -> int:
     """The acceptance proof; returns a process exit code.
 
     Phase 0 (paged-vs-bucketed): a :class:`BucketedInferenceEngine` is
@@ -555,11 +616,21 @@ def selftest(n_requests: int = 64, n_slots: int = 32, max_new: int = 12,
     concurrent mock clients — mixed short and long (chunked-prefill)
     prompts — through the asyncio bridge and the continuous batcher.
     Asserts zero dropped/deadlocked requests, batched tokens/sec strictly
-    above sequential, and exactly TWO compiled serving programs (one
-    decode + one chunked prefill) after the whole mixed-length run, then
-    prints one JSON line with p50/p99 latency and throughput from the
-    metrics registry.
+    above sequential, bit-identical streams from the pallas paged-
+    attention kernel (interpret mode) vs the gather path, and exactly TWO
+    compiled serving programs (one decode + one chunked prefill) after the
+    whole mixed-length run, then prints one JSON line with p50/p99 latency
+    and throughput from the metrics registry.
+
+    ``kv_quant=True`` runs the quantized acceptance instead (int8 KV
+    pages): >=2x admitted concurrency at equal pool bytes vs fp pages
+    with prefix sharing on, zero dropped, logit drift within
+    :data:`QUANT_LOGIT_DRIFT_BOUND`, kernel-vs-gather stream identity on
+    the SAME quantized pages, and the analyzer pricing quantized bytes.
     """
+    if kv_quant:
+        return _selftest_quant(n_requests=n_requests, max_new=max_new,
+                               seed=seed)
     from autodist_tpu.serve.engine import BucketedInferenceEngine
 
     registry = M.MetricsRegistry()
@@ -592,6 +663,15 @@ def selftest(n_requests: int = 64, n_slots: int = 32, max_new: int = 12,
     ]
     parity_ok = all(
         engine.generate(p, 10) == bucketed.generate(p, 10)
+        for p in parity_prompts)
+
+    # ---- pallas kernel vs gather: bit-identical streams on the same
+    # checkpoint (interpret mode on CPU — the same kernel logic the TPU
+    # compiles; ops/paged_attention.py). Small engine: the interpreted
+    # grid walks (rows x pages) in Python.
+    kernel_engine, _, _ = _tiny_engine(n_slots=4, paged_impl="kernel")
+    kernel_parity_ok = all(
+        engine.generate(p, 10) == kernel_engine.generate(p, 10)
         for p in parity_prompts)
 
     def mock_prompt(i=None):
@@ -638,6 +718,7 @@ def selftest(n_requests: int = 64, n_slots: int = 32, max_new: int = 12,
         and batched_tps > seq_tps
         and concurrency_x >= 2.0
         and parity_ok
+        and kernel_parity_ok
         and programs == 2
     )
     line = {
@@ -658,6 +739,8 @@ def selftest(n_requests: int = 64, n_slots: int = 32, max_new: int = 12,
         "concurrency_x_vs_bucketed": round(concurrency_x, 2),
         "kv_pool_tokens": paged_pool_tokens,
         "paged_vs_bucketed_bit_equal": bool(parity_ok),
+        "kernel_vs_gather_bit_equal": bool(kernel_parity_ok),
+        "kv_quant": "off",
         "programs_compiled": programs,
         "page_len": engine.page_len,
         "n_pages": engine.pool.n_pages,
@@ -668,7 +751,151 @@ def selftest(n_requests: int = 64, n_slots: int = 32, max_new: int = 12,
     if not ok:
         logging.warning(
             "selftest failed: states=%s seq=%.1f batched=%.1f "
-            "concurrency_x=%.2f parity=%s programs=%d",
+            "concurrency_x=%.2f parity=%s kernel_parity=%s programs=%d",
             {s.value: n for s, n in states.items() if n},
-            seq_tps, batched_tps, concurrency_x, parity_ok, programs)
+            seq_tps, batched_tps, concurrency_x, parity_ok,
+            kernel_parity_ok, programs)
+    return 0 if ok else 1
+
+
+def _selftest_quant(n_requests: int = 64, max_new: int = 12,
+                    seed: int = 0) -> int:
+    """The int8-KV-pages acceptance proof (``--selftest --kv-quant``).
+
+    An fp paged engine (the oracle) and a quantized engine sized to the
+    SAME pool bytes — equal HBM — both with COW prefix sharing on. The
+    quantized pool funds ~3.2x the pages (int8 + f32 scales vs f32 KV at
+    head_dim 16), which must buy >=2x admitted concurrency; the batched
+    phase must complete every request (zero dropped); teacher-forced
+    logit drift vs the fp oracle stays within
+    :data:`QUANT_LOGIT_DRIFT_BOUND`; the pallas kernel over the SAME
+    quantized pages streams bit-identically to the quantized gather; the
+    analyzer's memory pass prices the PHYSICAL quantized bytes with the
+    capacity multiplier annotated; and the program pin (exactly 2) holds
+    on the quantized engine.
+    """
+    import jax
+
+    from autodist_tpu.analysis.passes import hbm_budget
+    from autodist_tpu.models.transformer import init_paged_kv_cache
+
+    registry = M.MetricsRegistry()
+    rng = np.random.default_rng(seed)
+    n_slots = 96   # past both pools' page capacity: pages are the binding
+    #                constraint the equal-bytes comparison measures.
+    fp_engine, params, cfg = _tiny_engine(
+        n_slots=n_slots, prefix_cache=True)
+    fp_pool_bytes = fp_engine.page_pool_bytes
+
+    # Size the quantized pool to the fp pool's byte budget.
+    quant_page_bytes = sum(
+        int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree_util.tree_leaves(jax.eval_shape(
+            lambda: init_paged_kv_cache(cfg, 1, _PAGE_LEN,
+                                        quantized=True))))
+    n_pages_q = int(fp_pool_bytes // quant_page_bytes)
+    engine, _, qcfg = _tiny_engine(
+        n_slots=n_slots, n_pages=n_pages_q, kv_quant=True,
+        prefix_cache=True)
+    equal_bytes_ok = engine.page_pool_bytes <= fp_pool_bytes
+
+    # ---- admitted concurrency at equal pool bytes (6 prompt + 6 new).
+    quant_cap = _admission_capacity(engine, 6, 6)
+    fp_cap = _admission_capacity(fp_engine, 6, 6)
+    concurrency_x = quant_cap / max(fp_cap, 1)
+
+    # ---- teacher-forced logit drift vs the fp oracle.
+    drift = _quant_logit_drift(params, cfg)
+    drift_ok = drift < QUANT_LOGIT_DRIFT_BOUND
+
+    # ---- kernel vs gather over the SAME quantized pages: bit-identical
+    # streams (interpret mode on CPU).
+    parity_prompts = [
+        np.array([5, 17, 3, 88, 2], np.int32),
+        rng.integers(1, 127, size=20).astype(np.int32),
+        rng.integers(1, 127, size=41).astype(np.int32),
+    ]
+    kernel_engine, _, _ = _tiny_engine(
+        n_slots=4, kv_quant=True, paged_impl="kernel")
+    gather_small, _, _ = _tiny_engine(n_slots=4, kv_quant=True)
+    kernel_parity_ok = all(
+        gather_small.generate(p, 10) == kernel_engine.generate(p, 10)
+        for p in parity_prompts)
+
+    # ---- analyzer accounting: the pool tenant carries the PHYSICAL
+    # quantized bytes; the capacity multiplier rides the summary.
+    _, mem = hbm_budget(
+        engine.plan, serve_pool_bytes=engine.page_pool_bytes,
+        serve_quant_capacity_x=engine.quant_capacity_x)
+    analyzer_ok = (
+        abs(mem["serve_pool_gb_per_chip"] * 1e9
+            - engine.page_pool_bytes) < 1.0
+        and mem["serve_quant_capacity_x"] >= 2.0)
+
+    # ---- batched phase through the quantized engine: zero dropped.
+    def mock_prompt(i=None):
+        return mock_load_prompt(rng, i)
+
+    engine.generate(mock_prompt(), max_new)   # warm the compile caches
+    batcher = ContinuousBatcher(engine, max_queue=max(n_requests, 64),
+                                registry=registry)
+
+    async def run_clients():
+        async def client(i):
+            await asyncio.sleep(0.001 * (i % 8))
+            return await async_generate(batcher, mock_prompt(i), max_new)
+
+        return await asyncio.gather(*(client(i) for i in range(n_requests)))
+
+    batcher.start()
+    try:
+        results = asyncio.run(asyncio.wait_for(run_clients(), timeout=300))
+    finally:
+        batcher.stop(drain=False)
+    states = {s: sum(1 for r in results if r.state is s)
+              for s in RequestState}
+    programs = engine.compiled_programs
+    snap = registry.snapshot()
+    ok = (
+        states.get(RequestState.DONE, 0) == n_requests
+        and equal_bytes_ok
+        and concurrency_x >= 2.0
+        and drift_ok
+        and kernel_parity_ok
+        and analyzer_ok
+        and programs == 2
+    )
+    line = {
+        "selftest": "autodist_tpu.serve.kv_quant",
+        "ok": bool(ok),
+        "kv_quant": "on",
+        "n_requests": n_requests,
+        "completed": states.get(RequestState.DONE, 0),
+        "dropped": n_requests - states.get(RequestState.DONE, 0),
+        "pool_bytes": int(engine.page_pool_bytes),
+        "fp_pool_bytes": int(fp_pool_bytes),
+        "n_pages_quant": engine.pool.n_pages,
+        "n_pages_fp": fp_engine.pool.n_pages,
+        "quant_capacity_x": round(engine.quant_capacity_x, 2),
+        "quant_capacity": quant_cap,
+        "fp_capacity": fp_cap,
+        "concurrency_x_vs_fp": round(concurrency_x, 2),
+        "logit_drift": round(drift, 5),
+        "logit_drift_bound": QUANT_LOGIT_DRIFT_BOUND,
+        "kernel_vs_gather_bit_equal": bool(kernel_parity_ok),
+        "analyzer_prices_quant": bool(analyzer_ok),
+        "programs_compiled": programs,
+        "quant_pool_gauge_bytes": float(snap.get(
+            "serve_page_pool_physical_bytes", 0.0)),
+        "page_len": engine.page_len,
+        "device": jax.devices()[0].platform,
+    }
+    print(json.dumps(line))
+    if not ok:
+        logging.warning(
+            "kv-quant selftest failed: states=%s equal_bytes=%s "
+            "concurrency_x=%.2f drift=%.5f kernel_parity=%s analyzer=%s "
+            "programs=%d",
+            {s.value: n for s, n in states.items() if n}, equal_bytes_ok,
+            concurrency_x, drift, kernel_parity_ok, analyzer_ok, programs)
     return 0 if ok else 1

@@ -177,12 +177,19 @@ class SpecDecodeEngine(InferenceEngine):
         dn = max(dn, 2)
         if dn % self._data_degree:
             dn += self._data_degree - dn % self._data_degree
-        self.draft_pool = serve_pages.build_pool(dn, self.page_len)
+        draft_shaped = jax.eval_shape(
+            lambda: draft_decode_model.init_paged_cache(1, self.page_len))
         self.draft_page_bytes = sum(
             int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
-            for leaf in jax.tree_util.tree_leaves(jax.eval_shape(
-                lambda: draft_decode_model.init_paged_cache(
-                    1, self.page_len))))
+            for leaf in jax.tree_util.tree_leaves(draft_shaped))
+        # Quantized draft pages ride the same detection the target pool
+        # uses — spec losslessness under quantization holds because draft
+        # and verify both read the SAME quantized page contents.
+        self.draft_pool = serve_pages.build_pool(
+            dn, self.page_len,
+            quantized=isinstance(draft_shaped, dict)
+            and "k_scale" in draft_shaped,
+            bytes_per_page=float(self.draft_page_bytes))
         self._draft_cache_sh = self._cache_shardings(
             draft_decode_model.init_paged_cache, dn)
         self._draft_cache = jax.device_put(

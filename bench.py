@@ -654,15 +654,25 @@ def _measure_in_subprocess(name: str, cpu_smoke: bool, timeout_s: float,
     return None, f"workload exited rc={r.returncode} with no JSON line"
 
 
-def _serve_decode_bench(n_requests: int = 48, max_new: int = 10) -> dict:
+def _serve_decode_bench(n_requests: int = 48, max_new: int = 10,
+                        kv_quant: bool = False) -> dict:
     """The ``serve_decode`` workload: paged continuous-batching decode on
     the CPU-sim serving stack (build_inference → paged engine → batcher →
     asyncio bridge), mixed short and long (chunked-prefill) prompts.
+    ``kv_quant=True`` serves from int8 quantized KV pages (the
+    ``serve_decode_quant`` arm); every result line stamps ``kv_quant``
+    and the resolved kernel-vs-gather choice, so the driver's history can
+    bucket the two configurations apart.
 
     Measures the serving SCHEDULER + paged-cache math (decode tokens/sec,
     p50/p99 request latency, peak page-pool utilization), not chip speed
     — which is exactly why it can run before any accelerator preflight
-    and still emit when the tunnel is wedged.
+    and still emit when the tunnel is wedged. Against it, a bucketed
+    sequential baseline on the SAME checkpoint gives the
+    ``vs_bucketed_x`` throughput ratio; the line carries
+    ``"cached": false`` — a fresh CPU-proxy measurement, never the
+    driver's cached-accelerator fallback (the device sweep is deferred
+    until a TPU answers the preflight).
     """
     import asyncio
 
@@ -671,15 +681,37 @@ def _serve_decode_bench(n_requests: int = 48, max_new: int = 10) -> dict:
 
     from autodist_tpu import metrics as M
     from autodist_tpu.obs.slo import SLOTracker
+    from autodist_tpu.ops.crossover import resolve_paged_impl
     from autodist_tpu.serve.batcher import ContinuousBatcher, RequestState
     from autodist_tpu.serve.sampling import SamplingParams
     from autodist_tpu.serve.server import (
-        _tiny_engine, async_generate, mock_load_prompt)
+        _BASELINE_BUCKETS, _BASELINE_SLOTS, _tiny_engine, async_generate,
+        mock_load_prompt)
 
     registry = M.MetricsRegistry()
     rng = np.random.default_rng(0)
-    engine, _params, _cfg = _tiny_engine(n_slots=32, prefix_cache=True)
+    engine, _params, _cfg = _tiny_engine(n_slots=32, prefix_cache=True,
+                                         kv_quant=kv_quant)
     engine.generate(rng.integers(1, 127, size=6), max_new)  # warm compiles
+    paged_impl = resolve_paged_impl(
+        getattr(_cfg, "paged_attention_impl", "auto"), engine.n_slots,
+        engine.max_pages, engine.page_len, _cfg.num_heads)
+
+    # The bucketed sequential baseline on the SAME checkpoint + plan (the
+    # selftest's geometry): the >=2x decode-throughput bar is vs THIS.
+    from autodist_tpu.models.transformer import decode_model as _dm
+    from autodist_tpu.serve.engine import BucketedInferenceEngine
+
+    bucketed = BucketedInferenceEngine(
+        _params, engine.plan, decode_model=_dm(_cfg),
+        n_slots=_BASELINE_SLOTS, bucket_lens=_BASELINE_BUCKETS)
+    base_rng = np.random.default_rng(1)
+    baseline_prompts = [mock_load_prompt(base_rng, i) for i in range(6)]
+    bucketed.generate(baseline_prompts[0], max_new)        # warm compiles
+    b0 = time.perf_counter()
+    btok = sum(len(bucketed.generate(p, max_new)) for p in baseline_prompts)
+    bdt = time.perf_counter() - b0
+    bucketed_tps = btok / bdt if bdt > 0 else 0.0
 
     slo = SLOTracker()
     batcher = ContinuousBatcher(engine, max_queue=max(n_requests, 64),
@@ -770,6 +802,14 @@ def _serve_decode_bench(n_requests: int = 48, max_new: int = 10) -> dict:
         "programs_compiled": engine.compiled_programs,
         "page_len": engine.page_len,
         "n_pages": engine.pool.n_pages,
+        "kv_quant": "on" if kv_quant else "off",
+        "paged_attention_impl": paged_impl,
+        "quant_capacity_x": round(
+            float(getattr(engine, "quant_capacity_x", 1.0)), 2),
+        "bucketed_tokens_per_sec": round(bucketed_tps, 1),
+        "vs_bucketed_x": round((tokens / dt) / bucketed_tps, 2)
+        if dt > 0 and bucketed_tps > 0 else None,
+        "cached": False,
         "device": jax.devices()[0].platform,
     }}
 
@@ -859,6 +899,9 @@ def _run_one(name: str, cpu_smoke: bool, plan_cache: str = "") -> None:
         jax.config.update("jax_platforms", "cpu")
     if name == "serve_decode":
         print(json.dumps(_serve_decode_bench()))
+        return
+    if name == "serve_decode_quant":
+        print(json.dumps(_serve_decode_bench(kv_quant=True)))
         return
     if name == "serve_router":
         print(json.dumps(_router_bench()))
@@ -1144,6 +1187,14 @@ def _main() -> None:
         # --lint/--attrib early-emit discipline.
         out, err = _measure_in_subprocess("serve_decode", cpu_smoke=True,
                                           timeout_s=300.0)
+        print(json.dumps(out if out and "bench_serve" in out
+                         else {"bench_serve": {"failed": err or "no JSON"}}),
+              flush=True)
+        # The quantized arm rides second (kv_quant: on, same workload):
+        # the two lines differ only in the stamp + pool accounting, so
+        # the driver's history buckets fp vs int8 serving apart.
+        out, err = _measure_in_subprocess("serve_decode_quant",
+                                          cpu_smoke=True, timeout_s=300.0)
         print(json.dumps(out if out and "bench_serve" in out
                          else {"bench_serve": {"failed": err or "no JSON"}}),
               flush=True)

@@ -297,6 +297,12 @@ class TestFaultClassPins:
         assert res.injected == 1
         assert "bit-identical" in res.notes
 
+    def test_kill_mid_quantized_stream_bit_identity(self, tmp_path):
+        res = _run("kill_mid_quantized_stream", tmp_path)
+        assert res.detected == ["DEAD", "quantized_bit_identity", "DOC006"]
+        assert res.injected == 1
+        assert "bit-identical" in res.notes
+
     def test_replica_partition_suspect_routed_around(self, tmp_path):
         res = _run("replica_partition", tmp_path)
         assert res.detected == ["SUSPECT", "routed around", "rejoined"]

@@ -1,0 +1,321 @@
+"""Pallas paged-attention kernel + int8 quantized KV pages (ISSUE 20 bars).
+
+- **ops-level parity**: the pallas kernel (interpret mode on CPU — the
+  tier-1 correctness vehicle) matches the verbatim gather reference for
+  all three entry points — decode step, spec verify (including draft
+  windows whose positions clamp to the scratch page), prefill chunk —
+  quantized and fp;
+- **engine-level bit-identity with quant OFF**: kernel-vs-gather token
+  STREAMS are bit-equal, greedy and sampled, so flipping the impl can
+  never fork a delivered stream (the PR-13/15/17 contracts ride on this);
+- **spec losslessness under quant**: draft and verify read the SAME
+  quantized pages, so spec streams equal plain streams bit for bit on a
+  quantized engine too;
+- **bounded quant drift**: teacher-forced max |Δlogit| vs the fp oracle
+  stays within the documented bound (docs/serving.md § quantized pages);
+- **quantized pool accounting**: pool capacity multiplier, analyzer
+  summary, and the scatter/gather round trip;
+- **measured crossover**: "auto" resolves kernel-vs-gather per
+  (batch, table width, heads) from a recorded sweep, gather off-TPU.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from autodist_tpu.ops import paged_attention as pa
+from autodist_tpu.ops.crossover import (
+    DEFAULT_PAGED_CROSSOVER_TIMELINE,
+    paged_crossover_timeline,
+    resolve_paged_impl,
+)
+
+B, P, PAGE_LEN, H, D = 3, 4, 8, 2, 16
+N_PAGES = 12
+
+
+def _pages(rng, quantized=False):
+    k = rng.standard_normal((N_PAGES, PAGE_LEN, H, D)).astype(np.float32)
+    v = rng.standard_normal((N_PAGES, PAGE_LEN, H, D)).astype(np.float32)
+    if not quantized:
+        return jnp.asarray(k), jnp.asarray(v), None, None
+    kq, ks = pa.quantize_kv(jnp.asarray(k))
+    vq, vs = pa.quantize_kv(jnp.asarray(v))
+    return kq, vq, ks, vs
+
+
+def _tables(rng):
+    # Distinct physical pages per row, deliberately out of order: the
+    # kernel must follow the table, not the pool layout.
+    flat = rng.permutation(N_PAGES)[:B * P].reshape(B, P)
+    return jnp.asarray(flat, jnp.int32)
+
+
+class TestOpsParity:
+    """Kernel vs the verbatim gather reference, fp and quantized."""
+
+    @pytest.mark.parametrize("quantized", [False, True])
+    def test_decode(self, quantized):
+        rng = np.random.default_rng(0)
+        kp, vp, ks, vs = _pages(rng, quantized)
+        tables = _tables(rng)
+        q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+        positions = jnp.asarray([0, 7, P * PAGE_LEN - 1], jnp.int32)
+        outs = [pa.paged_decode_attention(
+            q, kp, vp, tables, positions, k_scale=ks, v_scale=vs,
+            impl=impl) for impl in ("gather", "kernel")]
+        np.testing.assert_allclose(outs[0], outs[1], atol=1e-5, rtol=1e-5)
+
+    @pytest.mark.parametrize("quantized", [False, True])
+    def test_verify_with_scratch_clamped_draft_window(self, quantized):
+        rng = np.random.default_rng(1)
+        kp, vp, ks, vs = _pages(rng, quantized)
+        tables = _tables(rng)
+        k1 = 5
+        q = jnp.asarray(rng.standard_normal((B, k1, H, D)), jnp.float32)
+        # Row 2's draft window hangs off the timeline ceiling — exactly
+        # the near-max_new_tokens shape forward_paged_verify clamps to
+        # the scratch page; its out-of-table queries still attend over
+        # every committed position and must match the gather reference.
+        base = jnp.asarray([0, 9, P * PAGE_LEN - 2], jnp.int32)
+        rows_pos = jnp.minimum(base[:, None] + jnp.arange(k1)[None, :],
+                               P * PAGE_LEN - 1)
+        outs = [pa.paged_verify_attention(
+            q, kp, vp, tables, rows_pos, k_scale=ks, v_scale=vs,
+            impl=impl) for impl in ("gather", "kernel")]
+        np.testing.assert_allclose(outs[0], outs[1], atol=1e-5, rtol=1e-5)
+
+    @pytest.mark.parametrize("quantized", [False, True])
+    def test_prefill_chunk(self, quantized):
+        rng = np.random.default_rng(2)
+        kp, vp, ks, vs = _pages(rng, quantized)
+        table = _tables(rng)[0]
+        chunk = PAGE_LEN
+        q = jnp.asarray(rng.standard_normal((chunk, H, D)), jnp.float32)
+        positions = jnp.arange(PAGE_LEN, PAGE_LEN + chunk, dtype=jnp.int32)
+        outs = [pa.paged_prefill_attention(
+            q, kp, vp, table, positions, k_scale=ks, v_scale=vs,
+            impl=impl) for impl in ("gather", "kernel")]
+        np.testing.assert_allclose(outs[0], outs[1], atol=1e-5, rtol=1e-5)
+
+    def test_kernel_is_jittable(self):
+        rng = np.random.default_rng(3)
+        kp, vp, _, _ = _pages(rng)
+        tables = _tables(rng)
+        q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+        positions = jnp.asarray([3, 11, 30], jnp.int32)
+        fn = jax.jit(lambda *a: pa.paged_decode_attention(
+            *a, impl="kernel", interpret=True))
+        np.testing.assert_allclose(
+            fn(q, kp, vp, tables, positions),
+            pa.paged_decode_attention(q, kp, vp, tables, positions),
+            atol=1e-5, rtol=1e-5)
+
+
+class TestQuantization:
+    def test_round_trip_error_bounded(self):
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.standard_normal((5, PAGE_LEN, H, D)) * 3.0,
+                        jnp.float32)
+        q, scale = pa.quantize_kv(x)
+        assert q.dtype == jnp.int8 and scale.dtype == jnp.float32
+        back = pa.dequantize_kv(q, scale, jnp.float32)
+        # int8 symmetric: error <= scale/2 = amax/254 per (pos, head) row.
+        bound = np.asarray(scale)[..., None] / 2.0 + 1e-8
+        assert np.all(np.abs(np.asarray(back - x)) <= bound)
+
+    def test_zero_rows_stay_zero(self):
+        x = jnp.zeros((2, PAGE_LEN, H, D), jnp.float32)
+        q, scale = pa.quantize_kv(x)
+        assert not np.any(np.asarray(q)) and not np.any(np.asarray(scale))
+        assert not np.any(np.asarray(pa.dequantize_kv(q, scale, jnp.float32)))
+
+    def test_quantize_is_deterministic(self):
+        # Failover re-prefill must reproduce the dead replica's pages
+        # bit-exactly (chaos: kill_mid_quantized_stream).
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.standard_normal((3, PAGE_LEN, H, D)), jnp.float32)
+        q1, s1 = pa.quantize_kv(x)
+        q2, s2 = pa.quantize_kv(jnp.asarray(np.asarray(x)))
+        assert np.array_equal(np.asarray(q1), np.asarray(q2))
+        assert np.array_equal(np.asarray(s1), np.asarray(s2))
+
+
+class TestMaskHelper:
+    """The ONE shared mask/-1e30 helper all four forward paths use."""
+
+    def test_fp32_mask_value_preserves_bit_identity(self):
+        # The historical constant: changing it would fork every pinned
+        # fp32 stream in the repo.
+        assert pa.mask_value(jnp.float32) == -1e30
+        assert pa.mask_value(jnp.float64) == -1e30
+
+    @pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float16])
+    def test_half_precision_mask_is_finite(self, dtype):
+        # -1e30 overflows fp16 to -inf; -inf minus -inf is NaN in the
+        # online-softmax rescale. The helper keeps halves finite.
+        mv = pa.mask_value(dtype)
+        assert np.isfinite(np.asarray(jnp.asarray(mv, dtype), np.float32))
+        assert mv < -1e4
+
+    def test_position_mask_and_apply(self):
+        mask = pa.position_mask(4, jnp.asarray([0, 2]))
+        np.testing.assert_array_equal(
+            np.asarray(mask),
+            [[True, False, False, False], [True, True, True, False]])
+        logits = jnp.zeros((2, 4), jnp.float32)
+        out = np.asarray(pa.apply_mask(logits, mask))
+        assert out[0, 1] == -1e30 and out[1, 3] == -1e30 and out[1, 2] == 0
+
+
+class TestEngineStreams:
+    """Kernel-vs-gather and quant bars at the token-stream level."""
+
+    def _prompts(self, seed=7, n=6):
+        rng = np.random.default_rng(seed)
+        out = [rng.integers(1, 127, size=int(rng.integers(3, 12)))
+               .astype(np.int32) for _ in range(n - 1)]
+        out.append(rng.integers(1, 127, size=20).astype(np.int32))
+        return out
+
+    @pytest.mark.parametrize("kv_quant", [False, True])
+    def test_kernel_stream_bit_equal_greedy_and_sampled(self, kv_quant):
+        from autodist_tpu.serve.sampling import SamplingParams
+        from autodist_tpu.serve.server import _tiny_engine
+
+        gather, _, _ = _tiny_engine(n_slots=4, kv_quant=kv_quant)
+        kernel, _, _ = _tiny_engine(n_slots=4, kv_quant=kv_quant,
+                                    paged_impl="kernel")
+        for i, p in enumerate(self._prompts()):
+            assert gather.generate(p, 10) == kernel.generate(p, 10)
+            sp = SamplingParams(temperature=0.9, top_k=24, top_p=0.95,
+                                seed=i)
+            rid = f"kq-{i}"
+            assert (gather.generate(p, 10, request_id=rid, sampling=sp)
+                    == kernel.generate(p, 10, request_id=rid, sampling=sp))
+
+    def test_quant_off_stream_unchanged_vs_fp(self):
+        # kv_quant=False engines must stream exactly what they always
+        # streamed — the refactor is bit-preserving for existing serving.
+        from autodist_tpu.serve.server import _tiny_engine
+
+        fp, _, _ = _tiny_engine(n_slots=4)
+        quant, _, _ = _tiny_engine(n_slots=4, kv_quant=True)
+        assert fp.kv_quant is False and quant.kv_quant is True
+
+    def test_spec_lossless_under_quant(self):
+        # Draft and verify read the SAME quantized pages: spec streams on
+        # a quantized engine equal the plain quantized engine's greedy.
+        from autodist_tpu.serve.router import build_test_fleet
+
+        router, control = build_test_fleet(n_replicas=1, spec_decode=True,
+                                           kv_quant=True)
+        try:
+            spec_engine = router.replicas[0].engine_factory()
+            assert control.kv_quant and spec_engine.kv_quant
+            for p in self._prompts(seed=11, n=4):
+                assert (spec_engine.generate(p, 8)
+                        == control.generate(p, 8))
+        finally:
+            router.stop(drain=False)
+
+    def test_quant_drift_bounded(self):
+        from autodist_tpu.serve.server import (
+            QUANT_LOGIT_DRIFT_BOUND,
+            _quant_logit_drift,
+            _tiny_engine,
+        )
+
+        _, params, cfg = _tiny_engine(n_slots=4)
+        drift = _quant_logit_drift(params, cfg)
+        assert 0.0 < drift < QUANT_LOGIT_DRIFT_BOUND
+
+
+class TestQuantPool:
+    def test_pool_capacity_multiplier(self):
+        from autodist_tpu.serve import pages as serve_pages
+
+        pool = serve_pages.build_pool(10, 8, quantized=True,
+                                      bytes_per_page=1280.0,
+                                      fp_equiv_bytes_per_page=4096.0)
+        assert pool.quantized
+        assert pool.physical_bytes == 12800.0
+        assert pool.fp_equiv_bytes == 40960.0
+        assert pool.quant_capacity_x == pytest.approx(3.2)
+        fp_pool = serve_pages.build_pool(10, 8, bytes_per_page=4096.0)
+        assert fp_pool.quant_capacity_x == 1.0
+
+    def test_engine_prices_quant_pages(self):
+        from autodist_tpu.serve.server import _tiny_engine
+
+        engine, _, _ = _tiny_engine(n_slots=4, kv_quant=True)
+        assert engine.kv_quant
+        # int8 k/v + f32 scales vs f32 k/v at head_dim 16: 3.2x.
+        assert engine.quant_capacity_x == pytest.approx(3.2)
+        assert (engine.page_pool_fp_equiv_bytes
+                > 3 * engine.page_pool_bytes)
+
+    def test_analyzer_accounts_quant_bytes(self):
+        from autodist_tpu.analysis.passes import hbm_budget
+        from autodist_tpu.serve.server import _tiny_engine
+
+        engine, _, _ = _tiny_engine(n_slots=4, kv_quant=True)
+        _, mem = hbm_budget(engine.plan,
+                            serve_pool_bytes=engine.page_pool_bytes,
+                            serve_quant_capacity_x=engine.quant_capacity_x)
+        # SLM001 prices the PHYSICAL quantized bytes...
+        assert mem["serve_pool_gb_per_chip"] * 1e9 == pytest.approx(
+            engine.page_pool_bytes)
+        # ...and the summary carries the effective-capacity multiplier.
+        assert mem["serve_quant_capacity_x"] == pytest.approx(
+            engine.quant_capacity_x)
+        assert mem["serve_pool_fp_equiv_gb_per_chip"] == pytest.approx(
+            mem["serve_pool_gb_per_chip"] * engine.quant_capacity_x)
+
+
+class TestCrossover:
+    def test_explicit_impls_pass_through(self):
+        assert resolve_paged_impl("gather", 4, 4, 8, 2) == "gather"
+        assert resolve_paged_impl("kernel", 4, 4, 8, 2) == "kernel"
+        with pytest.raises(ValueError):
+            pa.paged_decode_attention(
+                jnp.zeros((1, H, D)), jnp.zeros((2, PAGE_LEN, H, D)),
+                jnp.zeros((2, PAGE_LEN, H, D)), jnp.zeros((1, 1), jnp.int32),
+                jnp.zeros((1,), jnp.int32), impl="auto")
+
+    def test_auto_is_gather_off_tpu(self):
+        if jax.default_backend() == "tpu":
+            pytest.skip("off-TPU rule")
+        assert resolve_paged_impl("auto", 4, 512, 8, 2) == "gather"
+
+    def test_measured_sweep_picks_crossover(self, tmp_path):
+        rows = []
+        for tl, (g, k) in [(64, (100.0, 50.0)), (256, (80.0, 70.0)),
+                           (1024, (60.0, 90.0)), (4096, (40.0, 110.0))]:
+            rows.append(dict(batch=8, heads=8, table_pages=tl // 16,
+                             page_len=16, impl="gather", tokens_per_sec=g))
+            rows.append(dict(batch=8, heads=8, table_pages=tl // 16,
+                             page_len=16, impl="kernel", tokens_per_sec=k))
+        path = tmp_path / "paged_crossover.json"
+        path.write_text(json.dumps({"rows": rows}))
+        assert paged_crossover_timeline(8, 8, path=str(path)) == 1024
+
+    def test_nearest_bucket_and_default(self, tmp_path):
+        rows = [dict(batch=1, heads=2, table_pages=2, page_len=16,
+                     impl=i, tokens_per_sec=t)
+                for i, t in [("gather", 10.0), ("kernel", 20.0)]]
+        rows += [dict(batch=32, heads=8, table_pages=64, page_len=16,
+                      impl=i, tokens_per_sec=t)
+                 for i, t in [("gather", 30.0), ("kernel", 40.0)]]
+        path = tmp_path / "paged_crossover.json"
+        path.write_text(json.dumps({"rows": rows}))
+        # batch 2 is nearest the (1, 2) bucket: crossover at its timeline.
+        assert paged_crossover_timeline(2, 2, path=str(path)) == 32
+        # batch 40 is nearest the (32, 8) bucket.
+        assert paged_crossover_timeline(40, 8, path=str(path)) == 1024
+        # Missing file -> packaged default.
+        missing = tmp_path / "nope.json"
+        assert (paged_crossover_timeline(8, 8, path=str(missing))
+                == DEFAULT_PAGED_CROSSOVER_TIMELINE)

@@ -118,6 +118,19 @@ exits 1 listing ``file:line`` offenders. Rules:
     (``pilot_dir()`` / ``read_decisions``) is open to everyone — the
     doctor stitches the journal into its timeline that way.
 
+12. **ONE paged-attention math home** — spelling paged attention math
+    (the per-layer page gather ``_paged_gather(`` or the paged timeline
+    einsum contractions ``bthd->bht`` / ``bthd->bhqt`` / ``thd->hct``)
+    anywhere in ``autodist_tpu/models/`` or ``autodist_tpu/serve/``
+    outside ``ops/paged_attention.py`` is banned (same single-home
+    policy as rules 8–11): the kernel-vs-gather bit-identity bar, the
+    int8 dequantize-in-kernel contract and the measured crossover are
+    only sound because every forward path — decode, prefill-chunk, spec
+    verify — calls the one ops module; a re-inlined gather/einsum would
+    silently fork streams the moment the impl flips
+    (docs/serving.md § paged-attention kernel). Call
+    ``ops.paged_attention.paged_{decode,prefill,verify}_attention``.
+
 Pure stdlib, no third-party deps — runs anywhere Python runs.
 """
 from __future__ import annotations
@@ -154,6 +167,10 @@ SAMPLING_RE = re.compile(
 # Rule 11: pilot actuator construction outside pilot/.
 PILOT_RE = re.compile(
     r"\bPilotState\s*\(|\bPilotStateStore\s*\(|\bDecisionJournal\s*\(")
+# Rule 12: paged-attention math outside ops/paged_attention.py — the page
+# gather helper or any paged timeline einsum contraction.
+PAGED_MATH_RE = re.compile(
+    r"\b_paged_gather\s*\(|bthd->bht\b|bthd->bhqt\b|thd->hct\b")
 
 
 def _py_files(*roots):
@@ -348,6 +365,20 @@ def main() -> int:
                         f"the ONE actuator over plan/serve knobs; deploy "
                         f"through its Controller, read via "
                         f"pilot.read_decisions (docs/autopilot.md)")
+
+    for rel in _py_files(os.path.join("autodist_tpu", "models"),
+                         os.path.join("autodist_tpu", "serve")):
+        with open(os.path.join(REPO, rel), "r", encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                code = line.split("#", 1)[0]
+                if PAGED_MATH_RE.search(code):
+                    errors.append(
+                        f"{rel}:{i}: paged-attention math outside "
+                        f"autodist_tpu/ops/paged_attention.py — call "
+                        f"ops.paged_attention.paged_*_attention (the ONE "
+                        f"home the kernel-vs-gather bit-identity and the "
+                        f"int8 dequantize-in-kernel contract hold over; "
+                        f"docs/serving.md § paged-attention kernel)")
 
     if errors:
         print("banned-pattern lint FAILED:", file=sys.stderr)
